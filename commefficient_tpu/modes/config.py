@@ -1,0 +1,83 @@
+"""Static configuration for compression modes + server optimizer semantics.
+
+Mirrors the reference's `argparse` surface (SURVEY.md §5.6: --mode,
+--error_type, --local_momentum/--virtual_momentum, --k, --num_rows,
+--num_cols, --num_blocks, --num_local_iters, ...) as one frozen, hashable
+dataclass that jitted round steps can close over.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+MODES = ("sketch", "true_topk", "local_topk", "fedavg", "localSGD", "uncompressed")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModeConfig:
+    mode: str
+    d: int  # flat gradient dimensionality
+    k: int = 0  # top-k size (sketch / true_topk / local_topk)
+    num_rows: int = 5  # sketch rows r
+    num_cols: int = 0  # sketch cols c
+    num_blocks: int = 1
+    seed: int = 42
+    momentum: float = 0.9
+    momentum_type: str = "virtual"  # none | virtual | local
+    error_type: str = "virtual"  # none | virtual | local
+    num_local_iters: int = 1  # fedavg / localSGD local steps
+    num_clients: int = 0  # total virtual clients (for local state allocation)
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"unknown mode {self.mode!r}; expected one of {MODES}")
+        if self.mode in ("sketch",) and (self.num_cols <= 0 or self.k <= 0):
+            raise ValueError("mode=sketch requires num_cols > 0 and k > 0")
+        if self.mode in ("true_topk", "local_topk") and self.k <= 0:
+            raise ValueError(f"mode={self.mode} requires k > 0")
+        if self.momentum_type not in ("none", "virtual", "local"):
+            raise ValueError(f"bad momentum_type {self.momentum_type!r}")
+        if self.error_type not in ("none", "virtual", "local"):
+            raise ValueError(f"bad error_type {self.error_type!r}")
+        # Reject combinations the mode library does not implement, rather than
+        # silently running a different algorithm than the user configured.
+        allowed = {
+            "sketch": {"momentum": ("none", "virtual"), "error": ("virtual",)},
+            "true_topk": {"momentum": ("none", "virtual"), "error": ("none", "virtual")},
+            "local_topk": {"momentum": ("none", "virtual", "local"), "error": ("none", "local")},
+            "fedavg": {"momentum": ("none", "virtual"), "error": ("none",)},
+            "localSGD": {"momentum": ("none", "virtual"), "error": ("none",)},
+            "uncompressed": {"momentum": ("none", "virtual"), "error": ("none",)},
+        }[self.mode]
+        if self.momentum_type not in allowed["momentum"]:
+            raise ValueError(
+                f"mode={self.mode} supports momentum_type {allowed['momentum']}, "
+                f"got {self.momentum_type!r}"
+            )
+        if self.error_type not in allowed["error"]:
+            raise ValueError(
+                f"mode={self.mode} supports error_type {allowed['error']}, "
+                f"got {self.error_type!r}"
+            )
+
+    @property
+    def sketch_spec(self):
+        from ..sketch import CSVecSpec
+
+        return CSVecSpec(
+            d=self.d, c=self.num_cols, r=self.num_rows, num_blocks=self.num_blocks, seed=self.seed
+        )
+
+    @property
+    def uses_weight_delta(self) -> bool:
+        """fedavg/localSGD clients send weight deltas from >1 local steps; all
+        other modes send (transforms of) a single gradient."""
+        return self.mode in ("fedavg", "localSGD")
+
+    @property
+    def needs_local_state(self) -> bool:
+        """Per-client persistent state ([num_clients, d] — the memory wall,
+        SURVEY.md §3.3) is only needed for client-side momentum/error."""
+        return self.mode == "local_topk" and (
+            self.momentum_type == "local" or self.error_type == "local"
+        )

@@ -1,0 +1,196 @@
+"""Compression-mode transforms — the `functions.py` equivalent (SURVEY.md L2).
+
+Every mode is expressed as three pure functions over static-shape arrays so the
+whole round compiles into one XLA program:
+
+- `client_compress(cfg, update, cstate) -> (wire, cstate')` — per-client
+  transform of the raw update (gradient, or weight delta for fedavg/localSGD).
+- `aggregate(cfg, wires) -> agg` — combine the W sampled clients' wires
+  (leading axis W). Linear modes reduce with a mean that XLA lowers to
+  `psum`-style collectives over the client-sharded mesh axis.
+- `server_step(cfg, agg, sstate, lr) -> (delta, sstate')` — server momentum +
+  error feedback per mode; `delta` is the dense [d] vector to *subtract* from
+  the flat parameters.
+
+Server/virtual state (`Vvelocity`, `Verror` — dense [d] vectors, or [r, c]
+sketch tables for mode=sketch) matches the reference's `FedOptimizer` state
+(SURVEY.md §2 "Fed API + server"); the sketch-mode algebra is FetchSGD Alg. 1
+(SURVEY.md §3.1): momentum and error feedback live in sketch space, top-k is
+extracted via `unSketch`, and the extracted sketch is subtracted from both
+error and momentum ("momentum factor masking").
+
+Wire formats (pytrees with static shapes):
+    dense:  {"dense": [d]}
+    sketch: {"table": [r, c]}
+    sparse: {"idx": [k] int32, "vals": [k]}   (idx = -1 padding allowed)
+
+For linear modes (sketch, true_topk, uncompressed, fedavg — sketching and
+averaging commute) the engine may compress once on the client-mean update
+instead of per client; `is_linear` advertises this. local_topk is the
+nonlinear one: top-k per client, then average of sparse vectors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..sketch import csvec
+from .config import ModeConfig
+
+
+def topk_dense(v: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(idx[k], vals[k]) of the k largest-|.| coordinates of dense v."""
+    _, idx = jax.lax.top_k(jnp.abs(v), k)
+    return idx.astype(jnp.int32), v[idx]
+
+
+def is_linear(cfg: ModeConfig) -> bool:
+    return cfg.mode != "local_topk"
+
+
+# ---------------------------------------------------------------- state init
+
+
+def init_server_state(cfg: ModeConfig) -> dict:
+    """Vvelocity / Verror, shaped for the mode. Always present (zeros) so the
+    step signature is mode-independent; unused pieces are never touched."""
+    if cfg.mode == "sketch":
+        shape = cfg.sketch_spec.table_shape
+    else:
+        shape = (cfg.d,)
+    z = jnp.zeros(shape, dtype=jnp.float32)
+    return {"Vvelocity": z, "Verror": z}
+
+
+def init_client_state(cfg: ModeConfig, num_clients: int | None = None) -> dict | None:
+    """[num_clients, d] error/momentum for client-local state (local_topk with
+    local error feedback). This is the reference's memory wall (SURVEY.md
+    §3.3); shard it over the client mesh axis at scale."""
+    if not cfg.needs_local_state:
+        return None
+    n = num_clients if num_clients is not None else cfg.num_clients
+    if n <= 0:
+        raise ValueError("local state requires num_clients > 0")
+    out = {}
+    if cfg.error_type == "local":
+        out["error"] = jnp.zeros((n, cfg.d), dtype=jnp.float32)
+    if cfg.momentum_type == "local":
+        out["momentum"] = jnp.zeros((n, cfg.d), dtype=jnp.float32)
+    return out
+
+
+def empty_client_row(cfg: ModeConfig) -> dict:
+    """A zero per-client state row (for modes without local state the engine
+    passes this through untouched)."""
+    out = {}
+    if cfg.needs_local_state:
+        if cfg.error_type == "local":
+            out["error"] = jnp.zeros((cfg.d,), dtype=jnp.float32)
+        if cfg.momentum_type == "local":
+            out["momentum"] = jnp.zeros((cfg.d,), dtype=jnp.float32)
+    return out
+
+
+# ------------------------------------------------------------ client side
+
+
+def client_compress(cfg: ModeConfig, update: jnp.ndarray, cstate: dict) -> tuple[dict, dict]:
+    """Per-client transform of the raw update (flat [d]).
+
+    `update` is the client's gradient (grad-based modes) or its weight delta
+    w_start - w_local (fedavg/localSGD); `cstate` is this client's slice of
+    the local state (possibly empty dict).
+    """
+    if cfg.mode == "sketch":
+        return {"table": csvec.sketch_vec(cfg.sketch_spec, update)}, cstate
+
+    if cfg.mode == "local_topk":
+        acc = update
+        new_state = dict(cstate)
+        if cfg.momentum_type == "local":
+            m = cfg.momentum * cstate["momentum"] + update
+            new_state["momentum"] = m
+            acc = m
+        if cfg.error_type == "local":
+            u = cstate["error"] + acc
+        else:
+            u = acc
+        idx, vals = topk_dense(u, cfg.k)
+        if cfg.error_type == "local":
+            new_state["error"] = u - csvec.to_dense(cfg.d, idx, vals)
+        return {"idx": idx, "vals": vals}, new_state
+
+    # true_topk / uncompressed / fedavg / localSGD: wire is the dense update;
+    # all server-side work happens in server_step.
+    return {"dense": update}, cstate
+
+
+# ------------------------------------------------------------- aggregation
+
+
+def aggregate(cfg: ModeConfig, wires: dict) -> dict:
+    """Mean over the W client wires (leading axis W). Sparse wires are
+    densified then averaged — in the simulator the sparse form exists for
+    faithful semantics + communication accounting, not for saving FLOPs."""
+    if cfg.mode == "sketch":
+        return {"table": jnp.mean(wires["table"], axis=0)}
+    if cfg.mode == "local_topk":
+        dense = jax.vmap(lambda i, v: csvec.to_dense(cfg.d, i, v))(wires["idx"], wires["vals"])
+        return {"dense": jnp.mean(dense, axis=0)}
+    return {"dense": jnp.mean(wires["dense"], axis=0)}
+
+
+# ------------------------------------------------------------- server side
+
+
+def server_step(
+    cfg: ModeConfig, agg: dict, sstate: dict, lr: jnp.ndarray
+) -> tuple[jnp.ndarray, dict]:
+    """Server momentum + error feedback; returns (delta[d], new_state).
+    New params are `params - delta`."""
+    rho = cfg.momentum if cfg.momentum_type == "virtual" else 0.0
+
+    if cfg.mode == "sketch":
+        # FetchSGD Alg. 1 in sketch space (SURVEY.md §3.1)
+        spec = cfg.sketch_spec
+        S = agg["table"]
+        V = rho * sstate["Vvelocity"] + S
+        E = sstate["Verror"] + lr * V
+        idx, vals = csvec.unsketch_topk(spec, E, cfg.k)
+        delta = csvec.to_dense(cfg.d, idx, vals)
+        sdelta = csvec.sketch_sparse(spec, idx, vals)
+        E = E - sdelta
+        V = V - sdelta  # momentum factor masking, sketch-space approximation
+        return delta, {"Vvelocity": V, "Verror": E}
+
+    g = agg["dense"]
+
+    if cfg.mode == "true_topk":
+        V = rho * sstate["Vvelocity"] + g
+        use_error = cfg.error_type != "none"
+        E = sstate["Verror"] + lr * V if use_error else lr * V
+        idx, vals = topk_dense(E, cfg.k)
+        delta = csvec.to_dense(cfg.d, idx, vals)
+        # mask from the selected indices, not delta's values: a transmitted
+        # coordinate whose value happens to be 0 must still be masked.
+        mask = csvec.to_dense(cfg.d, idx, jnp.ones((cfg.k,), dtype=V.dtype))
+        E = (E - delta) if use_error else sstate["Verror"]
+        V = V * (1.0 - mask)  # momentum factor masking
+        return delta, {"Vvelocity": V, "Verror": E}
+
+    if cfg.mode == "local_topk":
+        # clients already applied top-k + local error feedback; server applies
+        # (optionally momentum'd) averaged sparse update scaled by lr.
+        V = rho * sstate["Vvelocity"] + g
+        return lr * V, {"Vvelocity": V, "Verror": sstate["Verror"]}
+
+    if cfg.mode in ("fedavg", "localSGD"):
+        # agg is the mean weight delta (w_start - w_local); local steps already
+        # carry the client lr, so server lr defaults to 1 (slowmo via momentum).
+        V = rho * sstate["Vvelocity"] + g
+        return lr * V, {"Vvelocity": V, "Verror": sstate["Verror"]}
+
+    # uncompressed: plain SGD with (virtual) momentum — the bit-for-bit control
+    V = rho * sstate["Vvelocity"] + g
+    return lr * V, {"Vvelocity": V, "Verror": sstate["Verror"]}

@@ -1,0 +1,25 @@
+"""Count-sketch library: TPU-native replacement for the reference's vendored
+CSVec (SURVEY.md L1). Pure-JAX oracle in `csvec`; Pallas TPU kernels (added
+after profiling) must match it bit-for-bit on the property tests."""
+
+from .csvec import (
+    CSVecSpec,
+    query,
+    query_all,
+    sketch_sparse,
+    sketch_vec,
+    to_dense,
+    unsketch_topk,
+    zero_table,
+)
+
+__all__ = [
+    "CSVecSpec",
+    "query",
+    "query_all",
+    "sketch_sparse",
+    "sketch_vec",
+    "to_dense",
+    "unsketch_topk",
+    "zero_table",
+]

@@ -1,0 +1,188 @@
+"""Count-Sketch of a length-`d` vector into an `r x c` table — pure-JAX oracle.
+
+TPU-native re-design of the reference's vendored CSVec library (SURVEY.md L1:
+`csvec/csvec.py`, `CSVec.accumulateVec` / `__add__` / `unSketch(k)` /
+`_findValues` median-of-rows query).  Differences from the reference, by
+design rather than accident:
+
+- **Functional, not stateful.** A sketch is just an `[r, c]` float array; the
+  static configuration lives in a hashable `CSVecSpec`.  Sketch addition is
+  array addition, so cross-client aggregation is a plain `sum`/`psum` and XLA
+  fuses it with whatever surrounds it.
+- **Hashes are computed on the fly** from a seed (see `hashing.py`), never
+  materialised as `[r, d]` tensors.  The reference's `numBlocks` memory
+  workaround survives as `num_blocks`, but here it bounds the *transient*
+  index/sign working set inside a `lax.scan`, not persistent hash tensors.
+- **Static shapes throughout**: `unsketch_topk` returns exactly-`k` results by
+  merging per-block `lax.top_k` candidates in the scan carry, so the whole
+  thing jits and vmaps.
+
+Estimate semantics match the reference: the estimate of coordinate `i` is the
+median over the `r` rows of `sign[row, i] * table[row, bucket[row, i]]`, and
+`unsketch_topk` takes the top-k of those estimates by magnitude
+(SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .hashing import bucket_hash, row_keys, sign_hash
+
+
+@dataclasses.dataclass(frozen=True)
+class CSVecSpec:
+    """Static configuration of a count-sketch. Hashable; safe to close over."""
+
+    d: int  # dimensionality of the sketched vector
+    c: int  # number of columns (buckets per row)
+    r: int  # number of rows (independent hash functions)
+    num_blocks: int = 1  # chunks the d-axis to bound transient memory
+    seed: int = 42
+
+    def __post_init__(self):
+        if self.d <= 0 or self.c <= 0 or self.r <= 0 or self.num_blocks <= 0:
+            raise ValueError(f"invalid CSVecSpec: {self}")
+
+    @property
+    def block_size(self) -> int:
+        return math.ceil(self.d / self.num_blocks)
+
+    @property
+    def padded_d(self) -> int:
+        return self.block_size * self.num_blocks
+
+    @property
+    def table_shape(self) -> tuple[int, int]:
+        return (self.r, self.c)
+
+
+def zero_table(spec: CSVecSpec, dtype=jnp.float32) -> jnp.ndarray:
+    return jnp.zeros(spec.table_shape, dtype=dtype)
+
+
+def _block_hashes(spec: CSVecSpec, idx: jnp.ndarray, dtype):
+    """buckets[r, n], signs[r, n] for coordinate indices idx[n]."""
+    kb, ks = row_keys(spec.seed, spec.r)
+    buckets = jax.vmap(lambda k: bucket_hash(idx, k, spec.c))(kb)
+    signs = jax.vmap(lambda k: sign_hash(idx, k, dtype=dtype))(ks)
+    return buckets, signs
+
+
+def _accumulate(
+    spec: CSVecSpec, vals: jnp.ndarray, idx: jnp.ndarray, valid: jnp.ndarray
+) -> jnp.ndarray:
+    """Scatter (idx, vals) masked by `valid` into a fresh [r, c] table.
+
+    Single scatter path shared by dense-block and sparse sketching, so the two
+    can never diverge (and a future Pallas kernel swaps in at one place)."""
+    buckets, signs = _block_hashes(spec, idx, vals.dtype)
+    contrib = signs * (vals * valid.astype(vals.dtype))[None, :]  # [r, n]
+    return jax.vmap(
+        lambda c_row, b_row: jax.ops.segment_sum(c_row, b_row, num_segments=spec.c)
+    )(contrib, buckets)
+
+
+def _accumulate_block(spec: CSVecSpec, v_block: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Sketch one contiguous block of the vector into a fresh [r, c] table."""
+    return _accumulate(spec, v_block, idx, idx < spec.d)
+
+
+def sketch_vec(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
+    """Sketch a dense [d] vector into an [r, c] table (CSVec.accumulateVec)."""
+    if v.shape != (spec.d,):
+        raise ValueError(f"expected shape ({spec.d},), got {v.shape}")
+    if spec.num_blocks == 1:
+        return _accumulate_block(spec, v, jnp.arange(spec.d, dtype=jnp.int32))
+
+    bs = spec.block_size
+    v_pad = jnp.pad(v, (0, spec.padded_d - spec.d)).reshape(spec.num_blocks, bs)
+    starts = jnp.arange(spec.num_blocks, dtype=jnp.int32) * bs
+
+    def body(table, xs):
+        v_blk, start = xs
+        idx = start + jnp.arange(bs, dtype=jnp.int32)
+        return table + _accumulate_block(spec, v_blk, idx), None
+
+    table, _ = jax.lax.scan(body, zero_table(spec, v.dtype), (v_pad, starts))
+    return table
+
+
+def sketch_sparse(spec: CSVecSpec, idx: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """Sketch a k-sparse vector given by (idx[k], vals[k]).
+
+    Exactly equals `sketch_vec` of the scattered dense vector (used to subtract
+    the transmitted top-k from sketched error/momentum state — FetchSGD's
+    "error sketch subtract", SURVEY.md §3.1). Entries with idx < 0 or >= d are
+    ignored, so callers can pad with idx = -1.
+    """
+    valid = (idx >= 0) & (idx < spec.d)
+    return _accumulate(spec, vals, jnp.clip(idx, 0, spec.d - 1), valid)
+
+
+def query(spec: CSVecSpec, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Estimate coordinates idx[m] from the table: median over the r rows of
+    sign * table[row, bucket] (CSVec._findValues)."""
+    buckets, signs = _block_hashes(spec, idx, table.dtype)
+    rows = jnp.arange(spec.r)[:, None]
+    per_row = signs * table[rows, buckets]  # [r, m]
+    # lower median (sorted element at index (r-1)//2), matching torch.median's
+    # behavior in the reference CSVec for even r; true median for odd r.
+    return jnp.sort(per_row, axis=0)[(spec.r - 1) // 2]
+
+
+def query_all(spec: CSVecSpec, table: jnp.ndarray) -> jnp.ndarray:
+    """Dense [d] vector of estimates for every coordinate. O(r*d) transient
+    memory when num_blocks == 1; scanned per block otherwise."""
+    if spec.num_blocks == 1:
+        return query(spec, table, jnp.arange(spec.d, dtype=jnp.int32))
+
+    bs = spec.block_size
+    starts = jnp.arange(spec.num_blocks, dtype=jnp.int32) * bs
+
+    def body(_, start):
+        idx = start + jnp.arange(bs, dtype=jnp.int32)
+        return None, query(spec, table, jnp.clip(idx, 0, spec.d - 1))
+
+    _, blocks = jax.lax.scan(body, None, starts)
+    return blocks.reshape(-1)[: spec.d]
+
+
+def unsketch_topk(spec: CSVecSpec, table: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k heavy hitters by |estimate|: (idx[k], vals[k]) (CSVec.unSketch(k)).
+
+    Scans the d-axis in blocks, keeping a running top-k in the carry, so peak
+    transient memory is O(r * block_size) regardless of d.
+    """
+    if k > spec.d:
+        raise ValueError(f"k={k} > d={spec.d}")
+    bs = spec.block_size
+    starts = jnp.arange(spec.num_blocks, dtype=jnp.int32) * bs
+
+    def body(carry, start):
+        run_idx, run_vals = carry
+        idx = start + jnp.arange(bs, dtype=jnp.int32)
+        valid = idx < spec.d
+        est = jnp.where(valid, query(spec, table, jnp.clip(idx, 0, spec.d - 1)), 0.0)
+        cand_idx = jnp.concatenate([run_idx, idx])
+        cand_vals = jnp.concatenate([run_vals, est])
+        cand_valid = jnp.concatenate([run_idx >= 0, valid])
+        score = jnp.where(cand_valid, jnp.abs(cand_vals), -1.0)
+        _, sel = jax.lax.top_k(score, k)
+        return (cand_idx[sel], cand_vals[sel]), None
+
+    init = (jnp.full((k,), -1, dtype=jnp.int32), jnp.zeros((k,), dtype=table.dtype))
+    (top_idx, top_vals), _ = jax.lax.scan(body, init, starts)
+    # entries that never filled (k > #valid coords) keep idx -1 / val 0
+    return top_idx, jnp.where(top_idx >= 0, top_vals, 0.0)
+
+
+def to_dense(d: int, idx: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """Scatter (idx, vals) into a dense [d] vector; idx < 0 entries ignored."""
+    safe = jnp.clip(idx, 0, d - 1)
+    contrib = jnp.where(idx >= 0, vals, 0.0)
+    return jnp.zeros((d,), dtype=vals.dtype).at[safe].add(contrib)

@@ -1,0 +1,65 @@
+"""Deterministic, stateless hash functions for the Count-Sketch.
+
+The reference library (vendored csvec, SURVEY.md L1) materialises per-row
+bucket/sign hash tensors with a 4-universal polynomial hash mod LARGEPRIME,
+processed in `numBlocks` chunks to bound memory.  On TPU we instead compute
+hashes *on the fly* inside the compiled program with a murmur3-style integer
+mixer over uint32: no O(r*d) hash tensors ever exist in HBM, nothing needs to
+be shipped between hosts, and every shard can rebuild identical hashes from a
+single integer seed (SURVEY.md §7.1: "Sign/bucket hashes precomputed per-shard
+from a seed — deterministic, rebuildable").
+
+The mixer is the murmur3 32-bit finaliser, which passes avalanche tests and is
+in practice statistically indistinguishable from a random function for this
+use (count-sketch only needs pairwise-independent-ish buckets and signs).
+All arithmetic wraps mod 2**32, which XLA's uint32 ops do natively.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# murmur3 fmix32 constants
+_C1 = jnp.uint32(0x85EBCA6B)
+_C2 = jnp.uint32(0xC2B2AE35)
+# distinct stream constants for deriving per-row keys
+_BUCKET_STREAM = 0x9E3779B9  # golden-ratio odd constant
+_SIGN_STREAM = 0x7FEB352D
+
+
+def fmix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 32-bit finaliser. Input/output uint32."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * _C1
+    x = x ^ (x >> 13)
+    x = x * _C2
+    x = x ^ (x >> 16)
+    return x
+
+
+def row_keys(seed: int, num_rows: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row keys for the bucket and sign hash streams.
+
+    Returns (bucket_keys[r], sign_keys[r]), both uint32, derived purely from
+    the integer seed — identical on every host/shard.
+    """
+    rows = jnp.arange(1, num_rows + 1, dtype=jnp.uint32)
+    seed32 = jnp.uint32(seed & 0xFFFFFFFF)
+    kb = fmix32(rows * jnp.uint32(_BUCKET_STREAM) ^ seed32)
+    ks = fmix32(rows * jnp.uint32(_SIGN_STREAM) ^ (seed32 * _C1 + jnp.uint32(1)))
+    return kb, ks
+
+
+def bucket_hash(idx: jnp.ndarray, bucket_key: jnp.ndarray, num_cols: int) -> jnp.ndarray:
+    """Bucket in [0, num_cols) for coordinate indices `idx` (any int dtype)."""
+    h = fmix32(idx.astype(jnp.uint32) ^ bucket_key)
+    return (h % jnp.uint32(num_cols)).astype(jnp.int32)
+
+
+def sign_hash(idx: jnp.ndarray, sign_key: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """Random sign in {-1, +1} for coordinate indices `idx`."""
+    h = fmix32(idx.astype(jnp.uint32) ^ sign_key)
+    # use bit 16 (well-mixed interior bit)
+    bit = (h >> jnp.uint32(16)) & jnp.uint32(1)
+    return (jnp.int32(1) - jnp.int32(2) * bit.astype(jnp.int32)).astype(dtype)

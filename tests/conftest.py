@@ -1,0 +1,15 @@
+"""Test config: force an 8-device CPU mesh so multi-device sharding paths run
+without TPU hardware (SURVEY.md §4 "Distributed without a cluster")."""
+
+import os
+
+# Must be set before jax initialises its backends. Append (don't setdefault):
+# a pre-existing XLA_FLAGS must not silently drop the forced 8-device mesh.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)

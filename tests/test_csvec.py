@@ -1,0 +1,129 @@
+"""Property tests for the count-sketch library (SURVEY.md §4 unit list):
+linearity, seed-determinism, block-count invariance, heavy-hitter recovery,
+unbiasedness of single-coordinate estimates, sparse==dense sketching."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.sketch import (
+    CSVecSpec,
+    query,
+    query_all,
+    sketch_sparse,
+    sketch_vec,
+    to_dense,
+    unsketch_topk,
+)
+
+SPEC = CSVecSpec(d=5000, c=1000, r=5, num_blocks=1, seed=7)
+
+
+def _randn(key, shape):
+    return jax.random.normal(jax.random.PRNGKey(key), shape)
+
+
+def test_linearity():
+    a = _randn(0, (SPEC.d,))
+    b = _randn(1, (SPEC.d,))
+    np.testing.assert_allclose(
+        sketch_vec(SPEC, a) + sketch_vec(SPEC, b),
+        sketch_vec(SPEC, a + b),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_seed_determinism_and_difference():
+    v = _randn(2, (SPEC.d,))
+    t1 = sketch_vec(SPEC, v)
+    t2 = sketch_vec(CSVecSpec(**{**SPEC.__dict__}), v)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    other = sketch_vec(CSVecSpec(d=SPEC.d, c=SPEC.c, r=SPEC.r, seed=8), v)
+    assert not np.allclose(np.asarray(t1), np.asarray(other))
+
+
+@pytest.mark.parametrize("num_blocks", [2, 4, 7])
+def test_block_invariance(num_blocks):
+    """num_blocks is a memory knob, not a semantics knob."""
+    v = _randn(3, (SPEC.d,))
+    blocked = CSVecSpec(d=SPEC.d, c=SPEC.c, r=SPEC.r, num_blocks=num_blocks, seed=SPEC.seed)
+    np.testing.assert_allclose(
+        np.asarray(sketch_vec(SPEC, v)), np.asarray(sketch_vec(blocked, v)), rtol=1e-5, atol=1e-5
+    )
+    t = sketch_vec(SPEC, v)
+    np.testing.assert_allclose(
+        np.asarray(query_all(SPEC, t)), np.asarray(query_all(blocked, t)), rtol=1e-5, atol=1e-5
+    )
+    ib, vb = unsketch_topk(blocked, t, 50)
+    i1, v1 = unsketch_topk(SPEC, t, 50)
+    assert set(np.asarray(ib).tolist()) == set(np.asarray(i1).tolist())
+
+
+def test_heavy_hitter_recovery():
+    """Plant k heavy coords in noise; assert exact recovery (SURVEY.md §4)."""
+    d, k = 20000, 20
+    spec = CSVecSpec(d=d, c=4000, r=5, num_blocks=4, seed=11)
+    rng = np.random.RandomState(0)
+    v = rng.normal(0, 0.01, size=d).astype(np.float32)
+    heavy_idx = rng.choice(d, size=k, replace=False)
+    heavy_vals = rng.choice([-10.0, 10.0], size=k) * rng.uniform(1.0, 2.0, size=k)
+    v[heavy_idx] = heavy_vals
+    idx, vals = unsketch_topk(spec, sketch_vec(spec, jnp.asarray(v)), k)
+    assert set(np.asarray(idx).tolist()) == set(heavy_idx.tolist())
+    # recovered values close to true values
+    order = np.argsort(np.asarray(idx))
+    torder = np.argsort(heavy_idx)
+    np.testing.assert_allclose(
+        np.asarray(vals)[order], heavy_vals[torder].astype(np.float32), rtol=0.15, atol=0.3
+    )
+
+
+def test_unbiasedness():
+    """Median-of-rows estimate of a fixed coord, averaged over seeds, ≈ truth."""
+    d = 2000
+    v = np.zeros(d, dtype=np.float32)
+    v[123] = 5.0
+    v[777] = -3.0
+    rng = np.random.RandomState(1)
+    v += rng.normal(0, 0.5, size=d).astype(np.float32)
+    ests = []
+    for seed in range(30):
+        spec = CSVecSpec(d=d, c=500, r=5, seed=seed)
+        t = sketch_vec(spec, jnp.asarray(v))
+        ests.append(float(query(spec, t, jnp.array([123]))[0]))
+    assert abs(np.mean(ests) - float(v[123])) < 0.3
+
+
+def test_sparse_equals_dense():
+    d = 1000
+    spec = CSVecSpec(d=d, c=300, r=3, seed=5)
+    idx = jnp.array([3, 500, 999, -1], dtype=jnp.int32)  # -1 = padding, ignored
+    vals = jnp.array([1.5, -2.0, 4.0, 100.0], dtype=jnp.float32)
+    dense = to_dense(d, idx, vals)
+    np.testing.assert_allclose(
+        np.asarray(sketch_sparse(spec, idx, vals)),
+        np.asarray(sketch_vec(spec, dense)),
+        rtol=1e-6,
+        atol=1e-6,
+    )
+
+
+def test_to_dense_ignores_padding():
+    dense = to_dense(10, jnp.array([-1, 2]), jnp.array([9.0, 1.0]))
+    np.testing.assert_array_equal(np.asarray(dense), np.eye(10, dtype=np.float32)[2])
+
+
+def test_jit_and_vmap():
+    """Sketch ops must compose with jit/vmap — they live inside the round step."""
+    spec = CSVecSpec(d=256, c=64, r=3, num_blocks=2, seed=0)
+    vs = _randn(4, (6, spec.d))
+    tables = jax.jit(jax.vmap(lambda v: sketch_vec(spec, v)))(vs)
+    assert tables.shape == (6, spec.r, spec.c)
+    summed = tables.sum(0)
+    np.testing.assert_allclose(
+        np.asarray(summed), np.asarray(sketch_vec(spec, vs.sum(0))), rtol=1e-4, atol=1e-4
+    )
+    idx, vals = jax.jit(lambda t: unsketch_topk(spec, t, 10))(summed)
+    assert idx.shape == (10,) and vals.shape == (10,)

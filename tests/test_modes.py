@@ -1,0 +1,137 @@
+"""Mode-transform tests (SURVEY.md §4): tiny vectors with hand-computed
+answers; error-feedback invariant (sent + residual == accumulated)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.modes import modes
+
+
+def _cfg(**kw):
+    base = dict(mode="uncompressed", d=8, momentum_type="none", error_type="none")
+    base.update(kw)
+    return ModeConfig(**base)
+
+
+def test_config_rejects_unimplemented_combos():
+    with pytest.raises(ValueError):
+        _cfg(mode="sketch", k=2, num_cols=4, momentum_type="local", error_type="virtual")
+    with pytest.raises(ValueError):
+        _cfg(mode="uncompressed", error_type="virtual")
+    with pytest.raises(ValueError):
+        _cfg(mode="true_topk", k=2, error_type="local")
+    with pytest.raises(ValueError):
+        _cfg(mode="bogus")
+
+
+def test_uncompressed_is_sgd_with_momentum():
+    cfg = _cfg(momentum_type="virtual", momentum=0.5)
+    sstate = modes.init_server_state(cfg)
+    g = jnp.arange(8, dtype=jnp.float32)
+    wire, _ = modes.client_compress(cfg, g, {})
+    agg = modes.aggregate(cfg, {"dense": wire["dense"][None, :]})
+    d1, sstate = modes.server_step(cfg, agg, sstate, jnp.float32(0.1))
+    np.testing.assert_allclose(np.asarray(d1), 0.1 * np.arange(8), rtol=1e-6)
+    d2, sstate = modes.server_step(cfg, agg, sstate, jnp.float32(0.1))
+    # V = 0.5*g + g = 1.5g -> delta = 0.15g
+    np.testing.assert_allclose(np.asarray(d2), 0.15 * np.arange(8), rtol=1e-6)
+
+
+def test_true_topk_hand_computed():
+    cfg = _cfg(mode="true_topk", k=2, momentum_type="none", error_type="virtual")
+    sstate = modes.init_server_state(cfg)
+    g = jnp.array([0.1, -5.0, 0.2, 3.0, 0.0, 0.0, 0.0, 0.0])
+    agg = {"dense": g}
+    delta, sstate = modes.server_step(cfg, agg, sstate, jnp.float32(1.0))
+    expect = np.zeros(8, np.float32)
+    expect[1], expect[3] = -5.0, 3.0
+    np.testing.assert_allclose(np.asarray(delta), expect, rtol=1e-6)
+    # error keeps the untransmitted mass
+    np.testing.assert_allclose(
+        np.asarray(sstate["Verror"]), [0.1, 0, 0.2, 0, 0, 0, 0, 0], rtol=1e-6
+    )
+    # next round: error feedback promotes 0.2 then 0.1
+    delta2, sstate = modes.server_step(
+        cfg, {"dense": jnp.zeros(8)}, sstate, jnp.float32(1.0)
+    )
+    got = np.asarray(delta2)
+    assert got[2] == pytest.approx(0.2) and got[0] == pytest.approx(0.1)
+    np.testing.assert_allclose(np.asarray(sstate["Verror"]), np.zeros(8), atol=1e-7)
+
+
+def test_true_topk_error_feedback_invariant():
+    """sent + residual == accumulated (lr-scaled), over random rounds."""
+    cfg = _cfg(mode="true_topk", k=3, d=32, momentum_type="none", error_type="virtual")
+    sstate = modes.init_server_state(cfg)
+    rng = np.random.RandomState(0)
+    lr = 0.5
+    total_sent = np.zeros(32, np.float32)
+    total_grad = np.zeros(32, np.float32)
+    for _ in range(10):
+        g = rng.normal(size=32).astype(np.float32)
+        total_grad += lr * g
+        delta, sstate = modes.server_step(cfg, {"dense": jnp.asarray(g)}, sstate, jnp.float32(lr))
+        total_sent += np.asarray(delta)
+    np.testing.assert_allclose(total_sent + np.asarray(sstate["Verror"]), total_grad, rtol=1e-4, atol=1e-5)
+
+
+def test_local_topk_error_feedback():
+    cfg = _cfg(mode="local_topk", k=1, d=4, momentum_type="none", error_type="local", num_clients=2)
+    cstate = modes.empty_client_row(cfg)
+    g = jnp.array([1.0, -3.0, 0.5, 0.0])
+    wire, cstate = modes.client_compress(cfg, g, cstate)
+    assert int(wire["idx"][0]) == 1 and float(wire["vals"][0]) == -3.0
+    np.testing.assert_allclose(np.asarray(cstate["error"]), [1.0, 0.0, 0.5, 0.0], rtol=1e-6)
+    # residual promotes idx 0 next round
+    wire2, cstate = modes.client_compress(cfg, jnp.zeros(4), cstate)
+    assert int(wire2["idx"][0]) == 0
+    np.testing.assert_allclose(np.asarray(cstate["error"]), [0.0, 0.0, 0.5, 0.0], rtol=1e-6)
+
+
+def test_sketch_mode_roundtrip():
+    """sketch mode recovers a heavy gradient coordinate and maintains the
+    FetchSGD error-feedback algebra (residual at sent coords ≈ 0)."""
+    d = 512
+    cfg = _cfg(mode="sketch", d=d, k=4, num_rows=5, num_cols=256,
+               momentum_type="none", error_type="virtual")
+    sstate = modes.init_server_state(cfg)
+    g = np.random.RandomState(0).normal(0, 0.01, d).astype(np.float32)
+    g[[7, 100, 300, 444]] = [4.0, -6.0, 5.0, -3.0]
+    wires = []
+    for _ in range(3):  # 3 identical clients
+        w, _ = modes.client_compress(cfg, jnp.asarray(g), {})
+        wires.append(w["table"])
+    agg = modes.aggregate(cfg, {"table": jnp.stack(wires)})
+    delta, sstate = modes.server_step(cfg, agg, sstate, jnp.float32(1.0))
+    got = np.asarray(delta)
+    nz = np.nonzero(got)[0]
+    assert set(nz.tolist()) == {7, 100, 300, 444}
+    np.testing.assert_allclose(got[nz], g[nz], rtol=0.1, atol=0.2)
+
+
+def test_sketch_linearity_client_mean_equals_per_client():
+    """is_linear contract: compressing the client-mean equals averaging
+    per-client sketches."""
+    d = 128
+    cfg = _cfg(mode="sketch", d=d, k=4, num_rows=3, num_cols=64,
+               momentum_type="none", error_type="virtual")
+    rng = np.random.RandomState(1)
+    gs = jnp.asarray(rng.normal(size=(5, d)).astype(np.float32))
+    per_client = jnp.stack([modes.client_compress(cfg, g, {})[0]["table"] for g in gs])
+    agg1 = modes.aggregate(cfg, {"table": per_client})["table"]
+    agg2 = modes.client_compress(cfg, gs.mean(0), {})[0]["table"]
+    np.testing.assert_allclose(np.asarray(agg1), np.asarray(agg2), rtol=1e-4, atol=1e-5)
+    assert modes.is_linear(cfg)
+    assert not modes.is_linear(_cfg(mode="local_topk", k=1, d=4, momentum_type="none",
+                                    error_type="local", num_clients=2))
+
+
+def test_fedavg_server_average():
+    cfg = _cfg(mode="fedavg", d=4, momentum_type="none", num_local_iters=2)
+    sstate = modes.init_server_state(cfg)
+    deltas = jnp.array([[1.0, 0, 0, 0], [3.0, 0, 0, 0]])  # two clients
+    agg = modes.aggregate(cfg, {"dense": deltas})
+    delta, _ = modes.server_step(cfg, agg, sstate, jnp.float32(1.0))
+    np.testing.assert_allclose(np.asarray(delta), [2.0, 0, 0, 0], rtol=1e-6)
