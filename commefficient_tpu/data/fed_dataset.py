@@ -1,0 +1,98 @@
+"""Federated dataset layer (SURVEY.md L0a: `data_utils.py` / FedDataset).
+
+Client sharding is an *index map* over one global array (SURVEY.md §7.5):
+each virtual client owns a slice of indices into (x, y).  Per round the
+session samples W clients and assembles a fixed-shape [W, B, ...] batch with
+a validity mask — wildly unequal shard sizes (CIFAR non-iid: 5 images/client;
+FEMNIST: natural per-writer counts) become padding, never dynamic shapes,
+so the round step compiles once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class FedDataset:
+    """Global (x, y) arrays + per-client index shards.
+
+    `client_indices` is a list of 1-D int arrays (ragged). Batches are
+    assembled host-side with numpy (cheap gather) and fed to the compiled
+    round step.
+    """
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, client_indices: list[np.ndarray]):
+        self.x = x
+        self.y = y
+        self.client_indices = [np.asarray(ix, dtype=np.int64) for ix in client_indices]
+        if any(len(ix) == 0 for ix in self.client_indices):
+            raise ValueError("every client needs at least one example")
+
+    @property
+    def num_clients(self) -> int:
+        return len(self.client_indices)
+
+    def __len__(self) -> int:
+        return len(self.x)
+
+    def sample_clients(self, rng: np.random.RandomState, num: int) -> np.ndarray:
+        """Uniform without replacement over all virtual clients (SURVEY.md
+        §3.1 'sample round clients')."""
+        return rng.choice(self.num_clients, size=min(num, self.num_clients), replace=False)
+
+    def client_batch(
+        self, rng: np.random.RandomState, client_ids: np.ndarray, batch_size: int,
+        local_iters: int = 1,
+    ) -> dict:
+        """Fixed-shape per-round batch.
+
+        Returns {"x": [W, B, ...], "y": [W, B], "mask": [W, B]} — or with an
+        extra [local_iters] axis after W when local_iters > 1 (fedavg/localSGD
+        microbatches, each drawn with replacement from the client shard).
+        """
+        W = len(client_ids)
+        L = local_iters
+        n = batch_size
+        xs = np.zeros((W, L, n) + self.x.shape[1:], dtype=self.x.dtype)
+        ys = np.zeros((W, L, n), dtype=self.y.dtype)
+        mask = np.zeros((W, L, n), dtype=np.float32)
+        for wi, cid in enumerate(client_ids):
+            shard = self.client_indices[int(cid)]
+            for li in range(L):
+                if len(shard) >= n:
+                    take = rng.choice(shard, size=n, replace=False)
+                    k = n
+                else:
+                    take = shard
+                    k = len(shard)
+                xs[wi, li, :k] = self.x[take]
+                ys[wi, li, :k] = self.y[take]
+                mask[wi, li, :k] = 1.0
+        if L == 1:
+            return {"x": xs[:, 0], "y": ys[:, 0], "mask": mask[:, 0]}
+        return {"x": xs, "y": ys, "mask": mask}
+
+    def eval_batches(self, batch_size: int):
+        """Fixed-shape eval iterator over the whole set (pads the tail)."""
+        n = len(self.x)
+        for start in range(0, n, batch_size):
+            end = min(start + batch_size, n)
+            k = end - start
+            x = np.zeros((batch_size,) + self.x.shape[1:], dtype=self.x.dtype)
+            y = np.zeros((batch_size,), dtype=self.y.dtype)
+            mask = np.zeros((batch_size,), dtype=np.float32)
+            x[:k], y[:k], mask[:k] = self.x[start:end], self.y[start:end], 1.0
+            yield {"x": x, "y": y, "mask": mask}
+
+
+def shard_iid(num_examples: int, num_clients: int, rng: np.random.RandomState) -> list[np.ndarray]:
+    perm = rng.permutation(num_examples)
+    return [s for s in np.array_split(perm, num_clients) if len(s)]
+
+
+def shard_by_label(labels: np.ndarray, num_clients: int) -> list[np.ndarray]:
+    """The reference's non-iid protocol (SURVEY.md §2 'Fed datasets'): sort by
+    label, split into contiguous equal shards — at 10k clients on CIFAR-10
+    each client holds ~5 images of (mostly) one class."""
+    order = np.argsort(labels, kind="stable")
+    return [s for s in np.array_split(order, num_clients) if len(s)]

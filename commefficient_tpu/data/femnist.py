@@ -1,0 +1,82 @@
+"""FEMNIST (LEAF) federated dataset (SURVEY.md L0a: ~3.5k natural clients,
+one per writer).
+
+Reads LEAF's json shards (`all_data_*.json` with per-user `x`/`y`) from disk
+when present; falls back to a deterministic synthetic set with naturally
+non-iid per-writer class skew (each synthetic writer draws from a writer-
+specific class distribution), matching LEAF's statistical shape without
+network access.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+
+from .fed_dataset import FedDataset
+
+
+def _load_leaf(root: str):
+    files = sorted(glob.glob(os.path.join(root, "**", "all_data*.json"), recursive=True))
+    if not files:
+        return None
+    xs, ys, shards = [], [], []
+    offset = 0
+    for path in files:
+        with open(path) as f:
+            blob = json.load(f)
+        for user in blob["users"]:
+            ud = blob["user_data"][user]
+            x = np.asarray(ud["x"], dtype=np.float32).reshape(-1, 28, 28, 1)
+            y = np.asarray(ud["y"], dtype=np.int32)
+            xs.append(x)
+            ys.append(y)
+            shards.append(np.arange(offset, offset + len(y)))
+            offset += len(y)
+    return np.concatenate(xs), np.concatenate(ys), shards
+
+
+def _synthetic(num_clients: int, seed: int, per_client: tuple[int, int] = (10, 40)):
+    rng = np.random.RandomState(seed)
+    protos = rng.normal(0, 1.0, size=(62, 28, 28, 1)).astype(np.float32)
+    xs, ys, shards = [], [], []
+    offset = 0
+    for _ in range(num_clients):
+        n = rng.randint(*per_client)
+        # writer-specific skew: a handful of favoured classes
+        favoured = rng.choice(62, size=8, replace=False)
+        y = favoured[rng.randint(0, 8, size=n)].astype(np.int32)
+        x = protos[y] + rng.normal(0, 0.6, size=(n, 28, 28, 1)).astype(np.float32)
+        xs.append(x.astype(np.float32))
+        ys.append(y)
+        shards.append(np.arange(offset, offset + n))
+        offset += n
+    return np.concatenate(xs), np.concatenate(ys), shards
+
+
+def load_femnist_fed(
+    data_root: str = "./data",
+    num_clients: int = 3550,
+    seed: int = 0,
+    test_frac: float = 0.1,
+) -> tuple[FedDataset, FedDataset, int]:
+    loaded = _load_leaf(os.path.join(data_root, "femnist"))
+    if loaded is None:
+        loaded = _synthetic(num_clients, seed)
+    x, y, shards = loaded
+
+    # hold out a test split per client (LEAF convention is per-user splits)
+    rng = np.random.RandomState(seed + 1)
+    train_shards, test_idx = [], []
+    for s in shards:
+        s = rng.permutation(s)
+        n_test = max(1, int(len(s) * test_frac)) if len(s) > 1 else 0
+        test_idx.append(s[:n_test])
+        if len(s) > n_test:
+            train_shards.append(s[n_test:])
+    train = FedDataset(x, y, train_shards)
+    test = FedDataset(x, y, [np.concatenate(test_idx)])
+    return train, test, 62

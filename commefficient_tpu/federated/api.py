@@ -1,0 +1,134 @@
+"""High-level federated API (SURVEY.md L5: `FedModel` / `FedOptimizer`).
+
+`FederatedSession` is the TPU-native core: it owns the compiled round step,
+the server state, per-client persistent state, and host-side client sampling
+(SURVEY.md §7.3 "Client sampling + data indexing on host; everything else
+compiled").  `FedModel` / `FedOptimizer` are thin reference-parity wrappers
+over it so a training loop reads like the reference's
+(`loss = model(...); opt.step()`) without any process/queue machinery behind
+it — there are no workers to spawn, no shared memory to allocate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from ..data.fed_dataset import FedDataset
+from ..modes import modes
+from ..modes.config import ModeConfig
+from ..parallel import mesh as meshlib
+from . import engine
+
+
+class FederatedSession:
+    def __init__(
+        self,
+        train_loss_fn: Callable,
+        eval_loss_fn: Callable,
+        params: Any,
+        net_state: Any,
+        mode_cfg: ModeConfig,
+        train_set: FedDataset,
+        num_workers: int,
+        local_batch_size: int,
+        weight_decay: float = 0.0,
+        seed: int = 0,
+        mesh=None,
+    ):
+        self.cfg = engine.EngineConfig(mode=mode_cfg, weight_decay=weight_decay)
+        self.train_set = train_set
+        self.num_workers = min(num_workers, train_set.num_clients)
+        self.local_batch_size = local_batch_size
+        self.mesh = mesh
+        self.rng = np.random.RandomState(seed)
+        self._rng_key = jax.random.PRNGKey(seed)
+
+        self.state = engine.init_server_state(self.cfg, params, net_state)
+        self.client_state = modes.init_client_state(mode_cfg, train_set.num_clients)
+
+        self._step = jax.jit(engine.make_round_step(train_loss_fn, self.cfg), donate_argnums=(0,))
+        self._eval = jax.jit(engine.make_eval_step(eval_loss_fn))
+        if self.client_state is not None:
+            self._gather = jax.jit(lambda st, ids: jax.tree.map(lambda a: a[ids], st))
+            self._scatter = jax.jit(
+                lambda st, ids, rows: jax.tree.map(lambda a, r: a.at[ids].set(r), st, rows),
+                donate_argnums=(0,),
+            )
+        self.round = 0
+
+    # -- one federated round -------------------------------------------------
+    def run_round(self, lr: float) -> dict:
+        ids = self.train_set.sample_clients(self.rng, self.num_workers)
+        batch = self.train_set.client_batch(
+            self.rng, ids, self.local_batch_size, self.cfg.mode.num_local_iters
+        )
+        if self.mesh is not None:
+            batch = meshlib.shard_client_batch(self.mesh, batch)
+        ids_dev = jnp.asarray(ids)
+        rows = self._gather(self.client_state, ids_dev) if self.client_state is not None else {}
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        self.state, new_rows, metrics = self._step(self.state, batch, rows, jnp.float32(lr), sub)
+        if self.client_state is not None:
+            self.client_state = self._scatter(self.client_state, ids_dev, new_rows)
+        self.round += 1
+        m = jax.tree.map(float, jax.device_get(metrics))
+        m["lr"] = float(lr)
+        return m
+
+    # -- evaluation (SURVEY.md §3.4: forward-only, no compression) -----------
+    def evaluate(self, dataset: FedDataset, batch_size: int = 512) -> dict:
+        totals: dict[str, float] = {}
+        for batch in dataset.eval_batches(batch_size):
+            metrics = self._eval(
+                self.state["params"], self.state["net_state"], batch, jax.random.PRNGKey(0)
+            )
+            for k, v in jax.device_get(metrics).items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+        return totals
+
+
+# ---------------------------------------------------------- reference parity
+
+
+class FedModel:
+    """Drop-in-ish wrapper (reference `FedModel(model, loss_fn, args)`):
+    calling it runs one federated round and returns train metrics; `.eval()`
+    runs the forward-only eval pass."""
+
+    def __init__(self, session: FederatedSession):
+        self.session = session
+
+    def __call__(self, lr: float) -> dict:
+        return self.session.run_round(lr)
+
+    def eval(self, dataset: FedDataset, batch_size: int = 512) -> dict:
+        return self.session.evaluate(dataset, batch_size)
+
+    @property
+    def params(self):
+        return self.session.state["params"]
+
+
+class FedOptimizer:
+    """Reference `FedOptimizer(opt, args)` parity: owns the LR schedule; the
+    server update itself (momentum + error feedback, Vvelocity/Verror) already
+    ran inside the compiled round step, so `step()` only advances the
+    schedule."""
+
+    def __init__(self, schedule: Callable[[float], float], rounds_per_epoch: int):
+        self.schedule = schedule
+        self.rounds_per_epoch = max(rounds_per_epoch, 1)
+        self._round = 0
+
+    @property
+    def lr(self) -> float:
+        return float(self.schedule(self._round / self.rounds_per_epoch))
+
+    def step(self):
+        self._round += 1

@@ -1,0 +1,150 @@
+"""The federated round engine — one compiled step per round.
+
+TPU-native replacement for the reference's entire L3-L5 stack (SURVEY.md §1:
+`fed_aggregator`/`fed_ps` + `fed_worker` + torch.multiprocessing queues +
+shared-memory tensors).  Where the reference spawns a process per GPU and
+streams (client, batch) work items through queues (SURVEY.md §3.1 hot loop),
+here the sampled clients of a round are a leading batch axis: per-client
+forward/backward is a `vmap`, compression is a mode transform, aggregation is
+a mean that XLA lowers to collectives over the client-sharded mesh axis, and
+the server update runs in the same XLA program.  Weight "broadcast" is
+replicated-array residency — there is no transport code to get right.
+
+Loss-function protocol (model-agnostic):
+
+    loss_fn(params, net_state, batch, rng) -> (loss, aux)
+
+where `loss` is the masked mean loss used for the gradient, and
+`aux = {"net_state": new_net_state, "metrics": {...sums incl "count"}}`.
+`net_state` carries mutable collections (BN batch_stats); per-round new stats
+are averaged across clients and EMA'd by the caller's model wrapper.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from ..modes import modes
+from ..modes.config import ModeConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    mode: ModeConfig
+    weight_decay: float = 0.0  # applied to the gradient client-side, as in the
+    # reference workers (SURVEY.md §3.1 hot loop)
+
+
+def init_server_state(cfg: EngineConfig, params: Any, net_state: Any) -> dict:
+    return {
+        "params": params,
+        "net_state": net_state,
+        "mode_state": modes.init_server_state(cfg.mode),
+        "round": jnp.zeros((), dtype=jnp.int32),
+    }
+
+
+def make_round_step(
+    loss_fn: Callable, cfg: EngineConfig
+) -> Callable[[dict, Any, dict, jnp.ndarray, jnp.ndarray], tuple[dict, dict, dict]]:
+    """Build the jittable round step.
+
+    step(state, batch, client_rows, lr, rng) -> (state', client_rows', metrics)
+
+    - `batch`: pytree of arrays with leading axis W (sampled clients); for
+      fedavg/localSGD modes the per-client arrays additionally have a
+      [num_local_iters] microbatch axis right after W.
+    - `client_rows`: per-sampled-client slices of persistent local state
+      ({} when the mode needs none); caller gathers/scatters by client id.
+    - `lr`: scalar client learning rate (schedule value). Weight-delta modes
+      consume it in the local SGD loop and the server applies the averaged
+      delta at unit rate; grad modes apply it server-side.
+    - metrics are summed over clients (and local iters); caller normalises.
+    """
+    mcfg = cfg.mode
+
+    def grad_client(params, pflat, net_state, cbatch, rng):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, net_state, cbatch, rng
+        )
+        gflat, _ = ravel_pytree(grads)
+        gflat = gflat + cfg.weight_decay * pflat
+        return gflat, aux["net_state"], aux["metrics"]
+
+    def local_sgd_client(params, pflat, net_state, cbatch, rng, lr):
+        _, unravel = ravel_pytree(params)
+
+        def body(carry, xs):
+            p_cur, nstate = carry
+            micro, step_rng = xs
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                unravel(p_cur), nstate, micro, step_rng
+            )
+            gflat, _ = ravel_pytree(grads)
+            gflat = gflat + cfg.weight_decay * p_cur
+            return (p_cur - lr * gflat, aux["net_state"]), aux["metrics"]
+
+        iters = mcfg.num_local_iters
+        rngs = jax.random.split(rng, iters)
+        (p_final, nstate), metrics = jax.lax.scan(body, (pflat, net_state), (cbatch, rngs))
+        delta = pflat - p_final
+        return delta, nstate, jax.tree.map(lambda m: m.sum(0), metrics)
+
+    def step(state, batch, client_rows, lr, rng):
+        params, net_state = state["params"], state["net_state"]
+        pflat, unravel = ravel_pytree(params)
+        num_sampled = jax.tree.leaves(batch)[0].shape[0]
+        client_rngs = jax.random.split(rng, num_sampled)
+
+        if mcfg.uses_weight_delta:
+            updates, nstates, metrics = jax.vmap(
+                lambda cb, r: local_sgd_client(params, pflat, net_state, cb, r, lr)
+            )(batch, client_rngs)
+        else:
+            updates, nstates, metrics = jax.vmap(
+                lambda cb, r: grad_client(params, pflat, net_state, cb, r)
+            )(batch, client_rngs)
+
+        if modes.is_linear(mcfg) and not mcfg.needs_local_state:
+            # sketching/averaging commute (linearity) — compress once on the
+            # client mean instead of per client. Exactly equal, much cheaper.
+            agg, _ = modes.client_compress(mcfg, jnp.mean(updates, axis=0), {})
+            agg = modes.aggregate(mcfg, jax.tree.map(lambda x: x[None], agg))
+            new_rows = client_rows
+        else:
+            wires, new_rows = jax.vmap(lambda u, row: modes.client_compress(mcfg, u, row))(
+                updates, client_rows
+            )
+            agg = modes.aggregate(mcfg, wires)
+
+        server_lr = jnp.float32(1.0) if mcfg.uses_weight_delta else lr
+        delta, mode_state = modes.server_step(mcfg, agg, state["mode_state"], server_lr)
+        new_params = unravel(pflat - delta)
+        # mutable model collections (BN stats): average the per-client results
+        new_net_state = jax.tree.map(lambda s: jnp.mean(s, axis=0), nstates)
+        new_state = {
+            "params": new_params,
+            "net_state": new_net_state,
+            "mode_state": mode_state,
+            "round": state["round"] + 1,
+        }
+        return new_state, new_rows, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+
+    return step
+
+
+def make_eval_step(loss_fn: Callable) -> Callable:
+    """Forward-only metrics over an eval batch (no compression — SURVEY.md
+    §3.4). `batch` has no client axis; rng is for completeness (dropout off
+    in eval loss_fns)."""
+
+    def eval_step(params, net_state, batch, rng):
+        _, aux = loss_fn(params, net_state, batch, rng)
+        return aux["metrics"]
+
+    return eval_step

@@ -1,0 +1,23 @@
+"""Small FEMNIST CNN (SURVEY.md L0b): the LEAF-standard 2-conv network for
+62-class handwritten character recognition on 28x28 inputs."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class FEMNISTCNN(nn.Module):
+    num_classes: int = 62
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(32, (5, 5), padding=2)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding=2)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(2048)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)

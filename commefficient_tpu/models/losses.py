@@ -1,0 +1,78 @@
+"""Loss-function adapters binding flax models to the engine's protocol
+(engine.py: loss_fn(params, net_state, batch, rng) -> (loss, aux))."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_classification_loss(model, train: bool):
+    """Masked softmax cross-entropy for image classifiers with BN state.
+
+    batch = {"x": [B, H, W, C], "y": [B] int, "mask": [B] 0/1}. Metrics are
+    sums (loss_sum, count, correct) so they aggregate across clients/batches.
+    """
+
+    def loss_fn(params, net_state, batch, rng):
+        variables = {"params": params, **net_state}
+        if train:
+            logits, new_model_state = model.apply(
+                variables, batch["x"], train=True, mutable=["batch_stats"]
+            )
+            new_net_state = dict(new_model_state)
+        else:
+            logits = model.apply(variables, batch["x"], train=False)
+            new_net_state = net_state
+        logp = jax.nn.log_softmax(logits)
+        per_ex = -jnp.take_along_axis(logp, batch["y"][:, None], axis=1)[:, 0]
+        mask = batch["mask"].astype(per_ex.dtype)
+        count = jnp.maximum(mask.sum(), 1.0)
+        loss = (per_ex * mask).sum() / count
+        correct = ((logits.argmax(-1) == batch["y"]) * mask).sum()
+        return loss, {
+            "net_state": new_net_state,
+            "metrics": {
+                "loss_sum": (per_ex * mask).sum(),
+                "count": mask.sum(),
+                "correct": correct,
+            },
+        }
+
+    return loss_fn
+
+
+def make_lm_loss(model, train: bool):
+    """Next-token cross-entropy for causal LMs.
+
+    batch = {"input_ids": [B, T] int, "labels": [B, T] int with -100 = ignore}.
+    Metrics: loss_sum / count (token-level) -> PPL = exp(loss_sum / count).
+    """
+
+    def loss_fn(params, net_state, batch, rng):
+        logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            train=train,
+            rngs={"dropout": rng} if (train and rng is not None) else None,
+        )
+        # shift: predict token t+1 from prefix ..t
+        logits = logits[:, :-1]
+        labels = batch["labels"][:, 1:]
+        mask = (labels != -100).astype(logits.dtype)
+        safe_labels = jnp.maximum(labels, 0)
+        logp = jax.nn.log_softmax(logits)
+        per_tok = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        count = jnp.maximum(mask.sum(), 1.0)
+        loss = (per_tok * mask).sum() / count
+        correct = ((logits.argmax(-1) == safe_labels) * mask).sum()
+        return loss, {
+            "net_state": net_state,
+            "metrics": {
+                "loss_sum": (per_tok * mask).sum(),
+                "count": mask.sum(),
+                "correct": correct,
+            },
+        }
+
+    return loss_fn

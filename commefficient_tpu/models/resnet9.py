@@ -1,0 +1,55 @@
+"""ResNet-9, cifar10-fast bag-of-tricks lineage (SURVEY.md L0b: the
+reference's CV model for CIFAR-10/100).
+
+Structure: prep conv -> (conv+pool) layer with residual -> middle conv+pool ->
+(conv+pool) layer with residual -> global maxpool -> linear, with batch norm
+after every conv and logits scaled by 0.125.  Written as flax NNX-free linen
+for a clean `{"params", "batch_stats"}` split that the federated engine
+threads through its `net_state`.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(self.features, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5)(x)
+        return nn.relu(x)
+
+
+class Residual(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        y = ConvBN(self.features)(x, train)
+        y = ConvBN(self.features)(y, train)
+        return x + y
+
+
+class ResNet9(nn.Module):
+    num_classes: int = 10
+    logit_scale: float = 0.125
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = ConvBN(64)(x, train)  # prep
+        x = ConvBN(128)(x, train)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = Residual(128)(x, train)
+        x = ConvBN(256)(x, train)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = ConvBN(512)(x, train)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = Residual(512)(x, train)
+        x = nn.max_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes)(x)
+        return x * self.logit_scale

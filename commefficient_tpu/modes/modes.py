@@ -59,8 +59,12 @@ def init_server_state(cfg: ModeConfig) -> dict:
         shape = cfg.sketch_spec.table_shape
     else:
         shape = (cfg.d,)
-    z = jnp.zeros(shape, dtype=jnp.float32)
-    return {"Vvelocity": z, "Verror": z}
+    # two distinct buffers — the step donates its input state, and donating
+    # one aliased buffer twice is an XLA error
+    return {
+        "Vvelocity": jnp.zeros(shape, dtype=jnp.float32),
+        "Verror": jnp.zeros(shape, dtype=jnp.float32),
+    }
 
 
 def init_client_state(cfg: ModeConfig, num_clients: int | None = None) -> dict | None:

@@ -1,0 +1,47 @@
+"""Device-mesh helpers — the TPU-native "communication backend".
+
+The reference's transport is torch.multiprocessing queues + shared-memory
+tensors on a single host (SURVEY.md §1, §2 "Distributed comm backend").  Here
+there is no transport layer at all: sampled clients are a sharded batch axis
+on a `jax.sharding.Mesh`, cross-client reductions are XLA collectives over
+ICI (DCN at multi-slice scale), and weight "broadcast" is replicated-array
+residency.  These helpers name the axes and build the shardings the round
+engine uses.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENT_AXIS = "clients"  # data-parallel axis over sampled virtual clients
+MODEL_AXIS = "model"  # tensor-parallel axis (GPT-2 path, optional)
+
+
+def make_mesh(num_devices: int | None = None, model_parallel: int = 1) -> Mesh:
+    """1-D client mesh, or 2-D (clients, model) when model_parallel > 1."""
+    devs = jax.devices()
+    n = len(devs) if num_devices is None else num_devices
+    devs = np.asarray(devs[:n])
+    if model_parallel > 1:
+        if n % model_parallel:
+            raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+        return Mesh(devs.reshape(n // model_parallel, model_parallel), (CLIENT_AXIS, MODEL_AXIS))
+    return Mesh(devs, (CLIENT_AXIS,))
+
+
+def client_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (sampled-client) axis over the client mesh axis."""
+    return NamedSharding(mesh, P(CLIENT_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_client_batch(mesh: Mesh, tree):
+    """Place every array in `tree` with its leading [W] axis sharded over the
+    client mesh axis (weights/params stay replicated — see `replicated`)."""
+    return jax.device_put(tree, client_sharding(mesh))

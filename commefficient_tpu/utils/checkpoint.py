@@ -1,0 +1,72 @@
+"""Checkpoint / resume (SURVEY.md §5: the reference has only a minimal model
+save; the rebuild checkpoints the full server state — params, net_state,
+Vvelocity/Verror, per-client state, round counter, host RNG — via orbax, so a
+run can resume mid-schedule at the exact round)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import numpy as np
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def save(ckpt_dir: str, session, keep: int = 3):
+    path = os.path.abspath(os.path.join(ckpt_dir, f"round_{session.round:08d}"))
+    payload = {
+        "state": jax.device_get(session.state),
+        "round": session.round,
+    }
+    if session.client_state is not None:
+        payload["client_state"] = jax.device_get(session.client_state)
+    ckpt = ocp.PyTreeCheckpointer()
+    ckpt.save(path, payload, force=True)
+    # host-side sampling RNG, so resumed runs replay the same client sequence
+    rng_state = session.rng.get_state()
+    np.save(os.path.join(path, "host_rng.npy"),
+            np.array([rng_state[0], rng_state[1].tolist(), rng_state[2], rng_state[3],
+                      rng_state[4]], dtype=object), allow_pickle=True)
+    _prune(ckpt_dir, keep)
+    return path
+
+
+def latest(ckpt_dir: str) -> str | None:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    rounds = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("round_"))
+    return os.path.join(ckpt_dir, rounds[-1]) if rounds else None
+
+
+def restore(path: str, session) -> None:
+    ckpt = ocp.PyTreeCheckpointer()
+    template: dict[str, Any] = {
+        "state": jax.device_get(session.state),
+        "round": 0,
+    }
+    if session.client_state is not None:
+        template["client_state"] = jax.device_get(session.client_state)
+    payload = ckpt.restore(path, item=template)
+    session.state = jax.tree.map(jax.numpy.asarray, payload["state"])
+    session.round = int(payload["round"])
+    if session.client_state is not None:
+        session.client_state = jax.tree.map(jax.numpy.asarray, payload["client_state"])
+    rng_file = os.path.join(path, "host_rng.npy")
+    if os.path.exists(rng_file):
+        s = np.load(rng_file, allow_pickle=True)
+        session.rng.set_state((s[0], np.asarray(s[1], dtype=np.uint32), int(s[2]),
+                               int(s[3]), float(s[4])))
+
+
+def _prune(ckpt_dir: str, keep: int):
+    rounds = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("round_"))
+    for stale in rounds[:-keep]:
+        full = os.path.join(ckpt_dir, stale)
+        for root, dirs, files in os.walk(full, topdown=False):
+            for f in files:
+                os.unlink(os.path.join(root, f))
+            for d in dirs:
+                os.rmdir(os.path.join(root, d))
+        os.rmdir(full)

@@ -1,0 +1,99 @@
+"""The flag surface — reference CLI compatibility (SURVEY.md §5.6).
+
+One argparse namespace drives everything, as in the reference's
+`utils.parse_args`. Flag names follow the reference ([K]-provenance; SURVEY.md
+notes they may differ from the mounted fork — re-ground via SURVEY.md §0.3
+when the mount is populated).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..modes.config import MODES, ModeConfig
+
+
+def make_parser(task: str = "cv") -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=f"commefficient-tpu {task} training")
+    # compression / update mode
+    p.add_argument("--mode", default="uncompressed", choices=list(MODES))
+    p.add_argument("--error_type", default=None, choices=["none", "local", "virtual"],
+                   help="default: virtual for sketch/true_topk, local for local_topk, else none")
+    p.add_argument("--momentum_type", default=None, choices=["none", "virtual", "local"],
+                   help="default: virtual when --momentum > 0 (local for local_topk), else none")
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--k", type=int, default=50000)
+    p.add_argument("--num_rows", type=int, default=5)
+    p.add_argument("--num_cols", type=int, default=500000)
+    p.add_argument("--num_blocks", type=int, default=1)
+    # federation shape
+    p.add_argument("--num_clients", type=int, default=100)
+    p.add_argument("--num_workers", type=int, default=8,
+                   help="clients sampled (simulated) per round")
+    p.add_argument("--local_batch_size", type=int, default=8)
+    p.add_argument("--num_local_iters", type=int, default=1)
+    p.add_argument("--iid", action="store_true")
+    # optimisation
+    p.add_argument("--num_epochs", type=float, default=24)
+    p.add_argument("--lr_scale", type=float, default=0.4)
+    p.add_argument("--pivot_epoch", type=float, default=5)
+    p.add_argument("--weight_decay", type=float, default=5e-4)
+    # run plumbing
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--num_devices", type=int, default=0, help="0 = all visible")
+    p.add_argument("--eval_batch_size", type=int, default=512)
+    p.add_argument("--eval_every", type=int, default=0, help="rounds; 0 = once per epoch")
+    p.add_argument("--num_rounds", type=int, default=0,
+                   help="hard round cap (0 = derive from epochs); handy for smoke tests")
+    p.add_argument("--data_root", default="./data")
+    p.add_argument("--checkpoint_dir", default="")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--checkpoint_every", type=int, default=0, help="rounds; 0 = never")
+    p.add_argument("--log_jsonl", default="")
+    p.add_argument("--profile_dir", default="", help="write a jax.profiler trace here")
+    if task == "cv":
+        p.add_argument("--dataset", default="cifar10",
+                       choices=["cifar10", "cifar100", "femnist"])
+    else:  # gpt2
+        p.add_argument("--dataset", default="personachat", choices=["personachat"])
+        p.add_argument("--seq_len", type=int, default=256)
+        p.add_argument("--model_size", default="small", choices=["tiny", "small"])
+        p.add_argument("--model_parallel", type=int, default=1,
+                       help="tensor-parallel ways for the GPT-2 path")
+    return p
+
+
+def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
+    """Fill mode-dependent defaults so every reference flag combo maps onto a
+    ModeConfig the mode library implements (see ModeConfig validation)."""
+    if args.momentum_type is None:
+        if args.momentum and args.momentum > 0:
+            args.momentum_type = "local" if args.mode == "local_topk" else "virtual"
+        else:
+            args.momentum_type = "none"
+    if args.error_type is None:
+        args.error_type = {
+            "sketch": "virtual",
+            "true_topk": "virtual",
+            "local_topk": "local",
+        }.get(args.mode, "none")
+    if args.mode in ("fedavg", "localSGD") and args.num_local_iters < 1:
+        args.num_local_iters = 1
+    return args
+
+
+def mode_config_from_args(args: argparse.Namespace, d: int) -> ModeConfig:
+    return ModeConfig(
+        mode=args.mode,
+        d=d,
+        k=min(args.k, d) if args.k else 0,
+        num_rows=args.num_rows,
+        num_cols=args.num_cols,
+        num_blocks=args.num_blocks,
+        seed=args.seed,
+        momentum=args.momentum if args.momentum_type != "none" else 0.0,
+        momentum_type=args.momentum_type,
+        error_type=args.error_type,
+        num_local_iters=args.num_local_iters if args.mode in ("fedavg", "localSGD") else 1,
+        num_clients=args.num_clients,
+    )

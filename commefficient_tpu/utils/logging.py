@@ -1,0 +1,50 @@
+"""Run logging (SURVEY.md L0c / §5: `TableLogger` stdout tables + `Timer`).
+
+The reference prints fixed-width epoch tables; we keep that surface and add a
+JSONL sink so runs are machine-readable (the rebuild's observability upgrade,
+SURVEY.md §5 "Metrics / logging").
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+class Timer:
+    """Wall-clock phase timer: t = timer(); ... ; dt = timer()."""
+
+    def __init__(self):
+        self._last = time.perf_counter()
+        self.total = 0.0
+
+    def __call__(self) -> float:
+        now = time.perf_counter()
+        dt = now - self._last
+        self._last = now
+        self.total += dt
+        return dt
+
+
+class TableLogger:
+    """Fixed-width column table printed incrementally, one row per epoch."""
+
+    def __init__(self, jsonl_path: str | None = None):
+        self.columns: list[str] | None = None
+        self.jsonl_path = jsonl_path
+
+    def append(self, row: dict):
+        if self.columns is None:
+            self.columns = list(row.keys())
+            print("  ".join(f"{c:>12s}" for c in self.columns), flush=True)
+        cells = []
+        for c in self.columns:
+            v = row.get(c, "")
+            if isinstance(v, float):
+                cells.append(f"{v:>12.4f}")
+            else:
+                cells.append(f"{str(v):>12s}")
+        print("  ".join(cells), flush=True)
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(row) + "\n")

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""CV federated training CLI (SURVEY.md L6: reference `cv_train.py` —
+CIFAR-10/100 + FEMNIST experiment driver, same flag surface, dispatching to
+the TPU engine instead of worker processes).
+
+Example (paper config #2, SURVEY.md §6):
+    python cv_train.py --dataset cifar10 --mode sketch --num_clients 10000 \
+        --num_workers 100 --k 50000 --num_rows 5 --num_cols 500000 \
+        --num_epochs 24 --lr_scale 0.4 --pivot_epoch 5
+Smoke test (BASELINE config #1):
+    python cv_train.py --dataset cifar10 --mode uncompressed --num_clients 10 \
+        --num_workers 2 --num_rounds 20
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from commefficient_tpu.data.cifar import load_cifar_fed
+from commefficient_tpu.data.femnist import load_femnist_fed
+from commefficient_tpu.federated.api import FederatedSession, FedModel, FedOptimizer
+from commefficient_tpu.models.femnist_cnn import FEMNISTCNN
+from commefficient_tpu.models.losses import make_classification_loss
+from commefficient_tpu.models.resnet9 import ResNet9
+from commefficient_tpu.parallel import mesh as meshlib
+from commefficient_tpu.utils import checkpoint as ckpt
+from commefficient_tpu.utils.config import make_parser, mode_config_from_args, resolve_defaults
+from commefficient_tpu.utils.logging import TableLogger, Timer
+from commefficient_tpu.utils.schedules import triangular
+
+
+def build(args):
+    if args.dataset == "femnist":
+        train_set, test_set, num_classes = load_femnist_fed(
+            args.data_root, args.num_clients, args.seed
+        )
+        model = FEMNISTCNN(num_classes=num_classes)
+        sample_shape = (1, 28, 28, 1)
+    else:
+        train_set, test_set, num_classes = load_cifar_fed(
+            args.dataset, args.num_clients, args.iid, args.data_root, args.seed
+        )
+        model = ResNet9(num_classes=num_classes)
+        sample_shape = (1, 32, 32, 3)
+    args.num_clients = train_set.num_clients  # actual shard count
+
+    variables = model.init(jax.random.PRNGKey(args.seed), jnp.zeros(sample_shape), train=False)
+    params = variables["params"]
+    net_state = {k: v for k, v in variables.items() if k != "params"}
+    d = ravel_pytree(params)[0].size
+    print(f"model: {type(model).__name__}  d={d:,}  clients={train_set.num_clients}  "
+          f"mode={args.mode}", flush=True)
+
+    mode_cfg = mode_config_from_args(args, d)
+    mesh = meshlib.make_mesh(args.num_devices or None) if jax.device_count() > 1 else None
+    session = FederatedSession(
+        train_loss_fn=make_classification_loss(model, train=True),
+        eval_loss_fn=make_classification_loss(model, train=False),
+        params=params,
+        net_state=net_state,
+        mode_cfg=mode_cfg,
+        train_set=train_set,
+        num_workers=args.num_workers,
+        local_batch_size=args.local_batch_size,
+        weight_decay=args.weight_decay,
+        seed=args.seed,
+        mesh=mesh,
+    )
+    return session, test_set
+
+
+def main(argv=None):
+    args = resolve_defaults(make_parser("cv").parse_args(argv))
+    session, test_set = build(args)
+
+    rounds_per_epoch = max(1, math.ceil(args.num_clients / session.num_workers))
+    total_rounds = args.num_rounds or int(args.num_epochs * rounds_per_epoch)
+    schedule = triangular(args.lr_scale, args.pivot_epoch, args.num_epochs)
+    opt = FedOptimizer(schedule, rounds_per_epoch)
+    model = FedModel(session)
+
+    if args.resume and args.checkpoint_dir:
+        path = ckpt.latest(args.checkpoint_dir)
+        if path:
+            ckpt.restore(path, session)
+            opt._round = session.round
+            print(f"resumed from {path} at round {session.round}", flush=True)
+
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+
+    logger = TableLogger(args.log_jsonl or None)
+    timer = Timer()
+    eval_every = args.eval_every or rounds_per_epoch
+    acc_loss = acc_count = acc_correct = 0.0
+    for rnd in range(session.round, total_rounds):
+        m = model(opt.lr)
+        opt.step()
+        acc_loss += m["loss_sum"]
+        acc_count += m["count"]
+        acc_correct += m["correct"]
+        if args.checkpoint_every and args.checkpoint_dir and (rnd + 1) % args.checkpoint_every == 0:
+            ckpt.save(args.checkpoint_dir, session)
+        if (rnd + 1) % eval_every == 0 or rnd + 1 == total_rounds:
+            ev = model.eval(test_set, args.eval_batch_size)
+            logger.append({
+                "round": rnd + 1,
+                "epoch": (rnd + 1) / rounds_per_epoch,
+                "lr": m["lr"],
+                "train_loss": acc_loss / max(acc_count, 1),
+                "train_acc": acc_correct / max(acc_count, 1),
+                "test_loss": ev["loss_sum"] / max(ev["count"], 1),
+                "test_acc": ev["correct"] / max(ev["count"], 1),
+                "time_s": timer(),
+            })
+            acc_loss = acc_count = acc_correct = 0.0
+
+    if args.profile_dir:
+        jax.profiler.stop_trace()
+    if args.checkpoint_dir:
+        ckpt.save(args.checkpoint_dir, session)
+    return session
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

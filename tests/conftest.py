@@ -12,4 +12,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
+# This machine's sitecustomize registers a TPU-tunnel PJRT plugin ("axon") in
+# every interpreter; its backend init can hang when the tunnel is down, even
+# under JAX_PLATFORMS=cpu. Tests must be hermetic on the CPU mesh, so drop the
+# factory before any backend is initialised.
+from jax._src import xla_bridge  # noqa: E402
+
+xla_bridge._backend_factories.pop("axon", None)
+
+# A pytest plugin may import jax before this conftest, in which case jax has
+# already latched JAX_PLATFORMS from the ambient env ("axon"); set the config
+# explicitly rather than relying on the env write above.
+jax.config.update("jax_platforms", "cpu")
+
 jax.config.update("jax_threefry_partitionable", True)

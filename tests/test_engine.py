@@ -1,0 +1,185 @@
+"""Round-engine tests (SURVEY.md §4 integration list): `uncompressed` matches
+plain SGD bit-for-bit (the reference's control mode); fedavg with 1 local iter
+matches SGD; sharded-over-8-CPU-devices result matches unsharded; loss falls
+under every mode on a tiny synthetic problem."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.flatten_util import ravel_pytree
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from commefficient_tpu.federated import engine
+from commefficient_tpu.modes import modes
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.parallel import mesh as meshlib
+
+
+def init_mlp(key, din=10, dh=16, dout=4):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (din, dh)) * 0.1,
+        "b1": jnp.zeros(dh),
+        "w2": jax.random.normal(k2, (dh, dout)) * 0.1,
+        "b2": jnp.zeros(dout),
+    }
+
+
+def mlp_loss(params, net_state, batch, rng):
+    h = jnp.tanh(batch["x"] @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    logp = jax.nn.log_softmax(logits)
+    per_ex = -jnp.take_along_axis(logp, batch["y"][:, None], axis=1)[:, 0]
+    mask = batch["mask"]
+    count = jnp.maximum(mask.sum(), 1.0)
+    loss = (per_ex * mask).sum() / count
+    correct = ((logits.argmax(-1) == batch["y"]) * mask).sum()
+    return loss, {
+        "net_state": net_state,
+        "metrics": {"loss_sum": (per_ex * mask).sum(), "count": mask.sum(), "correct": correct},
+    }
+
+
+def _data(key, n, din=10, dout=4):
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (n, din))
+    w_true = jax.random.normal(kw, (din, dout))
+    y = (x @ w_true).argmax(-1)
+    return {"x": x, "y": y, "mask": jnp.ones(n)}
+
+
+def _ucfg(**kw):
+    base = dict(mode="uncompressed", d=0, momentum_type="none", error_type="none")
+    base.update(kw)
+    return base
+
+
+def _make(cfg_kw, wd=0.0):
+    params = init_mlp(jax.random.PRNGKey(0))
+    d = ravel_pytree(params)[0].size
+    mcfg = ModeConfig(**{**cfg_kw, "d": d})
+    cfg = engine.EngineConfig(mode=mcfg, weight_decay=wd)
+    state = engine.init_server_state(cfg, params, {})
+    step = jax.jit(engine.make_round_step(mlp_loss, cfg))
+    return cfg, state, step
+
+
+def test_uncompressed_matches_plain_sgd():
+    data = _data(jax.random.PRNGKey(1), 16)
+    batch = jax.tree.map(lambda a: a[None], data)  # W=1
+    cfg, state, step = _make(_ucfg())
+    lr = jnp.float32(0.2)
+
+    # manual SGD on the same loss
+    params = init_mlp(jax.random.PRNGKey(0))
+    for i in range(5):
+        state, _, metrics = step(state, batch, {}, lr, jax.random.PRNGKey(i))
+        g = jax.grad(lambda p: mlp_loss(p, {}, data, None)[0])(params)
+        params = jax.tree.map(lambda p, gg: p - lr * gg, params, g)
+    for a, b in zip(jax.tree.leaves(state["params"]), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_uncompressed_momentum_weight_decay_matches_manual():
+    data = _data(jax.random.PRNGKey(2), 16)
+    batch = jax.tree.map(lambda a: a[None], data)
+    cfg, state, step = _make(_ucfg(momentum_type="virtual", momentum=0.9), wd=0.01)
+    lr = jnp.float32(0.1)
+
+    params = init_mlp(jax.random.PRNGKey(0))
+    vel = jax.tree.map(jnp.zeros_like, params)
+    for i in range(4):
+        state, _, _ = step(state, batch, {}, lr, jax.random.PRNGKey(i))
+        g = jax.grad(lambda p: mlp_loss(p, {}, data, None)[0])(params)
+        g = jax.tree.map(lambda gg, p: gg + 0.01 * p, g, params)
+        vel = jax.tree.map(lambda v, gg: 0.9 * v + gg, vel, g)
+        params = jax.tree.map(lambda p, v: p - lr * v, params, vel)
+    for a, b in zip(jax.tree.leaves(state["params"]), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_fedavg_single_local_iter_matches_sgd():
+    data = _data(jax.random.PRNGKey(3), 8)
+    batch = jax.tree.map(lambda a: a[None, None], data)  # W=1, L=1
+    cfg, state, step = _make(
+        dict(mode="fedavg", momentum_type="none", error_type="none", num_local_iters=1)
+    )
+    lr = jnp.float32(0.2)
+    state, _, _ = step(state, batch, {}, lr, jax.random.PRNGKey(0))
+
+    params = init_mlp(jax.random.PRNGKey(0))
+    g = jax.grad(lambda p: mlp_loss(p, {}, data, None)[0])(params)
+    params = jax.tree.map(lambda p, gg: p - lr * gg, params, g)
+    for a, b in zip(jax.tree.leaves(state["params"]), jax.tree.leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_multi_client_mean_equals_big_batch():
+    """W clients with equal shards == one client with the union (uniform
+    client weighting; shards equal-sized so the means coincide)."""
+    data = _data(jax.random.PRNGKey(4), 32)
+    w4 = jax.tree.map(lambda a: a.reshape((4,) + (8,) + a.shape[1:]), data)
+    one = jax.tree.map(lambda a: a[None], data)
+    lr = jnp.float32(0.1)
+    cfg, state4, step = _make(_ucfg())
+    _, state1, _ = _make(_ucfg())
+    s4, _, m4 = step(state4, w4, {}, lr, jax.random.PRNGKey(0))
+    s1, _, m1 = step(state1, one, {}, lr, jax.random.PRNGKey(0))
+    for a, b in zip(jax.tree.leaves(s4["params"]), jax.tree.leaves(s1["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+    assert float(m4["count"]) == float(m1["count"]) == 32.0
+
+
+def test_sharded_equals_unsharded():
+    """The same step over an 8-device CPU mesh (client axis sharded) produces
+    the same new params — 'distributed without a cluster' (SURVEY.md §4)."""
+    mesh = meshlib.make_mesh(8)
+    data = _data(jax.random.PRNGKey(5), 64)
+    w8 = jax.tree.map(lambda a: a.reshape((8,) + (8,) + a.shape[1:]), data)
+    lr = jnp.float32(0.1)
+    cfg, state, step = _make(_ucfg())
+    ref, _, _ = step(state, w8, {}, lr, jax.random.PRNGKey(0))
+
+    _, state2, _ = _make(_ucfg())
+    sharded_batch = meshlib.shard_client_batch(mesh, w8)
+    got, _, _ = step(state2, sharded_batch, {}, lr, jax.random.PRNGKey(0))
+    for a, b in zip(jax.tree.leaves(got["params"]), jax.tree.leaves(ref["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "cfg_kw",
+    [
+        _ucfg(),
+        _ucfg(momentum_type="virtual"),
+        dict(mode="sketch", k=50, num_rows=3, num_cols=200, momentum_type="virtual",
+             error_type="virtual"),
+        dict(mode="true_topk", k=50, momentum_type="virtual", error_type="virtual"),
+        dict(mode="local_topk", k=50, momentum_type="none", error_type="local",
+             num_clients=4),
+        dict(mode="fedavg", momentum_type="none", error_type="none", num_local_iters=3),
+    ],
+    ids=["uncompressed", "uncompressed+mom", "sketch", "true_topk", "local_topk", "fedavg"],
+)
+def test_loss_decreases_every_mode(cfg_kw):
+    W, B = 4, 16
+    data = _data(jax.random.PRNGKey(6), W * B)
+    if cfg_kw.get("mode") == "fedavg":
+        L = cfg_kw["num_local_iters"]
+        data = _data(jax.random.PRNGKey(6), W * L * B)
+        batch = jax.tree.map(lambda a: a.reshape((W, L, B) + a.shape[1:]), data)
+    else:
+        batch = jax.tree.map(lambda a: a.reshape((W, B) + a.shape[1:]), data)
+    cfg, state, step = _make(cfg_kw)
+    rows = (
+        jax.tree.map(lambda a: a[:W], modes.init_client_state(cfg.mode, 4))
+        if cfg.mode.needs_local_state
+        else {}
+    )
+    lr = jnp.float32(0.3)
+    losses = []
+    for i in range(12):
+        state, rows, metrics = step(state, batch, rows, lr, jax.random.PRNGKey(i))
+        losses.append(float(metrics["loss_sum"]) / float(metrics["count"]))
+    assert losses[-1] < losses[0] * 0.7, losses
