@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Benchmark: client-updates/sec/chip on the FetchSGD flagship workload
+(CIFAR-10 ResNet-9, mode=sketch) — BASELINE.json's north-star metric.
+
+Runs on whatever the default JAX platform is (the driver points this at one
+real TPU chip). Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline normalises against REFERENCE_CLIENT_UPDATES_PER_SEC, an estimate
+of the reference implementation's single-GPU simulated-client throughput on
+the same workload. BASELINE.json's `published` field is empty (no hard
+numbers exist in the reference repo — see BASELINE.md); the estimate is
+derived from paper-era figures: cifar10-fast ResNet-9 forward+backward at
+batch 8 on a V100-class GPU ≈ 4-6k img/s ≈ 600 client-updates/s at 8
+imgs/client, minus sketching overhead ≈ 500/s. Re-derive when a populated
+reference mount allows measuring directly.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import os
+
+REFERENCE_CLIENT_UPDATES_PER_SEC = 500.0
+
+# flagship shape: 10k-client federation, 1% participation, paper sketch dims.
+# Env overrides exist so the script can be smoke-tested small on CPU
+# (BENCH_WORKERS=4 BENCH_COLS=20000 ... python bench.py); the defaults are
+# what the driver measures on the real chip.
+NUM_WORKERS = int(os.environ.get("BENCH_WORKERS", 64))  # sampled clients/round
+LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH", 8))  # images per client
+SKETCH_ROWS = int(os.environ.get("BENCH_ROWS", 5))
+SKETCH_COLS = int(os.environ.get("BENCH_COLS", 500_000))
+TOPK = int(os.environ.get("BENCH_TOPK", 50_000))
+NUM_BLOCKS = int(os.environ.get("BENCH_BLOCKS", 4))
+WARMUP_ROUNDS = int(os.environ.get("BENCH_WARMUP", 3))
+TIMED_ROUNDS = int(os.environ.get("BENCH_ROUNDS", 10))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.federated import engine
+    from commefficient_tpu.models.losses import make_classification_loss
+    from commefficient_tpu.models.resnet9 import ResNet9
+    from commefficient_tpu.modes.config import ModeConfig
+
+    model = ResNet9(num_classes=10)
+    x0 = jnp.zeros((1, 32, 32, 3), dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0, train=False)
+    params = variables["params"]
+    net_state = {k: v for k, v in variables.items() if k != "params"}
+    d = ravel_pytree(params)[0].size
+
+    mode_cfg = ModeConfig(
+        mode="sketch", d=d, k=TOPK, num_rows=SKETCH_ROWS, num_cols=SKETCH_COLS,
+        num_blocks=NUM_BLOCKS, momentum_type="virtual", error_type="virtual",
+    )
+    cfg = engine.EngineConfig(mode=mode_cfg, weight_decay=5e-4)
+    state = engine.init_server_state(cfg, params, net_state)
+    step = jax.jit(
+        engine.make_round_step(make_classification_loss(model, train=True), cfg),
+        donate_argnums=(0,),
+    )
+
+    key = jax.random.PRNGKey(1)
+    batch = {
+        "x": jax.random.normal(key, (NUM_WORKERS, LOCAL_BATCH, 32, 32, 3), jnp.float32),
+        "y": jax.random.randint(key, (NUM_WORKERS, LOCAL_BATCH), 0, 10, jnp.int32),
+        "mask": jnp.ones((NUM_WORKERS, LOCAL_BATCH), jnp.float32),
+    }
+
+    for i in range(WARMUP_ROUNDS):
+        state, _, _ = step(state, batch, {}, jnp.float32(0.01), jax.random.PRNGKey(i))
+    jax.block_until_ready(state["params"])
+
+    t0 = time.perf_counter()
+    for i in range(TIMED_ROUNDS):
+        state, _, _ = step(state, batch, {}, jnp.float32(0.01), jax.random.PRNGKey(100 + i))
+    jax.block_until_ready(state["params"])
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    updates_per_sec_per_chip = (NUM_WORKERS * TIMED_ROUNDS) / dt / n_chips
+    print(json.dumps({
+        "metric": "client-updates/sec/chip (CIFAR-10 ResNet-9, mode=sketch, "
+                  f"r={SKETCH_ROWS} c={SKETCH_COLS} k={TOPK}, {LOCAL_BATCH} img/client)",
+        "value": round(updates_per_sec_per_chip, 2),
+        "unit": "client-updates/sec/chip",
+        "vs_baseline": round(updates_per_sec_per_chip / REFERENCE_CLIENT_UPDATES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""The driver's contract: entry() compiles single-chip; dryrun_multichip(8)
+jits the full sharded training step on the 8-device CPU mesh."""
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 10)
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
