@@ -1,0 +1,138 @@
+"""PersonaChat federated dataset (SURVEY.md L0a: one client per persona,
+~17.5k clients; SURVEY.md §3.2).
+
+Reads the transfer-learning-conv-ai json (`personachat_self_original.json`
+style: {"train": [{"personality": [...], "utterances": [{"history": [...],
+"candidates": [...]}]}], "valid": [...]}) when present under `data_root`;
+clients are formed by grouping dialogs on their persona description, matching
+the reference's client = persona construction.  Without the file (no network
+here) a deterministic synthetic corpus with the same persona-grouped shape is
+generated.
+
+Sequences are packed to a fixed `seq_len` ("persona | history | reply" for
+the real data), labels = tokens with padding masked to -100.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..utils.tokenizer import get_tokenizer, pack_sequence
+from .fed_dataset import FedDataset
+
+
+class FedTextDataset(FedDataset):
+    """FedDataset over packed token sequences: x = input_ids [N, T],
+    y = labels [N, T] (-100 = ignore). Batches are LM-shaped dicts."""
+
+    def client_batch(self, rng, client_ids, batch_size, local_iters: int = 1):
+        W, L, n = len(client_ids), local_iters, batch_size
+        T = self.x.shape[1]
+        ids = np.zeros((W, L, n, T), dtype=np.int32)
+        labels = np.full((W, L, n, T), -100, dtype=np.int32)
+        for wi, cid in enumerate(client_ids):
+            shard = self.client_indices[int(cid)]
+            for li in range(L):
+                k = min(len(shard), n)
+                take = rng.choice(shard, size=k, replace=False)
+                ids[wi, li, :k] = self.x[take]
+                labels[wi, li, :k] = self.y[take]
+        if L == 1:
+            return {"input_ids": ids[:, 0], "labels": labels[:, 0]}
+        return {"input_ids": ids, "labels": labels}
+
+    def eval_batches(self, batch_size):
+        n = len(self.x)
+        T = self.x.shape[1]
+        for start in range(0, n, batch_size):
+            end = min(start + batch_size, n)
+            k = end - start
+            ids = np.zeros((batch_size, T), dtype=np.int32)
+            labels = np.full((batch_size, T), -100, dtype=np.int32)
+            ids[:k] = self.x[start:end]
+            labels[:k] = self.y[start:end]
+            yield {"input_ids": ids, "labels": labels}
+
+
+def _find_personachat_json(root: str) -> str | None:
+    for name in ("personachat_self_original.json", "personachat.json"):
+        for cand in (os.path.join(root, name), os.path.join(root, "personachat", name)):
+            if os.path.exists(cand):
+                return cand
+    return None
+
+
+def _from_json(path: str, tok, seq_len: int):
+    with open(path) as f:
+        blob = json.load(f)
+
+    def build(split):
+        by_persona: dict[str, list[tuple[np.ndarray, np.ndarray]]] = {}
+        for dialog in split:
+            persona = " ".join(dialog["personality"])
+            seqs = by_persona.setdefault(persona, [])
+            for utt in dialog["utterances"]:
+                history = " ".join(utt["history"][-3:])
+                reply = utt["candidates"][-1]  # convention: last = gold reply
+                ids = (
+                    tok.encode(persona)[: seq_len // 3]
+                    + tok.encode(" " + history)[: seq_len // 3]
+                    + tok.encode(" " + reply)
+                )
+                seqs.append(pack_sequence(ids + [tok.eos_id], seq_len, tok.pad_id))
+        return by_persona
+
+    return build(blob["train"]), build(blob.get("valid", []))
+
+
+def _synthetic(num_clients: int, seq_len: int, tok, seed: int):
+    """Persona-grouped synthetic corpus: each persona has a char-distribution
+    'style' so per-client data is non-iid, as in the real set."""
+    rng = np.random.RandomState(seed)
+    words = ["the", "cat", "dog", "runs", "jumps", "likes", "hates", "sees",
+             "red", "blue", "big", "small", "fast", "slow", "happy", "sad"]
+    by_persona = {}
+    for c in range(num_clients):
+        favored = rng.choice(len(words), size=6, replace=False)
+        seqs = []
+        for _ in range(rng.randint(4, 12)):
+            n_words = rng.randint(8, seq_len // 4)
+            text = " ".join(words[favored[rng.randint(6)]] if rng.rand() < 0.7
+                            else words[rng.randint(len(words))] for _ in range(n_words))
+            seqs.append(pack_sequence(tok.encode(text) + [tok.eos_id], seq_len, tok.pad_id))
+        by_persona[f"persona_{c}"] = seqs
+    # valid split: last sequence of every 10th persona
+    valid = {p: [s[-1]] for i, (p, s) in enumerate(by_persona.items()) if i % 10 == 0}
+    return by_persona, valid
+
+
+def _to_fed(by_persona: dict) -> FedTextDataset:
+    xs, ys, shards = [], [], []
+    offset = 0
+    for seqs in by_persona.values():
+        for x, y in seqs:
+            xs.append(x)
+            ys.append(y)
+        shards.append(np.arange(offset, offset + len(seqs)))
+        offset += len(seqs)
+    return FedTextDataset(np.stack(xs), np.stack(ys), shards)
+
+
+def load_personachat_fed(
+    data_root: str = "./data",
+    num_clients: int = 1000,
+    seq_len: int = 256,
+    seed: int = 0,
+):
+    """Returns (train FedTextDataset, valid FedTextDataset, tokenizer)."""
+    tok = get_tokenizer()
+    path = _find_personachat_json(data_root)
+    if path:
+        train_p, valid_p = _from_json(path, tok, seq_len)
+    else:
+        train_p, valid_p = _synthetic(num_clients, seq_len, tok, seed)
+    valid = valid_p if valid_p else {k: v for k, v in list(train_p.items())[:10]}
+    return _to_fed(train_p), _to_fed(valid), tok

@@ -1,0 +1,127 @@
+"""GPT-2 in flax (SURVEY.md L0b: the reference wraps HuggingFace's torch
+GPT-2-small for PersonaChat federated fine-tuning; here the model is native
+flax so the whole client step stays inside one XLA program).
+
+TPU-first choices:
+- einsum attention with a static causal mask, optionally computed in bfloat16
+  (`dtype`) with float32 params and logits;
+- optional per-block rematerialisation (`remat`) to trade FLOPs for HBM;
+- weights laid out Megatron-style so `parallel.tp.gpt2_partition_specs` can
+  shard attention heads / MLP hidden over a 'model' mesh axis;
+- optional ring attention (`attn_impl="ring"`) for sequence lengths beyond a
+  single chip's HBM — see ops/ring_attention.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.1
+    dtype: str = "float32"  # compute dtype for activations ("bfloat16" on TPU)
+    remat: bool = False
+    attn_impl: str = "dense"  # "dense" | "ring" (ring needs a 'seq' mesh axis)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def compute_dtype(self):
+        return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+
+
+TINY = GPT2Config(vocab_size=512, n_positions=128, n_embd=64, n_layer=2, n_head=2, dropout=0.0)
+SMALL = GPT2Config()  # GPT-2 small: 124M params, the reference's NLP model
+
+
+class Attention(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.cfg
+        B, T, C = x.shape
+        qkv = nn.Dense(3 * C, dtype=cfg.compute_dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, T, cfg.n_head, cfg.head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if cfg.attn_impl == "ring":
+            from ..ops.ring_attention import ring_attention
+
+            y = ring_attention(q, k, v, causal=True)
+        else:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, dtype=q.dtype))
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            att = jnp.where(mask[None, None], att, jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
+            att = nn.Dropout(cfg.dropout, deterministic=not train)(att)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+        y = y.reshape(B, T, C)
+        y = nn.Dense(C, dtype=cfg.compute_dtype, name="c_proj")(y)
+        return nn.Dropout(cfg.dropout, deterministic=not train)(y)
+
+
+class MLP(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.cfg
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.compute_dtype, name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.compute_dtype, name="c_proj")(h)
+        return nn.Dropout(cfg.dropout, deterministic=not train)(h)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = x + Attention(self.cfg, name="attn")(nn.LayerNorm(name="ln_1")(x), train)
+        x = x + MLP(self.cfg, name="mlp")(nn.LayerNorm(name="ln_2")(x), train)
+        return x
+
+
+class GPT2LMHead(nn.Module):
+    """Causal LM with tied input/output embeddings (as GPT-2)."""
+
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = True):
+        cfg = self.cfg
+        B, T = input_ids.shape
+        wte = self.param(
+            "wte", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.n_embd), jnp.float32
+        )
+        wpe = self.param(
+            "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd), jnp.float32
+        )
+        x = wte[input_ids] + wpe[:T][None]
+        x = x.astype(cfg.compute_dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h_{i}")(x, train)
+        x = nn.LayerNorm(name="ln_f")(x)
+        # tied LM head; logits in float32 for a stable softmax
+        return jnp.einsum("btc,vc->btv", x.astype(jnp.float32), wte)

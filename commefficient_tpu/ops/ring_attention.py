@@ -1,0 +1,112 @@
+"""Ring attention: causal attention with the sequence axis sharded over the
+device mesh, K/V blocks rotating over ICI via `ppermute`.
+
+Long-context support is absent from the reference (SURVEY.md §5 "Long-context
+/ sequence parallelism: absent" — PersonaChat fits in GPT-2's window); it is
+first-class here so the GPT-2 path scales past one chip's HBM.  Design is the
+standard blockwise/flash online-softmax accumulation: each device keeps its
+query block and a running (max, sum, acc) triple; at every ring step it
+attends its queries against the visiting K/V block, then passes that block to
+the next device.  All control flow is a `lax.scan` over ring steps — one
+compiled program, no dynamic shapes; communication is `ppermute` neighbor
+exchange, which XLA schedules on ICI concurrently with the block matmuls.
+
+Layout contract: q, k, v are [B, T, H, D] with T sharded over the mesh axis
+(`seq`); the output has the same layout.  `ring_attention` wraps itself in
+`shard_map` using the mesh installed via `use_ring_mesh` (or runs a plain
+masked-softmax fallback when no mesh is installed, so the same model code
+works single-chip).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+_RING: dict = {"mesh": None, "axis": "seq"}
+
+
+@contextlib.contextmanager
+def use_ring_mesh(mesh: Optional[Mesh], axis: str = "seq"):
+    """Install the mesh/axis that `ring_attention` shard_maps over."""
+    prev = dict(_RING)
+    _RING.update(mesh=mesh, axis=axis)
+    try:
+        yield
+    finally:
+        _RING.update(prev)
+
+
+def _dense_causal(q, k, v):
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=q.dtype))
+    att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    T = q.shape[1]
+    mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+    att = jnp.where(mask[None, None], att, _NEG)
+    att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", att, v)
+
+
+def _ring_local(q, k, v, *, axis: str, ring_size: int):
+    """Body run under shard_map: local blocks [B, Tl, H, D]."""
+    B, Tl, H, D = q.shape
+    my = jax.lax.axis_index(axis)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, dtype=jnp.float32))
+    qf = q.astype(jnp.float32) * scale
+    q_pos = my * Tl + jnp.arange(Tl)
+
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+    def step(carry, s):
+        k_blk, v_blk, m, l, acc = carry
+        src = (my - s) % ring_size  # whose K/V block we hold this step
+        k_pos = src * Tl + jnp.arange(Tl)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # causal, global ids
+        scores = jnp.where(mask, scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None]) * mask
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        k_nxt = jax.lax.ppermute(k_blk, axis, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    # the accumulators become device-varying inside the scan (axis_index use),
+    # so mark the initial values varying over the ring axis up front
+    varying = lambda x: jax.lax.pcast(x, (axis,), to="varying")
+    m0 = varying(jnp.full((B, H, Tl), _NEG, dtype=jnp.float32))
+    l0 = varying(jnp.zeros((B, H, Tl), dtype=jnp.float32))
+    acc0 = varying(jnp.zeros((B, H, Tl, D), dtype=jnp.float32))
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(ring_size)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, Tl, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # -> [B, Tl, H, D]
+
+
+def ring_attention(q, k, v, causal: bool = True):
+    """Causal attention over a seq-sharded [B, T, H, D]; see module docstring.
+
+    With no ring mesh installed this is a plain (flash-style numerics) causal
+    attention — the single-chip path of the same model code.
+    """
+    if not causal:
+        raise NotImplementedError("ring_attention is causal-only (LM path)")
+    mesh, axis = _RING["mesh"], _RING["axis"]
+    if mesh is None:
+        return _dense_causal(q, k, v)
+    ring_size = mesh.shape[axis]
+    body = functools.partial(_ring_local, axis=axis, ring_size=ring_size)
+    spec = P(None, axis, None, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)

@@ -1,0 +1,41 @@
+"""Tensor-parallel parameter layouts for the GPT-2 path.
+
+Megatron-style sharding over the 'model' mesh axis: attention QKV and MLP
+up-projection split column-wise, their output projections row-wise, so each
+block needs one reduction (which XLA inserts from the shardings) per
+sub-layer.  Embeddings, layer norms, and biases of row-parallel layers stay
+replicated.  The reference has no model parallelism at all (SURVEY.md §2
+"Parallelism strategies present": data-parallel client simulation only);
+this is native capability the TPU rebuild adds for the 124M-param GPT-2.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import MODEL_AXIS
+
+
+def gpt2_partition_specs(params) -> dict:
+    """PartitionSpec pytree matching a GPT2LMHead params tree."""
+
+    def spec_for(path, leaf):
+        keys = [getattr(p, "key", str(p)) for p in path]
+        name = "/".join(keys)
+        if "c_attn" in name or "c_fc" in name:
+            # column-parallel: kernel [in, out] -> out sharded; bias [out]
+            return P(None, MODEL_AXIS) if leaf.ndim == 2 else P(MODEL_AXIS)
+        if "c_proj" in name:
+            # row-parallel: kernel [in, out] -> in sharded; bias replicated
+            return P(MODEL_AXIS, None) if leaf.ndim == 2 else P()
+        return P()  # embeddings, layer norms
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_params(mesh: Mesh, params, specs=None):
+    specs = specs if specs is not None else gpt2_partition_specs(params)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)), params, specs
+    )

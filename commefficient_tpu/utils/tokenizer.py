@@ -1,0 +1,64 @@
+"""Tokenization for the NLP path.
+
+The reference tokenizes PersonaChat with HuggingFace's GPT-2 BPE (SURVEY.md
+§2 "Fed datasets": transfer-learning-conv-ai lineage).  This environment has
+no network, so we use the cached HF tokenizer when present and otherwise a
+byte-level fallback with the same interface — every pipeline stage
+(persona grouping, packing, masking, PPL eval) is exercised identically;
+only the subword inventory differs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: 256 byte values + bos/eos/pad specials."""
+
+    def __init__(self):
+        self.bos_id = 256
+        self.eos_id = 257
+        self.pad_id = 258
+        self.vocab_size = 259
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8", errors="replace"))
+
+    def decode(self, ids) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    def __init__(self, tok):
+        self.tok = tok
+        self.bos_id = tok.bos_token_id
+        self.eos_id = tok.eos_token_id
+        self.pad_id = tok.eos_token_id  # GPT-2 has no pad token
+        self.vocab_size = int(tok.vocab_size)
+
+    def encode(self, text: str) -> list[int]:
+        return self.tok.encode(text)
+
+    def decode(self, ids) -> str:
+        return self.tok.decode(list(ids))
+
+
+def get_tokenizer():
+    try:
+        from transformers import GPT2TokenizerFast
+
+        return HFTokenizer(GPT2TokenizerFast.from_pretrained("gpt2", local_files_only=True))
+    except Exception:
+        return ByteTokenizer()
+
+
+def pack_sequence(ids: list[int], seq_len: int, pad_id: int) -> tuple[np.ndarray, np.ndarray]:
+    """(input_ids[T], labels[T]) — labels are input_ids with pad masked to
+    -100 (ignored by the LM loss)."""
+    ids = ids[:seq_len]
+    x = np.full(seq_len, pad_id, dtype=np.int32)
+    y = np.full(seq_len, -100, dtype=np.int32)
+    x[: len(ids)] = ids
+    y[: len(ids)] = ids
+    return x, y

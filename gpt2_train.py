@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""GPT-2 PersonaChat federated fine-tuning CLI (SURVEY.md L6 / §3.2:
+reference `gpt2_train.py` — same skeleton as cv_train with the FedPersona
+dataset, GPT-2 LM loss, and validation NLL -> PPL).
+
+Example (paper config #4):
+    python gpt2_train.py --mode sketch --num_clients 17500 --num_workers 4 \
+        --k 50000 --num_cols 1000000 --num_rows 5 --num_blocks 20
+Smoke test:
+    python gpt2_train.py --model_size tiny --num_clients 50 --num_workers 4 \
+        --num_rounds 10 --mode uncompressed
+Tensor parallel (2-D mesh: clients x model):
+    python gpt2_train.py --model_size small --model_parallel 4 ...
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from commefficient_tpu.data.personachat import load_personachat_fed
+from commefficient_tpu.federated.api import FederatedSession, FedModel, FedOptimizer
+from commefficient_tpu.models.gpt2 import SMALL, TINY, GPT2Config, GPT2LMHead
+from commefficient_tpu.models.losses import make_lm_loss
+from commefficient_tpu.parallel import mesh as meshlib, tp
+from commefficient_tpu.utils import checkpoint as ckpt
+from commefficient_tpu.utils.config import make_parser, mode_config_from_args, resolve_defaults
+from commefficient_tpu.utils.logging import TableLogger, Timer
+from commefficient_tpu.utils.schedules import triangular
+
+
+def build(args):
+    train_set, valid_set, tok = load_personachat_fed(
+        args.data_root, args.num_clients, args.seq_len, args.seed
+    )
+    args.num_clients = train_set.num_clients
+    base = TINY if args.model_size == "tiny" else SMALL
+    cfg = dataclasses.replace(
+        base, vocab_size=tok.vocab_size, n_positions=max(args.seq_len, 1)
+    )
+    model = GPT2LMHead(cfg)
+    ids0 = jnp.zeros((1, args.seq_len), dtype=jnp.int32)
+    params = model.init(jax.random.PRNGKey(args.seed), ids0, train=False)["params"]
+    d = ravel_pytree(params)[0].size
+    print(f"model: GPT2({args.model_size})  d={d:,}  vocab={cfg.vocab_size}  "
+          f"clients={train_set.num_clients}  mode={args.mode}", flush=True)
+
+    mesh = None
+    if args.model_parallel > 1:
+        mesh = meshlib.make_mesh(args.num_devices or None, model_parallel=args.model_parallel)
+        params = tp.shard_params(mesh, params)
+    elif jax.device_count() > 1:
+        mesh = meshlib.make_mesh(args.num_devices or None)
+
+    mode_cfg = mode_config_from_args(args, d)
+    session = FederatedSession(
+        train_loss_fn=make_lm_loss(model, train=True),
+        eval_loss_fn=make_lm_loss(model, train=False),
+        params=params,
+        net_state={},
+        mode_cfg=mode_cfg,
+        train_set=train_set,
+        num_workers=args.num_workers,
+        local_batch_size=args.local_batch_size,
+        weight_decay=args.weight_decay,
+        seed=args.seed,
+        mesh=mesh,
+    )
+    return session, valid_set
+
+
+def main(argv=None):
+    args = resolve_defaults(make_parser("gpt2").parse_args(argv))
+    session, valid_set = build(args)
+
+    rounds_per_epoch = max(1, math.ceil(args.num_clients / session.num_workers))
+    total_rounds = args.num_rounds or int(args.num_epochs * rounds_per_epoch)
+    opt = FedOptimizer(triangular(args.lr_scale, args.pivot_epoch, args.num_epochs),
+                       rounds_per_epoch)
+    model = FedModel(session)
+
+    if args.resume and args.checkpoint_dir:
+        path = ckpt.latest(args.checkpoint_dir)
+        if path:
+            ckpt.restore(path, session)
+            opt._round = session.round
+            print(f"resumed from {path} at round {session.round}", flush=True)
+
+    logger = TableLogger(args.log_jsonl or None)
+    timer = Timer()
+    eval_every = args.eval_every or min(rounds_per_epoch, 200)
+    acc_loss = acc_count = 0.0
+    for rnd in range(session.round, total_rounds):
+        m = model(opt.lr)
+        opt.step()
+        acc_loss += m["loss_sum"]
+        acc_count += m["count"]
+        if args.checkpoint_every and args.checkpoint_dir and (rnd + 1) % args.checkpoint_every == 0:
+            ckpt.save(args.checkpoint_dir, session)
+        if (rnd + 1) % eval_every == 0 or rnd + 1 == total_rounds:
+            ev = model.eval(valid_set, args.eval_batch_size)
+            train_nll = acc_loss / max(acc_count, 1)
+            val_nll = ev["loss_sum"] / max(ev["count"], 1)
+            logger.append({
+                "round": rnd + 1,
+                "epoch": (rnd + 1) / rounds_per_epoch,
+                "lr": m["lr"],
+                "train_nll": train_nll,
+                "train_ppl": math.exp(min(train_nll, 20)),
+                "val_nll": val_nll,
+                "val_ppl": math.exp(min(val_nll, 20)),
+                "time_s": timer(),
+            })
+            acc_loss = acc_count = 0.0
+
+    if args.checkpoint_dir:
+        ckpt.save(args.checkpoint_dir, session)
+    return session
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
