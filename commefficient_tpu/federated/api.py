@@ -45,6 +45,15 @@ class FederatedSession:
         self.train_set = train_set
         self.num_workers = min(num_workers, train_set.num_clients)
         self.local_batch_size = local_batch_size
+        if mesh is not None and self.num_workers % mesh.shape[meshlib.CLIENT_AXIS] != 0:
+            # the sampled-client axis must split evenly over the mesh; fall
+            # back to single-device execution rather than failing mid-run
+            print(
+                f"warning: num_workers={self.num_workers} not divisible by "
+                f"{mesh.shape[meshlib.CLIENT_AXIS]}-way client mesh; running unsharded",
+                flush=True,
+            )
+            mesh = None
         self.mesh = mesh
         self.rng = np.random.RandomState(seed)
         self._rng_key = jax.random.PRNGKey(seed)
