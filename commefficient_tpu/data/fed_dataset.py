@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import native
+
 
 class FedDataset:
     """Global (x, y) arrays + per-client index shards.
@@ -22,11 +24,15 @@ class FedDataset:
     """
 
     def __init__(self, x: np.ndarray, y: np.ndarray, client_indices: list[np.ndarray]):
-        self.x = x
-        self.y = y
+        self.x = np.ascontiguousarray(x)
+        self.y = np.ascontiguousarray(y)
         self.client_indices = [np.asarray(ix, dtype=np.int64) for ix in client_indices]
         if any(len(ix) == 0 for ix in self.client_indices):
             raise ValueError("every client needs at least one example")
+        # CSR view of the shards for the native batch-assembly runtime
+        self.shard_flat = np.concatenate(self.client_indices).astype(np.int64)
+        self.shard_off = np.zeros(len(self.client_indices) + 1, dtype=np.int64)
+        np.cumsum([len(ix) for ix in self.client_indices], out=self.shard_off[1:])
 
     @property
     def num_clients(self) -> int:
@@ -50,24 +56,15 @@ class FedDataset:
         extra [local_iters] axis after W when local_iters > 1 (fedavg/localSGD
         microbatches, each drawn with replacement from the client shard).
         """
-        W = len(client_ids)
-        L = local_iters
-        n = batch_size
+        W, L, n = len(client_ids), local_iters, batch_size
         xs = np.zeros((W, L, n) + self.x.shape[1:], dtype=self.x.dtype)
-        ys = np.zeros((W, L, n), dtype=self.y.dtype)
+        ys = np.zeros((W, L, n) + self.y.shape[1:], dtype=self.y.dtype)
         mask = np.zeros((W, L, n), dtype=np.float32)
-        for wi, cid in enumerate(client_ids):
-            shard = self.client_indices[int(cid)]
-            for li in range(L):
-                if len(shard) >= n:
-                    take = rng.choice(shard, size=n, replace=False)
-                    k = n
-                else:
-                    take = shard
-                    k = len(shard)
-                xs[wi, li, :k] = self.x[take]
-                ys[wi, li, :k] = self.y[take]
-                mask[wi, li, :k] = 1.0
+        native.assemble_rows(
+            self.x, self.y, self.shard_flat, self.shard_off,
+            np.asarray(client_ids), L, n, int(rng.randint(1 << 62)),
+            xs, ys, mask,
+        )
         if L == 1:
             return {"x": xs[:, 0], "y": ys[:, 0], "mask": mask[:, 0]}
         return {"x": xs, "y": ys, "mask": mask}

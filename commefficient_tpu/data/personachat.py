@@ -29,17 +29,16 @@ class FedTextDataset(FedDataset):
     y = labels [N, T] (-100 = ignore). Batches are LM-shaped dicts."""
 
     def client_batch(self, rng, client_ids, batch_size, local_iters: int = 1):
+        from .. import native
+
         W, L, n = len(client_ids), local_iters, batch_size
         T = self.x.shape[1]
         ids = np.zeros((W, L, n, T), dtype=np.int32)
-        labels = np.full((W, L, n, T), -100, dtype=np.int32)
-        for wi, cid in enumerate(client_ids):
-            shard = self.client_indices[int(cid)]
-            for li in range(L):
-                k = min(len(shard), n)
-                take = rng.choice(shard, size=k, replace=False)
-                ids[wi, li, :k] = self.x[take]
-                labels[wi, li, :k] = self.y[take]
+        labels = np.full((W, L, n, T), -100, dtype=np.int32)  # pad rows ignored
+        native.assemble_rows(
+            self.x, self.y, self.shard_flat, self.shard_off,
+            np.asarray(client_ids), L, n, int(rng.randint(1 << 62)), ids, labels, None,
+        )
         if L == 1:
             return {"input_ids": ids[:, 0], "labels": labels[:, 0]}
         return {"input_ids": ids, "labels": labels}

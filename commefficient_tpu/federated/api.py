@@ -23,6 +23,7 @@ from ..data.fed_dataset import FedDataset
 from ..modes import modes
 from ..modes.config import ModeConfig
 from ..parallel import mesh as meshlib
+from ..utils.comm import round_comm_mb
 from . import engine
 
 
@@ -70,6 +71,8 @@ class FederatedSession:
                 donate_argnums=(0,),
             )
         self.round = 0
+        # analytic wire-cost of one round (SURVEY.md §6 row 4 accounting)
+        self.comm_per_round = round_comm_mb(mode_cfg, self.num_workers)
 
     # -- one federated round -------------------------------------------------
     def run_round(self, lr: float) -> dict:
@@ -88,6 +91,7 @@ class FederatedSession:
         self.round += 1
         m = jax.tree.map(float, jax.device_get(metrics))
         m["lr"] = float(lr)
+        m.update(self.comm_per_round)
         return m
 
     # -- evaluation (SURVEY.md §3.4: forward-only, no compression) -----------

@@ -1,0 +1,107 @@
+"""ctypes bindings for the native batch-assembly runtime.
+
+Builds `libbatch_assembly.so` with g++ on first use (cached next to this
+file); every entry point has a pure-numpy fallback so the framework works
+without a toolchain. See batch_assembly.cpp for the contract.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "batch_assembly.cpp")
+_SO = os.path.join(_DIR, "libbatch_assembly.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                     _SRC, "-o", _SO],
+                    check=True, capture_output=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.assemble_rows.restype = None
+            lib.assemble_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64,  # x, x_item_bytes
+                ctypes.c_void_p, ctypes.c_uint64,  # y, y_item_bytes
+                ctypes.c_void_p, ctypes.c_void_p,  # shard_flat, shard_off
+                ctypes.c_void_p,                    # client_ids
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # W, L, B
+                ctypes.c_uint64,                    # seed
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,  # out x/y/mask
+            ]
+            _lib = lib
+        except Exception as e:  # no toolchain / compile error -> numpy fallback
+            print(f"native batch assembly unavailable ({type(e).__name__}); "
+                  f"using numpy fallback", flush=True)
+            _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def assemble_rows(
+    x: np.ndarray,
+    y: np.ndarray,
+    shard_flat: np.ndarray,
+    shard_off: np.ndarray,
+    client_ids: np.ndarray,
+    local_iters: int,
+    batch_size: int,
+    seed: int,
+    out_x: np.ndarray,
+    out_y: np.ndarray,
+    out_mask: np.ndarray | None,
+) -> None:
+    """Fill pre-initialised [W, L, B, ...] buffers with sampled client rows.
+
+    Buffers must already hold padding values; rows beyond a client's shard
+    size are left untouched (and the mask stays 0 there).
+    """
+    W = len(client_ids)
+    lib = _load()
+    x = np.ascontiguousarray(x)
+    y = np.ascontiguousarray(y)
+    if lib is not None:
+        lib.assemble_rows(
+            x.ctypes.data, x.nbytes // max(len(x), 1),
+            y.ctypes.data, y.nbytes // max(len(y), 1),
+            np.ascontiguousarray(shard_flat, dtype=np.int64).ctypes.data,
+            np.ascontiguousarray(shard_off, dtype=np.int64).ctypes.data,
+            np.ascontiguousarray(client_ids, dtype=np.int64).ctypes.data,
+            W, local_iters, batch_size, seed & 0xFFFFFFFFFFFFFFFF,
+            out_x.ctypes.data, out_y.ctypes.data,
+            out_mask.ctypes.data if out_mask is not None else None,
+        )
+        return
+    # numpy fallback with identical output semantics (different RNG stream)
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    for wi, cid in enumerate(client_ids):
+        shard = shard_flat[shard_off[cid]: shard_off[cid + 1]]
+        for li in range(local_iters):
+            k = min(len(shard), batch_size)
+            take = shard[:k] if len(shard) <= batch_size else rng.choice(
+                shard, size=k, replace=False)
+            out_x[wi, li, :k] = x[take]
+            out_y[wi, li, :k] = y[take]
+            if out_mask is not None:
+                out_mask[wi, li, :k] = 1.0

@@ -1,0 +1,106 @@
+// Native host-side federated batch assembly.
+//
+// The reference's data hot path is Python: per sampled client, index into the
+// global arrays and copy into a per-round buffer (SURVEY.md §3.1: "split
+// batch -> per-client work items -> queues").  Here the whole per-round
+// gather/pad/mask loop is C++: given the client shards in CSR form and the
+// sampled client ids, sample a without-replacement batch per (client, local
+// iter) and memcpy rows into the fixed-shape output buffers, multithreaded
+// over clients.  The Python wrapper (native/__init__.py) falls back to a
+// numpy implementation with identical output semantics when the shared
+// library is unavailable.
+//
+// RNG: splitmix64 per (client slot, local iter), seeded from the round seed —
+// deterministic given (seed, client_ids), independent of thread scheduling.
+// Sampling: Floyd's algorithm (k distinct of n), O(k) memory.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct SplitMix64 {
+  uint64_t s;
+  explicit SplitMix64(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+  // uniform in [0, n) without modulo bias (n << 2^64 so rejection is rare)
+  uint64_t below(uint64_t n) {
+    uint64_t x, r;
+    do {
+      x = next();
+      r = x % n;
+    } while (x - r > UINT64_MAX - (n - 1));
+    return r;
+  }
+};
+
+// Floyd's sampling: k distinct values from [0, n)
+void sample_distinct(SplitMix64& rng, int64_t n, int64_t k, std::vector<int64_t>& out) {
+  out.clear();
+  std::unordered_set<int64_t> seen;
+  for (int64_t j = n - k; j < n; ++j) {
+    int64_t t = static_cast<int64_t>(rng.below(static_cast<uint64_t>(j + 1)));
+    if (seen.count(t)) t = j;
+    seen.insert(t);
+    out.push_back(t);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out_x: [W, L, B, x_item_bytes], out_y: [W, L, B, y_item_bytes],
+// out_mask: [W, L, B] float32 or nullptr. Buffers must be pre-filled with
+// the caller's padding values; only sampled rows are overwritten.
+void assemble_rows(const uint8_t* x, uint64_t x_item_bytes,
+                   const uint8_t* y, uint64_t y_item_bytes,
+                   const int64_t* shard_flat, const int64_t* shard_off,
+                   const int64_t* client_ids, int64_t W, int64_t L, int64_t B,
+                   uint64_t seed,
+                   uint8_t* out_x, uint8_t* out_y, float* out_mask) {
+  int64_t n_threads =
+      std::min<int64_t>(W, std::max(1u, std::thread::hardware_concurrency()));
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int64_t t = 0; t < n_threads; ++t) {
+    threads.emplace_back([=]() {
+      std::vector<int64_t> picks;
+      for (int64_t w = t; w < W; w += n_threads) {
+        const int64_t cid = client_ids[w];
+        const int64_t* shard = shard_flat + shard_off[cid];
+        const int64_t n = shard_off[cid + 1] - shard_off[cid];
+        for (int64_t l = 0; l < L; ++l) {
+          SplitMix64 rng(seed ^ (0x9E3779B97F4A7C15ULL * static_cast<uint64_t>(w * L + l + 1)));
+          const int64_t k = n < B ? n : B;
+          const int64_t slot = (w * L + l) * B;
+          if (n <= B) {
+            picks.resize(static_cast<size_t>(n));
+            for (int64_t i = 0; i < n; ++i) picks[static_cast<size_t>(i)] = i;
+          } else {
+            sample_distinct(rng, n, k, picks);
+          }
+          for (int64_t i = 0; i < k; ++i) {
+            const int64_t src = shard[picks[static_cast<size_t>(i)]];
+            std::memcpy(out_x + static_cast<uint64_t>(slot + i) * x_item_bytes,
+                        x + static_cast<uint64_t>(src) * x_item_bytes, x_item_bytes);
+            std::memcpy(out_y + static_cast<uint64_t>(slot + i) * y_item_bytes,
+                        y + static_cast<uint64_t>(src) * y_item_bytes, y_item_bytes);
+            if (out_mask) out_mask[slot + i] = 1.0f;
+          }
+        }
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

@@ -97,13 +97,14 @@ def main(argv=None):
     logger = TableLogger(args.log_jsonl or None)
     timer = Timer()
     eval_every = args.eval_every or rounds_per_epoch
-    acc_loss = acc_count = acc_correct = 0.0
+    acc_loss = acc_count = acc_correct = comm_mb = 0.0
     for rnd in range(session.round, total_rounds):
         m = model(opt.lr)
         opt.step()
         acc_loss += m["loss_sum"]
         acc_count += m["count"]
         acc_correct += m["correct"]
+        comm_mb += m["comm_total_mb"]
         if args.checkpoint_every and args.checkpoint_dir and (rnd + 1) % args.checkpoint_every == 0:
             ckpt.save(args.checkpoint_dir, session)
         if (rnd + 1) % eval_every == 0 or rnd + 1 == total_rounds:
@@ -116,6 +117,7 @@ def main(argv=None):
                 "train_acc": acc_correct / max(acc_count, 1),
                 "test_loss": ev["loss_sum"] / max(ev["count"], 1),
                 "test_acc": ev["correct"] / max(ev["count"], 1),
+                "comm_mb": comm_mb,
                 "time_s": timer(),
             })
             acc_loss = acc_count = acc_correct = 0.0

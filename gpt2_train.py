@@ -94,12 +94,13 @@ def main(argv=None):
     logger = TableLogger(args.log_jsonl or None)
     timer = Timer()
     eval_every = args.eval_every or min(rounds_per_epoch, 200)
-    acc_loss = acc_count = 0.0
+    acc_loss = acc_count = comm_mb = 0.0
     for rnd in range(session.round, total_rounds):
         m = model(opt.lr)
         opt.step()
         acc_loss += m["loss_sum"]
         acc_count += m["count"]
+        comm_mb += m["comm_total_mb"]
         if args.checkpoint_every and args.checkpoint_dir and (rnd + 1) % args.checkpoint_every == 0:
             ckpt.save(args.checkpoint_dir, session)
         if (rnd + 1) % eval_every == 0 or rnd + 1 == total_rounds:
@@ -114,6 +115,7 @@ def main(argv=None):
                 "train_ppl": math.exp(min(train_nll, 20)),
                 "val_nll": val_nll,
                 "val_ppl": math.exp(min(val_nll, 20)),
+                "comm_mb": comm_mb,
                 "time_s": timer(),
             })
             acc_loss = acc_count = 0.0

@@ -1,0 +1,28 @@
+"""Communication-accounting sanity: sketch beats dense at paper dims."""
+
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.utils.comm import compression_ratio, round_comm_mb
+
+
+def test_sketch_compresses_at_paper_dims():
+    # CIFAR ResNet-9: d=6.5M, sketch 5 x 500k, k=50k -> up 10MB vs dense 26MB
+    cfg = ModeConfig(mode="sketch", d=6_500_000, k=50_000, num_rows=5,
+                     num_cols=500_000, momentum_type="virtual", error_type="virtual")
+    assert compression_ratio(cfg, num_workers=100) > 2.0
+    mb = round_comm_mb(cfg, 100)
+    assert mb["comm_up_mb"] == 100 * 5 * 500_000 * 4 / 1e6
+    assert mb["comm_down_mb"] == 100 * 50_000 * 8 / 1e6
+
+
+def test_local_topk_cheap_up_dense_down_bounded():
+    cfg = ModeConfig(mode="local_topk", d=1_000_000, k=1000,
+                     momentum_type="none", error_type="local", num_clients=10)
+    mb = round_comm_mb(cfg, 10)
+    assert mb["comm_up_mb"] < mb["comm_down_mb"] <= 10 * 10 * 1000 * 8 / 1e6
+
+
+def test_uncompressed_is_dense_both_ways():
+    cfg = ModeConfig(mode="uncompressed", d=1000, momentum_type="none", error_type="none")
+    mb = round_comm_mb(cfg, 4)
+    assert mb["comm_up_mb"] == mb["comm_down_mb"] == 4 * 4000 / 1e6
+    assert abs(compression_ratio(cfg, 4) - 1.0) < 1e-9
