@@ -41,8 +41,12 @@ class FederatedSession:
         weight_decay: float = 0.0,
         seed: int = 0,
         mesh=None,
+        dp_clip: float = 0.0,
+        dp_noise: float = 0.0,
     ):
-        self.cfg = engine.EngineConfig(mode=mode_cfg, weight_decay=weight_decay)
+        self.cfg = engine.EngineConfig(
+            mode=mode_cfg, weight_decay=weight_decay, dp_clip=dp_clip, dp_noise=dp_noise
+        )
         self.train_set = train_set
         self.num_workers = min(num_workers, train_set.num_clients)
         self.local_batch_size = local_batch_size

@@ -38,9 +38,46 @@ class EngineConfig:
     mode: ModeConfig
     weight_decay: float = 0.0  # applied to the gradient client-side, as in the
     # reference workers (SURVEY.md §3.1 hot loop)
+    # Differential privacy (SURVEY.md §0.5 / §2 "fork deltas": upstream grew
+    # per-update clipping + Gaussian noise). dp_clip > 0 clips each client's
+    # update to L2 norm ≤ dp_clip before aggregation; dp_noise > 0 is the
+    # central-DP noise multiplier — N(0, (dp_noise·dp_clip/W)²) is added to
+    # the aggregated wire (dense vector or sketch table, i.e. the object that
+    # would be transmitted), where W is the number of sampled clients.
+    dp_clip: float = 0.0
+    dp_noise: float = 0.0
+
+    def __post_init__(self):
+        if self.dp_noise > 0 and self.dp_clip <= 0:
+            raise ValueError("dp_noise > 0 requires dp_clip > 0 (unbounded "
+                             "sensitivity has no meaningful noise scale)")
+        if self.dp_noise > 0 and self.mode.needs_local_state:
+            raise ValueError(
+                "dp_noise with client-local error/momentum state is unsound: the "
+                "transmitted wire is topk(error_accumulator + update), whose norm "
+                "is unbounded across rounds, so dp_clip does not bound sensitivity. "
+                "Use error_type=none/virtual, or a mode without local state."
+            )
+        if self.dp_noise > 0 and self.mode.mode == "sketch":
+            raise ValueError(
+                "dp_noise with mode=sketch is unsound: a count-sketch table's "
+                "worst-case L2 sensitivity under an L2 clip is l1-scale (an "
+                "adversarial update aligned with the public hash can pile its "
+                "mass into one bucket per row), so dp_clip-calibrated Gaussian "
+                "noise on the table under-delivers the configured privacy. Use "
+                "a dense-wire mode (uncompressed/true_topk/fedavg/localSGD) or "
+                "local_topk without local state."
+            )
 
 
 def init_server_state(cfg: EngineConfig, params: Any, net_state: Any) -> dict:
+    if cfg.dp_noise > 0 and jax.tree.leaves(net_state):
+        raise ValueError(
+            "dp_noise with mutable model collections (e.g. BatchNorm batch_stats) "
+            "is unsound: per-client statistics are averaged into the released "
+            "model without clipping or noise, bypassing the DP mechanism. Use a "
+            "normalization-free or GroupNorm model for DP runs."
+        )
     return {
         "params": params,
         "net_state": net_state,
@@ -110,6 +147,15 @@ def make_round_step(
                 lambda cb, r: grad_client(params, pflat, net_state, cb, r)
             )(batch, client_rngs)
 
+        if cfg.dp_clip > 0:
+            # per-client L2 clip; nonlinear, so it must happen before the
+            # client mean — the linear-mode shortcut below stays exact.
+            def clip(u):
+                nrm = jnp.linalg.norm(u)
+                return u * jnp.minimum(1.0, cfg.dp_clip / jnp.maximum(nrm, 1e-12))
+
+            updates = jax.vmap(clip)(updates)
+
         if modes.is_linear(mcfg) and not mcfg.needs_local_state:
             # sketching/averaging commute (linearity) — compress once on the
             # client mean instead of per client. Exactly equal, much cheaper.
@@ -121,6 +167,18 @@ def make_round_step(
                 updates, client_rows
             )
             agg = modes.aggregate(mcfg, wires)
+
+        if cfg.dp_noise > 0:
+            # central DP: noise the aggregated dense wire. Mean aggregation
+            # over W L2-clipped updates has L2 sensitivity dp_clip/W. (Sketch
+            # tables are rejected in EngineConfig — their worst-case
+            # sensitivity under an L2 clip is l1-scale, not dp_clip.)
+            nkey = jax.random.fold_in(rng, 0x0D9)
+            std = jnp.float32(cfg.dp_noise * cfg.dp_clip / num_sampled)
+            agg = {
+                k: v + std * jax.random.normal(jax.random.fold_in(nkey, i), v.shape, v.dtype)
+                for i, (k, v) in enumerate(sorted(agg.items()))
+            }
 
         server_lr = jnp.float32(1.0) if mcfg.uses_weight_delta else lr
         delta, mode_state = modes.server_step(mcfg, agg, state["mode_state"], server_lr)

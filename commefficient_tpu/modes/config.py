@@ -27,6 +27,8 @@ class ModeConfig:
     error_type: str = "virtual"  # none | virtual | local
     num_local_iters: int = 1  # fedavg / localSGD local steps
     num_clients: int = 0  # total virtual clients (for local state allocation)
+    hash_family: str = "rotation"  # sketch bucket-hash family (see CSVecSpec);
+    # "rotation" is the TPU-fast default, "random" the reference-like one
 
     def __post_init__(self):
         if self.mode not in MODES:
@@ -65,7 +67,8 @@ class ModeConfig:
         from ..sketch import CSVecSpec
 
         return CSVecSpec(
-            d=self.d, c=self.num_cols, r=self.num_rows, num_blocks=self.num_blocks, seed=self.seed
+            d=self.d, c=self.num_cols, r=self.num_rows, num_blocks=self.num_blocks,
+            seed=self.seed, family=self.hash_family,
         )
 
     @property

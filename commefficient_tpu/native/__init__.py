@@ -82,12 +82,17 @@ def assemble_rows(
     x = np.ascontiguousarray(x)
     y = np.ascontiguousarray(y)
     if lib is not None:
+        # bind converted arrays to locals so any conversion temporaries stay
+        # alive across the foreign call (.ctypes.data alone keeps no reference)
+        shard_flat = np.ascontiguousarray(shard_flat, dtype=np.int64)
+        shard_off = np.ascontiguousarray(shard_off, dtype=np.int64)
+        client_ids = np.ascontiguousarray(client_ids, dtype=np.int64)
         lib.assemble_rows(
             x.ctypes.data, x.nbytes // max(len(x), 1),
             y.ctypes.data, y.nbytes // max(len(y), 1),
-            np.ascontiguousarray(shard_flat, dtype=np.int64).ctypes.data,
-            np.ascontiguousarray(shard_off, dtype=np.int64).ctypes.data,
-            np.ascontiguousarray(client_ids, dtype=np.int64).ctypes.data,
+            shard_flat.ctypes.data,
+            shard_off.ctypes.data,
+            client_ids.ctypes.data,
             W, local_iters, batch_size, seed & 0xFFFFFFFFFFFFFFFF,
             out_x.ctypes.data, out_y.ctypes.data,
             out_mask.ctypes.data if out_mask is not None else None,

@@ -31,22 +31,47 @@ import math
 import jax
 import jax.numpy as jnp
 
-from .hashing import bucket_hash, row_keys, sign_hash
+from .hashing import bucket_hash, row_keys, sign_hash, slab_shifts
+
+FAMILIES = ("random", "rotation")
 
 
 @dataclasses.dataclass(frozen=True)
 class CSVecSpec:
-    """Static configuration of a count-sketch. Hashable; safe to close over."""
+    """Static configuration of a count-sketch. Hashable; safe to close over.
+
+    `family` selects the bucket-hash family:
+
+    - "random" — murmur-mixed per-coordinate buckets, the closest analogue of
+      the reference CSVec's polynomial hashes. Accumulate/query are
+      scatter/gather, which TPUs execute serially — correct but slow.
+    - "rotation" — coordinate i of row j lands in bucket
+      (i mod c + shift[j, i // c]) mod c, with per-(row, slab) random shifts
+      (hashing.slab_shifts) and the same per-(row, coordinate) random signs.
+      Within a slab of c consecutive coordinates the bucket map is a pure
+      rotation, so dense accumulate/query are sign-multiply + roll + add —
+      all VPU-vectorizable, no scatter/gather anywhere. Estimates stay
+      unbiased (signs are independent across coordinates) and collision
+      behavior is at least as good as "random": intra-slab collisions are
+      impossible, cross-slab collision probability is exactly 1/c.
+
+    Both families share one generic (idx → buckets/signs) path for sparse
+    sketching and point queries, so the fast dense paths can be property-tested
+    against it.
+    """
 
     d: int  # dimensionality of the sketched vector
     c: int  # number of columns (buckets per row)
     r: int  # number of rows (independent hash functions)
     num_blocks: int = 1  # chunks the d-axis to bound transient memory
     seed: int = 42
+    family: str = "random"
 
     def __post_init__(self):
         if self.d <= 0 or self.c <= 0 or self.r <= 0 or self.num_blocks <= 0:
             raise ValueError(f"invalid CSVecSpec: {self}")
+        if self.family not in FAMILIES:
+            raise ValueError(f"unknown hash family {self.family!r}; expected {FAMILIES}")
 
     @property
     def block_size(self) -> int:
@@ -60,6 +85,11 @@ class CSVecSpec:
     def table_shape(self) -> tuple[int, int]:
         return (self.r, self.c)
 
+    @property
+    def num_slabs(self) -> int:
+        """c-sized slabs of the d-axis (rotation family's unit of structure)."""
+        return math.ceil(self.d / self.c)
+
 
 def zero_table(spec: CSVecSpec, dtype=jnp.float32) -> jnp.ndarray:
     return jnp.zeros(spec.table_shape, dtype=dtype)
@@ -68,9 +98,70 @@ def zero_table(spec: CSVecSpec, dtype=jnp.float32) -> jnp.ndarray:
 def _block_hashes(spec: CSVecSpec, idx: jnp.ndarray, dtype):
     """buckets[r, n], signs[r, n] for coordinate indices idx[n]."""
     kb, ks = row_keys(spec.seed, spec.r)
-    buckets = jax.vmap(lambda k: bucket_hash(idx, k, spec.c))(kb)
+    if spec.family == "rotation":
+        shifts = slab_shifts(spec.seed, spec.r, spec.num_slabs, spec.c)  # [r, S]
+        pos = (idx % spec.c).astype(jnp.int32)
+        slab = (idx // spec.c).astype(jnp.int32)
+        buckets = (pos[None, :] + shifts[:, slab]) % spec.c
+    else:
+        buckets = jax.vmap(lambda k: bucket_hash(idx, k, spec.c))(kb)
     signs = jax.vmap(lambda k: sign_hash(idx, k, dtype=dtype))(ks)
     return buckets, signs
+
+
+def _roll_right(x: jnp.ndarray, shift: jnp.ndarray) -> jnp.ndarray:
+    """out[(p + shift) mod c] = x[p] for a [c] vector and traced scalar shift.
+
+    Expressed as one contiguous dynamic_slice of [x ‖ x] so XLA lowers it to a
+    cheap windowed copy (and, vmapped over slabs, a batched contiguous gather)
+    instead of a random-access gather.
+    """
+    c = x.shape[0]
+    start = (c - shift.astype(jnp.int32)) % c
+    return jax.lax.dynamic_slice(jnp.concatenate([x, x]), (start,), (c,))
+
+
+def _roll_left(x: jnp.ndarray, shift: jnp.ndarray) -> jnp.ndarray:
+    """out[p] = x[(p + shift) mod c] — inverse of `_roll_right`."""
+    c = x.shape[0]
+    start = shift.astype(jnp.int32) % c
+    return jax.lax.dynamic_slice(jnp.concatenate([x, x]), (start,), (c,))
+
+
+def _pad_to_slabs(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
+    """[d] → [num_slabs, c], zero-padded."""
+    return jnp.pad(v, (0, spec.num_slabs * spec.c - spec.d)).reshape(spec.num_slabs, spec.c)
+
+
+def _sketch_vec_rotation(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
+    """Dense accumulate, rotation family: per row, sign the vector, roll each
+    slab by its shift, and add slabs — no scatter. O(r·d) VPU work."""
+    v_slabs = _pad_to_slabs(spec, v)  # zero-pad ⇒ padded coords contribute 0
+    idx = jnp.arange(spec.num_slabs * spec.c, dtype=jnp.int32)
+    _, ks = row_keys(spec.seed, spec.r)
+    shifts = slab_shifts(spec.seed, spec.r, spec.num_slabs, spec.c)  # [r, S]
+
+    def row_table(args):
+        k_sign, row_shifts = args
+        signed = v_slabs * sign_hash(idx, k_sign, dtype=v.dtype).reshape(v_slabs.shape)
+        return jax.vmap(_roll_right)(signed, row_shifts).sum(axis=0)
+
+    # sequential over the r rows (r is tiny) to bound transients to O(d)
+    return jax.lax.map(row_table, (ks, shifts))
+
+
+def _query_slab_rotation(spec: CSVecSpec, table: jnp.ndarray, slab: jnp.ndarray) -> jnp.ndarray:
+    """[c] estimates for slab `slab` (traced scalar): per row, unroll the table
+    row by the slab's shift and apply signs; then median over rows."""
+    _, ks = row_keys(spec.seed, spec.r)
+    shifts = slab_shifts(spec.seed, spec.r, spec.num_slabs, spec.c)  # [r, S]
+    idx = slab * spec.c + jnp.arange(spec.c, dtype=jnp.int32)
+
+    def row_est(tab_row, k_sign, s):
+        return sign_hash(idx, k_sign, dtype=table.dtype) * _roll_left(tab_row, s)
+
+    per_row = jax.vmap(row_est)(table, ks, shifts[:, slab])  # [r, c]
+    return jnp.sort(per_row, axis=0)[(spec.r - 1) // 2]
 
 
 def _accumulate(
@@ -96,6 +187,10 @@ def sketch_vec(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
     """Sketch a dense [d] vector into an [r, c] table (CSVec.accumulateVec)."""
     if v.shape != (spec.d,):
         raise ValueError(f"expected shape ({spec.d},), got {v.shape}")
+    if spec.family == "rotation":
+        # structural fast path (roll + add); num_blocks is irrelevant here —
+        # the slab size is pinned to c by the hash family itself.
+        return _sketch_vec_rotation(spec, v)
     if spec.num_blocks == 1:
         return _accumulate_block(spec, v, jnp.arange(spec.d, dtype=jnp.int32))
 
@@ -138,6 +233,10 @@ def query(spec: CSVecSpec, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
 def query_all(spec: CSVecSpec, table: jnp.ndarray) -> jnp.ndarray:
     """Dense [d] vector of estimates for every coordinate. O(r*d) transient
     memory when num_blocks == 1; scanned per block otherwise."""
+    if spec.family == "rotation":
+        slabs = jnp.arange(spec.num_slabs, dtype=jnp.int32)
+        ests = jax.lax.map(lambda b: _query_slab_rotation(spec, table, b), slabs)
+        return ests.reshape(-1)[: spec.d]
     if spec.num_blocks == 1:
         return query(spec, table, jnp.arange(spec.d, dtype=jnp.int32))
 
@@ -160,23 +259,35 @@ def unsketch_topk(spec: CSVecSpec, table: jnp.ndarray, k: int) -> tuple[jnp.ndar
     """
     if k > spec.d:
         raise ValueError(f"k={k} > d={spec.d}")
-    bs = spec.block_size
-    starts = jnp.arange(spec.num_blocks, dtype=jnp.int32) * bs
 
-    def body(carry, start):
+    if spec.family == "rotation":
+        # chunk = slab (the rotation family's structural unit)
+        chunks = jnp.arange(spec.num_slabs, dtype=jnp.int32)
+
+        def chunk_estimates(slab):
+            idx = slab * spec.c + jnp.arange(spec.c, dtype=jnp.int32)
+            return idx, _query_slab_rotation(spec, table, slab)
+
+    else:
+        chunks = jnp.arange(spec.num_blocks, dtype=jnp.int32) * spec.block_size
+
+        def chunk_estimates(start):
+            idx = start + jnp.arange(spec.block_size, dtype=jnp.int32)
+            return idx, query(spec, table, jnp.clip(idx, 0, spec.d - 1))
+
+    def body(carry, chunk):
         run_idx, run_vals = carry
-        idx = start + jnp.arange(bs, dtype=jnp.int32)
+        idx, est = chunk_estimates(chunk)
         valid = idx < spec.d
-        est = jnp.where(valid, query(spec, table, jnp.clip(idx, 0, spec.d - 1)), 0.0)
         cand_idx = jnp.concatenate([run_idx, idx])
-        cand_vals = jnp.concatenate([run_vals, est])
+        cand_vals = jnp.concatenate([run_vals, jnp.where(valid, est, 0.0)])
         cand_valid = jnp.concatenate([run_idx >= 0, valid])
         score = jnp.where(cand_valid, jnp.abs(cand_vals), -1.0)
         _, sel = jax.lax.top_k(score, k)
         return (cand_idx[sel], cand_vals[sel]), None
 
     init = (jnp.full((k,), -1, dtype=jnp.int32), jnp.zeros((k,), dtype=table.dtype))
-    (top_idx, top_vals), _ = jax.lax.scan(body, init, starts)
+    (top_idx, top_vals), _ = jax.lax.scan(body, init, chunks)
     # entries that never filled (k > #valid coords) keep idx -1 / val 0
     return top_idx, jnp.where(top_idx >= 0, top_vals, 0.0)
 

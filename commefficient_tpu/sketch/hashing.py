@@ -17,6 +17,7 @@ All arithmetic wraps mod 2**32, which XLA's uint32 ops do natively.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 # murmur3 fmix32 constants
@@ -55,6 +56,19 @@ def bucket_hash(idx: jnp.ndarray, bucket_key: jnp.ndarray, num_cols: int) -> jnp
     """Bucket in [0, num_cols) for coordinate indices `idx` (any int dtype)."""
     h = fmix32(idx.astype(jnp.uint32) ^ bucket_key)
     return (h % jnp.uint32(num_cols)).astype(jnp.int32)
+
+
+def slab_shifts(seed: int, num_rows: int, num_slabs: int, num_cols: int) -> jnp.ndarray:
+    """Per-(row, slab) rotation shifts in [0, num_cols) for the "rotation" hash
+    family: coordinate i lands in bucket (i mod c + shifts[row, i // c]) mod c.
+
+    Derived from the same per-row bucket keys as the "random" family (which
+    does not otherwise use them under this family), so one seed still rebuilds
+    every hash on every host/shard.
+    """
+    kb, _ = row_keys(seed, num_rows)
+    slabs = jnp.arange(num_slabs, dtype=jnp.uint32)
+    return jax.vmap(lambda k: bucket_hash(slabs, k, num_cols))(kb)
 
 
 def sign_hash(idx: jnp.ndarray, sign_key: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:

@@ -26,6 +26,9 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
     p.add_argument("--num_rows", type=int, default=5)
     p.add_argument("--num_cols", type=int, default=500000)
     p.add_argument("--num_blocks", type=int, default=1)
+    p.add_argument("--hash_family", default="rotation", choices=["rotation", "random"],
+                   help="sketch bucket-hash family: rotation = TPU-fast roll-based "
+                        "(default), random = reference-like per-coordinate hashing")
     # federation shape
     p.add_argument("--num_clients", type=int, default=100)
     p.add_argument("--num_workers", type=int, default=8,
@@ -38,6 +41,11 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
     p.add_argument("--lr_scale", type=float, default=0.4)
     p.add_argument("--pivot_epoch", type=float, default=5)
     p.add_argument("--weight_decay", type=float, default=5e-4)
+    # differential privacy (upstream fork deltas — SURVEY.md §0.5)
+    p.add_argument("--dp_clip", type=float, default=0.0,
+                   help="L2 clip per client update (0 = off)")
+    p.add_argument("--dp_noise", type=float, default=0.0,
+                   help="central-DP noise multiplier on the aggregate (needs --dp_clip)")
     # run plumbing
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible")
@@ -96,4 +104,5 @@ def mode_config_from_args(args: argparse.Namespace, d: int) -> ModeConfig:
         error_type=args.error_type,
         num_local_iters=args.num_local_iters if args.mode in ("fedavg", "localSGD") else 1,
         num_clients=args.num_clients,
+        hash_family=args.hash_family,
     )

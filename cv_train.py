@@ -70,6 +70,8 @@ def build(args):
         weight_decay=args.weight_decay,
         seed=args.seed,
         mesh=mesh,
+        dp_clip=args.dp_clip,
+        dp_noise=args.dp_noise,
     )
     return session, test_set
 
@@ -97,7 +99,9 @@ def main(argv=None):
     logger = TableLogger(args.log_jsonl or None)
     timer = Timer()
     eval_every = args.eval_every or rounds_per_epoch
-    acc_loss = acc_count = acc_correct = comm_mb = 0.0
+    acc_loss = acc_count = acc_correct = 0.0
+    # cumulative from round 0 — derived, so checkpoint resume stays consistent
+    comm_mb = session.round * session.comm_per_round["comm_total_mb"]
     for rnd in range(session.round, total_rounds):
         m = model(opt.lr)
         opt.step()

@@ -2,6 +2,8 @@
 linearity, seed-determinism, block-count invariance, heavy-hitter recovery,
 unbiasedness of single-coordinate estimates, sparse==dense sketching."""
 
+from dataclasses import replace as dataclasses_replace
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -113,6 +115,94 @@ def test_sparse_equals_dense():
 def test_to_dense_ignores_padding():
     dense = to_dense(10, jnp.array([-1, 2]), jnp.array([9.0, 1.0]))
     np.testing.assert_array_equal(np.asarray(dense), np.eye(10, dtype=np.float32)[2])
+
+
+# ------------------------------------------------------- rotation family
+
+ROT = CSVecSpec(d=5000, c=1000, r=5, seed=7, family="rotation")
+
+
+def test_rotation_fast_paths_match_generic():
+    """The roll-based dense accumulate/query must agree exactly with the
+    generic (idx → buckets/signs) path shared with sparse sketching."""
+    v = _randn(0, (ROT.d,))
+    all_idx = jnp.arange(ROT.d, dtype=jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(sketch_vec(ROT, v)),  # fast path
+        np.asarray(sketch_sparse(ROT, all_idx, v)),  # generic scatter path
+        rtol=1e-5,
+        atol=1e-5,
+    )
+    t = sketch_vec(ROT, v)
+    np.testing.assert_allclose(
+        np.asarray(query_all(ROT, t)),  # fast path
+        np.asarray(query(ROT, t, all_idx)),  # generic gather path
+        rtol=1e-6,
+        atol=1e-6,
+    )
+    i_fast, v_fast = unsketch_topk(ROT, t, 50)
+    est = np.asarray(query_all(ROT, t))
+    i_ref = np.argsort(-np.abs(est))[:50]
+    assert set(np.asarray(i_fast).tolist()) == set(i_ref.tolist())
+    np.testing.assert_allclose(np.sort(np.asarray(v_fast)), np.sort(est[i_ref]), rtol=1e-6)
+
+
+def test_rotation_linearity_and_determinism():
+    a = _randn(1, (ROT.d,))
+    b = _randn(2, (ROT.d,))
+    np.testing.assert_allclose(
+        sketch_vec(ROT, a) + sketch_vec(ROT, b), sketch_vec(ROT, a + b), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sketch_vec(ROT, a)), np.asarray(sketch_vec(CSVecSpec(**ROT.__dict__), a))
+    )
+    other = sketch_vec(dataclasses_replace(ROT, seed=8), a)
+    assert not np.allclose(np.asarray(sketch_vec(ROT, a)), np.asarray(other))
+
+
+def test_rotation_heavy_hitter_recovery():
+    d, k = 20000, 20
+    spec = CSVecSpec(d=d, c=4000, r=5, seed=11, family="rotation")
+    rng = np.random.RandomState(0)
+    v = rng.normal(0, 0.01, size=d).astype(np.float32)
+    heavy_idx = rng.choice(d, size=k, replace=False)
+    heavy_vals = rng.choice([-10.0, 10.0], size=k) * rng.uniform(1.0, 2.0, size=k)
+    v[heavy_idx] = heavy_vals
+    idx, vals = unsketch_topk(spec, sketch_vec(spec, jnp.asarray(v)), k)
+    assert set(np.asarray(idx).tolist()) == set(heavy_idx.tolist())
+    order = np.argsort(np.asarray(idx))
+    torder = np.argsort(heavy_idx)
+    np.testing.assert_allclose(
+        np.asarray(vals)[order], heavy_vals[torder].astype(np.float32), rtol=0.15, atol=0.3
+    )
+
+
+def test_rotation_unbiasedness():
+    d = 2000
+    v = np.zeros(d, dtype=np.float32)
+    v[123] = 5.0
+    v[777] = -3.0
+    rng = np.random.RandomState(1)
+    v += rng.normal(0, 0.5, size=d).astype(np.float32)
+    ests = []
+    for seed in range(30):
+        spec = CSVecSpec(d=d, c=500, r=5, seed=seed, family="rotation")
+        t = sketch_vec(spec, jnp.asarray(v))
+        ests.append(float(query(spec, t, jnp.array([123]))[0]))
+    assert abs(np.mean(ests) - float(v[123])) < 0.3
+
+
+def test_rotation_d_not_multiple_of_c():
+    """Partial last slab: padding must not contaminate sketches or top-k."""
+    spec = CSVecSpec(d=1234, c=500, r=3, seed=3, family="rotation")
+    v = _randn(5, (spec.d,))
+    t = sketch_vec(spec, v)
+    all_idx = jnp.arange(spec.d, dtype=jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(t), np.asarray(sketch_sparse(spec, all_idx, v)), rtol=1e-5, atol=1e-5
+    )
+    idx, vals = unsketch_topk(spec, t, 40)
+    assert np.all(np.asarray(idx) < spec.d) and np.all(np.asarray(idx) >= 0)
 
 
 def test_jit_and_vmap():

@@ -55,11 +55,11 @@ def _ucfg(**kw):
     return base
 
 
-def _make(cfg_kw, wd=0.0):
+def _make(cfg_kw, wd=0.0, **eng_kw):
     params = init_mlp(jax.random.PRNGKey(0))
     d = ravel_pytree(params)[0].size
     mcfg = ModeConfig(**{**cfg_kw, "d": d})
-    cfg = engine.EngineConfig(mode=mcfg, weight_decay=wd)
+    cfg = engine.EngineConfig(mode=mcfg, weight_decay=wd, **eng_kw)
     state = engine.init_server_state(cfg, params, {})
     step = jax.jit(engine.make_round_step(mlp_loss, cfg))
     return cfg, state, step
@@ -146,6 +146,95 @@ def test_sharded_equals_unsharded():
     got, _, _ = step(state2, sharded_batch, {}, lr, jax.random.PRNGKey(0))
     for a, b in zip(jax.tree.leaves(got["params"]), jax.tree.leaves(ref["params"])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------- differential privacy
+
+def _flat_delta(state_before, state_after):
+    a = ravel_pytree(state_before["params"])[0]
+    b = ravel_pytree(state_after["params"])[0]
+    return np.asarray(a - b)
+
+
+def test_dp_clip_bounds_update_norm():
+    """With a tiny clip, the server delta norm is ≤ lr·clip (uncompressed mode,
+    W clipped client updates averaged then scaled by lr)."""
+    data = _data(jax.random.PRNGKey(7), 32)
+    batch = jax.tree.map(lambda a: a.reshape((4, 8) + a.shape[1:]), data)
+    lr = 0.5
+    clip = 1e-3
+    cfg, state, step = _make(_ucfg(), dp_clip=clip)
+    new_state, _, _ = step(state, batch, {}, jnp.float32(lr), jax.random.PRNGKey(0))
+    delta = _flat_delta(state, new_state)
+    assert np.linalg.norm(delta) <= lr * clip * 1.001
+    # and with a huge clip the step matches the unclipped engine exactly
+    cfg2, state2, step2 = _make(_ucfg(), dp_clip=1e9)
+    cfg3, state3, step3 = _make(_ucfg())
+    s2, _, _ = step2(state2, batch, {}, jnp.float32(lr), jax.random.PRNGKey(0))
+    s3, _, _ = step3(state3, batch, {}, jnp.float32(lr), jax.random.PRNGKey(0))
+    np.testing.assert_allclose(
+        ravel_pytree(s2["params"])[0], ravel_pytree(s3["params"])[0], rtol=1e-6
+    )
+
+
+def test_dp_noise_perturbs_deterministically():
+    """Same rng ⇒ identical noised step; different rng ⇒ different params;
+    noise magnitude scales with the multiplier."""
+    data = _data(jax.random.PRNGKey(8), 16)
+    batch = jax.tree.map(lambda a: a.reshape((2, 8) + a.shape[1:]), data)
+    lr = jnp.float32(0.1)
+
+    def run(noise, key):
+        cfg, state, step = _make(_ucfg(), dp_clip=1.0, dp_noise=noise)
+        new_state, _, _ = step(state, batch, {}, lr, key)
+        return ravel_pytree(new_state["params"])[0]
+
+    p_a = run(0.5, jax.random.PRNGKey(0))
+    p_b = run(0.5, jax.random.PRNGKey(0))
+    p_c = run(0.5, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(p_a), np.asarray(p_b))
+    assert not np.allclose(np.asarray(p_a), np.asarray(p_c))
+    # true_topk's dense wire is also a sound noise surface
+    tcfg = dict(mode="true_topk", k=20, momentum_type="virtual", error_type="virtual")
+    cfg, state, step = _make(tcfg, dp_clip=1.0, dp_noise=0.1)
+    new_state, _, m = step(state, batch, {}, lr, jax.random.PRNGKey(0))
+    assert np.isfinite(_flat_delta(state, new_state)).all()
+
+
+def test_dp_noise_rejects_unsound_surfaces():
+    """Sketch tables (l1-scale worst-case sensitivity) and mutable model
+    collections (BN stats bypass the mechanism) must be rejected."""
+    with pytest.raises(ValueError):
+        _make(
+            dict(mode="sketch", k=20, num_rows=3, num_cols=100,
+                 momentum_type="virtual", error_type="virtual"),
+            dp_clip=1.0,
+            dp_noise=0.1,
+        )
+    params = init_mlp(jax.random.PRNGKey(0))
+    d = ravel_pytree(params)[0].size
+    cfg = engine.EngineConfig(
+        mode=ModeConfig(**_ucfg(d=d)), dp_clip=1.0, dp_noise=0.1
+    )
+    with pytest.raises(ValueError):
+        engine.init_server_state(cfg, params, {"batch_stats": {"m": jnp.zeros(3)}})
+
+
+def test_dp_noise_requires_clip():
+    with pytest.raises(ValueError):
+        _make(_ucfg(), dp_noise=1.0)
+
+
+def test_dp_noise_rejects_client_local_state():
+    """topk(error_accumulator + update) has unbounded norm across rounds, so
+    dp_clip cannot bound sensitivity — must be rejected, not silently unsound."""
+    with pytest.raises(ValueError):
+        _make(
+            dict(mode="local_topk", k=50, momentum_type="none", error_type="local",
+                 num_clients=4),
+            dp_clip=1.0,
+            dp_noise=0.5,
+        )
 
 
 @pytest.mark.parametrize(
