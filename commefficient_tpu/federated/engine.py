@@ -56,7 +56,8 @@ class EngineConfig:
                 "dp_noise with client-local error/momentum state is unsound: the "
                 "transmitted wire is topk(error_accumulator + update), whose norm "
                 "is unbounded across rounds, so dp_clip does not bound sensitivity. "
-                "Use error_type=none/virtual, or a mode without local state."
+                "Use local_topk with error_type=none and momentum_type=none/virtual, "
+                "or a mode without client-local state."
             )
         if self.dp_noise > 0 and self.mode.mode == "sketch":
             raise ValueError(

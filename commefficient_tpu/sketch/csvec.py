@@ -133,6 +133,19 @@ def _pad_to_slabs(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
     return jnp.pad(v, (0, spec.num_slabs * spec.c - spec.d)).reshape(spec.num_slabs, spec.c)
 
 
+def _use_pallas(spec: CSVecSpec) -> bool:
+    """Use the Pallas kernels on TPU-backed platforms for supported layouts.
+    COMMEFFICIENT_NO_PALLAS=1 forces the pure-JAX oracle (debugging)."""
+    import os
+
+    if os.environ.get("COMMEFFICIENT_NO_PALLAS"):
+        return False
+    from . import pallas_kernels
+
+    # "axon" is a tunnelled TPU platform (remote Pallas compile supported)
+    return pallas_kernels.supported(spec) and jax.default_backend() in ("tpu", "axon")
+
+
 def _sketch_vec_rotation(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
     """Dense accumulate, rotation family: per row, sign the vector, roll each
     slab by its shift, and add slabs — no scatter. O(r·d) VPU work."""
@@ -190,6 +203,10 @@ def sketch_vec(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
     if spec.family == "rotation":
         # structural fast path (roll + add); num_blocks is irrelevant here —
         # the slab size is pinned to c by the hash family itself.
+        if _use_pallas(spec):
+            from . import pallas_kernels
+
+            return pallas_kernels.sketch_vec(spec, v)
         return _sketch_vec_rotation(spec, v)
     if spec.num_blocks == 1:
         return _accumulate_block(spec, v, jnp.arange(spec.d, dtype=jnp.int32))
@@ -234,6 +251,10 @@ def query_all(spec: CSVecSpec, table: jnp.ndarray) -> jnp.ndarray:
     """Dense [d] vector of estimates for every coordinate. O(r*d) transient
     memory when num_blocks == 1; scanned per block otherwise."""
     if spec.family == "rotation":
+        if _use_pallas(spec):
+            from . import pallas_kernels
+
+            return pallas_kernels.query_all(spec, table)
         slabs = jnp.arange(spec.num_slabs, dtype=jnp.int32)
         ests = jax.lax.map(lambda b: _query_slab_rotation(spec, table, b), slabs)
         return ests.reshape(-1)[: spec.d]
@@ -263,6 +284,15 @@ def unsketch_topk(spec: CSVecSpec, table: jnp.ndarray, k: int) -> tuple[jnp.ndar
     if spec.family == "rotation":
         # chunk = slab (the rotation family's structural unit)
         chunks = jnp.arange(spec.num_slabs, dtype=jnp.int32)
+
+        if _use_pallas(spec):
+            # the kernel already materializes all d estimates, so the
+            # memory-bounding slab scan would only add work — one top_k.
+            from . import pallas_kernels
+
+            est = pallas_kernels.query_all(spec, table)
+            _, top_idx = jax.lax.top_k(jnp.abs(est), k)
+            return top_idx.astype(jnp.int32), est[top_idx]
 
         def chunk_estimates(slab):
             idx = slab * spec.c + jnp.arange(spec.c, dtype=jnp.int32)

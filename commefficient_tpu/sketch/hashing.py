@@ -20,9 +20,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-# murmur3 fmix32 constants
-_C1 = jnp.uint32(0x85EBCA6B)
-_C2 = jnp.uint32(0xC2B2AE35)
+# murmur3 fmix32 constants — kept as plain ints and cast in-trace, so these
+# functions stay usable inside Pallas kernels (module-level device arrays
+# would be "captured constants", which pallas_call rejects)
+_C1 = 0x85EBCA6B
+_C2 = 0xC2B2AE35
 # distinct stream constants for deriving per-row keys
 _BUCKET_STREAM = 0x9E3779B9  # golden-ratio odd constant
 _SIGN_STREAM = 0x7FEB352D
@@ -32,9 +34,9 @@ def fmix32(x: jnp.ndarray) -> jnp.ndarray:
     """murmur3 32-bit finaliser. Input/output uint32."""
     x = x.astype(jnp.uint32)
     x = x ^ (x >> 16)
-    x = x * _C1
+    x = x * jnp.uint32(_C1)
     x = x ^ (x >> 13)
-    x = x * _C2
+    x = x * jnp.uint32(_C2)
     x = x ^ (x >> 16)
     return x
 
@@ -48,7 +50,7 @@ def row_keys(seed: int, num_rows: int) -> tuple[jnp.ndarray, jnp.ndarray]:
     rows = jnp.arange(1, num_rows + 1, dtype=jnp.uint32)
     seed32 = jnp.uint32(seed & 0xFFFFFFFF)
     kb = fmix32(rows * jnp.uint32(_BUCKET_STREAM) ^ seed32)
-    ks = fmix32(rows * jnp.uint32(_SIGN_STREAM) ^ (seed32 * _C1 + jnp.uint32(1)))
+    ks = fmix32(rows * jnp.uint32(_SIGN_STREAM) ^ (seed32 * jnp.uint32(_C1) + jnp.uint32(1)))
     return kb, ks
 
 

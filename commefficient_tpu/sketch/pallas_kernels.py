@@ -1,0 +1,236 @@
+"""Pallas TPU kernels for the rotation-family count-sketch.
+
+These are the "accumulate / query" kernel pair SURVEY.md §3.5 / §7.1 targets
+(the reference's CSVec.accumulateVec / _findValues are pure-torch scatter and
+gather programs; here the rotation hash family makes both ops *structured*,
+and these kernels express that structure directly on the TPU memory system):
+
+- Every roll of a c-sized slab becomes ONE contiguous dynamic window into a
+  doubled copy of the source (``[x ‖ x]``), fetched HBM→VMEM with an async
+  copy whose start offset comes from the per-(row, slab) shift — no
+  scatter/gather at any granularity, no lane shuffles.
+- Bucket signs are recomputed inside the kernel from the integer seed with
+  the same murmur mixer as `hashing.py` (uint32 elementwise VPU ops), so no
+  [r, d] hash tensor ever exists in HBM.
+- The column axis is tiled, so VMEM use is O(r · col_tile) regardless of c.
+
+Layout requirements for this fast path (checked by `supported()`):
+`c % 128 == 0`.  Anything else — and any non-TPU backend, unless
+`interpret=True` — falls back to the pure-JAX oracle in `csvec.py`, which
+remains the correctness reference (`tests/test_pallas.py` pins the two
+together in interpreter mode).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .hashing import row_keys, sign_hash, slab_shifts
+
+# preferred column tile (lanes=128 × sublanes); 16K floats = 64 KiB
+COL_TILE = 16_384
+
+
+def supported(spec) -> bool:
+    """Whether the Pallas fast path can handle this spec's layout."""
+    return spec.family == "rotation" and spec.c % 128 == 0
+
+
+def _col_tile(c: int) -> int:
+    """Largest multiple of 128 that divides c and is ≤ COL_TILE (the tile must
+    divide c exactly; power-of-two-ish c gets the full 16K tile)."""
+    import math
+
+    return 128 * math.gcd(c // 128, COL_TILE // 128)
+
+
+def _sign_for(idx: jnp.ndarray, key: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Per-coordinate sign — hashing.sign_hash traced inside the kernel (pure
+    elementwise uint32 VPU ops), so kernel and oracle can never diverge."""
+    return sign_hash(idx, key, dtype=dtype)
+
+
+# --------------------------------------------------------------- accumulate
+
+
+def _accumulate_kernel(
+    # scalar prefetch
+    shifts_ref,  # [r, S] int32 (SMEM)
+    keys_ref,  # [r] uint32 sign keys (SMEM)
+    # inputs
+    v2_ref,  # [S, 2c] doubled vector slabs (HBM/ANY)
+    # outputs
+    out_ref,  # [1, ct_q, 128] VMEM block: (row j, col tile t) of the table
+    # scratch
+    buf_ref,  # [2, ct] VMEM double buffer (flat — DMA windows are 1-D)
+    sem,  # [2] DMA semaphores
+    *,
+    c: int,
+    num_slabs: int,
+    ct: int,
+):
+    j = pl.program_id(0)
+    t = pl.program_id(1)
+    ct_q = ct // 128
+    p0 = t * ct  # first column of this tile
+
+    def dma(slot, b):
+        # window of v slab b that lands on columns [p0, p0+ct) of row j after
+        # the roll-right by shifts[j, b]:   start = (p0 - shift) mod c
+        start = (p0 - shifts_ref[j, b]) % c
+        return pltpu.make_async_copy(
+            v2_ref.at[b, pl.ds(start, ct)],
+            buf_ref.at[slot],
+            sem.at[slot],
+        )
+
+    dma(0, 0).start()
+
+    def body(b, acc):
+        slot = jax.lax.rem(b, 2)
+
+        @pl.when(b + 1 < num_slabs)
+        def _():
+            dma(1 - slot, b + 1).start()
+
+        dma(slot, b).wait()
+        # sign of the ORIGINAL coordinate each window element came from:
+        # in-slab position = (start + offset) mod c, global idx = b*c + pos
+        start = (p0 - shifts_ref[j, b]) % c
+        off_q = jax.lax.broadcasted_iota(jnp.int32, (ct_q, 128), 0)
+        off_l = jax.lax.broadcasted_iota(jnp.int32, (ct_q, 128), 1)
+        pos = (start + off_q * 128 + off_l) % c
+        idx = b * c + pos
+        window = buf_ref[slot].reshape(ct_q, 128)
+        return acc + _sign_for(idx, keys_ref[j], window.dtype) * window
+
+    acc = jax.lax.fori_loop(
+        0, num_slabs, body, jnp.zeros((ct_q, 128), dtype=out_ref.dtype)
+    )
+    out_ref[0] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("d", "c", "r", "seed", "interpret"))
+def _accumulate_call(v, *, d, c, r, seed, interpret):
+    num_slabs = -(-d // c)
+    ct = _col_tile(c)
+    v_pad = jnp.pad(v, (0, num_slabs * c - d)).reshape(num_slabs, c)
+    v2 = jnp.concatenate([v_pad, v_pad], axis=1)  # doubled: rolls → windows
+    shifts = slab_shifts(seed, r, num_slabs, c).astype(jnp.int32)
+    _, ks = row_keys(seed, r)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(r, c // ct),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (1, ct // 128, 128), lambda j, t, *_: (j, t, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, ct), v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+
+    table = pl.pallas_call(
+        functools.partial(_accumulate_kernel, c=c, num_slabs=num_slabs, ct=ct),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, c // 128, 128), v.dtype),
+        interpret=interpret,
+    )(shifts, ks, v2)
+    return table.reshape(r, c)
+
+
+def sketch_vec(spec, v: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
+    """Pallas rotation-family CSVec.accumulateVec: [d] → [r, c] table."""
+    return _accumulate_call(
+        v, d=spec.d, c=spec.c, r=spec.r, seed=spec.seed, interpret=interpret
+    )
+
+
+# -------------------------------------------------------------------- query
+
+
+def _query_kernel(
+    shifts_ref,  # [r, S] int32
+    keys_ref,  # [r] uint32
+    tab2_ref,  # [r, 2c] doubled table rows (HBM/ANY)
+    out_ref,  # [1, ct_q, 128] block: (slab s, col tile t) of the estimates
+    rows_ref,  # [r, ct] VMEM scratch (flat — DMA windows are 1-D)
+    sem,  # [r] DMA semaphores
+    *,
+    c: int,
+    r: int,
+    ct: int,
+):
+    s = pl.program_id(0)
+    t = pl.program_id(1)
+    ct_q = ct // 128
+    p0 = t * ct
+
+    # estimate of in-slab position p, row j = sign(idx) · table[j, (p+shift) mod c]
+    # → a contiguous window of the doubled row starting at shift + p0
+    def dma(j):
+        return pltpu.make_async_copy(
+            tab2_ref.at[j, pl.ds(shifts_ref[j, s] + p0, ct)],
+            rows_ref.at[j],
+            sem.at[j],
+        )
+
+    for j in range(r):  # r is small and static
+        dma(j).start()
+
+    off_q = jax.lax.broadcasted_iota(jnp.int32, (ct_q, 128), 0)
+    off_l = jax.lax.broadcasted_iota(jnp.int32, (ct_q, 128), 1)
+    idx = s * c + p0 + off_q * 128 + off_l  # global coordinate of each element
+
+    per_row = []
+    for j in range(r):
+        dma(j).wait()
+        window = rows_ref[j].reshape(ct_q, 128)
+        per_row.append(_sign_for(idx, keys_ref[j], window.dtype) * window)
+
+    # lower median over the r per-row estimates (matches csvec.query)
+    out_ref[0] = jnp.sort(jnp.stack(per_row), axis=0)[(r - 1) // 2]
+
+
+@functools.partial(jax.jit, static_argnames=("d", "c", "r", "seed", "interpret"))
+def _query_call(table, *, d, c, r, seed, interpret):
+    num_slabs = -(-d // c)
+    ct = _col_tile(c)
+    tab2 = jnp.concatenate([table, table], axis=1)  # [r, 2c]
+    shifts = slab_shifts(seed, r, num_slabs, c).astype(jnp.int32)
+    _, ks = row_keys(seed, r)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(num_slabs, c // ct),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (1, ct // 128, 128), lambda s, t, *_: (s, t, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((r, ct), table.dtype),
+            pltpu.SemaphoreType.DMA((r,)),
+        ],
+    )
+
+    est = pl.pallas_call(
+        functools.partial(_query_kernel, c=c, r=r, ct=ct),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_slabs, c // 128, 128), table.dtype),
+        interpret=interpret,
+    )(shifts, ks, tab2)
+    return est.reshape(-1)[:d]
+
+
+def query_all(spec, table: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
+    """Pallas rotation-family CSVec._findValues over every coordinate."""
+    return _query_call(
+        table, d=spec.d, c=spec.c, r=spec.r, seed=spec.seed, interpret=interpret
+    )

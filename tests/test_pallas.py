@@ -1,0 +1,75 @@
+"""Pallas kernel tests (interpreter mode on the CPU mesh): the rotation-family
+accumulate/query kernels must match the pure-JAX oracle in csvec.py, which the
+property tests in test_csvec.py already pin to the generic hash path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.sketch import CSVecSpec, csvec
+from commefficient_tpu.sketch import pallas_kernels as pk
+
+# small enough for the interpreter, c % 128 == 0, d not a multiple of c
+SPEC = CSVecSpec(d=3000, c=1024, r=3, seed=13, family="rotation")
+
+
+def _v(key, d):
+    return jax.random.normal(jax.random.PRNGKey(key), (d,), jnp.float32)
+
+
+def test_supported_layouts():
+    assert pk.supported(SPEC)
+    assert not pk.supported(CSVecSpec(d=3000, c=1000, r=3, family="rotation"))
+    assert not pk.supported(CSVecSpec(d=3000, c=1024, r=3, family="random"))
+    # tile divides c exactly even for awkward c
+    for c in (1024, 1280, 2176, 16384, 524288):
+        assert c % pk._col_tile(c) == 0 and pk._col_tile(c) % 128 == 0
+
+
+def test_accumulate_matches_oracle():
+    v = _v(0, SPEC.d)
+    got = pk.sketch_vec(SPEC, v, interpret=True)
+    want = csvec.sketch_vec(SPEC, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_query_matches_oracle():
+    v = _v(1, SPEC.d)
+    table = csvec.sketch_vec(SPEC, v)
+    got = pk.query_all(SPEC, table, interpret=True)
+    want = csvec.query_all(SPEC, table)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+
+
+def test_single_slab_and_exact_multiple():
+    """d < c (one slab) and d == S*c (no padding) both round-trip."""
+    for d in (700, 2048):
+        spec = CSVecSpec(d=d, c=1024, r=3, seed=5, family="rotation")
+        v = _v(2, d)
+        np.testing.assert_allclose(
+            np.asarray(pk.sketch_vec(spec, v, interpret=True)),
+            np.asarray(csvec.sketch_vec(spec, v)),
+            rtol=1e-5,
+            atol=1e-5,
+        )
+        t = csvec.sketch_vec(spec, v)
+        np.testing.assert_allclose(
+            np.asarray(pk.query_all(spec, t, interpret=True)),
+            np.asarray(csvec.query_all(spec, t)),
+            rtol=1e-6,
+            atol=1e-6,
+        )
+
+
+def test_even_rows_lower_median():
+    """r even exercises the lower-median convention in the kernel's sort."""
+    spec = CSVecSpec(d=1500, c=256, r=4, seed=8, family="rotation")
+    v = _v(3, spec.d)
+    t = csvec.sketch_vec(spec, v)
+    np.testing.assert_allclose(
+        np.asarray(pk.query_all(spec, t, interpret=True)),
+        np.asarray(csvec.query_all(spec, t)),
+        rtol=1e-6,
+        atol=1e-6,
+    )
