@@ -41,9 +41,10 @@ class EngineConfig:
     # Differential privacy (SURVEY.md §0.5 / §2 "fork deltas": upstream grew
     # per-update clipping + Gaussian noise). dp_clip > 0 clips each client's
     # update to L2 norm ≤ dp_clip before aggregation; dp_noise > 0 is the
-    # central-DP noise multiplier — N(0, (dp_noise·dp_clip/W)²) is added to
-    # the aggregated wire (dense vector or sketch table, i.e. the object that
-    # would be transmitted), where W is the number of sampled clients.
+    # central-DP noise multiplier — N(0, (dp_noise·sens)²) is added to the
+    # aggregated wire (the object that would be transmitted), where the
+    # aggregate's L2 sensitivity `sens` is dp_clip/W for agg_op="mean" over W
+    # sampled clients and dp_clip for agg_op="sum".
     dp_clip: float = 0.0
     dp_noise: float = 0.0
 
@@ -137,7 +138,13 @@ def make_round_step(
         params, net_state = state["params"], state["net_state"]
         pflat, unravel = ravel_pytree(params)
         num_sampled = jax.tree.leaves(batch)[0].shape[0]
-        client_rngs = jax.random.split(rng, num_sampled)
+        # Dedicated streams: in JAX's threefry PRNG, fold_in(key, i) ==
+        # split(key, n)[i], so deriving the DP noise key by folding the same
+        # rng that client keys are split from would collide with client
+        # fold_in(rng, 0x0D9)=217's stream at large cohorts — voiding noise
+        # independence exactly when DP matters. Split first, then derive.
+        crng, noise_rng = jax.random.split(rng)
+        client_rngs = jax.random.split(crng, num_sampled)
 
         if mcfg.uses_weight_delta:
             updates, nstates, metrics = jax.vmap(
@@ -158,9 +165,10 @@ def make_round_step(
             updates = jax.vmap(clip)(updates)
 
         if modes.is_linear(mcfg) and not mcfg.needs_local_state:
-            # sketching/averaging commute (linearity) — compress once on the
-            # client mean instead of per client. Exactly equal, much cheaper.
-            agg, _ = modes.client_compress(mcfg, jnp.mean(updates, axis=0), {})
+            # sketching/reduction commute (linearity) — compress once on the
+            # reduced update instead of per client. Exactly equal, much cheaper.
+            reduce = jnp.sum if mcfg.agg_op == "sum" else jnp.mean
+            agg, _ = modes.client_compress(mcfg, reduce(updates, axis=0), {})
             agg = modes.aggregate(mcfg, jax.tree.map(lambda x: x[None], agg))
             new_rows = client_rows
         else:
@@ -170,14 +178,15 @@ def make_round_step(
             agg = modes.aggregate(mcfg, wires)
 
         if cfg.dp_noise > 0:
-            # central DP: noise the aggregated dense wire. Mean aggregation
-            # over W L2-clipped updates has L2 sensitivity dp_clip/W. (Sketch
-            # tables are rejected in EngineConfig — their worst-case
-            # sensitivity under an L2 clip is l1-scale, not dp_clip.)
-            nkey = jax.random.fold_in(rng, 0x0D9)
-            std = jnp.float32(cfg.dp_noise * cfg.dp_clip / num_sampled)
+            # central DP: noise the aggregated dense wire. Over W L2-clipped
+            # updates the aggregate's L2 sensitivity is dp_clip/W for mean
+            # aggregation and dp_clip for sum. (Sketch tables are rejected in
+            # EngineConfig — their worst-case sensitivity under an L2 clip is
+            # l1-scale, not dp_clip.)
+            sens = cfg.dp_clip if mcfg.agg_op == "sum" else cfg.dp_clip / num_sampled
+            std = jnp.float32(cfg.dp_noise * sens)
             agg = {
-                k: v + std * jax.random.normal(jax.random.fold_in(nkey, i), v.shape, v.dtype)
+                k: v + std * jax.random.normal(jax.random.fold_in(noise_rng, i), v.shape, v.dtype)
                 for i, (k, v) in enumerate(sorted(agg.items()))
             }
 

@@ -29,6 +29,20 @@ class ModeConfig:
     num_clients: int = 0  # total virtual clients (for local state allocation)
     hash_family: str = "rotation"  # sketch bucket-hash family (see CSVecSpec);
     # "rotation" is the TPU-fast default, "random" the reference-like one
+    agg_op: str = "mean"  # how client wires combine: "mean" | "sum".
+    # FetchSGD Alg. 1 writes the round sketch as a sum over client sketches
+    # (SURVEY.md §3.1) with the scaling absorbed into the learning rate; this
+    # library defaults to the mean (an unbiased gradient estimate independent
+    # of cohort size). The two are EXACTLY equivalent for every mode here:
+    # agg_op="sum" at lr η reproduces agg_op="mean" at lr η·W bit-for-bit
+    # (server steps are positively homogeneous: top-k selection is
+    # scale-invariant, everything else linear — tested in
+    # tests/test_modes.py::test_sum_vs_mean_lr_translation). When reproducing
+    # reference CLI hyperparameters (e.g. lr_scale 0.4), use agg_op="sum".
+    # Weight-delta modes (fedavg/localSGD) reject "sum": their lr is consumed
+    # inside the nonlinear local-SGD loop and the server applies the
+    # aggregate at unit rate, so no lr knob can absorb the factor W — a sum
+    # of W deltas would just be a W-times-too-large step.
 
     def __post_init__(self):
         if self.mode not in MODES:
@@ -41,12 +55,20 @@ class ModeConfig:
             raise ValueError(f"bad momentum_type {self.momentum_type!r}")
         if self.error_type not in ("none", "virtual", "local"):
             raise ValueError(f"bad error_type {self.error_type!r}")
+        if self.agg_op not in ("mean", "sum"):
+            raise ValueError(f"bad agg_op {self.agg_op!r}; expected 'mean' or 'sum'")
+        if self.agg_op == "sum" and self.mode in ("fedavg", "localSGD"):
+            raise ValueError(
+                f"mode={self.mode} requires agg_op='mean': the server applies the "
+                "aggregated weight delta at unit rate, so summing W deltas is a "
+                "W-times-too-large step with no lr knob to absorb it"
+            )
         # Reject combinations the mode library does not implement, rather than
         # silently running a different algorithm than the user configured.
         allowed = {
             "sketch": {"momentum": ("none", "virtual"), "error": ("virtual",)},
             "true_topk": {"momentum": ("none", "virtual"), "error": ("none", "virtual")},
-            "local_topk": {"momentum": ("none", "virtual", "local"), "error": ("none", "local")},
+            "local_topk": {"momentum": ("none", "virtual", "local"), "error": ("none", "local", "virtual")},
             "fedavg": {"momentum": ("none", "virtual"), "error": ("none",)},
             "localSGD": {"momentum": ("none", "virtual"), "error": ("none",)},
             "uncompressed": {"momentum": ("none", "virtual"), "error": ("none",)},

@@ -134,15 +134,18 @@ def client_compress(cfg: ModeConfig, update: jnp.ndarray, cstate: dict) -> tuple
 
 
 def aggregate(cfg: ModeConfig, wires: dict) -> dict:
-    """Mean over the W client wires (leading axis W). Sparse wires are
-    densified then averaged — in the simulator the sparse form exists for
-    faithful semantics + communication accounting, not for saving FLOPs."""
+    """Combine the W client wires (leading axis W) with cfg.agg_op (mean by
+    default; sum reproduces FetchSGD Alg. 1's Σ-of-sketches with the scaling
+    in the lr — see ModeConfig.agg_op). Sparse wires are densified then
+    reduced — in the simulator the sparse form exists for faithful semantics
+    + communication accounting, not for saving FLOPs."""
+    op = jnp.sum if cfg.agg_op == "sum" else jnp.mean
     if cfg.mode == "sketch":
-        return {"table": jnp.mean(wires["table"], axis=0)}
+        return {"table": op(wires["table"], axis=0)}
     if cfg.mode == "local_topk":
         dense = jax.vmap(lambda i, v: csvec.to_dense(cfg.d, i, v))(wires["idx"], wires["vals"])
-        return {"dense": jnp.mean(dense, axis=0)}
-    return {"dense": jnp.mean(wires["dense"], axis=0)}
+        return {"dense": op(dense, axis=0)}
+    return {"dense": op(wires["dense"], axis=0)}
 
 
 # ------------------------------------------------------------- server side
@@ -163,9 +166,14 @@ def server_step(
         E = sstate["Verror"] + lr * V
         idx, vals = csvec.unsketch_topk(spec, E, cfg.k)
         delta = csvec.to_dense(cfg.d, idx, vals)
-        sdelta = csvec.sketch_sparse(spec, idx, vals)
-        E = E - sdelta
-        V = V - sdelta  # momentum factor masking, sketch-space approximation
+        E = E - csvec.sketch_sparse(spec, idx, vals)
+        # Momentum factor masking, sketch-space: zero V's (estimated) mass at
+        # the transmitted coordinates — the sketch analogue of true_topk's
+        # V * (1 - mask). Subtracting V's own queried values (not lr-scaled
+        # delta) keeps units consistent, so agg_op sum/mean stay exactly
+        # lr-translatable (see ModeConfig.agg_op).
+        vvals = csvec.query(spec, V, idx)
+        V = V - csvec.sketch_sparse(spec, idx, vvals)
         return delta, {"Vvelocity": V, "Verror": E}
 
     g = agg["dense"]
@@ -184,9 +192,19 @@ def server_step(
         return delta, {"Vvelocity": V, "Verror": E}
 
     if cfg.mode == "local_topk":
-        # clients already applied top-k + local error feedback; server applies
-        # (optionally momentum'd) averaged sparse update scaled by lr.
+        # Clients already applied per-client top-k (and local momentum/error
+        # when configured). error_type="virtual" keeps ONE server-side error
+        # accumulator on the aggregated sparse update instead of a
+        # [num_clients, d] per-client residual — the FetchSGD paper's answer
+        # to the local-error memory wall (SURVEY.md §3.3): accumulate the
+        # aggregate into Verror, release its top-k, retain the rest.
         V = rho * sstate["Vvelocity"] + g
+        if cfg.error_type == "virtual":
+            E = sstate["Verror"] + lr * V
+            idx, vals = topk_dense(E, cfg.k)
+            delta = csvec.to_dense(cfg.d, idx, vals)
+            mask = csvec.to_dense(cfg.d, idx, jnp.ones((cfg.k,), dtype=V.dtype))
+            return delta, {"Vvelocity": V * (1.0 - mask), "Verror": E - delta}
         return lr * V, {"Vvelocity": V, "Verror": sstate["Verror"]}
 
     if cfg.mode in ("fedavg", "localSGD"):

@@ -29,6 +29,10 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
     p.add_argument("--hash_family", default="rotation", choices=["rotation", "random"],
                    help="sketch bucket-hash family: rotation = TPU-fast roll-based "
                         "(default), random = reference-like per-coordinate hashing")
+    p.add_argument("--agg_op", default="mean", choices=["mean", "sum"],
+                   help="client-wire aggregation: mean (cohort-size-independent "
+                        "default) or sum (FetchSGD Alg. 1 semantics — use with "
+                        "reference lr_scale values; sum@lr == mean@lr*W exactly)")
     # federation shape
     p.add_argument("--num_clients", type=int, default=100)
     p.add_argument("--num_workers", type=int, default=8,
@@ -105,4 +109,5 @@ def mode_config_from_args(args: argparse.Namespace, d: int) -> ModeConfig:
         num_local_iters=args.num_local_iters if args.mode in ("fedavg", "localSGD") else 1,
         num_clients=args.num_clients,
         hash_family=args.hash_family,
+        agg_op=args.agg_op,
     )

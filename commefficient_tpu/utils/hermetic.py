@@ -1,0 +1,40 @@
+"""Hermetic CPU-backend setup, shared by tests, bench, and driver entry points.
+
+This machine's sitecustomize registers a TPU-tunnel PJRT plugin ("axon") in
+every interpreter; its backend init can hang when the tunnel is down — even
+under JAX_PLATFORMS=cpu. Anything that must run hermetically on the host CPU
+(the forced-multi-device test mesh, the bench CPU fallback, dryrun_multichip)
+therefore strips that factory and pins the platform before any backend
+initialises. One helper so the plugin name / private-API touchpoint lives in
+exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_hermetic_cpu(n_devices: int | None = None) -> None:
+    """Pin this process's JAX to the CPU backend; optionally force an
+    n_devices virtual-device mesh (xla_force_host_platform_device_count).
+
+    Must run before the first JAX computation. Safe to call after `import
+    jax` as long as no backend has initialised yet (it sets the config
+    explicitly, not just the env, because jax may have latched JAX_PLATFORMS
+    from the ambient env at import time).
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        # append, don't setdefault: a pre-existing XLA_FLAGS must not
+        # silently drop the forced device count
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")

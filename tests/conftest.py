@@ -1,28 +1,12 @@
 """Test config: force an 8-device CPU mesh so multi-device sharding paths run
-without TPU hardware (SURVEY.md §4 "Distributed without a cluster")."""
+without TPU hardware (SURVEY.md §4 "Distributed without a cluster"). The
+hermetic dance (axon-plugin strip + platform pin) lives in
+commefficient_tpu.utils.hermetic, shared with bench.py and __graft_entry__."""
 
-import os
+from commefficient_tpu.utils.hermetic import force_hermetic_cpu
 
-# Must be set before jax initialises its backends. Append (don't setdefault):
-# a pre-existing XLA_FLAGS must not silently drop the forced 8-device mesh.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+force_hermetic_cpu(8)
 
 import jax  # noqa: E402
-
-# This machine's sitecustomize registers a TPU-tunnel PJRT plugin ("axon") in
-# every interpreter; its backend init can hang when the tunnel is down, even
-# under JAX_PLATFORMS=cpu. Tests must be hermetic on the CPU mesh, so drop the
-# factory before any backend is initialised.
-from jax._src import xla_bridge  # noqa: E402
-
-xla_bridge._backend_factories.pop("axon", None)
-
-# A pytest plugin may import jax before this conftest, in which case jax has
-# already latched JAX_PLATFORMS from the ambient env ("axon"); set the config
-# explicitly rather than relying on the env write above.
-jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_threefry_partitionable", True)

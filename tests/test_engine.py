@@ -201,6 +201,28 @@ def test_dp_noise_perturbs_deterministically():
     assert np.isfinite(_flat_delta(state, new_state)).all()
 
 
+def test_dp_noise_key_independent_of_client_keys():
+    """The DP noise stream must never coincide with any client's rng: in
+    threefry, fold_in(key, i) == split(key, n)[i], so deriving noise via
+    fold_in from the same rng the client keys are split from collides at
+    cohort sizes >= the folded constant (advisor finding, round 1). The
+    engine splits a dedicated stream first; mirror that derivation here and
+    assert no collision at a large cohort."""
+    rng = jax.random.PRNGKey(123)
+    num_sampled = 2048
+    crng, noise_rng = jax.random.split(rng)
+    client_keys = np.asarray(jax.random.split(crng, num_sampled))
+    noise_keys = np.asarray(
+        [jax.random.fold_in(noise_rng, i) for i in range(4)] + [noise_rng]
+    )
+    for nk in noise_keys:
+        assert not (client_keys == nk[None, :]).all(axis=1).any()
+    # and the old, broken derivation really does collide — the test's reason
+    old_nkey = np.asarray(jax.random.fold_in(rng, 0x0D9))
+    old_clients = np.asarray(jax.random.split(rng, num_sampled))
+    assert (old_clients == old_nkey[None, :]).all(axis=1).any()
+
+
 def test_dp_noise_rejects_unsound_surfaces():
     """Sketch tables (l1-scale worst-case sensitivity) and mutable model
     collections (BN stats bypass the mechanism) must be rejected."""

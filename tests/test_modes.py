@@ -24,6 +24,11 @@ def test_config_rejects_unimplemented_combos():
         _cfg(mode="true_topk", k=2, error_type="local")
     with pytest.raises(ValueError):
         _cfg(mode="bogus")
+    # sum aggregation of weight deltas has no lr knob to absorb the factor W
+    with pytest.raises(ValueError):
+        _cfg(mode="fedavg", agg_op="sum")
+    with pytest.raises(ValueError):
+        _cfg(agg_op="bogus")
 
 
 def test_uncompressed_is_sgd_with_momentum():
@@ -126,6 +131,66 @@ def test_sketch_linearity_client_mean_equals_per_client():
     assert modes.is_linear(cfg)
     assert not modes.is_linear(_cfg(mode="local_topk", k=1, d=4, momentum_type="none",
                                     error_type="local", num_clients=2))
+
+
+def test_local_topk_virtual_error_feedback_invariant():
+    """error_type=virtual: ONE server-side residual on the aggregated sparse
+    update (no [num_clients, d] state). sent + residual == accumulated."""
+    cfg = _cfg(mode="local_topk", k=2, d=16, momentum_type="none", error_type="virtual")
+    assert not cfg.needs_local_state  # the whole point of virtual error
+    sstate = modes.init_server_state(cfg)
+    rng = np.random.RandomState(3)
+    lr = 0.5
+    total_sent = np.zeros(16, np.float32)
+    total_agg = np.zeros(16, np.float32)
+    for _ in range(8):
+        gs = rng.normal(size=(3, 16)).astype(np.float32)  # 3 clients
+        wires = [modes.client_compress(cfg, jnp.asarray(g), {})[0] for g in gs]
+        agg = modes.aggregate(cfg, {
+            "idx": jnp.stack([w["idx"] for w in wires]),
+            "vals": jnp.stack([w["vals"] for w in wires]),
+        })
+        total_agg += lr * np.asarray(agg["dense"])
+        delta, sstate = modes.server_step(cfg, agg, sstate, jnp.float32(lr))
+        total_sent += np.asarray(delta)
+        assert np.count_nonzero(np.asarray(delta)) <= cfg.k
+    np.testing.assert_allclose(
+        total_sent + np.asarray(sstate["Verror"]), total_agg, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_sum_vs_mean_lr_translation():
+    """agg_op="sum" at lr η is bit-for-bit agg_op="mean" at lr η·W (ModeConfig
+    docs): server steps are positively homogeneous, so the documented lr
+    translation for reference (FetchSGD Alg. 1) hyperparameters is exact."""
+    W, lr = 4, 0.25
+    rng = np.random.RandomState(7)
+    for mode_kw in (
+        dict(mode="uncompressed", d=32, momentum_type="virtual", momentum=0.9,
+             error_type="none"),
+        dict(mode="true_topk", d=32, k=3, momentum_type="virtual", error_type="virtual"),
+        dict(mode="local_topk", d=32, k=3, momentum_type="none", error_type="virtual"),
+        dict(mode="sketch", d=64, k=4, num_rows=3, num_cols=32,
+             momentum_type="virtual", error_type="virtual"),
+    ):
+        cfg_mean = _cfg(**mode_kw, agg_op="mean")
+        cfg_sum = _cfg(**mode_kw, agg_op="sum")
+        st_mean = modes.init_server_state(cfg_mean)
+        st_sum = modes.init_server_state(cfg_sum)
+        for _ in range(5):
+            gs = rng.normal(size=(W, mode_kw["d"])).astype(np.float32)
+            wires = [modes.client_compress(cfg_mean, jnp.asarray(g), {})[0] for g in gs]
+            stacked = {k: jnp.stack([w[k] for w in wires]) for k in wires[0]}
+            d_mean, st_mean = modes.server_step(
+                cfg_mean, modes.aggregate(cfg_mean, stacked), st_mean, jnp.float32(lr * W)
+            )
+            d_sum, st_sum = modes.server_step(
+                cfg_sum, modes.aggregate(cfg_sum, stacked), st_sum, jnp.float32(lr)
+            )
+            np.testing.assert_allclose(
+                np.asarray(d_mean), np.asarray(d_sum), rtol=1e-5, atol=1e-6,
+                err_msg=f"mode={mode_kw['mode']}"
+            )
 
 
 def test_fedavg_server_average():
