@@ -9,8 +9,13 @@ the reference's client = persona construction.  Without the file (no network
 here) a deterministic synthetic corpus with the same persona-grouped shape is
 generated.
 
-Sequences are packed to a fixed `seq_len` ("persona | history | reply" for
-the real data), labels = tokens with padding masked to -100.
+Sequence packing follows the transfer-learning-conv-ai
+`build_input_from_segments` recipe the reference inherits (SURVEY.md §2 "Fed
+datasets", §3.2): `<bos> persona <speaker1/2> utt ... <speaker2> reply <eos>`
+with per-token speaker-type ids (embedded via wte — models/gpt2.py) and LM
+labels only on the reply tokens. Fixed `seq_len` is reached by dropping the
+oldest history utterances first, then truncating the persona, never the
+reply.
 """
 
 from __future__ import annotations
@@ -20,40 +25,129 @@ import os
 
 import numpy as np
 
-from ..utils.tokenizer import get_tokenizer, pack_sequence
+from ..utils.tokenizer import get_tokenizer
 from .fed_dataset import FedDataset
+
+MAX_HISTORY_UTTERANCES = 5  # last 2*max_history+1 with the lineage's default 2
+
+
+def build_input_from_segments(
+    persona: list[list[int]],
+    history: list[list[int]],
+    reply: list[int],
+    tok,
+    lm_labels: bool = True,
+    with_eos: bool = True,
+) -> dict:
+    """Pack one dialog example the transfer-learning-conv-ai way.
+
+    Segments: [<bos> + persona sentences] then each history utterance, then
+    the reply — every post-persona segment prefixed with its speaker token,
+    alternating so the reply (the model's own turn) is <speaker2> and the
+    persona (the model's self-description) is typed <speaker2> as well.
+    token_type_ids carry the segment's speaker id for every token; lm_labels
+    are -100 everywhere except the reply tokens (+ eos), so the LM loss
+    trains only the model's turn.
+
+    Returns {"input_ids", "token_type_ids", "lm_labels", "mc_token_ids"}
+    (mc_token_ids = index of the last token, for a next-utterance
+    classification head over candidates).
+    """
+    s1, s2 = tok.speaker1_id, tok.speaker2_id
+    persona_flat = [t for sent in persona for t in sent]
+    tail = list(history) + [list(reply) + ([tok.eos_id] if with_eos else [])]
+    n = len(tail)
+    # alternate backwards from the reply (= speaker2)
+    speakers = [s2 if (n - 1 - i) % 2 == 0 else s1 for i in range(n)]
+    segments = [[tok.bos_id] + persona_flat] + [
+        [spk] + seg for spk, seg in zip(speakers, tail)
+    ]
+    seg_types = [s2] + speakers  # persona typed as the responder's own turn
+    input_ids = [t for seg in segments for t in seg]
+    token_type_ids = [ty for seg, ty in zip(segments, seg_types) for _ in seg]
+    labels = [-100] * len(input_ids)
+    if lm_labels:
+        prefix = sum(len(seg) for seg in segments[:-1])
+        # reply speaker token masked; reply tokens + eos are the targets
+        labels = [-100] * (prefix + 1) + segments[-1][1:]
+    return {
+        "input_ids": input_ids,
+        "token_type_ids": token_type_ids,
+        "lm_labels": labels,
+        "mc_token_ids": len(input_ids) - 1,
+    }
+
+
+def pack_example(
+    persona: list[list[int]], history: list[list[int]], reply: list[int],
+    tok, seq_len: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(input_ids[T], token_type_ids[T], labels[T]) at exactly seq_len.
+
+    Overflow policy (documented above): drop oldest history utterance, then
+    truncate persona tokens from the end, then hard-truncate the tail."""
+    persona, history, reply = list(persona), list(history), list(reply)
+    inst = build_input_from_segments(persona, history, reply, tok)
+    while len(inst["input_ids"]) > seq_len and history:
+        history = history[1:]
+        inst = build_input_from_segments(persona, history, reply, tok)
+    if len(inst["input_ids"]) > seq_len:
+        overflow = len(inst["input_ids"]) - seq_len
+        persona_len = sum(len(s) for s in persona)
+        keep = max(0, persona_len - overflow)
+        flat = [t for s in persona for t in s][:keep]
+        inst = build_input_from_segments([flat], history, reply, tok)
+    x = np.full(seq_len, tok.pad_id, dtype=np.int32)
+    t = np.full(seq_len, tok.pad_id, dtype=np.int32)
+    y = np.full(seq_len, -100, dtype=np.int32)
+    ids = inst["input_ids"][:seq_len]
+    x[: len(ids)] = ids
+    t[: len(ids)] = inst["token_type_ids"][: seq_len]
+    y[: len(ids)] = inst["lm_labels"][: seq_len]
+    return x, t, y
 
 
 class FedTextDataset(FedDataset):
-    """FedDataset over packed token sequences: x = input_ids [N, T],
-    y = labels [N, T] (-100 = ignore). Batches are LM-shaped dicts."""
+    """FedDataset over packed dialog sequences. Stores input_ids and
+    token_type_ids column-concatenated ([N, 2T]) so the native batch-assembly
+    runtime moves both with one row copy; batches are LM-shaped dicts
+    {"input_ids", "token_type_ids", "labels"} (labels -100 = ignore)."""
+
+    def __init__(self, ids: np.ndarray, types: np.ndarray, labels: np.ndarray,
+                 client_indices: list[np.ndarray]):
+        self.seq_len = ids.shape[1]
+        super().__init__(
+            np.concatenate([ids, types], axis=1), labels, client_indices
+        )
 
     def client_batch(self, rng, client_ids, batch_size, local_iters: int = 1):
         from .. import native
 
         W, L, n = len(client_ids), local_iters, batch_size
-        T = self.x.shape[1]
-        ids = np.zeros((W, L, n, T), dtype=np.int32)
+        T = self.seq_len
+        xt = np.zeros((W, L, n, 2 * T), dtype=np.int32)
         labels = np.full((W, L, n, T), -100, dtype=np.int32)  # pad rows ignored
         native.assemble_rows(
             self.x, self.y, self.shard_flat, self.shard_off,
-            np.asarray(client_ids), L, n, int(rng.randint(1 << 62)), ids, labels, None,
+            np.asarray(client_ids), L, n, int(rng.randint(1 << 62)), xt, labels, None,
         )
+        batch = {"input_ids": xt[..., :T], "token_type_ids": xt[..., T:], "labels": labels}
         if L == 1:
-            return {"input_ids": ids[:, 0], "labels": labels[:, 0]}
-        return {"input_ids": ids, "labels": labels}
+            batch = {k: v[:, 0] for k, v in batch.items()}
+        return batch
 
     def eval_batches(self, batch_size):
         n = len(self.x)
-        T = self.x.shape[1]
+        T = self.seq_len
         for start in range(0, n, batch_size):
             end = min(start + batch_size, n)
             k = end - start
-            ids = np.zeros((batch_size, T), dtype=np.int32)
+            xt = np.zeros((batch_size, 2 * T), dtype=np.int32)
             labels = np.full((batch_size, T), -100, dtype=np.int32)
-            ids[:k] = self.x[start:end]
+            xt[:k] = self.x[start:end]
             labels[:k] = self.y[start:end]
-            yield {"input_ids": ids, "labels": labels}
+            yield {"input_ids": xt[:, :T], "token_type_ids": xt[:, T:],
+                   "labels": labels}
 
 
 def _find_personachat_json(root: str) -> str | None:
@@ -65,31 +159,32 @@ def _find_personachat_json(root: str) -> str | None:
 
 
 def _from_json(path: str, tok, seq_len: int):
+    """Parse the transfer-learning-conv-ai json into persona-grouped packed
+    examples. Gold reply = candidates[-1] (the lineage's convention; the
+    other candidates are next-utterance-classification distractors)."""
     with open(path) as f:
         blob = json.load(f)
 
     def build(split):
-        by_persona: dict[str, list[tuple[np.ndarray, np.ndarray]]] = {}
+        by_persona: dict[str, list[tuple[np.ndarray, np.ndarray, np.ndarray]]] = {}
         for dialog in split:
-            persona = " ".join(dialog["personality"])
-            seqs = by_persona.setdefault(persona, [])
+            persona_sents = [tok.encode(s) for s in dialog["personality"]]
+            key = " ".join(dialog["personality"])
+            seqs = by_persona.setdefault(key, [])
             for utt in dialog["utterances"]:
-                history = " ".join(utt["history"][-3:])
-                reply = utt["candidates"][-1]  # convention: last = gold reply
-                ids = (
-                    tok.encode(persona)[: seq_len // 3]
-                    + tok.encode(" " + history)[: seq_len // 3]
-                    + tok.encode(" " + reply)
-                )
-                seqs.append(pack_sequence(ids + [tok.eos_id], seq_len, tok.pad_id))
+                history = [tok.encode(h) for h in utt["history"][-MAX_HISTORY_UTTERANCES:]]
+                reply = tok.encode(utt["candidates"][-1])
+                seqs.append(pack_example(persona_sents, history, reply, tok, seq_len))
         return by_persona
 
     return build(blob["train"]), build(blob.get("valid", []))
 
 
 def _synthetic(num_clients: int, seq_len: int, tok, seed: int):
-    """Persona-grouped synthetic corpus: each persona has a char-distribution
-    'style' so per-client data is non-iid, as in the real set."""
+    """Persona-grouped synthetic corpus: each persona has a word-distribution
+    'style' so per-client data is non-iid, as in the real set. Examples go
+    through the same build_input_from_segments packing (empty persona and
+    history; the text is the reply)."""
     rng = np.random.RandomState(seed)
     words = ["the", "cat", "dog", "runs", "jumps", "likes", "hates", "sees",
              "red", "blue", "big", "small", "fast", "slow", "happy", "sad"]
@@ -98,10 +193,10 @@ def _synthetic(num_clients: int, seq_len: int, tok, seed: int):
         favored = rng.choice(len(words), size=6, replace=False)
         seqs = []
         for _ in range(rng.randint(4, 12)):
-            n_words = rng.randint(8, seq_len // 4)
+            n_words = rng.randint(8, max(9, seq_len // 4))
             text = " ".join(words[favored[rng.randint(6)]] if rng.rand() < 0.7
                             else words[rng.randint(len(words))] for _ in range(n_words))
-            seqs.append(pack_sequence(tok.encode(text) + [tok.eos_id], seq_len, tok.pad_id))
+            seqs.append(pack_example([], [], tok.encode(text), tok, seq_len))
         by_persona[f"persona_{c}"] = seqs
     # valid split: last sequence of every 10th persona
     valid = {p: [s[-1]] for i, (p, s) in enumerate(by_persona.items()) if i % 10 == 0}
@@ -109,15 +204,16 @@ def _synthetic(num_clients: int, seq_len: int, tok, seed: int):
 
 
 def _to_fed(by_persona: dict) -> FedTextDataset:
-    xs, ys, shards = [], [], []
+    xs, ts, ys, shards = [], [], [], []
     offset = 0
     for seqs in by_persona.values():
-        for x, y in seqs:
+        for x, t, y in seqs:
             xs.append(x)
+            ts.append(t)
             ys.append(y)
         shards.append(np.arange(offset, offset + len(seqs)))
         offset += len(seqs)
-    return FedTextDataset(np.stack(xs), np.stack(ys), shards)
+    return FedTextDataset(np.stack(xs), np.stack(ts), np.stack(ys), shards)
 
 
 def load_personachat_fed(

@@ -18,6 +18,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..data.fed_dataset import FedDataset
 from ..modes import modes
@@ -69,11 +70,34 @@ class FederatedSession:
         self._step = jax.jit(engine.make_round_step(train_loss_fn, self.cfg), donate_argnums=(0,))
         self._eval = jax.jit(engine.make_eval_step(eval_loss_fn))
         if self.client_state is not None:
-            self._gather = jax.jit(lambda st, ids: jax.tree.map(lambda a: a[ids], st))
-            self._scatter = jax.jit(
-                lambda st, ids, rows: jax.tree.map(lambda a, r: a.at[ids].set(r), st, rows),
-                donate_argnums=(0,),
+            gather = lambda st, ids: jax.tree.map(lambda a: a[ids], st)  # noqa: E731
+            scatter = lambda st, ids, rows: jax.tree.map(  # noqa: E731
+                lambda a, r: a.at[ids].set(r), st, rows
             )
+            if self.mesh is not None:
+                # [num_clients, d] per-client state is the reference's memory
+                # wall (SURVEY.md §3.3, §7 hard part (b)): shard its client
+                # axis over the mesh so per-device residency is
+                # num_clients/n_dev * d, and keep gather/scatter on-device
+                # (XLA lowers the cross-shard row moves to collectives).
+                ns = NamedSharding(self.mesh, P(meshlib.CLIENT_AXIS))
+                nshards = self.mesh.shape[meshlib.CLIENT_AXIS]
+                pad = (-train_set.num_clients) % nshards
+                if pad:  # pad rows are never indexed (ids < num_clients)
+                    self.client_state = jax.tree.map(
+                        lambda a: jnp.concatenate(
+                            [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]
+                        ),
+                        self.client_state,
+                    )
+                self.client_state = jax.device_put(self.client_state, ns)
+                # gathered rows ride the same client-axis sharding the batch
+                # uses, so the vmapped per-client step stays fully sharded
+                self._gather = jax.jit(gather, out_shardings=ns)
+                self._scatter = jax.jit(scatter, donate_argnums=(0,), out_shardings=ns)
+            else:
+                self._gather = jax.jit(gather)
+                self._scatter = jax.jit(scatter, donate_argnums=(0,))
         self.round = 0
         # analytic wire-cost of one round (SURVEY.md §6 row 4 accounting)
         self.comm_per_round = round_comm_mb(mode_cfg, self.num_workers)
@@ -96,6 +120,18 @@ class FederatedSession:
         m = jax.tree.map(float, jax.device_get(metrics))
         m["lr"] = float(lr)
         m.update(self.comm_per_round)
+        if "down_support" in m:
+            # local_topk: replace the static worst-case down-link estimate
+            # with the round's measured broadcast support; past the sparse/
+            # dense crossover a real server sends dense floats, so cap there
+            from ..utils.comm import BYTES_F32, BYTES_PAIR
+
+            per_client = min(
+                m.pop("down_support") * BYTES_PAIR, self.cfg.mode.d * BYTES_F32
+            )
+            down = per_client * self.num_workers / 1e6
+            m["comm_down_mb"] = down
+            m["comm_total_mb"] = m["comm_up_mb"] + down
         return m
 
     # -- evaluation (SURVEY.md §3.4: forward-only, no compression) -----------

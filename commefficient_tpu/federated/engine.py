@@ -201,7 +201,16 @@ def make_round_step(
             "mode_state": mode_state,
             "round": state["round"] + 1,
         }
-        return new_state, new_rows, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+        out_metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+        if mcfg.mode == "local_topk":
+            # support of the actually-broadcast delta (SURVEY.md §6 row 4):
+            # the union of client supports when momentum keeps nothing extra
+            # (momentum none), but with virtual momentum it carries past
+            # rounds' coordinates, and DP noise densifies it entirely — the
+            # accounting in run_round caps the pair encoding at the dense-
+            # float cost a real server would switch to past the crossover.
+            out_metrics["down_support"] = jnp.count_nonzero(delta).astype(jnp.float32)
+        return new_state, new_rows, out_metrics
 
     return step
 

@@ -32,6 +32,7 @@ class GPT2Config:
     dtype: str = "float32"  # compute dtype for activations ("bfloat16" on TPU)
     remat: bool = False
     attn_impl: str = "dense"  # "dense" | "ring" (ring needs a 'seq' mesh axis)
+    ln_eps: float = 1e-5  # GPT-2 uses 1e-5; needed for pretrained logit parity
 
     @property
     def head_dim(self) -> int:
@@ -94,8 +95,9 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool):
-        x = x + Attention(self.cfg, name="attn")(nn.LayerNorm(name="ln_1")(x), train)
-        x = x + MLP(self.cfg, name="mlp")(nn.LayerNorm(name="ln_2")(x), train)
+        eps = self.cfg.ln_eps
+        x = x + Attention(self.cfg, name="attn")(nn.LayerNorm(epsilon=eps, name="ln_1")(x), train)
+        x = x + MLP(self.cfg, name="mlp")(nn.LayerNorm(epsilon=eps, name="ln_2")(x), train)
         return x
 
 
@@ -105,7 +107,7 @@ class GPT2LMHead(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = True):
+    def __call__(self, input_ids, train: bool = True, token_type_ids=None):
         cfg = self.cfg
         B, T = input_ids.shape
         wte = self.param(
@@ -115,6 +117,11 @@ class GPT2LMHead(nn.Module):
             "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd), jnp.float32
         )
         x = wte[input_ids] + wpe[:T][None]
+        if token_type_ids is not None:
+            # dialog-segment embeddings looked up in wte (HF GPT-2 semantics;
+            # the transfer-learning-conv-ai packing tags every token with its
+            # speaker's special token — see data/personachat.py)
+            x = x + wte[token_type_ids]
         x = x.astype(cfg.compute_dtype)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         block = Block
@@ -122,6 +129,6 @@ class GPT2LMHead(nn.Module):
             block = nn.remat(Block, static_argnums=(2,))
         for i in range(cfg.n_layer):
             x = block(cfg, name=f"h_{i}")(x, train)
-        x = nn.LayerNorm(name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, name="ln_f")(x)
         # tied LM head; logits in float32 for a stable softmax
         return jnp.einsum("btc,vc->btv", x.astype(jnp.float32), wte)

@@ -1,0 +1,143 @@
+"""Pretrained GPT-2 weight loader: HuggingFace checkpoint -> flax param tree.
+
+The reference fine-tunes HF's pretrained torch GPT-2-small on PersonaChat
+(SURVEY.md §2 Models, §3.2); its PPL targets (BASELINE.md row 3) assume that
+initialisation. This maps an HF GPT-2 checkpoint directory (config.json +
+pytorch_model.bin or model.safetensors — a local cache dir; there is no
+network here) onto `models.gpt2.GPT2LMHead`'s parameter tree.
+
+Layout facts the mapping relies on (verified by the logit-parity test in
+tests/test_gpt2_loader.py against HF's torch implementation):
+- HF GPT-2 uses Conv1D with weight [in, out] — the same orientation as flax
+  Dense kernels, so weights copy without transposes;
+- c_attn packs Q|K|V contiguously on the output axis, matching gpt2.py's
+  `jnp.split(qkv, 3, axis=-1)`;
+- the LM head is tied to wte (no separate weight to load);
+- layer-norm epsilon is 1e-5 (GPT2Config.ln_eps default).
+
+Vocab resize (for the PersonaChat special tokens): new wte rows are
+initialised to the mean of the pretrained embeddings plus small deterministic
+noise — the standard trick so new tokens start "average" instead of far out
+of distribution.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from .gpt2 import GPT2Config
+
+
+def _read_state_dict(path: str) -> dict[str, np.ndarray]:
+    """{name: float32 ndarray} from a checkpoint file or directory."""
+    if os.path.isdir(path):
+        for name in ("pytorch_model.bin", "model.safetensors", "flax_model.msgpack"):
+            cand = os.path.join(path, name)
+            if os.path.exists(cand):
+                path = cand
+                break
+        else:
+            raise FileNotFoundError(
+                f"no pytorch_model.bin / model.safetensors under {path}"
+            )
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file  # optional dep; gated
+
+        raw = load_file(path)
+        return {k: np.asarray(v, dtype=np.float32) for k, v in raw.items()}
+    import torch
+
+    raw = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(raw, dict) and "state_dict" in raw:
+        raw = raw["state_dict"]
+    return {k: v.to(torch.float32).numpy() for k, v in raw.items()}
+
+
+def _read_config(path: str) -> dict:
+    if os.path.isdir(path):
+        cfg_path = os.path.join(path, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                return json.load(f)
+    return {}
+
+
+def load_hf_gpt2(
+    path: str,
+    target_vocab_size: int | None = None,
+    n_positions: int | None = None,
+    dtype: str = "float32",
+) -> tuple[dict, GPT2Config]:
+    """Load an HF GPT-2 checkpoint into (flax params, GPT2Config).
+
+    `target_vocab_size` > checkpoint vocab appends mean-initialised rows to
+    wte (PersonaChat special tokens); `n_positions` <= checkpoint positions
+    slices wpe (shorter contexts compile smaller graphs). Raises on
+    shrinking the vocab or growing positions — both silently corrupt a
+    pretrained model.
+    """
+    sd = _read_state_dict(path)
+    # strip HF's "transformer." prefix (GPT2LMHeadModel) if present
+    sd = {k.removeprefix("transformer."): v for k, v in sd.items()}
+    hf_cfg = _read_config(path)
+
+    wte, wpe = sd["wte.weight"], sd["wpe.weight"]
+    vocab, n_embd = wte.shape
+    layers = sorted(
+        {int(k.split(".")[1]) for k in sd if k.startswith("h.")}
+    )
+    n_layer = len(layers)
+    if layers != list(range(n_layer)):
+        raise ValueError(f"non-contiguous layer indices in checkpoint: {layers}")
+    n_head = int(hf_cfg.get("n_head", 12))
+    ln_eps = float(hf_cfg.get("layer_norm_epsilon", 1e-5))
+
+    if target_vocab_size is None:
+        target_vocab_size = vocab
+    if target_vocab_size < vocab:
+        raise ValueError(f"cannot shrink vocab {vocab} -> {target_vocab_size}")
+    if target_vocab_size > vocab:
+        extra = target_vocab_size - vocab
+        mean = wte.mean(axis=0, keepdims=True)
+        noise_rng = np.random.RandomState(0)  # deterministic: same init every load
+        new_rows = mean + 0.02 * noise_rng.standard_normal((extra, n_embd)).astype(np.float32)
+        wte = np.concatenate([wte, new_rows], axis=0)
+
+    if n_positions is None:
+        n_positions = wpe.shape[0]
+    if n_positions > wpe.shape[0]:
+        raise ValueError(
+            f"cannot extend positions {wpe.shape[0]} -> {n_positions}: GPT-2's "
+            "learned wpe has no values there"
+        )
+    wpe = wpe[:n_positions]
+
+    cfg = GPT2Config(
+        vocab_size=target_vocab_size, n_positions=n_positions, n_embd=n_embd,
+        n_layer=n_layer, n_head=n_head, ln_eps=ln_eps, dtype=dtype,
+    )
+
+    def ln(prefix):
+        return {"scale": jnp.asarray(sd[f"{prefix}.weight"]),
+                "bias": jnp.asarray(sd[f"{prefix}.bias"])}
+
+    def dense(prefix):
+        return {"kernel": jnp.asarray(sd[f"{prefix}.weight"]),
+                "bias": jnp.asarray(sd[f"{prefix}.bias"])}
+
+    params: dict = {"wte": jnp.asarray(wte), "wpe": jnp.asarray(wpe),
+                    "ln_f": ln("ln_f")}
+    for i in range(n_layer):
+        params[f"h_{i}"] = {
+            "ln_1": ln(f"h.{i}.ln_1"),
+            "ln_2": ln(f"h.{i}.ln_2"),
+            "attn": {"c_attn": dense(f"h.{i}.attn.c_attn"),
+                     "c_proj": dense(f"h.{i}.attn.c_proj")},
+            "mlp": {"c_fc": dense(f"h.{i}.mlp.c_fc"),
+                    "c_proj": dense(f"h.{i}.mlp.c_proj")},
+        }
+    return params, cfg

@@ -45,7 +45,8 @@ def make_classification_loss(model, train: bool):
 def make_lm_loss(model, train: bool):
     """Next-token cross-entropy for causal LMs.
 
-    batch = {"input_ids": [B, T] int, "labels": [B, T] int with -100 = ignore}.
+    batch = {"input_ids": [B, T] int, "labels": [B, T] int with -100 = ignore,
+    optionally "token_type_ids": [B, T] int (PersonaChat speaker segments)}.
     Metrics: loss_sum / count (token-level) -> PPL = exp(loss_sum / count).
     """
 
@@ -54,6 +55,7 @@ def make_lm_loss(model, train: bool):
             {"params": params},
             batch["input_ids"],
             train=train,
+            token_type_ids=batch.get("token_type_ids"),
             rngs={"dropout": rng} if (train and rng is not None) else None,
         )
         # shift: predict token t+1 from prefix ..t

@@ -14,6 +14,14 @@ import jax
 import orbax.checkpoint as ocp
 
 
+def _unpadded_client_state(session):
+    """Host copy of per-client state with mesh-padding rows stripped, so a
+    checkpoint is portable between sharded and unsharded sessions (the mesh
+    session pads [num_clients, d] to a multiple of the client-axis size)."""
+    n = session.train_set.num_clients
+    return jax.tree.map(lambda a: np.asarray(a)[:n], jax.device_get(session.client_state))
+
+
 def save(ckpt_dir: str, session, keep: int = 3):
     path = os.path.abspath(os.path.join(ckpt_dir, f"round_{session.round:08d}"))
     payload = {
@@ -21,7 +29,7 @@ def save(ckpt_dir: str, session, keep: int = 3):
         "round": session.round,
     }
     if session.client_state is not None:
-        payload["client_state"] = jax.device_get(session.client_state)
+        payload["client_state"] = _unpadded_client_state(session)
     ckpt = ocp.PyTreeCheckpointer()
     ckpt.save(path, payload, force=True)
     # host-side sampling RNG, so resumed runs replay the same client sequence
@@ -47,12 +55,30 @@ def restore(path: str, session) -> None:
         "round": 0,
     }
     if session.client_state is not None:
-        template["client_state"] = jax.device_get(session.client_state)
+        template["client_state"] = _unpadded_client_state(session)
     payload = ckpt.restore(path, item=template)
-    session.state = jax.tree.map(jax.numpy.asarray, payload["state"])
+
+    def _place(a, like):
+        # Mesh-sharded leaves (TP params, client-sharded local state) keep
+        # their NamedSharding; everything else stays an UNCOMMITTED plain
+        # array — committing to one device would conflict with sharded
+        # batches at the next jit call.
+        if isinstance(like.sharding, jax.sharding.NamedSharding):
+            return jax.device_put(a, like.sharding)
+        return jax.numpy.asarray(a)
+
+    session.state = jax.tree.map(_place, payload["state"], session.state)
     session.round = int(payload["round"])
     if session.client_state is not None:
-        session.client_state = jax.tree.map(jax.numpy.asarray, payload["client_state"])
+
+        def _fit(a, like):
+            a = np.asarray(a)
+            pad = like.shape[0] - a.shape[0]  # re-pad for the mesh, if any
+            if pad:
+                a = np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+            return _place(a, like)
+
+        session.client_state = jax.tree.map(_fit, payload["client_state"], session.client_state)
     rng_file = os.path.join(path, "host_rng.npy")
     if os.path.exists(rng_file):
         s = np.load(rng_file, allow_pickle=True)

@@ -9,7 +9,13 @@ send (matching the paper's accounting):
 - sketch:        up = r*c floats per client; down = k (index, value) pairs
 - true_topk:     up = d floats (dense);      down = k pairs
 - local_topk:    up = k pairs;               down = up to min(W*k, d) pairs
-                 (union of client supports after server aggregation)
+                 (union of client supports after server aggregation; the
+                 static figure is the no-server-momentum worst case — per
+                 round the engine reports the broadcast delta's measured
+                 support via the `down_support` metric and
+                 FederatedSession.run_round substitutes it, capped at the
+                 dense-float cost since virtual momentum / DP noise can
+                 densify the delta past the sparse-encoding crossover)
 - fedavg/localSGD: up = d floats (weight delta); down = d floats
 - uncompressed:  up = d floats;              down = d floats
 """

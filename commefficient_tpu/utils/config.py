@@ -70,6 +70,10 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
         p.add_argument("--dataset", default="personachat", choices=["personachat"])
         p.add_argument("--seq_len", type=int, default=256)
         p.add_argument("--model_size", default="small", choices=["tiny", "small"])
+        p.add_argument("--init_from", default="",
+                       help="HF GPT-2 checkpoint dir (config.json + "
+                            "pytorch_model.bin) to fine-tune from; the wte is "
+                            "grown for the dialog special tokens")
         p.add_argument("--model_parallel", type=int, default=1,
                        help="tensor-parallel ways for the GPT-2 path")
     return p

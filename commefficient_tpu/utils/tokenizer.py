@@ -10,17 +10,24 @@ only the subword inventory differs.
 
 from __future__ import annotations
 
-import numpy as np
+# PersonaChat dialog specials, transfer-learning-conv-ai lineage (SURVEY.md
+# §3.2): bos/eos frame the sequence, speaker1/speaker2 tag utterances (and
+# serve as the token_type embedding ids), pad fills to seq_len. Appended to
+# the base vocab; gpt2_loader.load_hf_gpt2(target_vocab_size=...) grows the
+# pretrained wte to match.
+SPECIAL_TOKENS = ("<bos>", "<eos>", "<speaker1>", "<speaker2>", "<pad>")
 
 
 class ByteTokenizer:
-    """Byte-level tokenizer: 256 byte values + bos/eos/pad specials."""
+    """Byte-level tokenizer: 256 byte values + the 5 dialog specials."""
 
     def __init__(self):
         self.bos_id = 256
         self.eos_id = 257
-        self.pad_id = 258
-        self.vocab_size = 259
+        self.speaker1_id = 258
+        self.speaker2_id = 259
+        self.pad_id = 260
+        self.vocab_size = 261
 
     def encode(self, text: str) -> list[int]:
         return list(text.encode("utf-8", errors="replace"))
@@ -30,12 +37,23 @@ class ByteTokenizer:
 
 
 class HFTokenizer:
+    """GPT-2 BPE with the dialog specials appended (ids >= 50257), as the
+    reference's `add_special_tokens_` does before fine-tuning."""
+
     def __init__(self, tok):
         self.tok = tok
+        tok.add_special_tokens({
+            "bos_token": SPECIAL_TOKENS[0],
+            "eos_token": SPECIAL_TOKENS[1],
+            "pad_token": SPECIAL_TOKENS[4],
+            "additional_special_tokens": list(SPECIAL_TOKENS[2:4]),
+        })
         self.bos_id = tok.bos_token_id
         self.eos_id = tok.eos_token_id
-        self.pad_id = tok.eos_token_id  # GPT-2 has no pad token
-        self.vocab_size = int(tok.vocab_size)
+        self.pad_id = tok.pad_token_id
+        self.speaker1_id = tok.convert_tokens_to_ids(SPECIAL_TOKENS[2])
+        self.speaker2_id = tok.convert_tokens_to_ids(SPECIAL_TOKENS[3])
+        self.vocab_size = len(tok)
 
     def encode(self, text: str) -> list[int]:
         return self.tok.encode(text)
@@ -53,12 +71,3 @@ def get_tokenizer():
         return ByteTokenizer()
 
 
-def pack_sequence(ids: list[int], seq_len: int, pad_id: int) -> tuple[np.ndarray, np.ndarray]:
-    """(input_ids[T], labels[T]) — labels are input_ids with pad masked to
-    -100 (ignored by the LM loss)."""
-    ids = ids[:seq_len]
-    x = np.full(seq_len, pad_id, dtype=np.int32)
-    y = np.full(seq_len, -100, dtype=np.int32)
-    x[: len(ids)] = ids
-    y[: len(ids)] = ids
-    return x, y

@@ -39,16 +39,39 @@ def build(args):
         args.data_root, args.num_clients, args.seq_len, args.seed
     )
     args.num_clients = train_set.num_clients
-    base = TINY if args.model_size == "tiny" else SMALL
-    cfg = dataclasses.replace(
-        base, vocab_size=tok.vocab_size, n_positions=max(args.seq_len, 1)
-    )
-    model = GPT2LMHead(cfg)
-    ids0 = jnp.zeros((1, args.seq_len), dtype=jnp.int32)
-    params = model.init(jax.random.PRNGKey(args.seed), ids0, train=False)["params"]
+    if args.init_from:
+        # pretrained HF GPT-2 (SURVEY.md §2 Models: the reference fine-tunes
+        # HF GPT-2-small); wte grows to cover the dialog special tokens
+        from commefficient_tpu.models.gpt2_loader import load_hf_gpt2
+
+        params, cfg = load_hf_gpt2(
+            args.init_from, target_vocab_size=tok.vocab_size,
+            n_positions=max(args.seq_len, 1),
+        )
+        model = GPT2LMHead(cfg)
+        # structural sanity: loaded tree must match what init would build
+        # (eval_shape: shapes/structure only, no allocation of a second tree)
+        ids0 = jnp.zeros((1, args.seq_len), dtype=jnp.int32)
+        ref = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), ids0, train=False)
+        )["params"]
+        if jax.tree.structure(ref) != jax.tree.structure(params) or any(
+            a.shape != b.shape for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(params))
+        ):
+            raise ValueError(f"checkpoint {args.init_from} does not match the model tree")
+        init_note = f"  init_from={args.init_from}"
+    else:
+        base = TINY if args.model_size == "tiny" else SMALL
+        cfg = dataclasses.replace(
+            base, vocab_size=tok.vocab_size, n_positions=max(args.seq_len, 1)
+        )
+        model = GPT2LMHead(cfg)
+        ids0 = jnp.zeros((1, args.seq_len), dtype=jnp.int32)
+        params = model.init(jax.random.PRNGKey(args.seed), ids0, train=False)["params"]
+        init_note = ""
     d = ravel_pytree(params)[0].size
     print(f"model: GPT2({args.model_size})  d={d:,}  vocab={cfg.vocab_size}  "
-          f"clients={train_set.num_clients}  mode={args.mode}", flush=True)
+          f"clients={train_set.num_clients}  mode={args.mode}{init_note}", flush=True)
 
     mesh = None
     if args.model_parallel > 1:
@@ -93,6 +116,9 @@ def main(argv=None):
             opt._round = session.round
             print(f"resumed from {path} at round {session.round}", flush=True)
 
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+
     logger = TableLogger(args.log_jsonl or None)
     timer = Timer()
     eval_every = args.eval_every or min(rounds_per_epoch, 200)
@@ -124,6 +150,8 @@ def main(argv=None):
             })
             acc_loss = acc_count = 0.0
 
+    if args.profile_dir:
+        jax.profiler.stop_trace()
     if args.checkpoint_dir:
         ckpt.save(args.checkpoint_dir, session)
     return session

@@ -1,0 +1,173 @@
+"""Per-client persistent state at scale (SURVEY.md §7 hard part (b)): the
+[num_clients, d] local_topk error state sharded over the mesh client axis —
+parity with the unsharded session, padding for non-divisible client counts,
+and the measured (not worst-case) down-link accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+from jax.sharding import PartitionSpec as P
+
+from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+from commefficient_tpu.federated.api import FederatedSession
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.parallel import mesh as meshlib
+from commefficient_tpu.utils.comm import BYTES_PAIR
+
+
+def _mlp_loss(din, dh, dout):
+    def loss_fn(params, net_state, batch, rng):
+        h = jnp.tanh(batch["x"] @ params["w1"] + params["b1"])
+        logits = h @ params["w2"] + params["b2"]
+        logp = jax.nn.log_softmax(logits)
+        per_ex = -jnp.take_along_axis(logp, batch["y"][:, None], axis=1)[:, 0]
+        mask = batch["mask"]
+        loss = (per_ex * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return loss, {"net_state": net_state,
+                      "metrics": {"loss_sum": (per_ex * mask).sum(), "count": mask.sum()}}
+
+    return loss_fn
+
+
+def _init_mlp(key, din, dh, dout):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (din, dh)) * 0.1,
+        "b1": jnp.zeros(dh),
+        "w2": jax.random.normal(k2, (dh, dout)) * 0.1,
+        "b2": jnp.zeros(dout),
+    }
+
+
+def _dataset(num_clients, per_client, din, dout, seed=0):
+    rng = np.random.RandomState(seed)
+    n = num_clients * per_client
+    x = rng.normal(size=(n, din)).astype(np.float32)
+    w = rng.normal(size=(din, dout)).astype(np.float32)
+    y = (x @ w).argmax(-1).astype(np.int32)
+    return FedDataset(x, y, shard_iid(n, num_clients, rng))
+
+
+def _session(num_clients, din=10, dh=16, dout=4, mesh=None, seed=3, k=8):
+    params = _init_mlp(jax.random.PRNGKey(0), din, dh, dout)
+    d = ravel_pytree(params)[0].size
+    mcfg = ModeConfig(mode="local_topk", d=d, k=k, momentum_type="none",
+                      error_type="local", num_clients=num_clients)
+    return FederatedSession(
+        train_loss_fn=_mlp_loss(din, dh, dout),
+        eval_loss_fn=_mlp_loss(din, dh, dout),
+        params=params, net_state={}, mode_cfg=mcfg,
+        train_set=_dataset(num_clients, 4, din, dout),
+        num_workers=8, local_batch_size=4, seed=seed, mesh=mesh,
+    )
+
+
+def test_sharded_client_state_matches_unsharded():
+    """Same seeds -> same sampled clients -> identical params and client
+    error state whether the [num_clients, d] state lives sharded on the mesh
+    or replicated on one device."""
+    mesh = meshlib.make_mesh(8)
+    s_ref = _session(16, mesh=None)
+    s_mesh = _session(16, mesh=mesh)
+    for _ in range(3):
+        m_ref = s_ref.run_round(0.1)
+        m_mesh = s_mesh.run_round(0.1)
+        assert m_ref["loss_sum"] == float(np.float32(m_mesh["loss_sum"])) or np.isclose(
+            m_ref["loss_sum"], m_mesh["loss_sum"], rtol=1e-5
+        )
+    np.testing.assert_allclose(
+        np.asarray(ravel_pytree(s_ref.state["params"])[0]),
+        np.asarray(ravel_pytree(s_mesh.state["params"])[0]),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s_ref.client_state["error"]),
+        np.asarray(s_mesh.client_state["error"]),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_client_state_sharding_and_padding_at_scale():
+    """num_clients=1027 (non-divisible), d ~ 1e5: state is padded to 1032 and
+    its client axis sharded over the 8-device mesh; rounds run and only
+    sampled clients' rows change."""
+    mesh = meshlib.make_mesh(8)
+    s = _session(1027, din=100, dh=900, dout=4, mesh=mesh, k=64)
+    err = s.client_state["error"]
+    assert err.shape[0] == 1032  # padded to a multiple of 8
+    assert err.sharding.spec == P(meshlib.CLIENT_AXIS)
+    # per-device shard holds 1/8 of the rows
+    assert err.addressable_shards[0].data.shape[0] == 1032 // 8
+    m = s.run_round(0.1)
+    assert np.isfinite(m["loss_sum"])
+    touched = np.unique(np.nonzero(np.asarray(s.client_state["error"]))[0])
+    assert 1 <= len(touched) <= 8  # exactly the sampled cohort (or fewer)
+    assert touched.max() < 1027  # padding rows never written
+
+
+def test_checkpoint_portable_between_mesh_and_unsharded(tmp_path):
+    """A checkpoint saved by a mesh session (padded, sharded client state)
+    restores into an unsharded session and vice versa — padding is stripped
+    at save and re-applied per the restoring session's mesh."""
+    from commefficient_tpu.utils import checkpoint as ckpt
+
+    mesh = meshlib.make_mesh(8)
+    s_mesh = _session(12, mesh=mesh, seed=5)  # pads 12 -> 16
+    for _ in range(2):
+        s_mesh.run_round(0.1)
+    path = ckpt.save(str(tmp_path / "a"), s_mesh)
+
+    s_plain = _session(12, mesh=None, seed=99)
+    ckpt.restore(path, s_plain)
+    assert s_plain.round == 2
+    assert s_plain.client_state["error"].shape[0] == 12  # no padding rows
+    np.testing.assert_allclose(
+        np.asarray(s_plain.client_state["error"]),
+        np.asarray(s_mesh.client_state["error"])[:12], rtol=1e-6,
+    )
+    # and back into a fresh mesh session: re-padded, re-sharded
+    s_mesh2 = _session(12, mesh=mesh, seed=100)
+    ckpt.restore(path, s_mesh2)
+    assert s_mesh2.client_state["error"].shape[0] == 16
+    assert s_mesh2.client_state["error"].sharding.spec == P(meshlib.CLIENT_AXIS)
+    np.testing.assert_allclose(
+        np.asarray(s_mesh2.client_state["error"])[:12],
+        np.asarray(s_mesh.client_state["error"])[:12], rtol=1e-6,
+    )
+    # both resumed sessions continue identically (same restored host rng)
+    m1 = s_plain.run_round(0.05)
+    m2 = s_mesh2.run_round(0.05)
+    np.testing.assert_allclose(m1["loss_sum"], m2["loss_sum"], rtol=1e-5)
+
+
+def test_local_topk_down_bytes_capped_at_dense():
+    """Virtual server momentum carries past rounds' coordinates, so the
+    broadcast support grows; accounting must cap at the dense-float cost."""
+    from commefficient_tpu.utils.comm import BYTES_F32
+
+    params = _init_mlp(jax.random.PRNGKey(0), 10, 16, 4)
+    d = ravel_pytree(params)[0].size
+    mcfg = ModeConfig(mode="local_topk", d=d, k=d // 2, momentum_type="virtual",
+                      error_type="none", num_clients=16)
+    s = FederatedSession(
+        train_loss_fn=_mlp_loss(10, 16, 4), eval_loss_fn=_mlp_loss(10, 16, 4),
+        params=params, net_state={}, mode_cfg=mcfg,
+        train_set=_dataset(16, 4, 10, 4), num_workers=8, local_batch_size=4,
+    )
+    dense_mb = d * BYTES_F32 * 8 / 1e6
+    for _ in range(6):  # momentum accumulates support over rounds
+        m = s.run_round(0.1)
+        assert m["comm_down_mb"] <= dense_mb * 1.000001
+
+
+def test_local_topk_down_bytes_measured_not_worst_case():
+    """comm_down_mb reflects the actual transmitted support, bounded by the
+    static worst case min(W*k, d)."""
+    s = _session(16, k=8)
+    m = s.run_round(0.1)
+    worst = min(8 * 8, s.cfg.mode.d) * BYTES_PAIR * 8 / 1e6
+    assert 0 < m["comm_down_mb"] <= worst * 1.000001
+    support = m["comm_down_mb"] * 1e6 / (BYTES_PAIR * 8)
+    assert support == int(support)  # integral pair count
+    assert "down_support" not in m  # folded into the comm figures

@@ -1,11 +1,21 @@
-"""Data-layer tests: shard protocols, fixed-shape batch assembly, masking."""
+"""Data-layer tests: shard protocols, fixed-shape batch assembly, masking,
+and the transfer-learning-conv-ai dialog packing."""
+
+import os
 
 import numpy as np
 
 from commefficient_tpu.data.cifar import load_cifar_fed
 from commefficient_tpu.data.fed_dataset import FedDataset, shard_by_label, shard_iid
 from commefficient_tpu.data.femnist import load_femnist_fed
-from commefficient_tpu.data.personachat import load_personachat_fed
+from commefficient_tpu.data.personachat import (
+    build_input_from_segments,
+    load_personachat_fed,
+    pack_example,
+)
+from commefficient_tpu.utils.tokenizer import ByteTokenizer
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 
 
 def test_shard_by_label_noniid():
@@ -69,6 +79,76 @@ def test_personachat_synthetic_fallback():
     assert train.num_clients == 30
     b = train.client_batch(np.random.RandomState(0), np.array([0, 1]), 2)
     assert b["input_ids"].shape == (2, 2, 64)
+    assert b["token_type_ids"].shape == (2, 2, 64)
     assert b["labels"].min() >= -100
     # padding masked
     assert (b["labels"] == -100).any()
+
+
+def test_build_input_from_segments_structure():
+    """The lineage recipe: <bos> persona, speaker-prefixed turns alternating
+    so the reply is <speaker2>; token types = segment speaker; labels only on
+    reply tokens + eos."""
+    tok = ByteTokenizer()
+    persona = [tok.encode("i like cats")]
+    history = [tok.encode("hi"), tok.encode("hello")]
+    reply = tok.encode("meow")
+    inst = build_input_from_segments(persona, history, reply, tok)
+    ids, types, labels = inst["input_ids"], inst["token_type_ids"], inst["lm_labels"]
+    assert len(ids) == len(types) == len(labels)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    # persona segment: bos + persona tokens, typed speaker2
+    p_len = 1 + len(persona[0])
+    assert types[:p_len] == [tok.speaker2_id] * p_len
+    # two history turns: with the reply at speaker2, they alternate s2, s1
+    h0 = p_len
+    assert ids[h0] == tok.speaker2_id
+    h1 = h0 + 1 + len(history[0])
+    assert ids[h1] == tok.speaker1_id
+    # reply segment: speaker token masked, reply + eos are the targets
+    r0 = h1 + 1 + len(history[1])
+    assert ids[r0] == tok.speaker2_id
+    assert labels[: r0 + 1] == [-100] * (r0 + 1)
+    assert labels[r0 + 1:] == reply + [tok.eos_id]
+    assert types[r0:] == [tok.speaker2_id] * (len(ids) - r0)
+    assert inst["mc_token_ids"] == len(ids) - 1
+
+
+def test_pack_example_overflow_drops_history_keeps_reply():
+    tok = ByteTokenizer()
+    persona = [tok.encode("persona here")]
+    history = [tok.encode("x" * 30) for _ in range(6)]
+    reply = tok.encode("final answer")
+    T = 64
+    x, t, y = pack_example(persona, history, reply, tok, T)
+    assert x.shape == (T,) and t.shape == (T,) and y.shape == (T,)
+    # the reply survives verbatim at the labeled positions
+    labeled = y[y != -100]
+    assert labeled.tolist() == reply + [tok.eos_id]
+    # sequence still starts with bos + persona
+    assert x[0] == tok.bos_id
+    assert x[1: 1 + len(persona[0])].tolist() == persona[0]
+
+
+def test_personachat_fixture_file():
+    """Real-file loader path over the checked-in tiny json: persona grouping
+    merges dialogs that share a persona; valid split is separate; packing is
+    the build_input_from_segments layout."""
+    train, valid, tok = load_personachat_fed(FIXTURES, seq_len=96)
+    # 3 train dialogs over 2 distinct personas -> 2 clients
+    assert train.num_clients == 2
+    # persona "i like cats/farm" has 2 dialogs with 2+1 utterances
+    assert [len(s) for s in train.client_indices] == [3, 1]
+    assert valid.num_clients == 1
+    b = train.client_batch(np.random.RandomState(0), np.array([0]), 2)
+    ids, types, labels = b["input_ids"][0, 0], b["token_type_ids"][0, 0], b["labels"][0, 0]
+    assert ids[0] == tok.bos_id
+    # gold reply is candidates[-1]; its tokens appear as labels
+    labeled = labels[labels != -100]
+    assert tok.eos_id in labeled.tolist()
+    assert set(np.asarray(types).tolist()) <= {
+        tok.speaker1_id, tok.speaker2_id, tok.pad_id
+    }
+    # eval path too
+    ev = next(valid.eval_batches(2))
+    assert ev["input_ids"].shape == (2, 96) and ev["token_type_ids"].shape == (2, 96)
