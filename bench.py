@@ -38,6 +38,10 @@ REFERENCE_CLIENT_UPDATES_PER_SEC = 500.0
 # Env overrides exist so the script can be smoke-tested small on CPU
 # (BENCH_WORKERS=4 BENCH_COLS=20000 ... python bench.py); the defaults are
 # what the driver measures on the real chip.
+# BENCH_MODEL=resnet9 (default; flagship CIFAR-10 workload) or gpt2
+# (PersonaChat-scale: GPT-2-small d~124M, paper config #4 sketch dims —
+# num_cols 1M, num_blocks 20; run manually, the driver measures resnet9)
+BENCH_MODEL = os.environ.get("BENCH_MODEL", "resnet9")
 NUM_WORKERS = int(os.environ.get("BENCH_WORKERS", 64))  # sampled clients/round
 LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH", 8))  # images per client
 SKETCH_ROWS = int(os.environ.get("BENCH_ROWS", 5))
@@ -159,6 +163,65 @@ def _kernel_microbench(platform: str) -> dict:
     return out
 
 
+def _resnet9_workload():
+    """Flagship: CIFAR-10 ResNet-9 sketch round (BASELINE config #2 dims)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.models.losses import make_classification_loss
+    from commefficient_tpu.models.resnet9 import ResNet9
+
+    model = ResNet9(num_classes=10)
+    x0 = jnp.zeros((1, 32, 32, 3), dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0, train=False)
+    params = variables["params"]
+    net_state = {k: v for k, v in variables.items() if k != "params"}
+    key = jax.random.PRNGKey(1)
+    batch = {
+        "x": jax.random.normal(key, (NUM_WORKERS, LOCAL_BATCH, 32, 32, 3), jnp.float32),
+        "y": jax.random.randint(key, (NUM_WORKERS, LOCAL_BATCH), 0, 10, jnp.int32),
+        "mask": jnp.ones((NUM_WORKERS, LOCAL_BATCH), jnp.float32),
+    }
+    loss_fn = make_classification_loss(model, train=True)
+    name = "CIFAR-10 ResNet-9"
+    return params, net_state, batch, loss_fn, name, dict(
+        k=TOPK, num_rows=SKETCH_ROWS, num_cols=SKETCH_COLS, num_blocks=NUM_BLOCKS
+    )
+
+
+def _gpt2_workload():
+    """PersonaChat-scale: GPT-2-small (d ~ 124M), paper config #4 sketch dims
+    (c = 1M, 20 blocks). Heavier; workers/seq overridable via env."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.models.gpt2 import SMALL, GPT2LMHead
+    from commefficient_tpu.models.losses import make_lm_loss
+
+    workers = int(os.environ.get("BENCH_WORKERS", 4))
+    seq = int(os.environ.get("BENCH_SEQ", 256))
+    global NUM_WORKERS
+    NUM_WORKERS = workers
+    cfg = dataclasses.replace(SMALL, n_positions=seq, dropout=0.0)
+    model = GPT2LMHead(cfg)
+    ids0 = jnp.zeros((1, seq), dtype=jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids0, train=False)["params"]
+    key = jax.random.PRNGKey(1)
+    ids = jax.random.randint(key, (workers, 2, seq), 0, cfg.vocab_size, jnp.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    loss_fn = make_lm_loss(model, train=True)
+    name = f"GPT-2-small PersonaChat seq={seq}"
+    return params, {}, batch, loss_fn, name, dict(
+        k=int(os.environ.get("BENCH_TOPK", 50_000)),
+        num_rows=SKETCH_ROWS,
+        num_cols=int(os.environ.get("BENCH_COLS", 1_048_576)),
+        num_blocks=int(os.environ.get("BENCH_BLOCKS", 20)),
+    )
+
+
 def run_bench(platform: str) -> dict:
     import jax
     import jax.numpy as jnp
@@ -167,34 +230,22 @@ def run_bench(platform: str) -> dict:
     _pallas_smoke_or_fallback()
 
     from commefficient_tpu.federated import engine
-    from commefficient_tpu.models.losses import make_classification_loss
-    from commefficient_tpu.models.resnet9 import ResNet9
     from commefficient_tpu.modes.config import ModeConfig
 
-    model = ResNet9(num_classes=10)
-    x0 = jnp.zeros((1, 32, 32, 3), dtype=jnp.float32)
-    variables = model.init(jax.random.PRNGKey(0), x0, train=False)
-    params = variables["params"]
-    net_state = {k: v for k, v in variables.items() if k != "params"}
+    workload = _gpt2_workload if BENCH_MODEL == "gpt2" else _resnet9_workload
+    params, net_state, batch, loss_fn, name, sketch_kw = workload()
     d = ravel_pytree(params)[0].size
 
     mode_cfg = ModeConfig(
-        mode="sketch", d=d, k=TOPK, num_rows=SKETCH_ROWS, num_cols=SKETCH_COLS,
-        num_blocks=NUM_BLOCKS, momentum_type="virtual", error_type="virtual",
+        mode="sketch", d=d, momentum_type="virtual", error_type="virtual",
+        **sketch_kw,
     )
     cfg = engine.EngineConfig(mode=mode_cfg, weight_decay=5e-4)
     state = engine.init_server_state(cfg, params, net_state)
     step = jax.jit(
-        engine.make_round_step(make_classification_loss(model, train=True), cfg),
+        engine.make_round_step(loss_fn, cfg),
         donate_argnums=(0,),
     )
-
-    key = jax.random.PRNGKey(1)
-    batch = {
-        "x": jax.random.normal(key, (NUM_WORKERS, LOCAL_BATCH, 32, 32, 3), jnp.float32),
-        "y": jax.random.randint(key, (NUM_WORKERS, LOCAL_BATCH), 0, 10, jnp.int32),
-        "mask": jnp.ones((NUM_WORKERS, LOCAL_BATCH), jnp.float32),
-    }
 
     for i in range(WARMUP_ROUNDS):
         state, _, _ = step(state, batch, {}, jnp.float32(0.01), jax.random.PRNGKey(i))
@@ -209,14 +260,14 @@ def run_bench(platform: str) -> dict:
     n_chips = jax.device_count()
     updates_per_sec_per_chip = (NUM_WORKERS * TIMED_ROUNDS) / dt / n_chips
     return {
-        "metric": "client-updates/sec/chip (CIFAR-10 ResNet-9, mode=sketch, "
-                  f"r={SKETCH_ROWS} c={SKETCH_COLS} k={TOPK}, {LOCAL_BATCH} img/client)",
+        "metric": f"client-updates/sec/chip ({name}, mode=sketch, "
+                  f"r={mode_cfg.num_rows} c={mode_cfg.num_cols} k={mode_cfg.k})",
         "value": round(updates_per_sec_per_chip, 2),
         "unit": "client-updates/sec/chip",
         "vs_baseline": round(updates_per_sec_per_chip / REFERENCE_CLIENT_UPDATES_PER_SEC, 3),
         "platform": platform,
-        "sketch": {"rows": SKETCH_ROWS, "cols": SKETCH_COLS, "k": TOPK,
-                   "blocks": NUM_BLOCKS, "d": int(d)},
+        "sketch": {"rows": mode_cfg.num_rows, "cols": mode_cfg.num_cols,
+                   "k": mode_cfg.k, "blocks": mode_cfg.num_blocks, "d": int(d)},
         "round_ms": round(dt / TIMED_ROUNDS * 1e3, 2),
         "kernel_microbench": _kernel_microbench(platform),
     }

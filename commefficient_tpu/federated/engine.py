@@ -117,20 +117,26 @@ def make_round_step(
 
     def local_sgd_client(params, pflat, net_state, cbatch, rng, lr):
         _, unravel = ravel_pytree(params)
+        # client-local momentum over the local iterations (fedavg "local
+        # momentum"; within-round only — sampled clients are stateless across
+        # rounds in fedavg). mu = 0 when momentum is virtual/none.
+        mu = mcfg.momentum if mcfg.momentum_type == "local" else 0.0
 
         def body(carry, xs):
-            p_cur, nstate = carry
+            p_cur, nstate, mom = carry
             micro, step_rng = xs
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 unravel(p_cur), nstate, micro, step_rng
             )
             gflat, _ = ravel_pytree(grads)
             gflat = gflat + cfg.weight_decay * p_cur
-            return (p_cur - lr * gflat, aux["net_state"]), aux["metrics"]
+            mom = mu * mom + gflat
+            return (p_cur - lr * mom, aux["net_state"], mom), aux["metrics"]
 
         iters = mcfg.num_local_iters
         rngs = jax.random.split(rng, iters)
-        (p_final, nstate), metrics = jax.lax.scan(body, (pflat, net_state), (cbatch, rngs))
+        init = (pflat, net_state, jnp.zeros_like(pflat))
+        (p_final, nstate, _), metrics = jax.lax.scan(body, init, (cbatch, rngs))
         delta = pflat - p_final
         return delta, nstate, jax.tree.map(lambda m: m.sum(0), metrics)
 
@@ -190,7 +196,10 @@ def make_round_step(
                 for i, (k, v) in enumerate(sorted(agg.items()))
             }
 
-        server_lr = jnp.float32(1.0) if mcfg.uses_weight_delta else lr
+        # weight-delta modes: local steps already carry the client lr; the
+        # server applies the averaged delta at the configured server rate
+        # ("slowmo" when combined with virtual momentum)
+        server_lr = jnp.float32(mcfg.server_lr) if mcfg.uses_weight_delta else lr
         delta, mode_state = modes.server_step(mcfg, agg, state["mode_state"], server_lr)
         new_params = unravel(pflat - delta)
         # mutable model collections (BN stats): average the per-client results

@@ -26,6 +26,10 @@ class ModeConfig:
     momentum_type: str = "virtual"  # none | virtual | local
     error_type: str = "virtual"  # none | virtual | local
     num_local_iters: int = 1  # fedavg / localSGD local steps
+    server_lr: float = 1.0  # weight-delta modes only: scales the averaged
+    # delta at the server ("slowmo" server optimizer — with momentum_type=
+    # "virtual" the server runs momentum-SGD over round deltas; SURVEY.md §3.1
+    # "fedavg: server LR / slowmo optional")
     num_clients: int = 0  # total virtual clients (for local state allocation)
     hash_family: str = "rotation"  # sketch bucket-hash family (see CSVecSpec);
     # "rotation" is the TPU-fast default, "random" the reference-like one
@@ -57,6 +61,11 @@ class ModeConfig:
             raise ValueError(f"bad error_type {self.error_type!r}")
         if self.agg_op not in ("mean", "sum"):
             raise ValueError(f"bad agg_op {self.agg_op!r}; expected 'mean' or 'sum'")
+        if self.server_lr != 1.0 and self.mode not in ("fedavg", "localSGD"):
+            raise ValueError(
+                "server_lr applies only to weight-delta modes (fedavg/localSGD); "
+                "grad modes take their server rate from the lr schedule"
+            )
         if self.agg_op == "sum" and self.mode in ("fedavg", "localSGD"):
             raise ValueError(
                 f"mode={self.mode} requires agg_op='mean': the server applies the "
@@ -69,8 +78,8 @@ class ModeConfig:
             "sketch": {"momentum": ("none", "virtual"), "error": ("virtual",)},
             "true_topk": {"momentum": ("none", "virtual"), "error": ("none", "virtual")},
             "local_topk": {"momentum": ("none", "virtual", "local"), "error": ("none", "local", "virtual")},
-            "fedavg": {"momentum": ("none", "virtual"), "error": ("none",)},
-            "localSGD": {"momentum": ("none", "virtual"), "error": ("none",)},
+            "fedavg": {"momentum": ("none", "virtual", "local"), "error": ("none",)},
+            "localSGD": {"momentum": ("none", "virtual", "local"), "error": ("none",)},
             "uncompressed": {"momentum": ("none", "virtual"), "error": ("none",)},
         }[self.mode]
         if self.momentum_type not in allowed["momentum"]:

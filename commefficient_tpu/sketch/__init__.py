@@ -9,6 +9,7 @@ from .csvec import (
     sketch_sparse,
     sketch_vec,
     to_dense,
+    unsketch_threshold,
     unsketch_topk,
     zero_table,
 )
@@ -20,6 +21,7 @@ __all__ = [
     "sketch_sparse",
     "sketch_vec",
     "to_dense",
+    "unsketch_threshold",
     "unsketch_topk",
     "zero_table",
 ]

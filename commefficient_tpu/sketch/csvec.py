@@ -51,9 +51,13 @@ class CSVecSpec:
       Within a slab of c consecutive coordinates the bucket map is a pure
       rotation, so dense accumulate/query are sign-multiply + roll + add —
       all VPU-vectorizable, no scatter/gather anywhere. Estimates stay
-      unbiased (signs are independent across coordinates) and collision
-      behavior is at least as good as "random": intra-slab collisions are
-      impossible, cross-slab collision probability is exactly 1/c.
+      unbiased (signs are independent across coordinates); intra-slab
+      collisions are impossible and cross-slab collision probability is
+      approximately 1/c (bucket_hash's % c has modulo bias when c doesn't
+      divide 2^32). Unlike per-coordinate hashing, collisions are
+      block-correlated: two slabs collide at ALL offset-aligned coordinate
+      pairs or none, a joint-distribution difference that leaves per-pair
+      probability and per-coordinate variance unchanged.
 
     Both families share one generic (idx → buckets/signs) path for sparse
     sketching and point queries, so the fast dense paths can be property-tested
@@ -320,6 +324,23 @@ def unsketch_topk(spec: CSVecSpec, table: jnp.ndarray, k: int) -> tuple[jnp.ndar
     (top_idx, top_vals), _ = jax.lax.scan(body, init, chunks)
     # entries that never filled (k > #valid coords) keep idx -1 / val 0
     return top_idx, jnp.where(top_idx >= 0, top_vals, 0.0)
+
+
+def unsketch_threshold(
+    spec: CSVecSpec, table: jnp.ndarray, thr: float | jnp.ndarray, max_k: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Heavy hitters by threshold (CSVec._findHHThr): all coordinates with
+    |estimate| >= thr, as (idx[max_k], vals[max_k]) padded with idx = -1.
+
+    Static shapes require a cap: if more than `max_k` coordinates pass the
+    threshold, only the `max_k` largest are returned (they are the top-k, so
+    nothing below a *kept* coordinate is dropped ahead of it). The reference
+    returns a variable-length tensor instead; callers that need exactness
+    must size max_k >= the expected count.
+    """
+    idx, vals = unsketch_topk(spec, table, max_k)
+    keep = (jnp.abs(vals) >= thr) & (idx >= 0)
+    return jnp.where(keep, idx, -1), jnp.where(keep, vals, 0.0)
 
 
 def to_dense(d: int, idx: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:

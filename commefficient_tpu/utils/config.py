@@ -39,6 +39,9 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                    help="clients sampled (simulated) per round")
     p.add_argument("--local_batch_size", type=int, default=8)
     p.add_argument("--num_local_iters", type=int, default=1)
+    p.add_argument("--server_lr", type=float, default=1.0,
+                   help="fedavg/localSGD: server rate on the averaged weight "
+                        "delta (with --momentum_type virtual this is slowmo)")
     p.add_argument("--iid", action="store_true")
     # optimisation
     p.add_argument("--num_epochs", type=float, default=24)
@@ -111,6 +114,7 @@ def mode_config_from_args(args: argparse.Namespace, d: int) -> ModeConfig:
         momentum_type=args.momentum_type,
         error_type=args.error_type,
         num_local_iters=args.num_local_iters if args.mode in ("fedavg", "localSGD") else 1,
+        server_lr=args.server_lr if args.mode in ("fedavg", "localSGD") else 1.0,
         num_clients=args.num_clients,
         hash_family=args.hash_family,
         agg_op=args.agg_op,

@@ -82,6 +82,29 @@ def test_heavy_hitter_recovery():
     )
 
 
+def test_threshold_query():
+    """unsketch_threshold (CSVec._findHHThr parity): every coordinate with
+    |estimate| >= thr is returned, sub-threshold ones padded out."""
+    from commefficient_tpu.sketch import unsketch_threshold
+
+    d, k = 20000, 20
+    spec = CSVecSpec(d=d, c=4000, r=5, num_blocks=4, seed=11)
+    rng = np.random.RandomState(0)
+    v = rng.normal(0, 0.01, size=d).astype(np.float32)
+    heavy_idx = rng.choice(d, size=k, replace=False)
+    v[heavy_idx] = rng.choice([-10.0, 10.0], size=k) * rng.uniform(1.0, 2.0, size=k)
+    t = sketch_vec(spec, jnp.asarray(v))
+    idx, vals = unsketch_threshold(spec, t, thr=5.0, max_k=3 * k)
+    got = set(np.asarray(idx)[np.asarray(idx) >= 0].tolist())
+    # exactly the planted heavies pass thr=5 (|vals| >= 10 planted, noise ~0.01)
+    assert got == set(heavy_idx.tolist())
+    assert np.all(np.abs(np.asarray(vals)[np.asarray(idx) >= 0]) >= 5.0)
+    assert np.all(np.asarray(vals)[np.asarray(idx) < 0] == 0.0)
+    # a threshold above everything returns an empty (all-padding) result
+    idx2, _ = unsketch_threshold(spec, t, thr=1e6, max_k=8)
+    assert np.all(np.asarray(idx2) == -1)
+
+
 def test_unbiasedness():
     """Median-of-rows estimate of a fixed coord, averaged over seeds, ≈ truth."""
     d = 2000

@@ -74,6 +74,41 @@ def test_femnist_synthetic_fallback():
         assert len(set(train.y[s].tolist())) <= 8
 
 
+def test_cifar_fixture_pickles():
+    """Real-file loader path over checked-in tiny pickle batches: 5 train
+    batches + test batch, CHW->HWC transpose, mean/std normalisation."""
+    from commefficient_tpu.data.cifar import CIFAR10_MEAN, CIFAR10_STD
+
+    train, test, nc = load_cifar_fed(
+        "cifar10", num_clients=2, iid=True, data_root=os.path.join(FIXTURES, "cifar")
+    )
+    assert nc == 10
+    assert train.x.shape == (10, 32, 32, 3) and test.x.shape == (2, 32, 32, 3)
+    assert train.x.dtype == np.float32
+    # labels concatenated in batch order
+    assert sorted(train.y.tolist()) == list(range(10))
+    # normalisation applied: uint8/255 range maps into ~(-mean/std, (1-mean)/std)
+    lo, hi = (-CIFAR10_MEAN / CIFAR10_STD).min(), ((1 - CIFAR10_MEAN) / CIFAR10_STD).max()
+    assert train.x.min() >= lo - 1e-5 and train.x.max() <= hi + 1e-5
+    assert train.num_clients == 2
+
+
+def test_femnist_fixture_leaf_json():
+    """Real-file LEAF loader over a checked-in 2-writer json: per-writer
+    shards, 28x28x1 reshape, per-user test holdout."""
+    train, test, nc = load_femnist_fed(FIXTURES)
+    assert nc == 62
+    # 7 examples total, 1 held out per writer -> 5 train, 2 test
+    assert len(train.x) == len(test.x) == 7  # shared arrays, index shards
+    assert train.x.shape[1:] == (28, 28, 1)
+    assert train.num_clients == 2
+    assert sum(len(s) for s in train.client_indices) == 5
+    assert len(test.client_indices[0]) == 2
+    # writer_a's favoured label dominates its shard
+    ya = train.y[train.client_indices[0]]
+    assert (ya == 3).sum() >= len(ya) - 1
+
+
 def test_personachat_synthetic_fallback():
     train, valid, tok = load_personachat_fed("/nonexistent", num_clients=30, seq_len=64)
     assert train.num_clients == 30

@@ -148,6 +148,48 @@ def test_sharded_equals_unsharded():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+def test_fedavg_local_momentum_matches_manual():
+    """momentum_type='local': heavy-ball momentum inside the local-SGD loop.
+    One client, 3 local iters — compare against a hand-rolled momentum SGD."""
+    data = _data(jax.random.PRNGKey(9), 12)
+    micro = jax.tree.map(lambda a: a.reshape((1, 3, 4) + a.shape[1:]), data)
+    lr, mu = 0.1, 0.5
+    cfg, state, step = _make(
+        dict(mode="fedavg", d=0, momentum_type="local", momentum=mu,
+             error_type="none", num_local_iters=3)
+    )
+    new_state, _, _ = step(state, micro, {}, jnp.float32(lr), jax.random.PRNGKey(0))
+
+    # manual: p_{t+1} = p_t - lr * m_t,  m_t = mu m_{t-1} + g_t
+    params = init_mlp(jax.random.PRNGKey(0))
+    pflat, unravel = ravel_pytree(params)
+    m = np.zeros_like(pflat)
+    p = np.asarray(pflat)
+    for i in range(3):
+        mb = jax.tree.map(lambda a: a[0, i], micro)
+        g = ravel_pytree(jax.grad(lambda pp: mlp_loss(pp, {}, mb, None)[0])(unravel(jnp.asarray(p))))[0]
+        m = mu * m + np.asarray(g)
+        p = p - lr * m
+    # server applies the averaged delta at server_lr = 1
+    np.testing.assert_allclose(
+        np.asarray(ravel_pytree(new_state["params"])[0]), p, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_fedavg_server_lr_scales_delta():
+    data = _data(jax.random.PRNGKey(10), 16)
+    batch = jax.tree.map(lambda a: a.reshape((2, 2, 4) + a.shape[1:]), data)
+    base = dict(mode="fedavg", d=0, momentum_type="none", error_type="none",
+                num_local_iters=2)
+    _, s1, step1 = _make(base)
+    _, s2, step2 = _make({**base, "server_lr": 0.5})
+    n1, _, _ = step1(s1, batch, {}, jnp.float32(0.1), jax.random.PRNGKey(0))
+    n2, _, _ = step2(s2, batch, {}, jnp.float32(0.1), jax.random.PRNGKey(0))
+    d1 = _flat_delta(s1, n1)
+    d2 = _flat_delta(s2, n2)
+    np.testing.assert_allclose(d2, 0.5 * d1, rtol=1e-5, atol=1e-7)
+
+
 # ------------------------------------------------- differential privacy
 
 def _flat_delta(state_before, state_after):

@@ -1,0 +1,55 @@
+"""Golden-value convergence regression (SURVEY.md §4 "Golden-value"): pins
+rounds-to-loss-threshold on a fixed-seed synthetic task so optimizer/mode
+regressions show up as test failures, not silent curve drift.
+
+The committed `results/cifar10_smoke_*.jsonl` artifacts are the full-size
+counterpart (ResNet-9 on synthetic CIFAR, uncompressed vs sketch, 48 rounds —
+see results/README.md); this test is the fast engine-level pin.
+
+Calibration (recorded 2026-07-29, CPU, jax_threefry_partitionable=True):
+uncompressed first crosses loss 0.2 at round 15, final(40) = 0.007;
+sketch k=60 c=256 final(40) = 0.26 (identical to true_topk because c >= d
+makes the rotation sketch collision-free, i.e. lossless).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from commefficient_tpu.federated import engine
+from commefficient_tpu.modes.config import ModeConfig
+
+from test_engine import _data, init_mlp, mlp_loss
+
+
+def _run(mode_kw, rounds=40, lr=0.2):
+    params = init_mlp(jax.random.PRNGKey(0))
+    d = ravel_pytree(params)[0].size
+    cfg = engine.EngineConfig(mode=ModeConfig(d=d, **mode_kw))
+    state = engine.init_server_state(cfg, params, {})
+    step = jax.jit(engine.make_round_step(mlp_loss, cfg))
+    data = _data(jax.random.PRNGKey(1), 64)
+    batch = jax.tree.map(lambda a: a.reshape((8, 8) + a.shape[1:]), data)
+    losses = []
+    for r in range(rounds):
+        state, _, m = step(state, batch, {}, jnp.float32(lr), jax.random.PRNGKey(r))
+        losses.append(float(m["loss_sum"]) / float(m["count"]))
+    return losses
+
+
+def test_golden_uncompressed_rounds_to_threshold():
+    losses = _run(dict(mode="uncompressed", momentum_type="virtual",
+                       momentum=0.9, error_type="none"))
+    first_below = next((i for i, l in enumerate(losses) if l < 0.2), None)
+    assert first_below is not None and first_below <= 25, (
+        f"uncompressed regressed: loss<0.2 first at round {first_below} "
+        f"(calibrated: 15; pinned bound: 25)"
+    )
+    assert losses[-1] < 0.05, f"final loss {losses[-1]:.4f} (calibrated 0.007)"
+
+
+def test_golden_sketch_rounds_to_threshold():
+    losses = _run(dict(mode="sketch", k=60, num_rows=5, num_cols=256,
+                       momentum_type="virtual", error_type="virtual"))
+    assert losses[-1] < 0.35, f"sketch final loss {losses[-1]:.4f} (calibrated 0.26)"
+    assert losses[-1] < losses[0] / 3, "sketch no longer converging"
