@@ -6,6 +6,17 @@ Runs on whatever the default JAX platform is (the driver points this at one
 real TPU chip). Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "platform": ...}
 
+Timing forensics (round 3): on the tunnelled "axon" platform,
+`block_until_ready` returns once the op is *enqueued* remotely, not when it
+finishes — round 2's 71,636 updates/s headline was that illusion (it implied
+~2 PFLOP/s f32 on one chip). Every timing here therefore uses
+`jax.device_get` of a scalar derived from the final state as the only true
+sync, times a CHAIN of K data-dependent rounds per sync, and subtracts the
+separately measured tunnel round-trip. The JSON records `device_kind`,
+analytic + XLA-cost-analysis FLOPs/round, achieved TFLOP/s, MFU against the
+chip's bf16 peak, per-chain round-time percentiles, and a workers scale
+check (2x clients ≈ 2x round time, else flagged) so the number is auditable.
+
 Robustness contract: a JSON line is ALWAYS emitted. Backend init is probed in
 a subprocess with a timeout first, so a broken/hanging TPU plugin (e.g. the
 axon tunnel being down) degrades to a CPU run flagged "platform": "cpu"
@@ -34,24 +45,41 @@ import time
 
 REFERENCE_CLIENT_UPDATES_PER_SEC = 500.0
 
+# bf16 peak FLOP/s per chip by device_kind substring (public spec sheets);
+# used only to report MFU — unknown kinds record mfu: null
+_PEAK_BF16 = [
+    ("v5 lite", 197e12),  # TPU v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12),  # Trillium
+    ("v6e", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
 # flagship shape: 10k-client federation, 1% participation, paper sketch dims.
 # Env overrides exist so the script can be smoke-tested small on CPU
 # (BENCH_WORKERS=4 BENCH_COLS=20000 ... python bench.py); the defaults are
 # what the driver measures on the real chip.
 # BENCH_MODEL=resnet9 (default; flagship CIFAR-10 workload) or gpt2
 # (PersonaChat-scale: GPT-2-small d~124M, paper config #4 sketch dims —
-# num_cols 1M, num_blocks 20; run manually, the driver measures resnet9)
+# num_cols 2^20, num_blocks 20; run manually, the driver measures resnet9)
 BENCH_MODEL = os.environ.get("BENCH_MODEL", "resnet9")
 NUM_WORKERS = int(os.environ.get("BENCH_WORKERS", 64))  # sampled clients/round
 LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH", 8))  # images per client
 SKETCH_ROWS = int(os.environ.get("BENCH_ROWS", 5))
-# 2^19 ≈ the paper's 500k, and 128-aligned so the Pallas fast path is eligible
+# 2^19 ≈ the paper's 500k, and 1024-aligned so the Pallas fast path is eligible
 SKETCH_COLS = int(os.environ.get("BENCH_COLS", 524_288))
 TOPK = int(os.environ.get("BENCH_TOPK", 50_000))
 NUM_BLOCKS = int(os.environ.get("BENCH_BLOCKS", 4))
 WARMUP_ROUNDS = int(os.environ.get("BENCH_WARMUP", 3))
-TIMED_ROUNDS = int(os.environ.get("BENCH_ROUNDS", 10))
+# timed work = BENCH_CHAINS chains of BENCH_CHAIN_LEN dependent rounds, one
+# device_get sync per chain (>= 30 rounds total for stable percentiles)
+CHAIN_LEN = int(os.environ.get("BENCH_CHAIN_LEN", 10))
+NUM_CHAINS = int(os.environ.get("BENCH_CHAINS", 4))
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
+SCALE_CHECK = os.environ.get("BENCH_SCALE_CHECK", "1") == "1"
 
 
 def _probe_backend() -> str | None:
@@ -82,36 +110,39 @@ def _force_cpu() -> None:
     force_hermetic_cpu()
 
 
-def _pallas_smoke_or_fallback():
-    """Try the Pallas sketch kernels on a tiny spec; on any failure fall back
-    to the pure-JAX oracle for the whole bench (the kernels are equivalent, so
-    this only affects speed, never the measured semantics)."""
+def _tunnel_round_trip_ms() -> float:
+    """Median host<->device sync cost (device transfer + tunnel latency on
+    axon; ~us locally). Subtracted from every chain timing."""
     import jax
     import jax.numpy as jnp
 
-    from commefficient_tpu.sketch import csvec
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0.0)
+    _ = jax.device_get(f(x))
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _ = jax.device_get(f(x))
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return sorted(samples)[len(samples) // 2]
 
-    try:
-        spec = csvec.CSVecSpec(d=1000, c=256, r=3, family="rotation")
-        if not csvec._use_pallas(spec):
-            return
-        from commefficient_tpu.sketch import pallas_kernels as pk
 
-        v = jnp.ones((spec.d,), jnp.float32)
-        t = pk.sketch_vec(spec, v)
-        jax.block_until_ready(pk.query_all(spec, t))
-    except Exception as e:  # compile/runtime failure on this platform
-        os.environ["COMMEFFICIENT_NO_PALLAS"] = "1"
-        print(f"# pallas kernels unavailable ({type(e).__name__}); using oracle",
-              flush=True)
+def _pallas_status() -> dict:
+    """Library-level probe outcome (full traceback preserved on failure)."""
+    from commefficient_tpu.sketch import pallas_kernels
+
+    return pallas_kernels.probe_status()
 
 
 MICROBENCH_D = int(os.environ.get("BENCH_MICRO_D", 6_500_000))
+MICRO_CHAIN = int(os.environ.get("BENCH_MICRO_CHAIN", 20))
 
 
-def _kernel_microbench(platform: str) -> dict:
-    """Pallas accumulate/query vs the pure-JAX oracle at bench dims.
-    Returns timings (ms) or a skip reason; never raises."""
+def _kernel_microbench(platform: str, rt_ms: float) -> dict:
+    """Pallas accumulate+query vs the pure-JAX oracle at bench dims, timed as
+    a data-dependent in-jit chain (sketch -> query -> next input) with ONE
+    device_get sync — immune to async dispatch. Returns per-iteration ms for
+    the PAIR, or a skip reason; never raises."""
     import jax
     import jax.numpy as jnp
 
@@ -124,38 +155,56 @@ def _kernel_microbench(platform: str) -> dict:
             num_blocks=NUM_BLOCKS,
         )
         v = jax.random.normal(jax.random.PRNGKey(0), (spec.d,), jnp.float32)
+        n = MICRO_CHAIN
 
-        def time_fn(f, *args):
-            r = jax.block_until_ready(f(*args))  # compile + warm
+        def chain(x, acc_fn, q_fn):
+            def body(carry, _):
+                est = q_fn(acc_fn(carry))
+                return est, None  # next input IS the estimates: no dead code
+
+            y, _ = jax.lax.scan(body, x, None, length=n)
+            return y[0]
+
+        def time_pair(acc_fn, q_fn):
+            f = jax.jit(lambda x: chain(x, acc_fn, q_fn))
+            _ = jax.device_get(f(v))  # compile + warm
             t0 = time.perf_counter()
-            for _ in range(5):
-                r = jax.block_until_ready(f(*args))
-            return (time.perf_counter() - t0) / 5 * 1e3, r
+            _ = jax.device_get(f(v))
+            return max((time.perf_counter() - t0) * 1e3 - rt_ms, 0.0) / n
 
-        def oracle_query_all(t):
+        def oracle_q(tab):
             slabs = jnp.arange(spec.num_slabs, dtype=jnp.int32)
-            ests = jax.lax.map(lambda b: csvec._query_slab_rotation(spec, t, b), slabs)
+            ests = jax.lax.map(
+                lambda b: csvec._query_slab_rotation(spec, tab, b), slabs
+            )
             return ests.reshape(-1)[: spec.d]
 
-        oracle_acc = jax.jit(lambda x: csvec._sketch_vec_rotation(spec, x))
-        ms, table = time_fn(oracle_acc, v)
-        out["oracle_accumulate_ms"] = round(ms, 3)
-        ms, est_o = time_fn(jax.jit(oracle_query_all), table)
-        out["oracle_query_ms"] = round(ms, 3)
+        out["oracle_pair_ms"] = round(
+            time_pair(lambda x: csvec._sketch_vec_rotation(spec, x), oracle_q), 3
+        )
 
         if csvec._use_pallas(spec):
             from commefficient_tpu.sketch import pallas_kernels as pk
 
-            pk_acc = jax.jit(lambda x: pk.sketch_vec(spec, x))
-            ms, ptable = time_fn(pk_acc, v)
-            out["pallas_accumulate_ms"] = round(ms, 3)
-            pk_q = jax.jit(lambda t: pk.query_all(spec, t))
-            ms, est_p = time_fn(pk_q, ptable)
-            out["pallas_query_ms"] = round(ms, 3)
-            out["pallas_matches_oracle"] = bool(
-                jnp.allclose(table, ptable, atol=1e-3)
-                and jnp.allclose(est_o, est_p, atol=1e-3)
+            out["pallas_pair_ms"] = round(
+                time_pair(
+                    lambda x: pk.sketch_vec(spec, x),
+                    lambda t: pk.query_all(spec, t),
+                ),
+                3,
             )
+            table = jax.jit(lambda x: pk.sketch_vec(spec, x))(v)
+            otable = jax.jit(lambda x: csvec._sketch_vec_rotation(spec, x))(v)
+            est_p = jax.jit(lambda t: pk.query_all(spec, t))(otable)
+            est_o = jax.jit(oracle_q)(otable)
+            out["pallas_matches_oracle"] = bool(
+                jnp.allclose(table, otable, atol=1e-3)
+                and jnp.allclose(est_p, est_o, atol=1e-3)
+            )
+            if out["oracle_pair_ms"] > 0:
+                out["pallas_speedup_vs_oracle"] = round(
+                    out["oracle_pair_ms"] / max(out["pallas_pair_ms"], 1e-6), 2
+                )
         else:
             out["pallas"] = f"ineligible on {platform}"
     except Exception as e:
@@ -167,7 +216,6 @@ def _resnet9_workload():
     """Flagship: CIFAR-10 ResNet-9 sketch round (BASELINE config #2 dims)."""
     import jax
     import jax.numpy as jnp
-    from jax.flatten_util import ravel_pytree
 
     from commefficient_tpu.models.losses import make_classification_loss
     from commefficient_tpu.models.resnet9 import ResNet9
@@ -178,21 +226,23 @@ def _resnet9_workload():
     params = variables["params"]
     net_state = {k: v for k, v in variables.items() if k != "params"}
     key = jax.random.PRNGKey(1)
+    workers = NUM_WORKERS
     batch = {
-        "x": jax.random.normal(key, (NUM_WORKERS, LOCAL_BATCH, 32, 32, 3), jnp.float32),
-        "y": jax.random.randint(key, (NUM_WORKERS, LOCAL_BATCH), 0, 10, jnp.int32),
-        "mask": jnp.ones((NUM_WORKERS, LOCAL_BATCH), jnp.float32),
+        "x": jax.random.normal(key, (workers, LOCAL_BATCH, 32, 32, 3), jnp.float32),
+        "y": jax.random.randint(key, (workers, LOCAL_BATCH), 0, 10, jnp.int32),
+        "mask": jnp.ones((workers, LOCAL_BATCH), jnp.float32),
     }
     loss_fn = make_classification_loss(model, train=True)
     name = "CIFAR-10 ResNet-9"
-    return params, net_state, batch, loss_fn, name, dict(
+    sketch_kw = dict(
         k=TOPK, num_rows=SKETCH_ROWS, num_cols=SKETCH_COLS, num_blocks=NUM_BLOCKS
     )
+    return params, net_state, batch, loss_fn, name, sketch_kw, workers
 
 
 def _gpt2_workload():
     """PersonaChat-scale: GPT-2-small (d ~ 124M), paper config #4 sketch dims
-    (c = 1M, 20 blocks). Heavier; workers/seq overridable via env."""
+    (c = 2^20, 20 blocks). Heavier; workers/seq overridable via env."""
     import dataclasses
 
     import jax
@@ -203,8 +253,6 @@ def _gpt2_workload():
 
     workers = int(os.environ.get("BENCH_WORKERS", 4))
     seq = int(os.environ.get("BENCH_SEQ", 256))
-    global NUM_WORKERS
-    NUM_WORKERS = workers
     cfg = dataclasses.replace(SMALL, n_positions=seq, dropout=0.0)
     model = GPT2LMHead(cfg)
     ids0 = jnp.zeros((1, seq), dtype=jnp.int32)
@@ -214,12 +262,79 @@ def _gpt2_workload():
     batch = {"input_ids": ids, "labels": ids}
     loss_fn = make_lm_loss(model, train=True)
     name = f"GPT-2-small PersonaChat seq={seq}"
-    return params, {}, batch, loss_fn, name, dict(
+    sketch_kw = dict(
         k=int(os.environ.get("BENCH_TOPK", 50_000)),
         num_rows=SKETCH_ROWS,
         num_cols=int(os.environ.get("BENCH_COLS", 1_048_576)),
         num_blocks=int(os.environ.get("BENCH_BLOCKS", 20)),
     )
+    return params, {}, batch, loss_fn, name, sketch_kw, workers
+
+
+def _make_step(loss_fn, sketch_kw, d):
+    import jax
+
+    from commefficient_tpu.federated import engine
+    from commefficient_tpu.modes.config import ModeConfig
+
+    mode_cfg = ModeConfig(
+        mode="sketch", d=d, momentum_type="virtual", error_type="virtual",
+        **sketch_kw,
+    )
+    cfg = engine.EngineConfig(mode=mode_cfg, weight_decay=5e-4)
+    # donate the server state, as a real training loop would (every call site
+    # rebinds: state, _, _ = step(state, ...)); keeps GPT-2-scale state 1x HBM
+    step = jax.jit(engine.make_round_step(loss_fn, cfg), donate_argnums=(0,))
+    return engine, mode_cfg, cfg, step
+
+
+def _timed_chains(step, state, batch, num_chains, chain_len, rt_ms):
+    """Run `num_chains` chains of `chain_len` data-dependent rounds; one
+    device_get sync per chain. Returns (per-round ms estimates, final state).
+    The K dispatches of a chain queue on the device back-to-back (the state
+    carry makes each round depend on the previous), so chain time ~= K x
+    round time + one sync, and dispatch overlaps compute."""
+    import jax
+    import jax.numpy as jnp
+
+    per_round_ms = []
+    for chain in range(num_chains):
+        t0 = time.perf_counter()
+        for i in range(chain_len):
+            state, _, _ = step(
+                state, batch, {}, jnp.float32(0.01),
+                jax.random.PRNGKey(1000 + chain * chain_len + i),
+            )
+        # the ONLY trustworthy sync: pull a scalar that depends on the params
+        _ = jax.device_get(state["round"] + jnp.int32(0))
+        total_ms = (time.perf_counter() - t0) * 1e3
+        per_round_ms.append(max(total_ms - rt_ms, 0.0) / chain_len)
+    return per_round_ms, state
+
+
+def _flops_per_round(step, state, batch):
+    """XLA's own cost analysis of the compiled round step (flops for the
+    whole round: W clients fwd+bwd + sketch accumulate/query + server step)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        lowered = step.lower(
+            state, batch, {}, jnp.float32(0.01), jax.random.PRNGKey(0)
+        )
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+def _analytic_resnet9_flops(workers: int, local_batch: int) -> float:
+    """Analytic check on the XLA number: cifar10-fast ResNet-9 is ~1.31
+    GFLOP/image forward (conv+fc MACs x2 at 32x32), fwd+bwd ~= 3x forward."""
+    fwd_per_image = 1.31e9
+    return workers * local_batch * fwd_per_image * 3.0
 
 
 def run_bench(platform: str) -> dict:
@@ -227,62 +342,107 @@ def run_bench(platform: str) -> dict:
     import jax.numpy as jnp
     from jax.flatten_util import ravel_pytree
 
-    _pallas_smoke_or_fallback()
-
-    from commefficient_tpu.federated import engine
-    from commefficient_tpu.modes.config import ModeConfig
-
     workload = _gpt2_workload if BENCH_MODEL == "gpt2" else _resnet9_workload
-    params, net_state, batch, loss_fn, name, sketch_kw = workload()
+    params, net_state, batch, loss_fn, name, sketch_kw, workers = workload()
     d = ravel_pytree(params)[0].size
 
-    mode_cfg = ModeConfig(
-        mode="sketch", d=d, momentum_type="virtual", error_type="virtual",
-        **sketch_kw,
+    engine, mode_cfg, cfg, step = _make_step(loss_fn, sketch_kw, d)
+    # the step donates its input state, which would invalidate `params`
+    # mid-run — give each state its own copy (scale check needs a second one)
+    state = engine.init_server_state(
+        cfg, jax.tree.map(jnp.copy, params), jax.tree.map(jnp.copy, net_state)
     )
-    cfg = engine.EngineConfig(mode=mode_cfg, weight_decay=5e-4)
-    state = engine.init_server_state(cfg, params, net_state)
-    step = jax.jit(
-        engine.make_round_step(loss_fn, cfg),
-        donate_argnums=(0,),
-    )
+
+    rt_ms = _tunnel_round_trip_ms()
 
     for i in range(WARMUP_ROUNDS):
         state, _, _ = step(state, batch, {}, jnp.float32(0.01), jax.random.PRNGKey(i))
-    jax.block_until_ready(state["params"])
+    _ = jax.device_get(state["round"] + jnp.int32(0))
 
-    t0 = time.perf_counter()
-    for i in range(TIMED_ROUNDS):
-        state, _, _ = step(state, batch, {}, jnp.float32(0.01), jax.random.PRNGKey(100 + i))
-    jax.block_until_ready(state["params"])
-    dt = time.perf_counter() - t0
+    per_round_ms, state = _timed_chains(
+        step, state, batch, NUM_CHAINS, CHAIN_LEN, rt_ms
+    )
+    round_ms = sorted(per_round_ms)[len(per_round_ms) // 2]
 
+    device_kind = jax.devices()[0].device_kind
     n_chips = jax.device_count()
-    updates_per_sec_per_chip = (NUM_WORKERS * TIMED_ROUNDS) / dt / n_chips
-    return {
+    updates_per_sec_per_chip = workers / (round_ms / 1e3) / n_chips
+
+    flops = _flops_per_round(step, state, batch)
+    peak = next((p for k, p in _PEAK_BF16 if k in device_kind.lower()), None)
+    achieved = flops / (round_ms / 1e3) if flops else None
+    mfu = achieved / peak if (achieved and peak) else None
+
+    result = {
         "metric": f"client-updates/sec/chip ({name}, mode=sketch, "
                   f"r={mode_cfg.num_rows} c={mode_cfg.num_cols} k={mode_cfg.k})",
         "value": round(updates_per_sec_per_chip, 2),
         "unit": "client-updates/sec/chip",
         "vs_baseline": round(updates_per_sec_per_chip / REFERENCE_CLIENT_UPDATES_PER_SEC, 3),
         "platform": platform,
+        "device_kind": device_kind,
         "sketch": {"rows": mode_cfg.num_rows, "cols": mode_cfg.num_cols,
                    "k": mode_cfg.k, "blocks": mode_cfg.num_blocks, "d": int(d)},
-        "round_ms": round(dt / TIMED_ROUNDS * 1e3, 2),
-        "kernel_microbench": _kernel_microbench(platform),
+        "round_ms": round(round_ms, 2),
+        "round_ms_percentiles": {
+            "min": round(min(per_round_ms), 2),
+            "median": round(round_ms, 2),
+            "max": round(max(per_round_ms), 2),
+            "chains": NUM_CHAINS, "chain_len": CHAIN_LEN,
+        },
+        "sync_method": "device_get(scalar) per chain, tunnel round-trip "
+                       f"{round(rt_ms, 2)} ms subtracted",
+        "flops_per_round_xla": flops,
+        "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
+        "bf16_peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "mfu": round(mfu, 4) if mfu else None,
+        "kernel_microbench": _kernel_microbench(platform, rt_ms),
+        "pallas": _pallas_status(),
     }
+    if BENCH_MODEL == "resnet9":
+        result["flops_per_round_analytic"] = _analytic_resnet9_flops(
+            workers, LOCAL_BATCH
+        )
+
+    if SCALE_CHECK and BENCH_MODEL == "resnet9":
+        # physical-consistency check: double the client count, round time
+        # should roughly double (compute-bound vmap). A flat time would mean
+        # the timing is still an async illusion.
+        batch2 = {
+            "x": jnp.concatenate([batch["x"]] * 2, axis=0),
+            "y": jnp.concatenate([batch["y"]] * 2, axis=0),
+            "mask": jnp.concatenate([batch["mask"]] * 2, axis=0),
+        }
+        state2 = engine.init_server_state(
+            cfg, jax.tree.map(jnp.copy, params), jax.tree.map(jnp.copy, net_state)
+        )
+        for i in range(2):
+            state2, _, _ = step(state2, batch2, {}, jnp.float32(0.01), jax.random.PRNGKey(i))
+        _ = jax.device_get(state2["round"] + jnp.int32(0))
+        ms2, _ = _timed_chains(step, state2, batch2, 2, CHAIN_LEN, rt_ms)
+        ratio = sorted(ms2)[len(ms2) // 2] / round_ms
+        result["scale_check"] = {
+            "workers_x2_round_ms_ratio": round(ratio, 2),
+            "plausible": bool(1.3 <= ratio <= 3.0),
+        }
+    return result
 
 
 def _shrink_for_cpu():
     """The flagship dims are sized for a TPU chip; on the CPU fallback shrink
     anything the env didn't pin so the script still finishes in minutes."""
     g = globals()
-    for name, small in [("NUM_WORKERS", 8), ("TIMED_ROUNDS", 3),
-                        ("WARMUP_ROUNDS", 1), ("MICROBENCH_D", 2_000_000)]:
-        env_name = {"NUM_WORKERS": "BENCH_WORKERS", "TIMED_ROUNDS": "BENCH_ROUNDS",
-                    "WARMUP_ROUNDS": "BENCH_WARMUP", "MICROBENCH_D": "BENCH_MICRO_D"}[name]
+    for name, small in [("NUM_WORKERS", 8), ("CHAIN_LEN", 3), ("NUM_CHAINS", 2),
+                        ("WARMUP_ROUNDS", 1), ("MICROBENCH_D", 2_000_000),
+                        ("MICRO_CHAIN", 3)]:
+        env_name = {"NUM_WORKERS": "BENCH_WORKERS", "CHAIN_LEN": "BENCH_CHAIN_LEN",
+                    "NUM_CHAINS": "BENCH_CHAINS", "WARMUP_ROUNDS": "BENCH_WARMUP",
+                    "MICROBENCH_D": "BENCH_MICRO_D",
+                    "MICRO_CHAIN": "BENCH_MICRO_CHAIN"}[name]
         if env_name not in os.environ:
             g[name] = small
+    if "BENCH_SCALE_CHECK" not in os.environ:
+        g["SCALE_CHECK"] = False
 
 
 def main():

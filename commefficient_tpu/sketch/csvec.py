@@ -139,6 +139,16 @@ def _pad_to_slabs(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
 
 def _use_pallas(spec: CSVecSpec) -> bool:
     """Use the Pallas kernels on TPU-backed platforms for supported layouts.
+
+    Gated by `pallas_kernels.probe(c, r)` — a try-once-per-layout smoke
+    compile+run at the caller's real (c, r) (so spec-scale VMEM exhaustion on
+    small-VMEM chips is caught, not just toolchain breakage) whose failure
+    (full traceback cached and logged) downgrades every caller to the
+    pure-JAX oracle: a Mosaic regression can never crash a training run.
+    vmap is safe without a guard here — the kernels are sequential_vmap-
+    wrapped (pallas_call's own batching rule hangs Mosaic compiles on
+    current toolchains), though the engine never needs it: sketching is
+    linear, so the round step sketches the client-aggregated update once.
     COMMEFFICIENT_NO_PALLAS=1 forces the pure-JAX oracle (debugging)."""
     import os
 
@@ -147,7 +157,10 @@ def _use_pallas(spec: CSVecSpec) -> bool:
     from . import pallas_kernels
 
     # "axon" is a tunnelled TPU platform (remote Pallas compile supported)
-    return pallas_kernels.supported(spec) and jax.default_backend() in ("tpu", "axon")
+    if not (pallas_kernels.supported(spec) and jax.default_backend() in ("tpu", "axon")):
+        return False
+    ok, _ = pallas_kernels.probe(spec.c, spec.r)
+    return ok
 
 
 def _sketch_vec_rotation(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:

@@ -3,27 +3,41 @@
 These are the "accumulate / query" kernel pair SURVEY.md §3.5 / §7.1 targets
 (the reference's CSVec.accumulateVec / _findValues are pure-torch scatter and
 gather programs; here the rotation hash family makes both ops *structured*,
-and these kernels express that structure directly on the TPU memory system):
+and these kernels express that structure directly on the TPU vector unit):
 
-- Every roll of a c-sized slab becomes ONE contiguous dynamic window into a
-  doubled copy of the source (``[x ‖ x]``), fetched HBM→VMEM with an async
-  copy whose start offset comes from the per-(row, slab) shift — no
-  scatter/gather at any granularity, no lane shuffles.
+- Every roll of a c-sized slab is two sublane rotates + two lane rotates + a
+  select (`_flat_roll`, built on `pltpu.roll` → Mosaic `tpu.dynamic_rotate`)
+  over the slab viewed as [c/128, 128] — no scatter/gather at any granularity
+  and no DMA at unaligned offsets.
 - Bucket signs are recomputed inside the kernel from the integer seed with
   the same murmur mixer as `hashing.py` (uint32 elementwise VPU ops), so no
   [r, d] hash tensor ever exists in HBM.
-- The column axis is tiled, so VMEM use is O(r · col_tile) regardless of c.
+- The slab axis is the pipelined grid dimension: Pallas streams one slab of
+  the input HBM→VMEM per step while the kernel reduces into the row's table
+  block, which stays resident in VMEM across the slab loop.
+- The median-of-rows query uses an odd-even-transposition network of
+  `minimum`/`maximum` (r is tiny and static) — `sort` has no Mosaic lowering
+  (the round-2 MosaicError), a comparator network lowers to plain VPU ops.
 
 Layout requirements for this fast path (checked by `supported()`):
-`c % 128 == 0`.  Anything else — and any non-TPU backend, unless
-`interpret=True` — falls back to the pure-JAX oracle in `csvec.py`, which
-remains the correctness reference (`tests/test_pallas.py` pins the two
-together in interpreter mode).
+`c % 1024 == 0` (so the [c/128, 128] slab view is fully (8,128)-tiled for
+f32) and the resident working set — the whole [r, c] table plus a couple of
+slabs — must fit comfortably in VMEM.  Anything else, and any non-TPU
+backend unless `interpret=True`, falls back to the pure-JAX oracle in
+`csvec.py`, which remains the correctness reference (`tests/test_pallas.py`
+pins the two together in interpreter mode).
+
+`probe()` is the library-level try-once gate: the first real-backend use
+compiles and runs both kernels on a tiny spec, and on any failure caches the
+FULL traceback (surfaced by `bench.py` and logged once) and flips every
+caller to the oracle — a training run can never crash, or silently fall
+back per-call, because of a Mosaic regression.
 """
 
 from __future__ import annotations
 
 import functools
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -32,205 +46,242 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .hashing import row_keys, sign_hash, slab_shifts
 
-# preferred column tile (lanes=128 × sublanes); 16K floats = 64 KiB
-COL_TILE = 16_384
+# resident-VMEM budget for supported(): table + pipelined slab buffers + roll
+# temporaries, kept well under any TPU generation's VMEM (v4+: >= 64 MiB).
+# The default *scoped* vmem limit is 16 MiB on current toolchains, so every
+# pallas_call raises it explicitly to this budget via CompilerParams.
+_VMEM_BUDGET_BYTES = 48 * 1024 * 1024
+
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_BUDGET_BYTES)
 
 
 def supported(spec) -> bool:
     """Whether the Pallas fast path can handle this spec's layout."""
-    return spec.family == "rotation" and spec.c % 128 == 0
+    if spec.family != "rotation" or spec.c % 1024 != 0:
+        return False
+    # query keeps the whole [r, c] table resident plus ~4 slab-sized buffers
+    return (spec.r + 4) * spec.c * 4 <= _VMEM_BUDGET_BYTES
 
 
-def _col_tile(c: int) -> int:
-    """Largest multiple of 128 that divides c and is ≤ COL_TILE (the tile must
-    divide c exactly; power-of-two-ish c gets the full 16K tile)."""
-    import math
+def _flat_roll(x: jnp.ndarray, shift: jnp.ndarray) -> jnp.ndarray:
+    """Roll-right by `shift` (traced scalar in [0, c)) of the flat [c] vector
+    stored as x[c//128, 128] (row-major: flat p = 128*sublane + lane).
 
-    return 128 * math.gcd(c // 128, COL_TILE // 128)
+    Flat roll by s = 128*sq + sl decomposes into sublane rolls and a lane
+    roll with borrow: out lane l takes sublane-roll sq for l >= sl and
+    sq + 1 (one extra carry row) for l < sl, both lane-rolled by sl.
+    """
+    shift = shift.astype(jnp.int32)
+    sq = shift // 128
+    sl = shift % 128
+    a = pltpu.roll(x, sq, 0)
+    b = pltpu.roll(x, sq + 1, 0)
+    a = pltpu.roll(a, sl, 1)
+    b = pltpu.roll(b, sl, 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(lane >= sl, a, b)
 
 
-def _sign_for(idx: jnp.ndarray, key: jnp.ndarray, dtype) -> jnp.ndarray:
-    """Per-coordinate sign — hashing.sign_hash traced inside the kernel (pure
-    elementwise uint32 VPU ops), so kernel and oracle can never diverge."""
-    return sign_hash(idx, key, dtype=dtype)
+def _lower_median(vals: list[jnp.ndarray]) -> jnp.ndarray:
+    """Lower median (sorted element (r-1)//2) of r same-shape arrays via an
+    odd-even transposition network — elementwise min/max only, since `sort`
+    has no Mosaic TPU lowering."""
+    v = list(vals)
+    n = len(v)
+    for p in range(n):
+        for i in range(p % 2, n - 1, 2):
+            lo = jnp.minimum(v[i], v[i + 1])
+            hi = jnp.maximum(v[i], v[i + 1])
+            v[i], v[i + 1] = lo, hi
+    return v[(n - 1) // 2]
+
+
+def _coord_iota(slab, c: int) -> jnp.ndarray:
+    """Global coordinate index of each element of slab `slab`'s [c/128, 128]
+    view (flat order: 128*sublane + lane)."""
+    cq = c // 128
+    sub = jax.lax.broadcasted_iota(jnp.int32, (cq, 128), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (cq, 128), 1)
+    return slab * c + sub * 128 + lane
 
 
 # --------------------------------------------------------------- accumulate
 
 
-def _accumulate_kernel(
-    # scalar prefetch
-    shifts_ref,  # [r, S] int32 (SMEM)
-    keys_ref,  # [r] uint32 sign keys (SMEM)
-    # inputs
-    v2_ref,  # [S, 2c] doubled vector slabs (HBM/ANY)
-    # outputs
-    out_ref,  # [1, ct_q, 128] VMEM block: (row j, col tile t) of the table
-    # scratch
-    buf_ref,  # [2, ct] VMEM double buffer (flat — DMA windows are 1-D)
-    sem,  # [2] DMA semaphores
-    *,
-    c: int,
-    num_slabs: int,
-    ct: int,
-):
+def _accumulate_kernel(shifts_ref, keys_ref, v_ref, out_ref, *, c: int):
+    """Grid (r, S): row j's table block stays resident while the slab axis
+    streams; slab b contributes sign ⊙ v rolled by shifts[j, b]."""
     j = pl.program_id(0)
-    t = pl.program_id(1)
-    ct_q = ct // 128
-    p0 = t * ct  # first column of this tile
+    b = pl.program_id(1)
+    idx = _coord_iota(b, c)
+    signed = sign_hash(idx, keys_ref[j], dtype=out_ref.dtype) * v_ref[0]
+    rolled = _flat_roll(signed, shifts_ref[j, b])
 
-    def dma(slot, b):
-        # window of v slab b that lands on columns [p0, p0+ct) of row j after
-        # the roll-right by shifts[j, b]:   start = (p0 - shift) mod c
-        start = (p0 - shifts_ref[j, b]) % c
-        return pltpu.make_async_copy(
-            v2_ref.at[b, pl.ds(start, ct)],
-            buf_ref.at[slot],
-            sem.at[slot],
-        )
+    @pl.when(b == 0)
+    def _():
+        out_ref[0] = rolled
 
-    dma(0, 0).start()
-
-    def body(b, acc):
-        slot = jax.lax.rem(b, 2)
-
-        @pl.when(b + 1 < num_slabs)
-        def _():
-            dma(1 - slot, b + 1).start()
-
-        dma(slot, b).wait()
-        # sign of the ORIGINAL coordinate each window element came from:
-        # in-slab position = (start + offset) mod c, global idx = b*c + pos
-        start = (p0 - shifts_ref[j, b]) % c
-        off_q = jax.lax.broadcasted_iota(jnp.int32, (ct_q, 128), 0)
-        off_l = jax.lax.broadcasted_iota(jnp.int32, (ct_q, 128), 1)
-        pos = (start + off_q * 128 + off_l) % c
-        idx = b * c + pos
-        window = buf_ref[slot].reshape(ct_q, 128)
-        return acc + _sign_for(idx, keys_ref[j], window.dtype) * window
-
-    acc = jax.lax.fori_loop(
-        0, num_slabs, body, jnp.zeros((ct_q, 128), dtype=out_ref.dtype)
-    )
-    out_ref[0] = acc
+    @pl.when(b != 0)
+    def _():
+        out_ref[0] += rolled
 
 
 @functools.partial(jax.jit, static_argnames=("d", "c", "r", "seed", "interpret"))
 def _accumulate_call(v, *, d, c, r, seed, interpret):
     num_slabs = -(-d // c)
-    ct = _col_tile(c)
-    v_pad = jnp.pad(v, (0, num_slabs * c - d)).reshape(num_slabs, c)
-    v2 = jnp.concatenate([v_pad, v_pad], axis=1)  # doubled: rolls → windows
+    cq = c // 128
+    v3 = jnp.pad(v, (0, num_slabs * c - d)).reshape(num_slabs, cq, 128)
     shifts = slab_shifts(seed, r, num_slabs, c).astype(jnp.int32)
     _, ks = row_keys(seed, r)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(r, c // ct),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(
-            (1, ct // 128, 128), lambda j, t, *_: (j, t, 0), memory_space=pltpu.VMEM
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((2, ct), v.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        grid=(r, num_slabs),
+        in_specs=[pl.BlockSpec((1, cq, 128), lambda j, b, *_: (b, 0, 0))],
+        out_specs=pl.BlockSpec((1, cq, 128), lambda j, b, *_: (j, 0, 0)),
     )
 
     table = pl.pallas_call(
-        functools.partial(_accumulate_kernel, c=c, num_slabs=num_slabs, ct=ct),
+        functools.partial(_accumulate_kernel, c=c),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((r, c // 128, 128), v.dtype),
+        out_shape=jax.ShapeDtypeStruct((r, cq, 128), v.dtype),
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
-    )(shifts, ks, v2)
+    )(shifts, ks, v3)
     return table.reshape(r, c)
+
+
+@functools.lru_cache(maxsize=None)
+def _sketch_fn(d: int, c: int, r: int, seed: int):
+    """sequential_vmap-wrapped accumulate: under ANY vmap (including through
+    jit) the batch axis lowers to a lax.map over the unbatched kernel instead
+    of pallas_call's batching rule, which hangs Mosaic on current toolchains."""
+    import jax.custom_batching
+
+    @jax.custom_batching.sequential_vmap
+    def f(v):
+        return _accumulate_call(v, d=d, c=c, r=r, seed=seed, interpret=False)
+
+    return f
 
 
 def sketch_vec(spec, v: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
     """Pallas rotation-family CSVec.accumulateVec: [d] → [r, c] table."""
-    return _accumulate_call(
-        v, d=spec.d, c=spec.c, r=spec.r, seed=spec.seed, interpret=interpret
-    )
+    if interpret:
+        return _accumulate_call(
+            v, d=spec.d, c=spec.c, r=spec.r, seed=spec.seed, interpret=True
+        )
+    return _sketch_fn(spec.d, spec.c, spec.r, spec.seed)(v)
 
 
 # -------------------------------------------------------------------- query
 
 
-def _query_kernel(
-    shifts_ref,  # [r, S] int32
-    keys_ref,  # [r] uint32
-    tab2_ref,  # [r, 2c] doubled table rows (HBM/ANY)
-    out_ref,  # [1, ct_q, 128] block: (slab s, col tile t) of the estimates
-    rows_ref,  # [r, ct] VMEM scratch (flat — DMA windows are 1-D)
-    sem,  # [r] DMA semaphores
-    *,
-    c: int,
-    r: int,
-    ct: int,
-):
+def _query_kernel(shifts_ref, keys_ref, tab_ref, out_ref, *, c: int, r: int):
+    """Grid (S,): the whole [r, c] table stays resident in VMEM; slab s's
+    estimates are the lower median over rows of sign ⊙ (row unrolled by
+    shifts[j, s])."""
     s = pl.program_id(0)
-    t = pl.program_id(1)
-    ct_q = ct // 128
-    p0 = t * ct
-
-    # estimate of in-slab position p, row j = sign(idx) · table[j, (p+shift) mod c]
-    # → a contiguous window of the doubled row starting at shift + p0
-    def dma(j):
-        return pltpu.make_async_copy(
-            tab2_ref.at[j, pl.ds(shifts_ref[j, s] + p0, ct)],
-            rows_ref.at[j],
-            sem.at[j],
-        )
-
-    for j in range(r):  # r is small and static
-        dma(j).start()
-
-    off_q = jax.lax.broadcasted_iota(jnp.int32, (ct_q, 128), 0)
-    off_l = jax.lax.broadcasted_iota(jnp.int32, (ct_q, 128), 1)
-    idx = s * c + p0 + off_q * 128 + off_l  # global coordinate of each element
-
-    per_row = []
-    for j in range(r):
-        dma(j).wait()
-        window = rows_ref[j].reshape(ct_q, 128)
-        per_row.append(_sign_for(idx, keys_ref[j], window.dtype) * window)
-
-    # lower median over the r per-row estimates (matches csvec.query)
-    out_ref[0] = jnp.sort(jnp.stack(per_row), axis=0)[(r - 1) // 2]
+    idx = _coord_iota(s, c)
+    ests = []
+    for j in range(r):  # r is tiny and static
+        # roll-left by shift == roll-right by (c - shift) mod c
+        inv = jax.lax.rem(c - shifts_ref[j, s], c)
+        row = _flat_roll(tab_ref[j], inv)
+        ests.append(sign_hash(idx, keys_ref[j], dtype=out_ref.dtype) * row)
+    out_ref[0] = _lower_median(ests)
 
 
 @functools.partial(jax.jit, static_argnames=("d", "c", "r", "seed", "interpret"))
 def _query_call(table, *, d, c, r, seed, interpret):
     num_slabs = -(-d // c)
-    ct = _col_tile(c)
-    tab2 = jnp.concatenate([table, table], axis=1)  # [r, 2c]
+    cq = c // 128
+    tab3 = table.reshape(r, cq, 128)
     shifts = slab_shifts(seed, r, num_slabs, c).astype(jnp.int32)
     _, ks = row_keys(seed, r)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(num_slabs, c // ct),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(
-            (1, ct // 128, 128), lambda s, t, *_: (s, t, 0), memory_space=pltpu.VMEM
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((r, ct), table.dtype),
-            pltpu.SemaphoreType.DMA((r,)),
-        ],
+        grid=(num_slabs,),
+        in_specs=[pl.BlockSpec((r, cq, 128), lambda s, *_: (0, 0, 0))],
+        out_specs=pl.BlockSpec((1, cq, 128), lambda s, *_: (s, 0, 0)),
     )
 
     est = pl.pallas_call(
-        functools.partial(_query_kernel, c=c, r=r, ct=ct),
+        functools.partial(_query_kernel, c=c, r=r),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((num_slabs, c // 128, 128), table.dtype),
+        out_shape=jax.ShapeDtypeStruct((num_slabs, cq, 128), table.dtype),
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
-    )(shifts, ks, tab2)
+    )(shifts, ks, tab3)
     return est.reshape(-1)[:d]
+
+
+@functools.lru_cache(maxsize=None)
+def _query_fn(d: int, c: int, r: int, seed: int):
+    """sequential_vmap-wrapped query (see _sketch_fn)."""
+    import jax.custom_batching
+
+    @jax.custom_batching.sequential_vmap
+    def f(table):
+        return _query_call(table, d=d, c=c, r=r, seed=seed, interpret=False)
+
+    return f
 
 
 def query_all(spec, table: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
     """Pallas rotation-family CSVec._findValues over every coordinate."""
-    return _query_call(
-        table, d=spec.d, c=spec.c, r=spec.r, seed=spec.seed, interpret=interpret
-    )
+    if interpret:
+        return _query_call(
+            table, d=spec.d, c=spec.c, r=spec.r, seed=spec.seed, interpret=True
+        )
+    return _query_fn(spec.d, spec.c, spec.r, spec.seed)(table)
+
+
+# ------------------------------------------------------- try-once probe gate
+
+_PROBE: dict = {}
+
+
+def probe(c: int = 1024, r: int = 3) -> tuple[bool, str | None]:
+    """Compile and run both kernels once PER (c, r) LAYOUT on the current
+    default backend; cache (ok, full traceback). Called by
+    `csvec._use_pallas` with the caller's real (c, r), so a Mosaic failure —
+    including spec-scale VMEM exhaustion on small-VMEM chips, which a
+    tiny-spec probe would miss — downgrades every caller (training runs
+    included) to the pure-JAX oracle exactly once, root cause preserved.
+    The probe uses d = 2c + c//2 (3 slabs: same kernel structure and VMEM
+    class as any d at this (c, r); d only changes the grid length)."""
+    key = (c, r)
+    if key not in _PROBE:
+        try:
+            from .csvec import CSVecSpec  # local import: csvec imports us lazily
+
+            spec = CSVecSpec(d=2 * c + c // 2, c=c, r=r, seed=7, family="rotation")
+            v = jnp.linspace(-1.0, 1.0, spec.d, dtype=jnp.float32)
+            t = sketch_vec(spec, v)
+            jax.block_until_ready(query_all(spec, t))
+            _PROBE[key] = (True, None)
+        except Exception:  # noqa: BLE001 — any compile/runtime failure
+            import traceback
+
+            _PROBE[key] = (False, traceback.format_exc())
+            print(
+                "# pallas sketch kernels unavailable on "
+                f"{jax.default_backend()!r} at c={c} r={r}; using the "
+                "pure-JAX oracle. Root cause:\n" + _PROBE[key][1],
+                file=sys.stderr,
+                flush=True,
+            )
+    return _PROBE[key]
+
+
+def probe_status() -> dict:
+    """Probe outcomes for observability (bench.py embeds this in its JSON)."""
+    if not _PROBE:
+        return {"probed": False}
+    out = {"probed": True, "ok": all(ok for ok, _ in _PROBE.values())}
+    errors = {f"c={c},r={r}": err for (c, r), (ok, err) in _PROBE.items() if not ok}
+    if errors:
+        out["errors"] = errors
+    return out

@@ -22,9 +22,10 @@ def test_supported_layouts():
     assert pk.supported(SPEC)
     assert not pk.supported(CSVecSpec(d=3000, c=1000, r=3, family="rotation"))
     assert not pk.supported(CSVecSpec(d=3000, c=1024, r=3, family="random"))
-    # tile divides c exactly even for awkward c
-    for c in (1024, 1280, 2176, 16384, 524288):
-        assert c % pk._col_tile(c) == 0 and pk._col_tile(c) % 128 == 0
+    # bench dims are eligible; a table that can't stay VMEM-resident is not
+    assert pk.supported(CSVecSpec(d=6_573_130, c=524_288, r=5, family="rotation"))
+    assert pk.supported(CSVecSpec(d=124_000_000, c=1_048_576, r=5, family="rotation"))
+    assert not pk.supported(CSVecSpec(d=124_000_000, c=8_388_608, r=5, family="rotation"))
 
 
 def test_accumulate_matches_oracle():
