@@ -11,6 +11,7 @@ it — there are no workers to spawn, no shared memory to allocate.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Callable
 
 import numpy as np
@@ -51,12 +52,12 @@ class FederatedSession:
         self.train_set = train_set
         self.num_workers = min(num_workers, train_set.num_clients)
         self.local_batch_size = local_batch_size
-        if mesh is not None and self.num_workers % mesh.shape[meshlib.CLIENT_AXIS] != 0:
+        if mesh is not None and self.num_workers % meshlib.client_shards(mesh) != 0:
             # the sampled-client axis must split evenly over the mesh; fall
             # back to single-device execution rather than failing mid-run
             print(
                 f"warning: num_workers={self.num_workers} not divisible by "
-                f"{mesh.shape[meshlib.CLIENT_AXIS]}-way client mesh; running unsharded",
+                f"{meshlib.client_shards(mesh)}-way client mesh; running unsharded",
                 flush=True,
             )
             mesh = None
@@ -80,8 +81,8 @@ class FederatedSession:
                 # axis over the mesh so per-device residency is
                 # num_clients/n_dev * d, and keep gather/scatter on-device
                 # (XLA lowers the cross-shard row moves to collectives).
-                ns = NamedSharding(self.mesh, P(meshlib.CLIENT_AXIS))
-                nshards = self.mesh.shape[meshlib.CLIENT_AXIS]
+                ns = meshlib.client_sharding(self.mesh)
+                nshards = meshlib.client_shards(self.mesh)
                 pad = (-train_set.num_clients) % nshards
                 if pad:  # pad rows are never indexed (ids < num_clients)
                     self.client_state = jax.tree.map(
@@ -102,6 +103,14 @@ class FederatedSession:
         # analytic wire-cost of one round (SURVEY.md §6 row 4 accounting)
         self.comm_per_round = round_comm_mb(mode_cfg, self.num_workers)
 
+    def _mesh_ctx(self):
+        """jax.set_mesh context for steps when the mesh carries axes that ops
+        resolve ambiently (ring attention's 'seq'); nullcontext otherwise so
+        plain client-DP/TP meshes change nothing."""
+        if self.mesh is not None and meshlib.SEQ_AXIS in self.mesh.axis_names:
+            return jax.set_mesh(self.mesh)
+        return contextlib.nullcontext()
+
     # -- one federated round -------------------------------------------------
     def run_round(self, lr: float) -> dict:
         ids = self.train_set.sample_clients(self.rng, self.num_workers)
@@ -113,7 +122,10 @@ class FederatedSession:
         ids_dev = jnp.asarray(ids)
         rows = self._gather(self.client_state, ids_dev) if self.client_state is not None else {}
         self._rng_key, sub = jax.random.split(self._rng_key)
-        self.state, new_rows, metrics = self._step(self.state, batch, rows, jnp.float32(lr), sub)
+        with self._mesh_ctx():
+            self.state, new_rows, metrics = self._step(
+                self.state, batch, rows, jnp.float32(lr), sub
+            )
         if self.client_state is not None:
             self.client_state = self._scatter(self.client_state, ids_dev, new_rows)
         self.round += 1
@@ -136,11 +148,25 @@ class FederatedSession:
 
     # -- evaluation (SURVEY.md §3.4: forward-only, no compression) -----------
     def evaluate(self, dataset: FedDataset, batch_size: int = 512) -> dict:
+        """Forward-only metrics over the whole eval set. On a mesh the batch
+        axis shards over the client axes (eval has no client dimension — it's
+        plain data parallelism over the same devices), so eval wall-clock
+        scales with the mesh instead of running one-device while training
+        runs n-way. eval_batches pads every batch to full shape with a
+        0-mask tail, so metric sums are shard-count invariant
+        (tests/test_engine.py::test_sharded_eval_matches_unsharded)."""
         totals: dict[str, float] = {}
+        if self.mesh is not None:
+            shards = meshlib.client_shards(self.mesh)
+            batch_size = -(-batch_size // shards) * shards  # round up
         for batch in dataset.eval_batches(batch_size):
-            metrics = self._eval(
-                self.state["params"], self.state["net_state"], batch, jax.random.PRNGKey(0)
-            )
+            if self.mesh is not None:
+                batch = meshlib.shard_client_batch(self.mesh, batch)
+            with self._mesh_ctx():
+                metrics = self._eval(
+                    self.state["params"], self.state["net_state"], batch,
+                    jax.random.PRNGKey(0),
+                )
             for k, v in jax.device_get(metrics).items():
                 totals[k] = totals.get(k, 0.0) + float(v)
         return totals

@@ -32,6 +32,8 @@ class GPT2Config:
     dtype: str = "float32"  # compute dtype for activations ("bfloat16" on TPU)
     remat: bool = False
     attn_impl: str = "dense"  # "dense" | "ring" (ring needs a 'seq' mesh axis)
+    ring_axis: str = "seq"  # mesh axis ring attention shards T over (the mesh
+    # itself comes from jax.set_mesh or an explicit arg — ops/ring_attention)
     ln_eps: float = 1e-5  # GPT-2 uses 1e-5; needed for pretrained logit parity
 
     @property
@@ -64,7 +66,7 @@ class Attention(nn.Module):
         if cfg.attn_impl == "ring":
             from ..ops.ring_attention import ring_attention
 
-            y = ring_attention(q, k, v, causal=True)
+            y = ring_attention(q, k, v, causal=True, axis=cfg.ring_axis)
         else:
             scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, dtype=q.dtype))
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale

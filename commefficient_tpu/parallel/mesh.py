@@ -7,6 +7,17 @@ on a `jax.sharding.Mesh`, cross-client reductions are XLA collectives over
 ICI (DCN at multi-slice scale), and weight "broadcast" is replicated-array
 residency.  These helpers name the axes and build the shardings the round
 engine uses.
+
+Multi-slice (pod-scale) topology — BASELINE config #5 / SURVEY.md §7.7: a
+`num_slices > 1` mesh adds a leading DCN axis.  Devices are grouped by their
+`slice_index` (falling back to contiguous chunks on hosts that don't expose
+one, e.g. the forced-CPU test mesh), so the model axis and the intra-slice
+client axis always ride ICI while only the once-per-round client reduction
+crosses DCN: sharding the sampled-client batch axis over
+(DCN_AXIS, CLIENT_AXIS) makes XLA lower the client mean to an in-slice
+reduce (ICI) followed by a cross-slice all-reduce of one [r, c] table or [d]
+vector per round — exactly the traffic a parameter server would ship, with
+no code beyond the sharding annotation.
 """
 
 from __future__ import annotations
@@ -16,25 +27,94 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+DCN_AXIS = "slices"  # data-parallel axis across pod slices (DCN traffic)
 CLIENT_AXIS = "clients"  # data-parallel axis over sampled virtual clients
+SEQ_AXIS = "seq"  # sequence-parallel axis (ring attention, optional)
 MODEL_AXIS = "model"  # tensor-parallel axis (GPT-2 path, optional)
 
 
-def make_mesh(num_devices: int | None = None, model_parallel: int = 1) -> Mesh:
-    """1-D client mesh, or 2-D (clients, model) when model_parallel > 1."""
+def _group_by_slice(devs: np.ndarray, num_slices: int) -> np.ndarray:
+    """[num_slices, per_slice] device grid, honoring hardware slice_index
+    when the platform exposes it (TPU multi-slice), contiguous otherwise."""
+    n = len(devs)
+    if n % num_slices:
+        raise ValueError(f"{n} devices not divisible by num_slices={num_slices}")
+    per_slice = n // num_slices
+    slice_ids = {getattr(d, "slice_index", None) for d in devs.flat}
+    if None not in slice_ids and len(slice_ids) != num_slices:
+        # real multi-slice hardware disagreeing with the requested layout:
+        # a contiguous reshape would route "ICI" axes over DCN — say so
+        print(
+            f"warning: hardware reports {len(slice_ids)} slices but "
+            f"num_slices={num_slices}; contiguous device grouping may place "
+            "intra-slice mesh axes across DCN",
+            flush=True,
+        )
+    if None not in slice_ids and len(slice_ids) == num_slices:
+        rows = []
+        for s in sorted(slice_ids):
+            row = [d for d in devs.flat if d.slice_index == s]
+            if len(row) != per_slice:
+                raise ValueError(
+                    f"slice {s} has {len(row)} devices, expected {per_slice}"
+                )
+            rows.append(row)
+        return np.asarray(rows)
+    return devs.reshape(num_slices, per_slice)
+
+
+def make_mesh(
+    num_devices: int | None = None,
+    model_parallel: int = 1,
+    num_slices: int = 1,
+    seq_parallel: int = 1,
+) -> Mesh:
+    """Client mesh, axes outermost-to-innermost (slices, clients, seq, model)
+    — axes of size 1 are omitted.  The innermost axes carry the
+    latency-sensitive collectives (TP all-reduces, ring-attention ppermute)
+    over ICI; only the once-per-round client reduction ever crosses DCN."""
     devs = jax.devices()
     n = len(devs) if num_devices is None else num_devices
     devs = np.asarray(devs[:n])
+    inner = model_parallel * seq_parallel
+    if n % (num_slices * inner):
+        raise ValueError(
+            f"{n} devices not divisible by num_slices={num_slices} x "
+            f"seq_parallel={seq_parallel} x model_parallel={model_parallel}"
+        )
+    dims = []
+    if num_slices > 1:
+        devs = _group_by_slice(devs, num_slices)
+        dims.append((DCN_AXIS, num_slices))
+    dims.append((CLIENT_AXIS, n // (num_slices * inner)))
+    if seq_parallel > 1:
+        dims.append((SEQ_AXIS, seq_parallel))
     if model_parallel > 1:
-        if n % model_parallel:
-            raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
-        return Mesh(devs.reshape(n // model_parallel, model_parallel), (CLIENT_AXIS, MODEL_AXIS))
-    return Mesh(devs, (CLIENT_AXIS,))
+        dims.append((MODEL_AXIS, model_parallel))
+    return Mesh(
+        devs.reshape([s for _, s in dims]), tuple(a for a, _ in dims)
+    )
+
+
+def client_axes(mesh: Mesh) -> tuple[str, ...] | str:
+    """Mesh axes the sampled-client batch dimension shards over: the client
+    axis, plus the DCN slice axis on hybrid meshes."""
+    if DCN_AXIS in mesh.axis_names:
+        return (DCN_AXIS, CLIENT_AXIS)
+    return CLIENT_AXIS
+
+
+def client_shards(mesh: Mesh) -> int:
+    """Total ways the client batch axis splits (must divide num_workers)."""
+    n = mesh.shape[CLIENT_AXIS]
+    if DCN_AXIS in mesh.axis_names:
+        n *= mesh.shape[DCN_AXIS]
+    return n
 
 
 def client_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard the leading (sampled-client) axis over the client mesh axis."""
-    return NamedSharding(mesh, P(CLIENT_AXIS))
+    """Shard the leading (sampled-client) axis over the client mesh axes."""
+    return NamedSharding(mesh, P(client_axes(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -43,5 +123,5 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def shard_client_batch(mesh: Mesh, tree):
     """Place every array in `tree` with its leading [W] axis sharded over the
-    client mesh axis (weights/params stay replicated — see `replicated`)."""
+    client mesh axes (weights/params stay replicated — see `replicated`)."""
     return jax.device_put(tree, client_sharding(mesh))

@@ -79,6 +79,12 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                             "grown for the dialog special tokens")
         p.add_argument("--model_parallel", type=int, default=1,
                        help="tensor-parallel ways for the GPT-2 path")
+        p.add_argument("--attn_impl", default="dense", choices=["dense", "ring"],
+                       help="ring = sequence-parallel ring attention (needs "
+                            "--seq_parallel > 1; K/V blocks rotate over ICI)")
+        p.add_argument("--seq_parallel", type=int, default=1,
+                       help="sequence-parallel ways (mesh 'seq' axis) for "
+                            "--attn_impl ring")
     return p
 
 

@@ -48,6 +48,7 @@ def build(args):
             args.init_from, target_vocab_size=tok.vocab_size,
             n_positions=max(args.seq_len, 1),
         )
+        cfg = dataclasses.replace(cfg, attn_impl=args.attn_impl)
         model = GPT2LMHead(cfg)
         # structural sanity: loaded tree must match what init would build
         # (eval_shape: shapes/structure only, no allocation of a second tree)
@@ -63,7 +64,8 @@ def build(args):
     else:
         base = TINY if args.model_size == "tiny" else SMALL
         cfg = dataclasses.replace(
-            base, vocab_size=tok.vocab_size, n_positions=max(args.seq_len, 1)
+            base, vocab_size=tok.vocab_size, n_positions=max(args.seq_len, 1),
+            attn_impl=args.attn_impl,
         )
         model = GPT2LMHead(cfg)
         ids0 = jnp.zeros((1, args.seq_len), dtype=jnp.int32)
@@ -73,10 +75,22 @@ def build(args):
     print(f"model: GPT2({args.model_size})  d={d:,}  vocab={cfg.vocab_size}  "
           f"clients={train_set.num_clients}  mode={args.mode}{init_note}", flush=True)
 
+    if args.attn_impl == "ring" and args.seq_parallel <= 1:
+        raise SystemExit(
+            "--attn_impl ring needs --seq_parallel > 1: without a 'seq' mesh "
+            "axis the model silently runs dense attention, which defeats the "
+            "point of asking for ring (the math is identical; the memory/"
+            "scaling behavior is not)"
+        )
     mesh = None
-    if args.model_parallel > 1:
-        mesh = meshlib.make_mesh(args.num_devices or None, model_parallel=args.model_parallel)
-        params = tp.shard_params(mesh, params)
+    if args.model_parallel > 1 or args.seq_parallel > 1:
+        mesh = meshlib.make_mesh(
+            args.num_devices or None,
+            model_parallel=args.model_parallel,
+            seq_parallel=args.seq_parallel,
+        )
+        if args.model_parallel > 1:
+            params = tp.shard_params(mesh, params)
     elif jax.device_count() > 1:
         mesh = meshlib.make_mesh(args.num_devices or None)
 
@@ -96,6 +110,13 @@ def build(args):
         dp_clip=args.dp_clip,
         dp_noise=args.dp_noise,
     )
+    if args.attn_impl == "ring" and session.mesh is None:
+        raise SystemExit(
+            "--attn_impl ring: the session dropped the seq mesh (num_workers "
+            "not divisible by the client shards — see warning above), which "
+            "would silently degrade ring attention to dense; fix num_workers "
+            "or --seq_parallel"
+        )
     return session, valid_set
 
 

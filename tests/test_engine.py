@@ -336,3 +336,66 @@ def test_loss_decreases_every_mode(cfg_kw):
         state, rows, metrics = step(state, batch, rows, lr, jax.random.PRNGKey(i))
         losses.append(float(metrics["loss_sum"]) / float(metrics["count"]))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_hybrid_multislice_mesh_equals_unsharded():
+    """A 2-slice x 4-device hybrid (DCN x ICI) mesh — BASELINE config #5 /
+    SURVEY.md §7.7 — runs the same round step unchanged and matches the
+    unsharded result: clients shard over (slices, clients), so the client
+    mean lowers to an in-slice reduce plus one cross-slice all-reduce."""
+    hmesh = meshlib.make_mesh(8, num_slices=2)
+    assert dict(hmesh.shape) == {meshlib.DCN_AXIS: 2, meshlib.CLIENT_AXIS: 4}
+    assert meshlib.client_shards(hmesh) == 8
+    data = _data(jax.random.PRNGKey(5), 64)
+    w8 = jax.tree.map(lambda a: a.reshape((8,) + (8,) + a.shape[1:]), data)
+    lr = jnp.float32(0.1)
+    cfg, state, step = _make(_ucfg())
+    ref, _, _ = step(state, w8, {}, lr, jax.random.PRNGKey(0))
+
+    _, state2, _ = _make(_ucfg())
+    sharded = meshlib.shard_client_batch(hmesh, w8)
+    got, _, _ = step(state2, sharded, {}, lr, jax.random.PRNGKey(0))
+    for a, b in zip(jax.tree.leaves(got["params"]), jax.tree.leaves(ref["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_mesh_with_model_axis():
+    """3-axis hybrid mesh (slices, clients, model): the TP axis stays
+    innermost (never crosses DCN) and client_shards counts slices x clients."""
+    m = meshlib.make_mesh(8, model_parallel=2, num_slices=2)
+    assert dict(m.shape) == {
+        meshlib.DCN_AXIS: 2, meshlib.CLIENT_AXIS: 2, meshlib.MODEL_AXIS: 2
+    }
+    assert meshlib.client_shards(m) == 4
+    assert meshlib.client_axes(m) == (meshlib.DCN_AXIS, meshlib.CLIENT_AXIS)
+
+
+def test_sharded_eval_matches_unsharded():
+    """evaluate() shards eval batches over the client axes (VERDICT r2 weak
+    #4: eval must not run 1-device while training runs 8-way); metric totals
+    must be identical because padded rows carry mask 0."""
+    from commefficient_tpu.data.fed_dataset import FedDataset
+    from commefficient_tpu.federated.api import FederatedSession
+
+    rng = np.random.RandomState(0)
+    n = 100  # deliberately not divisible by 8: exercises pad + round-up
+    x = rng.randn(n, 10).astype(np.float32)
+    w_true = rng.randn(10, 4).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int64)
+    ds = FedDataset(x, y, [np.arange(i, n, 16) for i in range(16)])
+
+    def build(mesh):
+        return FederatedSession(
+            train_loss_fn=mlp_loss, eval_loss_fn=mlp_loss,
+            params=init_mlp(jax.random.PRNGKey(0)), net_state={},
+            mode_cfg=ModeConfig(**_ucfg(d=ravel_pytree(init_mlp(jax.random.PRNGKey(0)))[0].size)),
+            train_set=ds, num_workers=8, local_batch_size=4, seed=1, mesh=mesh,
+        )
+
+    ref = build(None).evaluate(ds, batch_size=32)
+    got = build(meshlib.make_mesh(8)).evaluate(ds, batch_size=32)
+    got_hybrid = build(meshlib.make_mesh(8, num_slices=2)).evaluate(ds, batch_size=24)
+    assert ref["count"] == got["count"] == got_hybrid["count"] == float(n)
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-5)
+        np.testing.assert_allclose(got_hybrid[k], ref[k], rtol=1e-5)
