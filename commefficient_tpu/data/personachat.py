@@ -111,7 +111,11 @@ class FedTextDataset(FedDataset):
     """FedDataset over packed dialog sequences. Stores input_ids and
     token_type_ids column-concatenated ([N, 2T]) so the native batch-assembly
     runtime moves both with one row copy; batches are LM-shaped dicts
-    {"input_ids", "token_type_ids", "labels"} (labels -100 = ignore)."""
+    {"input_ids", "token_type_ids", "labels"} (labels -100 = ignore).
+
+    Subclasses change only the per-example row layout by overriding
+    `_unpack` (the buffer widths come from self.x/self.y); batch assembly —
+    the native row copy, -100 pad-row fill, L==1 squeeze — is shared."""
 
     def __init__(self, ids: np.ndarray, types: np.ndarray, labels: np.ndarray,
                  client_indices: list[np.ndarray]):
@@ -120,34 +124,101 @@ class FedTextDataset(FedDataset):
             np.concatenate([ids, types], axis=1), labels, client_indices
         )
 
+    def _unpack(self, xt: np.ndarray, y: np.ndarray) -> dict:
+        T = self.seq_len
+        return {"input_ids": xt[..., :T], "token_type_ids": xt[..., T:], "labels": y}
+
     def client_batch(self, rng, client_ids, batch_size, local_iters: int = 1):
         from .. import native
 
         W, L, n = len(client_ids), local_iters, batch_size
-        T = self.seq_len
-        xt = np.zeros((W, L, n, 2 * T), dtype=np.int32)
-        labels = np.full((W, L, n, T), -100, dtype=np.int32)  # pad rows ignored
+        xt = np.zeros((W, L, n, self.x.shape[1]), dtype=np.int32)
+        y = np.full((W, L, n, self.y.shape[1]), -100, dtype=np.int32)  # pad rows ignored
         native.assemble_rows(
             self.x, self.y, self.shard_flat, self.shard_off,
-            np.asarray(client_ids), L, n, int(rng.randint(1 << 62)), xt, labels, None,
+            np.asarray(client_ids), L, n, int(rng.randint(1 << 62)), xt, y, None,
         )
-        batch = {"input_ids": xt[..., :T], "token_type_ids": xt[..., T:], "labels": labels}
+        batch = self._unpack(xt, y)
         if L == 1:
             batch = {k: v[:, 0] for k, v in batch.items()}
         return batch
 
     def eval_batches(self, batch_size):
         n = len(self.x)
-        T = self.seq_len
         for start in range(0, n, batch_size):
             end = min(start + batch_size, n)
             k = end - start
-            xt = np.zeros((batch_size, 2 * T), dtype=np.int32)
-            labels = np.full((batch_size, T), -100, dtype=np.int32)
+            xt = np.zeros((batch_size, self.x.shape[1]), dtype=np.int32)
+            y = np.full((batch_size, self.y.shape[1]), -100, dtype=np.int32)
             xt[:k] = self.x[start:end]
-            labels[:k] = self.y[start:end]
-            yield {"input_ids": xt[:, :T], "token_type_ids": xt[:, T:],
-                   "labels": labels}
+            y[:k] = self.y[start:end]
+            yield self._unpack(xt, y)
+
+
+def _pack_candidates(
+    persona, history, gold_reply, distractor_replies, tok, seq_len, rng,
+    num_candidates,
+):
+    """[C, T] candidate set: C-1 packed distractors (labels all -100) plus
+    the gold reply at a shuffled position; returns (ids, types, labels, pos).
+    Short distractor lists pad with all-<pad> candidates (scored but
+    trivially losing — real PersonaChat carries ~19 distractors)."""
+    packed = []
+    for r in distractor_replies[: num_candidates - 1]:
+        x, t, y = pack_example(persona, history, r, tok, seq_len)
+        packed.append((x, t, np.full_like(y, -100)))
+    pad_cand = (
+        np.full(seq_len, tok.pad_id, np.int32),
+        np.full(seq_len, tok.pad_id, np.int32),
+        np.full(seq_len, -100, np.int32),
+    )
+    while len(packed) < num_candidates - 1:
+        packed.append(pad_cand)
+    gold = pack_example(persona, history, gold_reply, tok, seq_len)
+    pos = int(rng.randint(num_candidates))
+    cands = packed[:pos] + [gold] + packed[pos:]
+    return (
+        np.stack([c[0] for c in cands]),
+        np.stack([c[1] for c in cands]),
+        np.stack([c[2] for c in cands]),
+        pos,
+    )
+
+
+class FedTextMCDataset(FedTextDataset):
+    """FedTextDataset over candidate sets for the double-head (LM + next-
+    utterance classification) objective: each example is C packed sequences —
+    the gold reply plus C-1 distractors (SURVEY.md §3.2) — at a shuffled gold
+    position.
+
+    Storage keeps the native batch-assembly runtime untouched: per example,
+    x = [ids ‖ types] flattened to [C*2T] and y = labels flattened [C*T] with
+    the gold index appended ([C*T + 1]); one row copy moves the whole set.
+    Batch assembly is inherited; only `_unpack` differs. Batches:
+    {"input_ids"/"token_type_ids"/"labels": [W, n, C, T], "mc_label": [W, n]
+    (-100 on padded rows, ignored by both loss terms)}.
+    """
+
+    def __init__(self, ids: np.ndarray, types: np.ndarray, labels: np.ndarray,
+                 mc_label: np.ndarray, client_indices: list[np.ndarray]):
+        N, C, T = ids.shape
+        self.num_candidates = C
+        x = np.concatenate([ids.reshape(N, C * T), types.reshape(N, C * T)], axis=1)
+        y = np.concatenate(
+            [labels.reshape(N, C * T), mc_label[:, None].astype(np.int32)], axis=1
+        )
+        FedDataset.__init__(self, x, y, client_indices)
+        self.seq_len = T
+
+    def _unpack(self, xt: np.ndarray, y: np.ndarray) -> dict:
+        C, T = self.num_candidates, self.seq_len
+        lead = xt.shape[:-1]
+        return {
+            "input_ids": xt[..., : C * T].reshape(lead + (C, T)),
+            "token_type_ids": xt[..., C * T :].reshape(lead + (C, T)),
+            "labels": y[..., : C * T].reshape(lead + (C, T)),
+            "mc_label": y[..., C * T],
+        }
 
 
 def _find_personachat_json(root: str) -> str | None:
@@ -158,15 +229,17 @@ def _find_personachat_json(root: str) -> str | None:
     return None
 
 
-def _from_json(path: str, tok, seq_len: int):
+def _from_json(path: str, tok, seq_len: int, num_candidates: int = 1, seed: int = 0):
     """Parse the transfer-learning-conv-ai json into persona-grouped packed
     examples. Gold reply = candidates[-1] (the lineage's convention; the
-    other candidates are next-utterance-classification distractors)."""
+    other candidates are next-utterance-classification distractors —
+    consumed when num_candidates > 1, discarded for the LM-only path)."""
     with open(path) as f:
         blob = json.load(f)
+    rng = np.random.RandomState(seed)
 
     def build(split):
-        by_persona: dict[str, list[tuple[np.ndarray, np.ndarray, np.ndarray]]] = {}
+        by_persona: dict[str, list] = {}
         for dialog in split:
             persona_sents = [tok.encode(s) for s in dialog["personality"]]
             key = " ".join(dialog["personality"])
@@ -174,29 +247,76 @@ def _from_json(path: str, tok, seq_len: int):
             for utt in dialog["utterances"]:
                 history = [tok.encode(h) for h in utt["history"][-MAX_HISTORY_UTTERANCES:]]
                 reply = tok.encode(utt["candidates"][-1])
-                seqs.append(pack_example(persona_sents, history, reply, tok, seq_len))
+                if num_candidates > 1:
+                    distr = utt["candidates"][:-1]
+                    take = min(num_candidates - 1, len(distr))
+                    picks = rng.choice(len(distr), size=take, replace=False) if distr else []
+                    seqs.append(_pack_candidates(
+                        persona_sents, history, reply,
+                        [tok.encode(distr[i]) for i in picks],
+                        tok, seq_len, rng, num_candidates,
+                    ))
+                else:
+                    seqs.append(pack_example(persona_sents, history, reply, tok, seq_len))
         return by_persona
 
     return build(blob["train"]), build(blob.get("valid", []))
 
 
-def _synthetic(num_clients: int, seq_len: int, tok, seed: int):
+def _synthetic(num_clients: int, seq_len: int, tok, seed: int, num_candidates: int = 1):
     """Persona-grouped synthetic corpus: each persona has a word-distribution
     'style' so per-client data is non-iid, as in the real set. Examples go
-    through the same build_input_from_segments packing (empty persona and
-    history; the text is the reply)."""
+    through the same build_input_from_segments packing. With num_candidates >
+    1 each persona gets a persona sentence built from its favored words and
+    distractors drawn from OTHER personas' replies, so the MC task (does the
+    reply match the persona?) is learnable, mirroring the real set."""
     rng = np.random.RandomState(seed)
     words = ["the", "cat", "dog", "runs", "jumps", "likes", "hates", "sees",
              "red", "blue", "big", "small", "fast", "slow", "happy", "sad"]
-    by_persona = {}
+
+    # concentration/pool choices are gated on num_candidates so the LM-only
+    # corpus (and its val_ppl trajectories at a given seed) is byte-identical
+    # to what it always was
+    conc = 0.9 if num_candidates > 1 else 0.7
+
+    def gen_text(favored):
+        n_words = rng.randint(8, max(9, seq_len // 4))
+        return " ".join(words[favored[rng.randint(6)]] if rng.rand() < conc
+                        else words[rng.randint(len(words))] for _ in range(n_words))
+
+    # MC path only: all personas favor words from the LOWER half of the
+    # vocabulary; distractor replies are drawn from the reserved UPPER half.
+    # True PersonaChat distractor semantics (random other utterances,
+    # resolvable only by matching against the persona) come from _from_json
+    # on the real set; the synthetic corpus deliberately carries a linearly-
+    # readable gold-vs-distractor signal instead, so the double-head
+    # OBJECTIVE (joint loss, candidate batching, mc metrics) is testable
+    # within a few rounds on a tiny model — a matching circuit is not
+    # learnable at that scale.
+    half = len(words) // 2
+    pool = half if num_candidates > 1 else len(words)
+    personas = []
     for c in range(num_clients):
-        favored = rng.choice(len(words), size=6, replace=False)
-        seqs = []
-        for _ in range(rng.randint(4, 12)):
-            n_words = rng.randint(8, max(9, seq_len // 4))
-            text = " ".join(words[favored[rng.randint(6)]] if rng.rand() < 0.7
-                            else words[rng.randint(len(words))] for _ in range(n_words))
-            seqs.append(pack_example([], [], tok.encode(text), tok, seq_len))
+        favored = rng.choice(pool, size=6, replace=False)
+        personas.append((favored, [gen_text(favored) for _ in range(rng.randint(4, 12))]))
+
+    by_persona = {}
+    for c, (favored, texts) in enumerate(personas):
+        if num_candidates > 1:
+            persona_sents = [tok.encode("likes " + " ".join(words[i] for i in favored))]
+            seqs = []
+            for text in texts:
+                others = [
+                    gen_text(half + rng.choice(half, size=6, replace=False))
+                    for _ in range(num_candidates - 1)
+                ]
+                seqs.append(_pack_candidates(
+                    persona_sents, [], tok.encode(text),
+                    [tok.encode(o) for o in others], tok, seq_len, rng,
+                    num_candidates,
+                ))
+        else:
+            seqs = [pack_example([], [], tok.encode(t), tok, seq_len) for t in texts]
         by_persona[f"persona_{c}"] = seqs
     # valid split: last sequence of every 10th persona
     valid = {p: [s[-1]] for i, (p, s) in enumerate(by_persona.items()) if i % 10 == 0}
@@ -216,18 +336,38 @@ def _to_fed(by_persona: dict) -> FedTextDataset:
     return FedTextDataset(np.stack(xs), np.stack(ts), np.stack(ys), shards)
 
 
+def _to_fed_mc(by_persona: dict) -> FedTextMCDataset:
+    ids, ts, ys, mc, shards = [], [], [], [], []
+    offset = 0
+    for seqs in by_persona.values():
+        for x, t, y, pos in seqs:
+            ids.append(x)
+            ts.append(t)
+            ys.append(y)
+            mc.append(pos)
+        shards.append(np.arange(offset, offset + len(seqs)))
+        offset += len(seqs)
+    return FedTextMCDataset(
+        np.stack(ids), np.stack(ts), np.stack(ys), np.asarray(mc), shards
+    )
+
+
 def load_personachat_fed(
     data_root: str = "./data",
     num_clients: int = 1000,
     seq_len: int = 256,
     seed: int = 0,
+    num_candidates: int = 1,
 ):
-    """Returns (train FedTextDataset, valid FedTextDataset, tokenizer)."""
+    """Returns (train, valid, tokenizer): FedTextDataset for the LM-only
+    objective (num_candidates == 1), FedTextMCDataset candidate sets for the
+    double-head LM+MC objective (num_candidates > 1)."""
     tok = get_tokenizer()
     path = _find_personachat_json(data_root)
     if path:
-        train_p, valid_p = _from_json(path, tok, seq_len)
+        train_p, valid_p = _from_json(path, tok, seq_len, num_candidates, seed)
     else:
-        train_p, valid_p = _synthetic(num_clients, seq_len, tok, seed)
+        train_p, valid_p = _synthetic(num_clients, seq_len, tok, seed, num_candidates)
     valid = valid_p if valid_p else {k: v for k, v in list(train_p.items())[:10]}
-    return _to_fed(train_p), _to_fed(valid), tok
+    to = _to_fed_mc if num_candidates > 1 else _to_fed
+    return to(train_p), to(valid), tok

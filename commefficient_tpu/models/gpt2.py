@@ -34,6 +34,10 @@ class GPT2Config:
     attn_impl: str = "dense"  # "dense" | "ring" (ring needs a 'seq' mesh axis)
     ring_axis: str = "seq"  # mesh axis ring attention shards T over (the mesh
     # itself comes from jax.set_mesh or an explicit arg — ops/ring_attention)
+    with_mc_head: bool = False  # next-utterance-classification head (the
+    # transfer-learning-conv-ai double-head the reference inherits: hidden
+    # state at each candidate's last token -> linear -> candidate score;
+    # SURVEY.md §3.2 "possibly + next-utterance-classification head")
     ln_eps: float = 1e-5  # GPT-2 uses 1e-5; needed for pretrained logit parity
 
     @property
@@ -104,12 +108,15 @@ class Block(nn.Module):
 
 
 class GPT2LMHead(nn.Module):
-    """Causal LM with tied input/output embeddings (as GPT-2)."""
+    """Causal LM with tied input/output embeddings (as GPT-2); optional
+    next-utterance-classification head (cfg.with_mc_head)."""
 
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = True, token_type_ids=None):
+    def __call__(
+        self, input_ids, train: bool = True, token_type_ids=None, mc_positions=None
+    ):
         cfg = self.cfg
         B, T = input_ids.shape
         wte = self.param(
@@ -133,4 +140,17 @@ class GPT2LMHead(nn.Module):
             x = block(cfg, name=f"h_{i}")(x, train)
         x = nn.LayerNorm(epsilon=cfg.ln_eps, name="ln_f")(x)
         # tied LM head; logits in float32 for a stable softmax
-        return jnp.einsum("btc,vc->btv", x.astype(jnp.float32), wte)
+        lm_logits = jnp.einsum("btc,vc->btv", x.astype(jnp.float32), wte)
+        if not cfg.with_mc_head:
+            return lm_logits
+        # declared unconditionally (init/apply must agree); consumed only
+        # when the caller passes candidate-final positions
+        mc_w = self.param(
+            "mc_head", nn.initializers.normal(0.02), (cfg.n_embd,), jnp.float32
+        )
+        if mc_positions is None:
+            return lm_logits
+        h_last = jnp.take_along_axis(
+            x.astype(jnp.float32), mc_positions[:, None, None], axis=1
+        )[:, 0]  # [B, E] hidden at each sequence's mc token
+        return lm_logits, h_last @ mc_w

@@ -42,6 +42,79 @@ def make_classification_loss(model, train: bool):
     return loss_fn
 
 
+def make_lm_mc_loss(model, train: bool, mc_coef: float = 1.0, pad_id: int = 0):
+    """Joint LM + next-utterance-classification loss (the transfer-learning-
+    conv-ai double-head objective the reference inherits — SURVEY.md §3.2).
+
+    batch = {"input_ids": [B, C, T], "token_type_ids": [B, C, T],
+    "labels": [B, C, T] (-100 = ignore; only the gold candidate carries
+    reply labels), "mc_label": [B] int (gold candidate index; -100 = padded
+    example)}. Every candidate runs through the transformer (flattened to
+    [B*C, T]); the MC head scores each candidate's last non-pad token and a
+    softmax CE over the C candidates is added with weight `mc_coef`.
+    Metrics add mc_correct / mc_count (mc_acc = mc_correct / mc_count).
+    """
+
+    def loss_fn(params, net_state, batch, rng):
+        ids = batch["input_ids"]
+        B, C, T = ids.shape
+        flat = lambda a: a.reshape(B * C, T)  # noqa: E731
+        # last non-pad position of every candidate (pad is only ever a tail)
+        lengths = jnp.maximum((flat(ids) != pad_id).sum(-1), 1)
+        lm_logits, mc_logits = model.apply(
+            {"params": params},
+            flat(ids),
+            train=train,
+            token_type_ids=flat(batch["token_type_ids"]),
+            mc_positions=lengths - 1,
+            rngs={"dropout": rng} if (train and rng is not None) else None,
+        )
+        # LM term: only the gold candidate carries labels (distractors are
+        # all -100 by construction), so gather it BEFORE the vocab softmax —
+        # the [B*C, T, V] log_softmax would be C-fold wasted work/memory
+        gold = jnp.maximum(batch["mc_label"], 0)  # [B]; pad rows -> 0 (masked)
+        V = lm_logits.shape[-1]
+        lm_lgt = jnp.take_along_axis(
+            lm_logits.reshape(B, C, T, V), gold[:, None, None, None], axis=1
+        )[:, 0, :-1]
+        labels = jnp.take_along_axis(
+            batch["labels"], gold[:, None, None], axis=1
+        )[:, 0, 1:]
+        mask = (labels != -100).astype(lm_lgt.dtype)
+        safe = jnp.maximum(labels, 0)
+        logp = jax.nn.log_softmax(lm_lgt)
+        per_tok = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        count = jnp.maximum(mask.sum(), 1.0)
+        lm_loss = (per_tok * mask).sum() / count
+        lm_correct = ((lm_lgt.argmax(-1) == safe) * mask).sum()
+
+        # MC term: softmax CE over candidates
+        scores = mc_logits.reshape(B, C)
+        mc_label = batch["mc_label"]
+        mc_mask = (mc_label >= 0).astype(scores.dtype)
+        safe_mc = jnp.maximum(mc_label, 0)
+        mc_logp = jax.nn.log_softmax(scores, axis=-1)
+        per_ex = -jnp.take_along_axis(mc_logp, safe_mc[:, None], axis=1)[:, 0]
+        mc_count = jnp.maximum(mc_mask.sum(), 1.0)
+        mc_loss = (per_ex * mc_mask).sum() / mc_count
+        mc_correct = ((scores.argmax(-1) == safe_mc) * mc_mask).sum()
+
+        loss = lm_loss + mc_coef * mc_loss
+        return loss, {
+            "net_state": net_state,
+            "metrics": {
+                "loss_sum": (per_tok * mask).sum(),
+                "count": mask.sum(),
+                "correct": lm_correct,
+                "mc_loss_sum": (per_ex * mc_mask).sum(),
+                "mc_count": mc_mask.sum(),
+                "mc_correct": mc_correct,
+            },
+        }
+
+    return loss_fn
+
+
 def make_lm_loss(model, train: bool):
     """Next-token cross-entropy for causal LMs.
 

@@ -85,6 +85,14 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
         p.add_argument("--seq_parallel", type=int, default=1,
                        help="sequence-parallel ways (mesh 'seq' axis) for "
                             "--attn_impl ring")
+        p.add_argument("--mc_coef", type=float, default=0.0,
+                       help="> 0 enables the next-utterance-classification "
+                            "head: joint loss lm + mc_coef * mc over "
+                            "--num_candidates candidate replies "
+                            "(transfer-learning-conv-ai double head)")
+        p.add_argument("--num_candidates", type=int, default=2,
+                       help="candidates per example (gold + distractors) "
+                            "when --mc_coef > 0")
     return p
 
 

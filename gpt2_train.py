@@ -35,8 +35,15 @@ from commefficient_tpu.utils.schedules import triangular
 
 
 def build(args):
+    if args.mc_coef > 0 and args.num_candidates < 2:
+        raise SystemExit(
+            "--mc_coef > 0 needs --num_candidates >= 2 (the MC head scores "
+            "a gold reply against at least one distractor)"
+        )
+    num_candidates = args.num_candidates if args.mc_coef > 0 else 1
     train_set, valid_set, tok = load_personachat_fed(
-        args.data_root, args.num_clients, args.seq_len, args.seed
+        args.data_root, args.num_clients, args.seq_len, args.seed,
+        num_candidates=num_candidates,
     )
     args.num_clients = train_set.num_clients
     if args.init_from:
@@ -48,8 +55,16 @@ def build(args):
             args.init_from, target_vocab_size=tok.vocab_size,
             n_positions=max(args.seq_len, 1),
         )
-        cfg = dataclasses.replace(cfg, attn_impl=args.attn_impl)
+        cfg = dataclasses.replace(
+            cfg, attn_impl=args.attn_impl, with_mc_head=args.mc_coef > 0
+        )
         model = GPT2LMHead(cfg)
+        if cfg.with_mc_head:
+            # the HF checkpoint has no MC head; initialize it fresh
+            params = dict(params)
+            params["mc_head"] = 0.02 * jax.random.normal(
+                jax.random.PRNGKey(args.seed), (cfg.n_embd,), jnp.float32
+            )
         # structural sanity: loaded tree must match what init would build
         # (eval_shape: shapes/structure only, no allocation of a second tree)
         ids0 = jnp.zeros((1, args.seq_len), dtype=jnp.int32)
@@ -65,7 +80,7 @@ def build(args):
         base = TINY if args.model_size == "tiny" else SMALL
         cfg = dataclasses.replace(
             base, vocab_size=tok.vocab_size, n_positions=max(args.seq_len, 1),
-            attn_impl=args.attn_impl,
+            attn_impl=args.attn_impl, with_mc_head=args.mc_coef > 0,
         )
         model = GPT2LMHead(cfg)
         ids0 = jnp.zeros((1, args.seq_len), dtype=jnp.int32)
@@ -94,10 +109,18 @@ def build(args):
     elif jax.device_count() > 1:
         mesh = meshlib.make_mesh(args.num_devices or None)
 
+    if args.mc_coef > 0:
+        from commefficient_tpu.models.losses import make_lm_mc_loss
+
+        train_loss = make_lm_mc_loss(model, True, args.mc_coef, tok.pad_id)
+        eval_loss = make_lm_mc_loss(model, False, args.mc_coef, tok.pad_id)
+    else:
+        train_loss = make_lm_loss(model, train=True)
+        eval_loss = make_lm_loss(model, train=False)
     mode_cfg = mode_config_from_args(args, d)
     session = FederatedSession(
-        train_loss_fn=make_lm_loss(model, train=True),
-        eval_loss_fn=make_lm_loss(model, train=False),
+        train_loss_fn=train_loss,
+        eval_loss_fn=eval_loss,
         params=params,
         net_state={},
         mode_cfg=mode_cfg,
@@ -143,7 +166,7 @@ def main(argv=None):
     logger = TableLogger(args.log_jsonl or None)
     timer = Timer()
     eval_every = args.eval_every or min(rounds_per_epoch, 200)
-    acc_loss = acc_count = 0.0
+    acc_loss = acc_count = acc_mc_correct = acc_mc_count = 0.0
     # cumulative from round 0 — derived, so checkpoint resume stays consistent
     comm_mb = session.round * session.comm_per_round["comm_total_mb"]
     for rnd in range(session.round, total_rounds):
@@ -151,6 +174,8 @@ def main(argv=None):
         opt.step()
         acc_loss += m["loss_sum"]
         acc_count += m["count"]
+        acc_mc_correct += m.get("mc_correct", 0.0)
+        acc_mc_count += m.get("mc_count", 0.0)
         comm_mb += m["comm_total_mb"]
         if args.checkpoint_every and args.checkpoint_dir and (rnd + 1) % args.checkpoint_every == 0:
             ckpt.save(args.checkpoint_dir, session)
@@ -158,7 +183,7 @@ def main(argv=None):
             ev = model.eval(valid_set, args.eval_batch_size)
             train_nll = acc_loss / max(acc_count, 1)
             val_nll = ev["loss_sum"] / max(ev["count"], 1)
-            logger.append({
+            row = {
                 "round": rnd + 1,
                 "epoch": (rnd + 1) / rounds_per_epoch,
                 "lr": m["lr"],
@@ -168,8 +193,12 @@ def main(argv=None):
                 "val_ppl": math.exp(min(val_nll, 20)),
                 "comm_mb": comm_mb,
                 "time_s": timer(),
-            })
-            acc_loss = acc_count = 0.0
+            }
+            if args.mc_coef > 0:
+                row["mc_acc"] = acc_mc_correct / max(acc_mc_count, 1)
+                row["val_mc_acc"] = ev.get("mc_correct", 0.0) / max(ev.get("mc_count", 0.0), 1)
+            logger.append(row)
+            acc_loss = acc_count = acc_mc_correct = acc_mc_count = 0.0
 
     if args.profile_dir:
         jax.profiler.stop_trace()
