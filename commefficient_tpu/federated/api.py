@@ -206,6 +206,15 @@ class FedOptimizer:
         self._round = 0
 
     @property
+    def round(self) -> int:
+        """Schedule position; settable for checkpoint resume."""
+        return self._round
+
+    @round.setter
+    def round(self, value: int):
+        self._round = int(value)
+
+    @property
     def lr(self) -> float:
         return float(self.schedule(self._round / self.rounds_per_epoch))
 

@@ -157,7 +157,7 @@ def main(argv=None):
         path = ckpt.latest(args.checkpoint_dir)
         if path:
             ckpt.restore(path, session)
-            opt._round = session.round
+            opt.round = session.round
             print(f"resumed from {path} at round {session.round}", flush=True)
 
     if args.profile_dir:
