@@ -74,6 +74,12 @@ SKETCH_COLS = int(os.environ.get("BENCH_COLS", 524_288))
 TOPK = int(os.environ.get("BENCH_TOPK", 50_000))
 NUM_BLOCKS = int(os.environ.get("BENCH_BLOCKS", 4))
 WARMUP_ROUNDS = int(os.environ.get("BENCH_WARMUP", 3))
+# model compute dtype; bfloat16 (default) is the TPU-native choice — convs/
+# matmuls on the MXU at full rate, params/BN/logits f32 (cifar10-fast trains
+# half-precision too). BENCH_DTYPE=float32 measures the f32 path.
+BENCH_DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
+if BENCH_DTYPE not in ("float32", "bfloat16"):  # models silently f32 otherwise
+    raise SystemExit(f"BENCH_DTYPE must be float32|bfloat16, got {BENCH_DTYPE!r}")
 # timed work = BENCH_CHAINS chains of BENCH_CHAIN_LEN dependent rounds, one
 # device_get sync per chain (>= 30 rounds total for stable percentiles)
 CHAIN_LEN = int(os.environ.get("BENCH_CHAIN_LEN", 10))
@@ -220,7 +226,7 @@ def _resnet9_workload():
     from commefficient_tpu.models.losses import make_classification_loss
     from commefficient_tpu.models.resnet9 import ResNet9
 
-    model = ResNet9(num_classes=10)
+    model = ResNet9(num_classes=10, dtype=BENCH_DTYPE)
     x0 = jnp.zeros((1, 32, 32, 3), dtype=jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), x0, train=False)
     params = variables["params"]
@@ -253,7 +259,7 @@ def _gpt2_workload():
 
     workers = int(os.environ.get("BENCH_WORKERS", 4))
     seq = int(os.environ.get("BENCH_SEQ", 256))
-    cfg = dataclasses.replace(SMALL, n_positions=seq, dropout=0.0)
+    cfg = dataclasses.replace(SMALL, n_positions=seq, dropout=0.0, dtype=BENCH_DTYPE)
     model = GPT2LMHead(cfg)
     ids0 = jnp.zeros((1, seq), dtype=jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids0, train=False)["params"]
@@ -381,6 +387,7 @@ def run_bench(platform: str) -> dict:
         "vs_baseline": round(updates_per_sec_per_chip / REFERENCE_CLIENT_UPDATES_PER_SEC, 3),
         "platform": platform,
         "device_kind": device_kind,
+        "compute_dtype": BENCH_DTYPE,
         "sketch": {"rows": mode_cfg.num_rows, "cols": mode_cfg.num_cols,
                    "k": mode_cfg.k, "blocks": mode_cfg.num_blocks, "d": int(d)},
         "round_ms": round(round_ms, 2),

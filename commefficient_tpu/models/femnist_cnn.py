@@ -1,23 +1,28 @@
 """Small FEMNIST CNN (SURVEY.md L0b): the LEAF-standard 2-conv network for
-62-class handwritten character recognition on 28x28 inputs."""
+62-class handwritten character recognition on 28x28 inputs.  `dtype` follows
+the ResNet-9 convention: compute dtype only, params and logits float32."""
 
 from __future__ import annotations
 
 import flax.linen as nn
+import jax.numpy as jnp
 
 
 class FEMNISTCNN(nn.Module):
     num_classes: int = 62
+    dtype: str = "float32"  # compute dtype: "float32" | "bfloat16"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        x = nn.Conv(32, (5, 5), padding=2)(x)
+        dt = jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+        x = x.astype(dt)
+        x = nn.Conv(32, (5, 5), padding=2, dtype=dt)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(64, (5, 5), padding=2)(x)
+        x = nn.Conv(64, (5, 5), padding=2, dtype=dt)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(2048)(x)
+        x = nn.Dense(2048, dtype=dt)(x)
         x = nn.relu(x)
-        return nn.Dense(self.num_classes)(x)
+        return nn.Dense(self.num_classes, dtype=dt)(x).astype(jnp.float32)

@@ -6,6 +6,12 @@ Structure: prep conv -> (conv+pool) layer with residual -> middle conv+pool ->
 after every conv and logits scaled by 0.125.  Written as flax NNX-free linen
 for a clean `{"params", "batch_stats"}` split that the federated engine
 threads through its `net_state`.
+
+`dtype` selects the compute dtype for convs/dense (bfloat16 on TPU puts the
+convs on the MXU at full rate — the cifar10-fast lineage itself trains in
+half precision); params, BN statistics, and logits stay float32 (BN in f32
+for stable running stats, logits in f32 for a stable softmax), matching the
+GPT-2 path's mixed-precision convention (models/gpt2.py).
 """
 
 from __future__ import annotations
@@ -16,40 +22,49 @@ import jax.numpy as jnp
 
 class ConvBN(nn.Module):
     features: int
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
-        x = nn.Conv(self.features, (3, 3), padding=1, use_bias=False)(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5)(x)
-        return nn.relu(x)
+        x = nn.Conv(self.features, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        # BN computes its statistics in float32 regardless of input dtype
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5, dtype=jnp.float32
+        )(x)
+        return nn.relu(x).astype(self.dtype)
 
 
 class Residual(nn.Module):
     features: int
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool):
-        y = ConvBN(self.features)(x, train)
-        y = ConvBN(self.features)(y, train)
+        y = ConvBN(self.features, self.dtype)(x, train)
+        y = ConvBN(self.features, self.dtype)(y, train)
         return x + y
 
 
 class ResNet9(nn.Module):
     num_classes: int = 10
     logit_scale: float = 0.125
+    dtype: str = "float32"  # compute dtype: "float32" | "bfloat16"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        x = ConvBN(64)(x, train)  # prep
-        x = ConvBN(128)(x, train)
+        dt = jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+        x = x.astype(dt)
+        x = ConvBN(64, dt)(x, train)  # prep
+        x = ConvBN(128, dt)(x, train)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = Residual(128)(x, train)
-        x = ConvBN(256)(x, train)
+        x = Residual(128, dt)(x, train)
+        x = ConvBN(256, dt)(x, train)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = ConvBN(512)(x, train)
+        x = ConvBN(512, dt)(x, train)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = Residual(512)(x, train)
+        x = Residual(512, dt)(x, train)
         x = nn.max_pool(x, (4, 4), strides=(4, 4))
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(self.num_classes)(x)
-        return x * self.logit_scale
+        x = nn.Dense(self.num_classes, dtype=dt)(x)
+        # logits in float32 for a stable softmax
+        return x.astype(jnp.float32) * self.logit_scale

@@ -66,6 +66,9 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
     p.add_argument("--checkpoint_every", type=int, default=0, help="rounds; 0 = never")
     p.add_argument("--log_jsonl", default="")
     p.add_argument("--profile_dir", default="", help="write a jax.profiler trace here")
+    p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"],
+                   help="model compute dtype (params/BN/logits stay float32); "
+                        "bfloat16 runs convs/matmuls on the TPU MXU at full rate")
     if task == "cv":
         p.add_argument("--dataset", default="cifar10",
                        choices=["cifar10", "cifar100", "femnist"])

@@ -39,13 +39,13 @@ def build(args):
         train_set, test_set, num_classes = load_femnist_fed(
             args.data_root, args.num_clients, args.seed
         )
-        model = FEMNISTCNN(num_classes=num_classes)
+        model = FEMNISTCNN(num_classes=num_classes, dtype=args.dtype)
         sample_shape = (1, 28, 28, 1)
     else:
         train_set, test_set, num_classes = load_cifar_fed(
             args.dataset, args.num_clients, args.iid, args.data_root, args.seed
         )
-        model = ResNet9(num_classes=num_classes)
+        model = ResNet9(num_classes=num_classes, dtype=args.dtype)
         sample_shape = (1, 32, 32, 3)
     args.num_clients = train_set.num_clients  # actual shard count
 

@@ -56,7 +56,8 @@ def build(args):
             n_positions=max(args.seq_len, 1),
         )
         cfg = dataclasses.replace(
-            cfg, attn_impl=args.attn_impl, with_mc_head=args.mc_coef > 0
+            cfg, attn_impl=args.attn_impl, with_mc_head=args.mc_coef > 0,
+            dtype=args.dtype,
         )
         model = GPT2LMHead(cfg)
         if cfg.with_mc_head:
@@ -81,6 +82,7 @@ def build(args):
         cfg = dataclasses.replace(
             base, vocab_size=tok.vocab_size, n_positions=max(args.seq_len, 1),
             attn_impl=args.attn_impl, with_mc_head=args.mc_coef > 0,
+            dtype=args.dtype,
         )
         model = GPT2LMHead(cfg)
         ids0 = jnp.zeros((1, args.seq_len), dtype=jnp.int32)
