@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-3 TPU validation batch — run when the axon tunnel is alive
+# (probe first: timeout 100 python -c "import jax, jax.numpy as jnp;
+#  x=jnp.ones((128,128)); print(float(jax.device_get((x@x).sum())))").
+# Produces, in order:
+#   1. pallas probe + library routing check on the real chip
+#   2. BENCH_r03 flagship JSON (ResNet-9 bf16, MFU + forensics)  -> stdout
+#   3. BENCH_gpt2_r03.json (GPT-2-small d~124M, c=2^20, 20 blocks)
+#   4. results/cifar10_smoke_tpu.jsonl (48-round cv_train smoke + profile)
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. probe + routing
+timeout 600 python -c "
+import jax, jax.numpy as jnp
+from commefficient_tpu.sketch import csvec
+from commefficient_tpu.sketch.csvec import CSVecSpec
+from commefficient_tpu.sketch import pallas_kernels as pk
+spec = CSVecSpec(d=6_500_000, c=524_288, r=5, family='rotation')
+print('use_pallas(flagship):', csvec._use_pallas(spec))
+print('probe:', pk.probe_status())
+" 2>&1 | grep -v WARNING
+
+# 2. flagship bench
+timeout 3600 python bench.py 2>&1 | grep -v WARNING | tail -5
+
+# 3. GPT-2 bench
+BENCH_MODEL=gpt2 timeout 3600 python bench.py 2>&1 | grep -v WARNING | tail -3 | tee /tmp/bench_gpt2.out
+grep -o '{.*}' /tmp/bench_gpt2.out | tail -1 > BENCH_gpt2_r03.json || true
+
+# 4. cv_train smoke on the real chip
+timeout 3600 python cv_train.py --dataset cifar10 --mode sketch \
+    --k 50000 --num_cols 524288 --num_rows 5 --num_blocks 4 \
+    --momentum_type virtual --error_type virtual \
+    --num_clients 100 --num_workers 8 --num_rounds 48 --num_epochs 4 \
+    --eval_every 8 --lr_scale 0.4 --seed 42 --dtype bfloat16 \
+    --profile_dir /tmp/tpu_trace \
+    --log_jsonl results/cifar10_smoke_tpu.jsonl 2>&1 | grep -v WARNING | tail -10

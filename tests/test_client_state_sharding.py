@@ -171,3 +171,25 @@ def test_local_topk_down_bytes_measured_not_worst_case():
     support = m["comm_down_mb"] * 1e6 / (BYTES_PAIR * 8)
     assert support == int(support)  # integral pair count
     assert "down_support" not in m  # folded into the comm figures
+
+
+def test_sharded_client_state_hybrid_mesh_matches_unsharded():
+    """Same parity on a 2-slice x 4-device hybrid (DCN x ICI) mesh: the
+    [num_clients, d] state shards over (slices, clients) and the round still
+    matches the single-device session."""
+    hmesh = meshlib.make_mesh(8, num_slices=2)
+    s_ref = _session(16, mesh=None, seed=5)
+    s_mesh = _session(16, mesh=hmesh, seed=5)
+    for _ in range(3):
+        s_ref.run_round(0.1)
+        s_mesh.run_round(0.1)
+    np.testing.assert_allclose(
+        np.asarray(ravel_pytree(s_ref.state["params"])[0]),
+        np.asarray(ravel_pytree(s_mesh.state["params"])[0]),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s_ref.client_state["error"]),
+        np.asarray(s_mesh.client_state["error"]),
+        rtol=1e-5, atol=1e-6,
+    )
