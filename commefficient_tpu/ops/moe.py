@@ -1,0 +1,102 @@
+"""Expert parallelism: Switch-Transformer-style top-1 mixture-of-experts FFN
+with the expert axis sharded over the mesh.
+
+The reference has no MoE (its models are ResNet-9 and GPT-2-small —
+SURVEY.md §2); this op completes the rebuild's parallelism coverage
+(dp/tp/sp/pp/ep) the TPU-native way: routing is expressed as dense one-hot
+dispatch/combine einsums (the GShard/Switch recipe — no gather/scatter, no
+dynamic shapes, capacity overflow dropped), so sharding the expert axis of
+the dispatched activations and expert weights over the mesh turns the
+einsums into an all-to-all + per-device expert matmuls, all inserted by XLA
+from the shardings alone.
+
+Semantics (top-1, capacity factor c):
+- router logits [T, E] -> gate = softmax; expert = argmax.
+- each expert processes at most C = ceil(c * T / E) tokens (position within
+  the expert's queue via a cumsum over arrival order); overflow tokens pass
+  through unchanged (standard Switch behavior).
+- output = gate * expert_out + (1 - routed) * x  (dropped tokens keep x).
+- aux load-balancing loss = E * sum_e f_e * p_e (Switch eq. 4), returned so
+  callers can add `aux_coef * aux` to their objective.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_dispatch(router_logits: jnp.ndarray, capacity: int):
+    """Dispatch/combine tensors for top-1 routing.
+
+    router_logits: [T, E]. Returns (dispatch [T, E, C] bool-ish float,
+    combine [T, E, C] float, aux scalar). Token t occupies slot
+    (its arrival position among tokens routed to e) in expert e's queue iff
+    that position < capacity.
+    """
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # [T, E]
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [T, E]
+    # position of each token in its expert's queue (arrival order)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [T, E]; 0-based
+    kept = onehot * (pos < capacity)  # [T, E]
+    slot = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = kept[:, :, None] * slot[:, None, :]  # [T, E, C]
+    gate = (probs * kept).sum(-1)  # [T]
+    combine = dispatch * gate[:, None, None]
+    # Switch load-balancing aux: E * sum_e (fraction routed to e) * (mean prob e)
+    frac = onehot.mean(0)
+    mean_p = probs.mean(0)
+    aux = E * jnp.sum(frac * mean_p)
+    return dispatch, combine, aux
+
+
+def moe_ffn(
+    x: jnp.ndarray,
+    router_w: jnp.ndarray,
+    expert_params,
+    expert_fn: Callable,
+    *,
+    capacity_factor: float = 1.25,
+):
+    """Top-1 MoE FFN over tokens x [T, D].
+
+    `expert_params` leaves have leading axis [E] (shard it over the mesh's
+    expert axis; with x replicated or batch-sharded, XLA lowers the dispatch
+    einsum to an all-to-all). `expert_fn(params_e, h [C, D]) -> [C, D]`
+    applies one expert. Returns (y [T, D], aux).
+
+    Capacity overflow and unrouted mass degrade to identity (residual MoE
+    blocks add x outside), matching Switch's pass-through behavior.
+    """
+    T, D = x.shape
+    E = jax.tree.leaves(expert_params)[0].shape[0]
+    C = max(1, math.ceil(capacity_factor * T / E))
+    logits = x.astype(jnp.float32) @ router_w  # [T, E]
+    dispatch, combine, aux = top1_dispatch(logits, C)
+    # [T, E, C] x [T, D] -> [E, C, D]: expert-major queues
+    h = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    y = jax.vmap(expert_fn)(expert_params, h)  # [E, C, D]
+    out = jnp.einsum("tec,ecd->td", combine, y.astype(jnp.float32))
+    routed = combine.sum((1, 2))  # [T] gate mass that actually landed
+    out = out + (1.0 - routed)[:, None] * x.astype(jnp.float32)
+    return out.astype(x.dtype), aux
+
+
+def dense_oracle(x, router_w, expert_params, expert_fn):
+    """Every token through its argmax expert with NO capacity limit — the
+    correctness oracle moe_ffn must match when capacity is not binding."""
+    T, D = x.shape
+    probs = jax.nn.softmax(x.astype(jnp.float32) @ router_w, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    # run EVERY expert on ALL tokens, select after (oracle only — O(E*T*D))
+    all_y = jax.vmap(lambda p: expert_fn(p, x.astype(jnp.float32)))(expert_params)
+    sel = all_y[expert, jnp.arange(T)]  # [T, D]
+    # same residual convention as moe_ffn: (1 - gate) of every token's mass
+    # stays on x (no token is dropped here, so routed == gate)
+    return (gate[:, None] * sel + (1.0 - gate)[:, None] * x.astype(jnp.float32)).astype(x.dtype)
