@@ -38,6 +38,12 @@ class GPT2Config:
     # transfer-learning-conv-ai double-head the reference inherits: hidden
     # state at each candidate's last token -> linear -> candidate score;
     # SURVEY.md §3.2 "possibly + next-utterance-classification head")
+    moe_experts: int = 0  # > 0 replaces every `moe_every`-th block's MLP
+    # with a Switch-style top-1 MoE of this many experts (ops/moe.py);
+    # shard their [E, ...] leading axis over an 'expert' mesh axis for EP.
+    # The reference has no MoE — this is rebuild-side scale headroom.
+    moe_every: int = 2  # Switch convention: MoE in every 2nd block
+    moe_capacity: float = 1.25  # capacity factor (tokens/expert cap)
     ln_eps: float = 1e-5  # GPT-2 uses 1e-5; needed for pretrained logit parity
 
     @property
@@ -96,14 +102,55 @@ class MLP(nn.Module):
         return nn.Dropout(cfg.dropout, deterministic=not train)(h)
 
 
+class MoEMLP(nn.Module):
+    """Switch-style top-1 MoE replacement for the FFN (ops/moe.py). The
+    load-balancing aux loss is sown under intermediates/moe_aux; loss
+    adapters read it via mutable=['intermediates']."""
+
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        from ..ops import moe
+
+        cfg = self.cfg
+        B, T, C = x.shape
+        E = cfg.moe_experts
+        router = self.param("router", nn.initializers.normal(0.02), (C, E), jnp.float32)
+        wi = self.param(
+            "wi", nn.initializers.normal(0.02), (E, C, 4 * C), jnp.float32
+        )
+        wo = self.param(
+            "wo", nn.initializers.normal(0.02 / (2 * cfg.n_layer) ** 0.5),
+            (E, 4 * C, C), jnp.float32,
+        )
+
+        def expert_fn(p, h):
+            # expert matmuls (the MoE block's dominant FLOPs) honor the
+            # compute dtype like MLP's c_fc/c_proj; routing/dispatch stay f32
+            h = h.astype(cfg.compute_dtype)
+            y = nn.gelu(h @ p["wi"].astype(cfg.compute_dtype), approximate=True)
+            return (y @ p["wo"].astype(cfg.compute_dtype)).astype(jnp.float32)
+
+        y, aux = moe.moe_ffn(
+            x.reshape(B * T, C), router, {"wi": wi, "wo": wo}, expert_fn,
+            capacity_factor=cfg.moe_capacity,
+        )
+        self.sow("intermediates", "moe_aux", aux)
+        y = y.reshape(B, T, C).astype(cfg.compute_dtype)
+        return nn.Dropout(cfg.dropout, deterministic=not train)(y)
+
+
 class Block(nn.Module):
     cfg: GPT2Config
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
         eps = self.cfg.ln_eps
         x = x + Attention(self.cfg, name="attn")(nn.LayerNorm(epsilon=eps, name="ln_1")(x), train)
-        x = x + MLP(self.cfg, name="mlp")(nn.LayerNorm(epsilon=eps, name="ln_2")(x), train)
+        mlp = MoEMLP(self.cfg, name="moe_mlp") if self.use_moe else MLP(self.cfg, name="mlp")
+        x = x + mlp(nn.LayerNorm(epsilon=eps, name="ln_2")(x), train)
         return x
 
 
@@ -137,7 +184,8 @@ class GPT2LMHead(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, static_argnums=(2,))
         for i in range(cfg.n_layer):
-            x = block(cfg, name=f"h_{i}")(x, train)
+            use_moe = cfg.moe_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
+            x = block(cfg, use_moe, name=f"h_{i}")(x, train)
         x = nn.LayerNorm(epsilon=cfg.ln_eps, name="ln_f")(x)
         # tied LM head; logits in float32 for a stable softmax
         lm_logits = jnp.einsum("btc,vc->btv", x.astype(jnp.float32), wte)

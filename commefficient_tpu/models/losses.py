@@ -115,22 +115,32 @@ def make_lm_mc_loss(model, train: bool, mc_coef: float = 1.0, pad_id: int = 0):
     return loss_fn
 
 
-def make_lm_loss(model, train: bool):
+def make_lm_loss(model, train: bool, moe_aux_coef: float = 0.0):
     """Next-token cross-entropy for causal LMs.
 
     batch = {"input_ids": [B, T] int, "labels": [B, T] int with -100 = ignore,
     optionally "token_type_ids": [B, T] int (PersonaChat speaker segments)}.
     Metrics: loss_sum / count (token-level) -> PPL = exp(loss_sum / count).
+    `moe_aux_coef > 0` (MoE models) adds the Switch load-balancing aux sown
+    by MoEMLP, averaged over MoE layers.
     """
 
     def loss_fn(params, net_state, batch, rng):
-        logits = model.apply(
-            {"params": params},
-            batch["input_ids"],
+        kwargs = dict(
             train=train,
             token_type_ids=batch.get("token_type_ids"),
             rngs={"dropout": rng} if (train and rng is not None) else None,
         )
+        moe_aux = jnp.float32(0.0)
+        if moe_aux_coef > 0:
+            logits, inter = model.apply(
+                {"params": params}, batch["input_ids"],
+                mutable=["intermediates"], **kwargs,
+            )
+            auxs = jax.tree.leaves(inter)
+            moe_aux = sum(jnp.asarray(a).mean() for a in auxs) / max(len(auxs), 1)
+        else:
+            logits = model.apply({"params": params}, batch["input_ids"], **kwargs)
         # shift: predict token t+1 from prefix ..t
         logits = logits[:, :-1]
         labels = batch["labels"][:, 1:]
@@ -139,15 +149,19 @@ def make_lm_loss(model, train: bool):
         logp = jax.nn.log_softmax(logits)
         per_tok = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
         count = jnp.maximum(mask.sum(), 1.0)
-        loss = (per_tok * mask).sum() / count
+        loss = (per_tok * mask).sum() / count + moe_aux_coef * moe_aux
         correct = ((logits.argmax(-1) == safe_labels) * mask).sum()
-        return loss, {
-            "net_state": net_state,
-            "metrics": {
-                "loss_sum": (per_tok * mask).sum(),
-                "count": mask.sum(),
-                "correct": correct,
-            },
+        metrics = {
+            "loss_sum": (per_tok * mask).sum(),
+            "count": mask.sum(),
+            "correct": correct,
         }
+        if moe_aux_coef > 0:
+            # sum + count pair: the engine SUMS metrics over clients/local
+            # iters (and evaluate() over batches), so a bare mean would read
+            # cohort-size-inflated — normalize via moe_aux_sum/moe_aux_count
+            metrics["moe_aux_sum"] = moe_aux
+            metrics["moe_aux_count"] = jnp.float32(1.0)
+        return loss, {"net_state": net_state, "metrics": metrics}
 
     return loss_fn

@@ -23,6 +23,11 @@ def gpt2_partition_specs(params) -> dict:
     def spec_for(path, leaf):
         keys = [getattr(p, "key", str(p)) for p in path]
         name = "/".join(keys)
+        if "moe_mlp" in name:
+            # expert parallelism riding the model axis: the [E, ...] expert
+            # weights shard their expert dim (the dispatch einsum becomes an
+            # all-to-all); the small router stays replicated
+            return P(MODEL_AXIS, None, None) if leaf.ndim == 3 else P()
         if "c_attn" in name or "c_fc" in name:
             # column-parallel: kernel [in, out] -> out sharded; bias [out]
             return P(None, MODEL_AXIS) if leaf.ndim == 2 else P(MODEL_AXIS)

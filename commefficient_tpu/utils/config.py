@@ -96,6 +96,12 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
         p.add_argument("--num_candidates", type=int, default=2,
                        help="candidates per example (gold + distractors) "
                             "when --mc_coef > 0")
+        p.add_argument("--moe_experts", type=int, default=0,
+                       help="> 0 swaps every 2nd block's MLP for a "
+                            "Switch-style top-1 MoE with this many experts "
+                            "(shard over an 'expert' mesh axis for EP)")
+        p.add_argument("--moe_aux_coef", type=float, default=0.01,
+                       help="weight of the MoE load-balancing aux loss")
     return p
 
 

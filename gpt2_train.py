@@ -40,6 +40,8 @@ def build(args):
             "--mc_coef > 0 needs --num_candidates >= 2 (the MC head scores "
             "a gold reply against at least one distractor)"
         )
+    if args.mc_coef > 0 and args.moe_experts > 0:
+        raise SystemExit("--mc_coef with --moe_experts is not supported yet")
     num_candidates = args.num_candidates if args.mc_coef > 0 else 1
     train_set, valid_set, tok = load_personachat_fed(
         args.data_root, args.num_clients, args.seq_len, args.seed,
@@ -47,6 +49,11 @@ def build(args):
     )
     args.num_clients = train_set.num_clients
     if args.init_from:
+        if args.moe_experts > 0:
+            raise SystemExit(
+                "--moe_experts with --init_from is not supported: HF GPT-2 "
+                "checkpoints carry no expert weights"
+            )
         # pretrained HF GPT-2 (SURVEY.md §2 Models: the reference fine-tunes
         # HF GPT-2-small); wte grows to cover the dialog special tokens
         from commefficient_tpu.models.gpt2_loader import load_hf_gpt2
@@ -82,7 +89,7 @@ def build(args):
         cfg = dataclasses.replace(
             base, vocab_size=tok.vocab_size, n_positions=max(args.seq_len, 1),
             attn_impl=args.attn_impl, with_mc_head=args.mc_coef > 0,
-            dtype=args.dtype,
+            dtype=args.dtype, moe_experts=args.moe_experts,
         )
         model = GPT2LMHead(cfg)
         ids0 = jnp.zeros((1, args.seq_len), dtype=jnp.int32)
@@ -117,8 +124,9 @@ def build(args):
         train_loss = make_lm_mc_loss(model, True, args.mc_coef, tok.pad_id)
         eval_loss = make_lm_mc_loss(model, False, args.mc_coef, tok.pad_id)
     else:
-        train_loss = make_lm_loss(model, train=True)
-        eval_loss = make_lm_loss(model, train=False)
+        aux = args.moe_aux_coef if args.moe_experts > 0 else 0.0
+        train_loss = make_lm_loss(model, train=True, moe_aux_coef=aux)
+        eval_loss = make_lm_loss(model, train=False, moe_aux_coef=aux)
     mode_cfg = mode_config_from_args(args, d)
     session = FederatedSession(
         train_loss_fn=train_loss,
