@@ -441,11 +441,13 @@ def _shrink_for_cpu():
     g = globals()
     for name, small in [("NUM_WORKERS", 8), ("CHAIN_LEN", 3), ("NUM_CHAINS", 2),
                         ("WARMUP_ROUNDS", 1), ("MICROBENCH_D", 2_000_000),
-                        ("MICRO_CHAIN", 3)]:
+                        ("MICRO_CHAIN", 3), ("SKETCH_COLS", 65_536),
+                        ("TOPK", 8_192)]:
         env_name = {"NUM_WORKERS": "BENCH_WORKERS", "CHAIN_LEN": "BENCH_CHAIN_LEN",
                     "NUM_CHAINS": "BENCH_CHAINS", "WARMUP_ROUNDS": "BENCH_WARMUP",
                     "MICROBENCH_D": "BENCH_MICRO_D",
-                    "MICRO_CHAIN": "BENCH_MICRO_CHAIN"}[name]
+                    "MICRO_CHAIN": "BENCH_MICRO_CHAIN",
+                    "SKETCH_COLS": "BENCH_COLS", "TOPK": "BENCH_TOPK"}[name]
         if env_name not in os.environ:
             g[name] = small
     if "BENCH_SCALE_CHECK" not in os.environ:
