@@ -101,3 +101,37 @@ def test_mc_accuracy_rises_above_chance():
             count += float(metrics["mc_count"])
     acc = correct / max(count, 1.0)
     assert acc > 0.8, f"mc_acc {acc:.3f} not above chance (0.5) margin"
+
+
+def test_mc_eval_sharded_matches_unsharded():
+    """evaluate() over MC candidate batches under a mesh matches the
+    unsharded totals (the [B, C, T] eval batch shards its leading axis)."""
+    from commefficient_tpu.federated.api import FederatedSession
+    from commefficient_tpu.modes.config import ModeConfig
+    from commefficient_tpu.parallel import mesh as meshlib
+
+    train, valid, tok = _dataset(num_clients=16, seed=9)
+    cfg = dataclasses.replace(
+        TINY, vocab_size=tok.vocab_size, n_positions=SEQ, with_mc_head=True
+    )
+    model = GPT2LMHead(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, SEQ), jnp.int32), train=False
+    )["params"]
+    d = ravel_pytree(params)[0].size
+    loss = make_lm_mc_loss(model, train=False, mc_coef=1.0, pad_id=tok.pad_id)
+
+    def build(mesh):
+        return FederatedSession(
+            train_loss_fn=loss, eval_loss_fn=loss, params=params, net_state={},
+            mode_cfg=ModeConfig(mode="uncompressed", d=d, momentum_type="none",
+                                error_type="none"),
+            train_set=train, num_workers=8, local_batch_size=2, seed=1,
+            mesh=mesh,
+        )
+
+    ref = build(None).evaluate(valid, batch_size=8)
+    got = build(meshlib.make_mesh(8)).evaluate(valid, batch_size=8)
+    assert ref["mc_count"] > 0
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-5)
