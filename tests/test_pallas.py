@@ -74,3 +74,31 @@ def test_even_rows_lower_median():
         rtol=1e-6,
         atol=1e-6,
     )
+
+
+def test_probe_failure_falls_back_to_oracle(monkeypatch):
+    """The library-level gate: when the per-layout probe reports a Mosaic
+    failure on a TPU backend, sketch_vec/query_all silently use the pure-JAX
+    oracle instead of crashing — and the status surfaces the traceback."""
+    spec = CSVecSpec(d=3000, c=1024, r=3, seed=13, family="rotation")
+    v = _v(7, spec.d)
+    want = csvec._sketch_vec_rotation(spec, v)
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        pk, "probe", lambda c, r: (False, "MosaicError: simulated\n<traceback>")
+    )
+    assert not csvec._use_pallas(spec)
+    got = csvec.sketch_vec(spec, v)  # must route to the oracle, not raise
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_probe_status_reports_errors():
+    pk._PROBE.clear()
+    assert pk.probe_status() == {"probed": False}
+    pk._PROBE[(1024, 3)] = (True, None)
+    pk._PROBE[(2048, 5)] = (False, "tb")
+    st = pk.probe_status()
+    assert st["probed"] and not st["ok"]
+    assert st["errors"] == {"c=2048,r=5": "tb"}
+    pk._PROBE.clear()
