@@ -1,0 +1,18 @@
+#!/bin/bash
+# Loop-probe the TPU tunnel; on recovery immediately run the round-3 batch.
+# Exit 0 = batch ran; exit 7 = still wedged when the loop budget expired.
+cd "$(dirname "$0")/.."
+DEADLINE=$(( $(date +%s) + ${1:-540} ))
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128,128))
+print('tunnel alive:', float(jax.device_get((x@x).sum())))" 2>/dev/null | grep -q "tunnel alive"; then
+        echo "=== tunnel recovered at $(date -u +%H:%M:%S) — running batch ==="
+        bash scripts/tpu_round3.sh 2>&1
+        exit 0
+    fi
+    sleep 20
+done
+echo "still wedged at $(date -u +%H:%M:%S)"
+exit 7
