@@ -45,6 +45,14 @@ import time
 
 REFERENCE_CLIENT_UPDATES_PER_SEC = 500.0
 
+
+def _stage(msg: str) -> None:
+    """Progress marker on stderr (stdout carries only the JSON contract line).
+    Timestamped + flushed so a wedged tunnel run shows exactly which stage
+    stalled (device claim vs compile vs timed chains) in the captured log."""
+    print(f"# [{time.strftime('%H:%M:%S')}] bench: {msg}", file=sys.stderr,
+          flush=True)
+
 # bf16 peak FLOP/s per chip by device_kind substring (public spec sheets);
 # used only to report MFU — unknown kinds record mfu: null
 _PEAK_BF16 = [
@@ -348,9 +356,12 @@ def run_bench(platform: str) -> dict:
     import jax.numpy as jnp
     from jax.flatten_util import ravel_pytree
 
+    _stage(f"claiming device(s) on platform={platform} ...")
+    _stage(f"claimed: {jax.devices()}")
     workload = _gpt2_workload if BENCH_MODEL == "gpt2" else _resnet9_workload
     params, net_state, batch, loss_fn, name, sketch_kw, workers = workload()
     d = ravel_pytree(params)[0].size
+    _stage(f"workload ready: {name}, d={d}, workers={workers}")
 
     engine, mode_cfg, cfg, step = _make_step(loss_fn, sketch_kw, d)
     # the step donates its input state, which would invalidate `params`
@@ -360,21 +371,29 @@ def run_bench(platform: str) -> dict:
     )
 
     rt_ms = _tunnel_round_trip_ms()
+    _stage(f"tunnel round-trip {rt_ms:.2f} ms; compiling round step "
+           "(first call) ...")
 
     for i in range(WARMUP_ROUNDS):
         state, _, _ = step(state, batch, {}, jnp.float32(0.01), jax.random.PRNGKey(i))
     _ = jax.device_get(state["round"] + jnp.int32(0))
+    _stage("compile + warmup done; timing chains ...")
 
     per_round_ms, state = _timed_chains(
         step, state, batch, NUM_CHAINS, CHAIN_LEN, rt_ms
     )
+    _stage(f"chains done: per-round ms {sorted(round(m, 2) for m in per_round_ms)}")
     round_ms = sorted(per_round_ms)[len(per_round_ms) // 2]
 
     device_kind = jax.devices()[0].device_kind
     n_chips = jax.device_count()
     updates_per_sec_per_chip = workers / (round_ms / 1e3) / n_chips
 
+    _stage("running XLA cost analysis ...")
     flops = _flops_per_round(step, state, batch)
+    _stage("kernel microbench ...")
+    microbench = _kernel_microbench(platform, rt_ms)
+    _stage(f"microbench: {microbench}")
     peak = next((p for k, p in _PEAK_BF16 if k in device_kind.lower()), None)
     achieved = flops / (round_ms / 1e3) if flops else None
     mfu = achieved / peak if (achieved and peak) else None
@@ -403,7 +422,7 @@ def run_bench(platform: str) -> dict:
         "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
         "bf16_peak_tflops": round(peak / 1e12, 1) if peak else None,
         "mfu": round(mfu, 4) if mfu else None,
-        "kernel_microbench": _kernel_microbench(platform, rt_ms),
+        "kernel_microbench": microbench,
         "pallas": _pallas_status(),
     }
     if BENCH_MODEL == "resnet9":
@@ -412,6 +431,7 @@ def run_bench(platform: str) -> dict:
         )
 
     if SCALE_CHECK and BENCH_MODEL == "resnet9":
+        _stage("scale check (2x workers) ...")
         # physical-consistency check: double the client count, round time
         # should roughly double (compute-bound vmap). A flat time would mean
         # the timing is still an async illusion.
@@ -458,7 +478,9 @@ def main():
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         platform = "cpu"  # explicitly pinned; no probe needed
     else:
+        _stage("probing backend in subprocess ...")
         platform = _probe_backend()
+        _stage(f"backend probe -> {platform}")
     if platform is None or platform == "cpu":
         _force_cpu()
         platform = "cpu"

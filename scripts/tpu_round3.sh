@@ -1,38 +1,121 @@
 #!/bin/bash
-# Round-3 TPU validation batch — run when the axon tunnel is alive
-# (probe first: timeout 100 python -c "import jax, jax.numpy as jnp;
-#  x=jnp.ones((128,128)); print(float(jax.device_get((x@x).sum())))").
+# Round-3 TPU validation batch — run when the axon tunnel is alive.
+# Each step probes chip liveness first (a wedged tunnel hangs every device
+# claim; better to stop than queue hour-long timeouts back-to-back), logs
+# raw unbuffered output to results/logs/<step>.log (bench.py emits
+# timestamped stage markers on stderr), and steps can be cherry-picked:
+#   scripts/tpu_round3.sh 2 4     # just the flagship bench + cv_train
+# Exit codes: 0 = every requested step's python succeeded; 8 = at least one
+# step failed (timeout / crash) but the batch ran to the end; 10N = the
+# chip-liveness gate before step N failed (tunnel wedged — steps >= N never
+# ran); 64 = bad arguments.
 # Produces, in order:
 #   1. pallas probe + library routing check on the real chip
-#   2. BENCH_r03 flagship JSON (ResNet-9 bf16, MFU + forensics)  -> stdout
+#   2. BENCH_flagship_r03.json (ResNet-9 bf16, MFU + forensics)
 #   3. BENCH_gpt2_r03.json (GPT-2-small d~124M, c=2^20, 20 blocks)
 #   4. results/cifar10_smoke_tpu.jsonl (48-round cv_train smoke + profile)
 set -x
 cd "$(dirname "$0")/.."
+mkdir -p results/logs
+
+probe_chip() {
+    # A wedged tunnel hangs the device claim; a live one answers in seconds.
+    # Asserts the claimed backend really is the TPU — a silent CPU fallback
+    # must not pass the gate (it would produce useless "platform: cpu" runs).
+    timeout 180 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
+x = jnp.ones((256, 256))
+print('chip alive:', float(jax.device_get((x @ x).sum())), jax.devices())
+" 2>&1 | grep -v WARNING
+    return ${PIPESTATUS[0]}
+}
+
+want() { [ ${#STEPS[@]} -eq 0 ] || [[ " ${STEPS[*]} " == *" $1 "* ]]; }
+
+# Install the bench JSON line from a log into $2 — only when one exists, is
+# a real TPU measurement (not a CPU fallback), and is not the top-level
+# error-fallback record. A nested kernel_microbench {"error": ...} inside an
+# otherwise-good result must NOT disqualify it, so parse, don't substring.
+install_json() {
+    python - "$1" "$2" <<'PY'
+import json, sys
+log, dst = sys.argv[1], sys.argv[2]
+line = None
+for ln in open(log, errors="replace"):
+    if ln.startswith("{"):
+        line = ln.strip()
+if line is None:
+    sys.exit(print(f"no JSON line in {log}; keeping existing {dst}") or 0)
+obj = json.loads(line)
+if "error" in obj or obj.get("platform") not in ("tpu", "axon"):
+    sys.exit(print(f"JSON in {log} is a fallback/error record "
+                   f"(platform={obj.get('platform')}); keeping {dst}") or 0)
+open(dst, "w").write(line + "\n")
+print(f"installed {dst}: value={obj.get('value')} {obj.get('unit')}")
+PY
+}
+
+STEPS=("$@")
+for s in "${STEPS[@]}"; do
+    [[ "$s" =~ ^[1-4]$ ]] || { echo "unknown step '$s' (valid: 1-4)"; exit 64; }
+done
+
+# A CPU-fallback bench number is useless here (this batch exists to produce
+# TPU numbers) and bench.py's internal CPU retry would outlive the outer
+# timeout; fail fast with the error JSON instead.
+export BENCH_NO_RETRY=1
+
+FAIL=0
 
 # 1. probe + routing
-timeout 600 python -c "
-import jax, jax.numpy as jnp
+if want 1; then
+probe_chip || { echo "CHIP DEAD before step 1"; exit 101; }
+timeout 600 python -u -c "
+import jax
 from commefficient_tpu.sketch import csvec
 from commefficient_tpu.sketch.csvec import CSVecSpec
 from commefficient_tpu.sketch import pallas_kernels as pk
 spec = CSVecSpec(d=6_500_000, c=524_288, r=5, family='rotation')
 print('use_pallas(flagship):', csvec._use_pallas(spec))
 print('probe:', pk.probe_status())
-" 2>&1 | grep -v WARNING
+" 2>&1 | tee results/logs/step1_probe.log | grep -v WARNING
+[ "${PIPESTATUS[0]}" -eq 0 ] || { echo "STEP 1 FAILED"; FAIL=8; }
+fi
 
 # 2. flagship bench
-timeout 3600 python bench.py 2>&1 | grep -v WARNING | tail -5
+if want 2; then
+probe_chip || { echo "CHIP DEAD before step 2"; exit 102; }
+timeout 2400 python -u bench.py 2>&1 | tee results/logs/step2_bench.log \
+    | grep -v WARNING | tail -8
+[ "${PIPESTATUS[0]}" -eq 0 ] || { echo "STEP 2 FAILED"; FAIL=8; }
+# Distinct name: the driver writes its own wrapper to BENCH_r03.json at round
+# end and could clobber a good TPU number with a CPU fallback if the tunnel
+# wedges later; this file preserves the measurement either way.
+install_json results/logs/step2_bench.log BENCH_flagship_r03.json
+fi
 
 # 3. GPT-2 bench
-BENCH_MODEL=gpt2 timeout 3600 python bench.py 2>&1 | grep -v WARNING | tail -3 | tee /tmp/bench_gpt2.out
-grep -o '{.*}' /tmp/bench_gpt2.out | tail -1 > BENCH_gpt2_r03.json || true
+if want 3; then
+probe_chip || { echo "CHIP DEAD before step 3"; exit 103; }
+BENCH_MODEL=gpt2 timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/step3_bench_gpt2.log | grep -v WARNING | tail -5
+[ "${PIPESTATUS[0]}" -eq 0 ] || { echo "STEP 3 FAILED"; FAIL=8; }
+install_json results/logs/step3_bench_gpt2.log BENCH_gpt2_r03.json
+fi
 
 # 4. cv_train smoke on the real chip
-timeout 3600 python cv_train.py --dataset cifar10 --mode sketch \
+if want 4; then
+probe_chip || { echo "CHIP DEAD before step 4"; exit 104; }
+timeout 2400 python -u cv_train.py --dataset cifar10 --mode sketch \
     --k 50000 --num_cols 524288 --num_rows 5 --num_blocks 4 \
     --momentum_type virtual --error_type virtual \
     --num_clients 100 --num_workers 8 --num_rounds 48 --num_epochs 4 \
     --eval_every 8 --lr_scale 0.4 --seed 42 --dtype bfloat16 \
     --profile_dir /tmp/tpu_trace \
-    --log_jsonl results/cifar10_smoke_tpu.jsonl 2>&1 | grep -v WARNING | tail -10
+    --log_jsonl results/cifar10_smoke_tpu.jsonl 2>&1 \
+    | tee results/logs/step4_cvtrain.log | grep -v WARNING | tail -10
+[ "${PIPESTATUS[0]}" -eq 0 ] || { echo "STEP 4 FAILED"; FAIL=8; }
+fi
+
+exit "$FAIL"
