@@ -197,9 +197,15 @@ def _kernel_microbench(platform: str, rt_ms: float) -> dict:
             time_pair(lambda x: csvec._sketch_vec_rotation(spec, x), oracle_q), 3
         )
 
-        if csvec._use_pallas(spec):
-            from commefficient_tpu.sketch import pallas_kernels as pk
+        # Measure the kernels directly whenever they compile on this backend.
+        # Deliberately NOT csvec._use_pallas: COMMEFFICIENT_NO_PALLAS steers
+        # only the library/engine routing (so a wedge-prone engine compile can
+        # be avoided) while the microbench still characterises the kernels.
+        from commefficient_tpu.sketch import pallas_kernels as pk
 
+        if (pk.supported(spec)
+                and jax.default_backend() in ("tpu", "axon")
+                and pk.probe(spec.c, spec.r)[0]):
             out["pallas_pair_ms"] = round(
                 time_pair(
                     lambda x: pk.sketch_vec(spec, x),
@@ -356,6 +362,8 @@ def run_bench(platform: str) -> dict:
     import jax.numpy as jnp
     from jax.flatten_util import ravel_pytree
 
+    from commefficient_tpu.sketch import csvec
+
     _stage(f"claiming device(s) on platform={platform} ...")
     _stage(f"claimed: {jax.devices()}")
     workload = _gpt2_workload if BENCH_MODEL == "gpt2" else _resnet9_workload
@@ -409,6 +417,11 @@ def run_bench(platform: str) -> dict:
         "compute_dtype": BENCH_DTYPE,
         "sketch": {"rows": mode_cfg.num_rows, "cols": mode_cfg.num_cols,
                    "k": mode_cfg.k, "blocks": mode_cfg.num_blocks, "d": int(d)},
+        # which accumulate/query implementation the round step itself compiled
+        # (COMMEFFICIENT_NO_PALLAS=1 forces "oracle"; the microbench below
+        # still times the Pallas kernels directly either way)
+        "engine_sketch_path": (
+            "pallas" if csvec._use_pallas(mode_cfg.sketch_spec) else "oracle"),
         "round_ms": round(round_ms, 2),
         "round_ms_percentiles": {
             "min": round(min(per_round_ms), 2),

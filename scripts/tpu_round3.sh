@@ -1,19 +1,32 @@
 #!/bin/bash
 # Round-3 TPU validation batch — run when the axon tunnel is alive.
+#
+# SAFE-FIRST ORDER (learned the hard way): the one compile that has ever
+# wedged the tunnel is the FULL engine round step with the Pallas kernels
+# inlined (04:48-05:11 this round: step-1 probe passed, kernels fine alone,
+# then bench.py's first engine compile hung ~23 min and the tunnel stayed
+# wedged). The pure-JAX-oracle engine compiled and ran on this chip in
+# round 2, and the kernels alone compiled and ran in the round-3 window —
+# so steps 2-4 collect every must-have artifact on the oracle engine path
+# (COMMEFFICIENT_NO_PALLAS=1; bench.py's kernel microbench still times the
+# Pallas kernels directly), and only steps 5-6 attempt the suspect
+# pallas-in-engine compile, isolated and last.
+#
 # Each step probes chip liveness first (a wedged tunnel hangs every device
-# claim; better to stop than queue hour-long timeouts back-to-back), logs
-# raw unbuffered output to results/logs/<step>.log (bench.py emits
-# timestamped stage markers on stderr), and steps can be cherry-picked:
-#   scripts/tpu_round3.sh 2 4     # just the flagship bench + cv_train
+# claim), logs raw unbuffered output to results/logs/<step>.log (bench.py
+# emits timestamped stage markers on stderr), and steps can be
+# cherry-picked:  scripts/tpu_round3.sh 2 4
 # Exit codes: 0 = every requested step's python succeeded; 8 = at least one
 # step failed (timeout / crash) but the batch ran to the end; 10N = the
 # chip-liveness gate before step N failed (tunnel wedged — steps >= N never
 # ran); 64 = bad arguments.
-# Produces, in order:
+# Steps:
 #   1. pallas probe + library routing check on the real chip
-#   2. BENCH_flagship_r03.json (ResNet-9 bf16, MFU + forensics)
-#   3. BENCH_gpt2_r03.json (GPT-2-small d~124M, c=2^20, 20 blocks)
-#   4. results/cifar10_smoke_tpu.jsonl (48-round cv_train smoke + profile)
+#   2. BENCH_flagship_r03.json (ResNet-9 bf16, MFU + forensics; oracle engine)
+#   3. BENCH_gpt2_r03.json (GPT-2-small d~124M, c=2^20, 20 blocks; oracle)
+#   4. results/cifar10_smoke_tpu.jsonl (48-round cv_train smoke; oracle)
+#   5. pallas-in-engine minimal compile probe (the suspect, isolated)
+#   6. full flagship bench with the pallas engine (only if 5 passed)
 set -x
 cd "$(dirname "$0")/.."
 mkdir -p results/logs
@@ -31,7 +44,20 @@ print('chip alive:', float(jax.device_get((x @ x).sum())), jax.devices())
     return ${PIPESTATUS[0]}
 }
 
-want() { [ ${#STEPS[@]} -eq 0 ] || [[ " ${STEPS[*]} " == *" $1 "* ]]; }
+# A step is wanted if selected (or no selection given) AND, under RESUME=1,
+# it has not already succeeded (results/logs/stepN.ok marker). wait_tpu.sh
+# retries gate-interrupted batches with RESUME=1 so completed ~40-minute
+# benches are skipped but FAILED steps (timeout/crash, no marker) re-run.
+want() {
+    if [ ${#STEPS[@]} -gt 0 ] && [[ " ${STEPS[*]} " != *" $1 "* ]]; then
+        return 1
+    fi
+    if [ "${RESUME:-0}" = 1 ] && [ -f "results/logs/step$1.ok" ]; then
+        echo "step $1 already succeeded (results/logs/step$1.ok); skipping"
+        return 1
+    fi
+    return 0
+}
 
 # Install the bench JSON line from a log into $2 — only when one exists, is
 # a real TPU measurement (not a CPU fallback), and is not the top-level
@@ -58,13 +84,19 @@ PY
 
 STEPS=("$@")
 for s in "${STEPS[@]}"; do
-    [[ "$s" =~ ^[1-4]$ ]] || { echo "unknown step '$s' (valid: 1-4)"; exit 64; }
+    [[ "$s" =~ ^[1-6]$ ]] || { echo "unknown step '$s' (valid: 1-6)"; exit 64; }
 done
 
 # A CPU-fallback bench number is useless here (this batch exists to produce
 # TPU numbers) and bench.py's internal CPU retry would outlive the outer
 # timeout; fail fast with the error JSON instead.
 export BENCH_NO_RETRY=1
+
+# Fresh (non-resume) batches start with a clean slate of success markers so
+# a stale .ok from an earlier day can't suppress a requested step.
+if [ "${RESUME:-0}" != 1 ]; then
+    rm -f results/logs/step*.ok
+fi
 
 FAIL=0
 
@@ -80,34 +112,35 @@ spec = CSVecSpec(d=6_500_000, c=524_288, r=5, family='rotation')
 print('use_pallas(flagship):', csvec._use_pallas(spec))
 print('probe:', pk.probe_status())
 " 2>&1 | tee results/logs/step1_probe.log | grep -v WARNING
-[ "${PIPESTATUS[0]}" -eq 0 ] || { echo "STEP 1 FAILED"; FAIL=8; }
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step1.ok; else echo "STEP 1 FAILED"; FAIL=8; fi
 fi
 
-# 2. flagship bench
+# 2. flagship bench, oracle engine (kernel microbench still times Pallas)
 if want 2; then
 probe_chip || { echo "CHIP DEAD before step 2"; exit 102; }
-timeout 2400 python -u bench.py 2>&1 | tee results/logs/step2_bench.log \
-    | grep -v WARNING | tail -8
-[ "${PIPESTATUS[0]}" -eq 0 ] || { echo "STEP 2 FAILED"; FAIL=8; }
+COMMEFFICIENT_NO_PALLAS=1 timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/step2_bench.log | grep -v WARNING | tail -8
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step2.ok; else echo "STEP 2 FAILED"; FAIL=8; fi
 # Distinct name: the driver writes its own wrapper to BENCH_r03.json at round
 # end and could clobber a good TPU number with a CPU fallback if the tunnel
 # wedges later; this file preserves the measurement either way.
 install_json results/logs/step2_bench.log BENCH_flagship_r03.json
 fi
 
-# 3. GPT-2 bench
+# 3. GPT-2 bench, oracle engine
 if want 3; then
 probe_chip || { echo "CHIP DEAD before step 3"; exit 103; }
-BENCH_MODEL=gpt2 timeout 2400 python -u bench.py 2>&1 \
-    | tee results/logs/step3_bench_gpt2.log | grep -v WARNING | tail -5
-[ "${PIPESTATUS[0]}" -eq 0 ] || { echo "STEP 3 FAILED"; FAIL=8; }
+COMMEFFICIENT_NO_PALLAS=1 BENCH_MODEL=gpt2 timeout 2400 python -u bench.py \
+    2>&1 | tee results/logs/step3_bench_gpt2.log | grep -v WARNING | tail -5
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step3.ok; else echo "STEP 3 FAILED"; FAIL=8; fi
 install_json results/logs/step3_bench_gpt2.log BENCH_gpt2_r03.json
 fi
 
-# 4. cv_train smoke on the real chip
+# 4. cv_train smoke on the real chip, oracle engine
 if want 4; then
 probe_chip || { echo "CHIP DEAD before step 4"; exit 104; }
-timeout 2400 python -u cv_train.py --dataset cifar10 --mode sketch \
+COMMEFFICIENT_NO_PALLAS=1 timeout 2400 python -u cv_train.py \
+    --dataset cifar10 --mode sketch \
     --k 50000 --num_cols 524288 --num_rows 5 --num_blocks 4 \
     --momentum_type virtual --error_type virtual \
     --num_clients 100 --num_workers 8 --num_rounds 48 --num_epochs 4 \
@@ -115,7 +148,46 @@ timeout 2400 python -u cv_train.py --dataset cifar10 --mode sketch \
     --profile_dir /tmp/tpu_trace \
     --log_jsonl results/cifar10_smoke_tpu.jsonl 2>&1 \
     | tee results/logs/step4_cvtrain.log | grep -v WARNING | tail -10
-[ "${PIPESTATUS[0]}" -eq 0 ] || { echo "STEP 4 FAILED"; FAIL=8; }
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step4.ok; else echo "STEP 4 FAILED"; FAIL=8; fi
+fi
+
+# 5. THE SUSPECT, isolated: compile + run ONE engine round step with the
+# Pallas kernels inlined, at flagship sketch dims but a tiny client batch.
+# If this wedges the tunnel, everything above is already collected.
+if want 5; then
+probe_chip || { echo "CHIP DEAD before step 5"; exit 105; }
+BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
+    BENCH_WARMUP=0 BENCH_SCALE_CHECK=0 BENCH_MICRO_CHAIN=2 \
+    timeout 1800 python -u bench.py 2>&1 \
+    | tee results/logs/step5_pallas_engine_probe.log \
+    | grep -v WARNING | tail -8
+rc=${PIPESTATUS[0]}
+if [ "$rc" -eq 0 ] && grep -q '"engine_sketch_path": "pallas"' \
+        results/logs/step5_pallas_engine_probe.log; then
+    echo "PALLAS-IN-ENGINE OK"
+    touch results/logs/step5.ok
+else
+    echo "STEP 5 FAILED (rc=$rc) — pallas-in-engine compile is the wedge"
+    echo "trigger or kernels were ineligible; see the log. Step 6 will be"
+    echo "skipped by its own guard."
+    FAIL=8
+fi
+fi
+
+# 6. full flagship bench with the pallas engine — only after 5 proved it
+# (step5.ok is written only when step 5's bench succeeded AND its JSON shows
+# engine_sketch_path=pallas; it survives into RESUME retries)
+if want 6; then
+if [ ! -f results/logs/step5.ok ]; then
+    echo "skipping step 6: step 5 did not prove pallas-in-engine"
+else
+probe_chip || { echo "CHIP DEAD before step 6"; exit 106; }
+timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/step6_bench_pallas.log | grep -v WARNING | tail -8
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step6.ok; else echo "STEP 6 FAILED"; FAIL=8; fi
+# a pallas-engine flagship number supersedes the oracle-engine one
+install_json results/logs/step6_bench_pallas.log BENCH_flagship_r03.json
+fi
 fi
 
 exit "$FAIL"

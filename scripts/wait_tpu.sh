@@ -2,36 +2,29 @@
 # Loop-probe the TPU tunnel; on recovery run the round-3 batch (remaining
 # args are passed through as step selections, e.g. `wait_tpu.sh 3600 2 4`).
 # If the batch dies at a CHIP DEAD gate (exit 10N: the tunnel answered one
-# probe then wedged again before step N), resume probing and retry from the
-# FAILED step only — completed benches are not re-run.
+# probe then wedged again), resume probing and retry with RESUME=1 — the
+# batch's own results/logs/stepN.ok markers skip steps that SUCCEEDED and
+# re-run steps that failed or never ran, so nothing is lost or repeated.
 # Exit: the batch's exit code (0 = all requested steps ran, 8 = a step
 # failed but the batch finished); 7 = still wedged when the budget expired.
 cd "$(dirname "$0")/.."
 DEADLINE=$(( $(date +%s) + ${1:-540} ))
 shift 2>/dev/null || true
-STEPS=("$@")
+RESUME_FLAG=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     if timeout 75 python -c "
 import jax, jax.numpy as jnp
 assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
 x = jnp.ones((128,128))
 print('tunnel alive:', float(jax.device_get((x@x).sum())))" 2>/dev/null | grep -q "tunnel alive"; then
-        echo "=== tunnel recovered at $(date -u +%H:%M:%S) — running batch (steps: ${STEPS[*]:-all}) ==="
-        bash scripts/tpu_round3.sh "${STEPS[@]}" 2>&1
+        echo "=== tunnel recovered at $(date -u +%H:%M:%S) — running batch (steps: ${*:-all}, resume=$RESUME_FLAG) ==="
+        RESUME=$RESUME_FLAG bash scripts/tpu_round3.sh "$@" 2>&1
         rc=$?
-        if [ "$rc" -lt 101 ] || [ "$rc" -gt 104 ]; then
+        if [ "$rc" -lt 101 ] || [ "$rc" -gt 106 ]; then
             exit "$rc"
         fi
-        # Gate code encodes the first step that never ran; retry from there.
-        from=$((rc - 100))
-        NEXT=()
-        if [ ${#STEPS[@]} -eq 0 ]; then
-            for s in 1 2 3 4; do [ "$s" -ge "$from" ] && NEXT+=("$s"); done
-        else
-            for s in "${STEPS[@]}"; do [ "$s" -ge "$from" ] && NEXT+=("$s"); done
-        fi
-        STEPS=("${NEXT[@]}")
-        echo "=== CHIP DEAD gate before step $from; will retry steps: ${STEPS[*]} ==="
+        RESUME_FLAG=1
+        echo "=== CHIP DEAD gate (rc=$rc); resuming probe loop, will retry unfinished steps ==="
     fi
     sleep 20
 done
