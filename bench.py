@@ -203,9 +203,7 @@ def _kernel_microbench(platform: str, rt_ms: float) -> dict:
         # be avoided) while the microbench still characterises the kernels.
         from commefficient_tpu.sketch import pallas_kernels as pk
 
-        if (pk.supported(spec)
-                and jax.default_backend() in ("tpu", "axon")
-                and pk.probe(spec.c, spec.r)[0]):
+        if pk.eligible(spec):
             out["pallas_pair_ms"] = round(
                 time_pair(
                     lambda x: pk.sketch_vec(spec, x),

@@ -149,18 +149,25 @@ def _use_pallas(spec: CSVecSpec) -> bool:
     wrapped (pallas_call's own batching rule hangs Mosaic compiles on
     current toolchains), though the engine never needs it: sketching is
     linear, so the round step sketches the client-aggregated update once.
-    COMMEFFICIENT_NO_PALLAS=1 forces the pure-JAX oracle (debugging)."""
+    COMMEFFICIENT_NO_PALLAS=1 forces the pure-JAX oracle (debugging).
+    COMMEFFICIENT_PALLAS_INTERPRET=1 routes supported layouts through the
+    Pallas interpreter on ANY backend — CPU tests can then exercise the
+    exact engine+kernel composition that runs on hardware."""
     import os
 
     if os.environ.get("COMMEFFICIENT_NO_PALLAS"):
         return False
     from . import pallas_kernels
 
-    # "axon" is a tunnelled TPU platform (remote Pallas compile supported)
-    if not (pallas_kernels.supported(spec) and jax.default_backend() in ("tpu", "axon")):
-        return False
-    ok, _ = pallas_kernels.probe(spec.c, spec.r)
-    return ok
+    if os.environ.get("COMMEFFICIENT_PALLAS_INTERPRET"):
+        return pallas_kernels.supported(spec)
+    return pallas_kernels.eligible(spec)
+
+
+def _pallas_interpret() -> bool:
+    import os
+
+    return bool(os.environ.get("COMMEFFICIENT_PALLAS_INTERPRET"))
 
 
 def _sketch_vec_rotation(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
@@ -223,7 +230,7 @@ def sketch_vec(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
         if _use_pallas(spec):
             from . import pallas_kernels
 
-            return pallas_kernels.sketch_vec(spec, v)
+            return pallas_kernels.sketch_vec(spec, v, interpret=_pallas_interpret())
         return _sketch_vec_rotation(spec, v)
     if spec.num_blocks == 1:
         return _accumulate_block(spec, v, jnp.arange(spec.d, dtype=jnp.int32))
@@ -271,7 +278,7 @@ def query_all(spec: CSVecSpec, table: jnp.ndarray) -> jnp.ndarray:
         if _use_pallas(spec):
             from . import pallas_kernels
 
-            return pallas_kernels.query_all(spec, table)
+            return pallas_kernels.query_all(spec, table, interpret=_pallas_interpret())
         slabs = jnp.arange(spec.num_slabs, dtype=jnp.int32)
         ests = jax.lax.map(lambda b: _query_slab_rotation(spec, table, b), slabs)
         return ests.reshape(-1)[: spec.d]
@@ -307,7 +314,7 @@ def unsketch_topk(spec: CSVecSpec, table: jnp.ndarray, k: int) -> tuple[jnp.ndar
             # memory-bounding slab scan would only add work — one top_k.
             from . import pallas_kernels
 
-            est = pallas_kernels.query_all(spec, table)
+            est = pallas_kernels.query_all(spec, table, interpret=_pallas_interpret())
             _, top_idx = jax.lax.top_k(jnp.abs(est), k)
             return top_idx.astype(jnp.int32), est[top_idx]
 

@@ -276,6 +276,19 @@ def probe(c: int = 1024, r: int = 3) -> tuple[bool, str | None]:
     return _PROBE[key]
 
 
+def eligible(spec) -> bool:
+    """Mechanical eligibility of the native kernels for this spec on the
+    current backend: supported layout AND a TPU-backed platform ("axon" is
+    the tunnelled TPU) AND the try-once probe compiled+ran at this (c, r).
+    Shared by `csvec._use_pallas` (which layers the COMMEFFICIENT_NO_PALLAS /
+    COMMEFFICIENT_PALLAS_INTERPRET env policy on top) and bench.py's kernel
+    microbench (which deliberately ignores that env policy) — one place for
+    the platform allowlist."""
+    if not (supported(spec) and jax.default_backend() in ("tpu", "axon")):
+        return False
+    return probe(spec.c, spec.r)[0]
+
+
 def probe_status() -> dict:
     """Probe outcomes for observability (bench.py embeds this in its JSON)."""
     if not _PROBE:

@@ -179,7 +179,11 @@ fi
 # engine_sketch_path=pallas; it survives into RESUME retries)
 if want 6; then
 if [ ! -f results/logs/step5.ok ]; then
-    echo "skipping step 6: step 5 did not prove pallas-in-engine"
+    # Counts as failure: if 6 was explicitly requested, exiting 0 here would
+    # read as "pallas flagship measured" when it wasn't. (Re-running 6 alone
+    # needs RESUME=1 so the fresh-batch marker wipe keeps step5.ok.)
+    echo "STEP 6 SKIPPED: step 5 did not prove pallas-in-engine"
+    FAIL=8
 else
 probe_chip || { echo "CHIP DEAD before step 6"; exit 106; }
 timeout 2400 python -u bench.py 2>&1 \

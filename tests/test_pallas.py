@@ -102,3 +102,49 @@ def test_probe_status_reports_errors():
     assert st["probed"] and not st["ok"]
     assert st["errors"] == {"c=2048,r=5": "tb"}
     pk._PROBE.clear()
+
+def test_engine_round_step_with_pallas_kernels(monkeypatch):
+    """The EXACT composition that runs on hardware: the full federated round
+    step (client grads -> aggregate -> sketch -> virtual momentum/error ->
+    unsketch_topk) with the library routed to the Pallas kernels, pinned
+    against the oracle-engine result. COMMEFFICIENT_PALLAS_INTERPRET=1 runs
+    the kernels in the Pallas interpreter, so this passes on the CPU mesh —
+    it proves the composition traces, jits, and is numerically equal; only
+    the Mosaic/native compile of the same module remains hardware-only
+    (scripts/tpu_round3.sh step 5)."""
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.federated import engine
+    from commefficient_tpu.modes.config import ModeConfig
+
+    from test_engine import _data, init_mlp, mlp_loss
+
+    params = init_mlp(jax.random.PRNGKey(0), din=64, dh=128)
+    d = ravel_pytree(params)[0].size
+    assert d > 2 * 1024  # several slabs: the kernel grid loop is exercised
+    data = _data(jax.random.PRNGKey(1), 24, din=64)
+    batch = jax.tree.map(lambda a: a.reshape((4, 6) + a.shape[1:]), data)
+    kw = dict(
+        mode="sketch", d=d, k=32, num_rows=3, num_cols=1024,
+        hash_family="rotation", momentum_type="virtual", error_type="virtual",
+    )
+
+    def run(pallas: bool):
+        if pallas:
+            monkeypatch.setenv("COMMEFFICIENT_PALLAS_INTERPRET", "1")
+        else:
+            monkeypatch.delenv("COMMEFFICIENT_PALLAS_INTERPRET", raising=False)
+        cfg = engine.EngineConfig(mode=ModeConfig(**kw))
+        assert csvec._use_pallas(cfg.mode.sketch_spec) == pallas
+        state = engine.init_server_state(
+            cfg, jax.tree.map(jnp.copy, params), {}
+        )
+        step = jax.jit(engine.make_round_step(mlp_loss, cfg))
+        for i in range(3):
+            state, _, _ = step(
+                state, batch, {}, jnp.float32(0.1), jax.random.PRNGKey(i)
+            )
+        return ravel_pytree(state["params"])[0]
+
+    got, want = run(pallas=True), run(pallas=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
