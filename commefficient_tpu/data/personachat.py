@@ -154,6 +154,15 @@ class FedTextDataset(FedDataset):
             y[:k] = self.y[start:end]
             yield self._unpack(xt, y)
 
+    def decode_examples(self, n: int):
+        """First n packed examples as (ids[n, T], types[n, T], labels[n, T])
+        for the generation/F1 eval (models/generate.py): the decode prompt is
+        ids up to each row's first labelled position, the gold reply is the
+        labelled tokens."""
+        n = min(n, len(self.x))
+        b = self._unpack(self.x[:n], self.y[:n])
+        return b["input_ids"], b["token_type_ids"], b["labels"]
+
 
 def _pack_candidates(
     persona, history, gold_reply, distractor_replies, tok, seq_len, rng,
@@ -219,6 +228,18 @@ class FedTextMCDataset(FedTextDataset):
             "labels": y[..., : C * T].reshape(lead + (C, T)),
             "mc_label": y[..., C * T],
         }
+
+    def decode_examples(self, n: int):
+        """Gold candidate's row per example (the one carrying LM labels)."""
+        n = min(n, len(self.x))
+        b = self._unpack(self.x[:n], self.y[:n])
+        gold = np.maximum(b["mc_label"][:n], 0)
+        rows = np.arange(n)
+        return (
+            b["input_ids"][rows, gold],
+            b["token_type_ids"][rows, gold],
+            b["labels"][rows, gold],
+        )
 
 
 def _find_personachat_json(root: str) -> str | None:

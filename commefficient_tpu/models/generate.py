@@ -1,0 +1,139 @@
+"""Autoregressive decoding for the PersonaChat eval path (SURVEY.md §2 "NLP
+training CLI": the reference's eval is NLL/PPL "+ optionally F1/sampling" —
+this supplies the sampling/F1 half; the transfer-learning-conv-ai lineage the
+reference inherits evaluates generated replies with word-level F1).
+
+TPU-idiomatic shape discipline: the decode loop is a `lax.scan` over a FIXED
+number of steps on a FIXED [B, T] token buffer — no dynamic shapes, one
+compiled program regardless of prompt lengths or early <eos>. Each step runs
+a full forward over the buffer and reads the logits at every row's own
+current position; positions past a finished row (<eos> emitted) keep <pad>.
+A KV cache would cut per-step FLOPs ~T/2-fold, but eval decodes a handful of
+examples per round — compile simplicity wins (the buffer forward is the same
+XLA program the PPL eval already runs).
+
+Sampling: temperature 0 = greedy argmax; otherwise nucleus (top-p) sampling
+in sorted-logit space (sort desc, keep the smallest prefix with cumulative
+probability >= top_p, always at least the mode, categorical over the kept
+prefix, map back through the sort permutation).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _nucleus_pick(logits, rng, temperature: float, top_p: float):
+    """[B, V] logits -> [B] sampled token ids (greedy when temperature==0)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.float32(temperature)
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    order = jnp.argsort(-logits, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep every token whose PRECEDING cumulative mass is < top_p (the mode's
+    # preceding mass is 0, so at least one survives)
+    keep = (cum - probs) < jnp.float32(top_p)
+    filtered = jnp.where(keep, sorted_logits, -jnp.inf)
+    pick = jax.random.categorical(rng, filtered, axis=-1)  # index in sorted space
+    return jnp.take_along_axis(order, pick[:, None], axis=-1)[:, 0]
+
+
+def make_generate(
+    model,
+    *,
+    eos_id: int,
+    pad_id: int,
+    reply_type_id: int,
+    max_new: int,
+    temperature: float = 0.0,
+    top_p: float = 0.9,
+):
+    """Build a jitted decode fn for a GPT2LMHead-style model.
+
+        generate(params, ids, types, prompt_len, rng) -> (ids', lengths)
+
+    - ids/types: [B, T] packed buffers; positions >= prompt_len[b] must be
+      <pad> (they are overwritten as generation proceeds).
+    - prompt_len: [B] int32, number of conditioning tokens per row (the reply
+      speaker token included — generation continues the model's own turn).
+    - ids' has up to `max_new` generated tokens written from prompt_len[b];
+      lengths[b] = prompt_len[b] + number of tokens generated before <eos>
+      (the <eos> itself is not counted, mirroring the packing where labels
+      end at <eos>).
+    """
+
+    def step_logits(params, ids, types):
+        out = model.apply({"params": params}, ids, train=False, token_type_ids=types)
+        # with_mc_head models return just lm_logits when mc_positions is None
+        return out[0] if isinstance(out, tuple) else out
+
+    @jax.jit
+    def generate(params, ids, types, prompt_len, rng):
+        B, T = ids.shape
+        rows = jnp.arange(B)
+
+        def body(carry, step_rng):
+            ids, types, cur, done = carry
+            logits = step_logits(params, ids, types)  # [B, T, V]
+            # logits at position cur-1 predict the token at cur
+            nxt = _nucleus_pick(
+                logits[rows, jnp.maximum(cur - 1, 0)], step_rng, temperature, top_p
+            ).astype(ids.dtype)
+            in_range = cur < T
+            write = (~done) & in_range
+            nxt = jnp.where(write, nxt, pad_id)
+            pos = jnp.minimum(cur, T - 1)
+            ids = ids.at[rows, pos].set(jnp.where(write, nxt, ids[rows, pos]))
+            types = types.at[rows, pos].set(
+                jnp.where(write, reply_type_id, types[rows, pos])
+            )
+            done = done | (nxt == eos_id) | ~in_range
+            cur = cur + write.astype(cur.dtype)
+            return (ids, types, cur, done), None
+
+        done0 = jnp.zeros((B,), bool)
+        cur0 = prompt_len.astype(jnp.int32)
+        (ids, types, cur, _), _ = jax.lax.scan(
+            body, (ids, types, cur0, done0), jax.random.split(rng, max_new)
+        )
+        # lengths exclude a trailing <eos> if one was written
+        wrote_eos = (ids[rows, jnp.maximum(cur - 1, 0)] == eos_id) & (
+            cur > prompt_len
+        )
+        return ids, cur - wrote_eos.astype(cur.dtype)
+
+    return generate
+
+
+def decode_reply(tok, ids_row, prompt_len: int, length: int) -> str:
+    """Detokenize the generated span of one row (host-side)."""
+    span = [int(t) for t in ids_row[prompt_len:length]]
+    return tok.decode(span)
+
+
+@functools.lru_cache(maxsize=None)
+def _norm_word(w: str) -> str:
+    return "".join(ch for ch in w.lower() if ch.isalnum())
+
+
+def word_f1(pred: str, gold: str) -> float:
+    """ConvAI2-style word-level F1: bag-of-words overlap of the normalized
+    (lowercased, punctuation-stripped) prediction vs the gold reply."""
+    p = [w for w in (_norm_word(t) for t in pred.split()) if w]
+    g = [w for w in (_norm_word(t) for t in gold.split()) if w]
+    if not p or not g:
+        return float(p == g)
+    from collections import Counter
+
+    common = Counter(p) & Counter(g)
+    overlap = sum(common.values())
+    if overlap == 0:
+        return 0.0
+    precision = overlap / len(p)
+    recall = overlap / len(g)
+    return 2 * precision * recall / (precision + recall)

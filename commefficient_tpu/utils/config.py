@@ -102,6 +102,15 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                             "(shard over an 'expert' mesh axis for EP)")
         p.add_argument("--moe_aux_coef", type=float, default=0.01,
                        help="weight of the MoE load-balancing aux loss")
+        p.add_argument("--eval_f1", type=int, default=0,
+                       help="> 0 decodes this many validation dialogs at "
+                            "every eval and logs val_f1 (ConvAI2 word-level "
+                            "F1 of the generated reply vs gold)")
+        p.add_argument("--decode_max_new", type=int, default=32,
+                       help="max generated tokens per reply for --eval_f1")
+        p.add_argument("--decode_temperature", type=float, default=0.0,
+                       help="0 = greedy; > 0 samples with nucleus top-p")
+        p.add_argument("--decode_top_p", type=float, default=0.9)
     return p
 
 

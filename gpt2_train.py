@@ -150,12 +150,68 @@ def build(args):
             "would silently degrade ring attention to dense; fix num_workers "
             "or --seq_parallel"
         )
-    return session, valid_set
+    return session, valid_set, {"model": model, "tok": tok}
+
+
+def make_f1_eval(args, model, tok, valid_set):
+    """Generation/F1 evaluator for --eval_f1 (SURVEY.md §2: the reference
+    lineage's "F1/sampling" eval half; PPL is the other). Decodes the first
+    --eval_f1 validation dialogs from their packed prompts (reply region
+    blanked to <pad>) and scores ConvAI2 word-F1 vs the gold replies.
+    Returns eval(params, rnd) -> mean F1."""
+    import numpy as np
+
+    from commefficient_tpu.models.generate import (
+        decode_reply, make_generate, word_f1,
+    )
+
+    ids, types, labels = (np.asarray(a) for a in valid_set.decode_examples(args.eval_f1))
+    labelled = labels != -100
+    keep = labelled.any(axis=1)  # drop label-less rows (fully-truncated packs)
+    if not keep.any():
+        raise SystemExit(
+            f"--eval_f1 {args.eval_f1}: none of the sampled validation packs "
+            f"carry a reply at --seq_len {args.seq_len} (all labels "
+            "truncated); raise --seq_len or --eval_f1"
+        )
+    ids, types, labels, labelled = ids[keep], types[keep], labels[keep], labelled[keep]
+    prompt_len = labelled.argmax(axis=1).astype(np.int32)
+    golds = [
+        tok.decode([t for t in row[m] if t != tok.eos_id])
+        for row, m in zip(labels, labelled)
+    ]
+    # blank the gold reply out of the conditioning buffers
+    tail = np.arange(ids.shape[1])[None] >= prompt_len[:, None]
+    p_ids = jnp.asarray(np.where(tail, tok.pad_id, ids))
+    p_types = jnp.asarray(np.where(tail, tok.pad_id, types))
+    plen = jnp.asarray(prompt_len)
+    generate = make_generate(
+        model, eos_id=tok.eos_id, pad_id=tok.pad_id,
+        reply_type_id=tok.speaker2_id, max_new=args.decode_max_new,
+        temperature=args.decode_temperature, top_p=args.decode_top_p,
+    )
+
+    def evaluate(params, rnd: int) -> float:
+        out, lengths = generate(
+            params, p_ids, p_types, plen, jax.random.PRNGKey(10_000 + rnd)
+        )
+        out, lengths = np.asarray(out), np.asarray(lengths)
+        preds = [
+            decode_reply(tok, row, int(p), int(ln))
+            for row, p, ln in zip(out, prompt_len, lengths)
+        ]
+        return float(np.mean([word_f1(p, g) for p, g in zip(preds, golds)]))
+
+    return evaluate
 
 
 def main(argv=None):
     args = resolve_defaults(make_parser("gpt2").parse_args(argv))
-    session, valid_set = build(args)
+    session, valid_set, extras = build(args)
+    f1_eval = (
+        make_f1_eval(args, extras["model"], extras["tok"], valid_set)
+        if args.eval_f1 > 0 else None
+    )
 
     rounds_per_epoch = max(1, math.ceil(args.num_clients / session.num_workers))
     total_rounds = args.num_rounds or int(args.num_epochs * rounds_per_epoch)
@@ -207,6 +263,8 @@ def main(argv=None):
             if args.mc_coef > 0:
                 row["mc_acc"] = acc_mc_correct / max(acc_mc_count, 1)
                 row["val_mc_acc"] = ev.get("mc_correct", 0.0) / max(ev.get("mc_count", 0.0), 1)
+            if f1_eval is not None:
+                row["val_f1"] = f1_eval(model.params, rnd + 1)
             logger.append(row)
             acc_loss = acc_count = acc_mc_correct = acc_mc_count = 0.0
 

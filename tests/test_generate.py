@@ -1,0 +1,205 @@
+"""Generation / F1-eval tests (SURVEY.md §2 "NLP training CLI": the
+reference lineage's sampling+word-F1 eval half; PPL covered in test_gpt2).
+The scan decoder is pinned against a plain python-loop decode of the same
+model, eos/overflow bookkeeping against a rigged stub model, and the CLI
+integration against a tiny end-to-end run."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.models.generate import (
+    decode_reply, make_generate, word_f1,
+)
+from commefficient_tpu.models.gpt2 import TINY, GPT2LMHead
+
+
+def test_scan_decode_matches_python_loop():
+    cfg = dataclasses.replace(TINY, n_positions=32, dropout=0.0)
+    model = GPT2LMHead(cfg)
+    T, B, max_new = 32, 3, 6
+    key = jax.random.PRNGKey(0)
+    params = model.init(key, jnp.zeros((1, T), jnp.int32), train=False)["params"]
+    pad = 0
+    prompt_len = np.array([5, 9, 12], np.int32)
+    rng = np.random.RandomState(3)
+    ids = np.full((B, T), pad, np.int32)
+    types = np.full((B, T), pad, np.int32)
+    for b in range(B):
+        ids[b, : prompt_len[b]] = rng.randint(1, cfg.vocab_size, prompt_len[b])
+        types[b, : prompt_len[b]] = 7
+
+    gen = make_generate(
+        model, eos_id=-1, pad_id=pad, reply_type_id=9, max_new=max_new,
+        temperature=0.0,
+    )  # eos_id=-1: no token matches, so decode runs all max_new steps
+    out, lengths = gen(
+        params, jnp.asarray(ids), jnp.asarray(types), jnp.asarray(prompt_len),
+        jax.random.PRNGKey(1),
+    )
+    out = np.asarray(out)
+
+    # reference: python loop, full forward each step, argmax at cur-1
+    ref = ids.copy()
+    rtypes = types.copy()
+    for b in range(B):
+        cur = int(prompt_len[b])
+        for _ in range(max_new):
+            logits = model.apply(
+                {"params": params}, jnp.asarray(ref), train=False,
+                token_type_ids=jnp.asarray(rtypes),
+            )
+            ref[b, cur] = int(jnp.argmax(logits[b, cur - 1]))
+            rtypes[b, cur] = 9
+            cur += 1
+    np.testing.assert_array_equal(out, ref)
+    np.testing.assert_array_equal(np.asarray(lengths), prompt_len + max_new)
+
+
+class _StubModel:
+    """Emits a fixed per-row script of tokens regardless of input: logits at
+    position p put all mass on script[row, p+1 - prompt]. Enough to test the
+    eos / overflow bookkeeping without a trained model."""
+
+    def __init__(self, script, prompt_len, vocab):
+        self.script = script  # [B, S] tokens to emit in order
+        self.prompt = prompt_len
+        self.vocab = vocab
+
+    def apply(self, variables, ids, train, token_type_ids=None):
+        B, T = ids.shape
+        logits = np.zeros((B, T, self.vocab), np.float32)
+        for b in range(B):
+            for p in range(T):
+                step = p + 1 - self.prompt[b]  # token to emit AT position p+1
+                tok = self.script[b][step] if 0 <= step < len(self.script[b]) else 1
+                logits[b, p, tok] = 10.0
+        return jnp.asarray(logits)
+
+
+def test_eos_stops_row_and_length_excludes_eos():
+    eos, pad, V = 5, 0, 8
+    prompt_len = np.array([3, 3], np.int32)
+    # row 0 emits 2 tokens then eos; row 1 never emits eos
+    stub = _StubModel([[2, 3, eos, 4, 4], [4, 4, 4, 4, 4]], prompt_len, V)
+    gen = make_generate(
+        stub, eos_id=eos, pad_id=pad, reply_type_id=7, max_new=5, temperature=0.0
+    )
+    ids = np.zeros((2, 12), np.int32)
+    ids[:, :3] = 2
+    out, lengths = gen(
+        None, jnp.asarray(ids), jnp.asarray(ids), jnp.asarray(prompt_len),
+        jax.random.PRNGKey(0),
+    )
+    out, lengths = np.asarray(out), np.asarray(lengths)
+    assert lengths.tolist() == [5, 8]  # row 0: 3 + 2 (eos excluded); row 1: 3 + 5
+    assert out[0, 3:6].tolist() == [2, 3, eos]
+    assert out[0, 6:].tolist() == [pad] * 6  # nothing written after eos
+    assert out[1, 3:8].tolist() == [4] * 5
+    assert decode_reply(
+        type("T", (), {"decode": staticmethod(lambda ids: ",".join(map(str, ids)))}),
+        out[0], 3, int(lengths[0]),
+    ) == "2,3"
+
+
+def test_overflow_clamps_at_buffer_end():
+    eos, pad, V = 5, 0, 8
+    prompt_len = np.array([6], np.int32)
+    stub = _StubModel([[3] * 10], prompt_len, V)
+    gen = make_generate(
+        stub, eos_id=eos, pad_id=pad, reply_type_id=7, max_new=10, temperature=0.0
+    )
+    ids = np.zeros((1, 8), np.int32)
+    ids[:, :6] = 2
+    out, lengths = gen(
+        None, jnp.asarray(ids), jnp.asarray(ids), jnp.asarray(prompt_len),
+        jax.random.PRNGKey(0),
+    )
+    assert int(lengths[0]) == 8  # stopped at the buffer edge, no wraparound
+    assert np.asarray(out)[0, 6:].tolist() == [3, 3]
+
+
+def test_nucleus_sampling_stays_in_nucleus():
+    """With a peaked distribution and small top_p, sampling must always pick
+    the mode; with top_p=1 it must occasionally pick something else."""
+    eos, pad, V = 5, 0, 16
+    prompt_len = np.array([2], np.int32)
+
+    class Peaked:
+        def apply(self, variables, ids, train, token_type_ids=None):
+            B, T = ids.shape
+            base = jnp.tile(jnp.linspace(0.0, 2.0, V), (B, T, 1))
+            return base.at[..., 9].set(6.0)  # mode = 9, holds > 0.9 mass
+
+    gen_tight = make_generate(
+        Peaked(), eos_id=eos, pad_id=pad, reply_type_id=7, max_new=4,
+        temperature=1.0, top_p=0.5,
+    )
+    ids = np.zeros((1, 10), np.int32)
+    ids[:, :2] = 1
+    out, _ = gen_tight(
+        None, jnp.asarray(ids), jnp.asarray(ids), jnp.asarray(prompt_len),
+        jax.random.PRNGKey(0),
+    )
+    assert np.asarray(out)[0, 2:6].tolist() == [9, 9, 9, 9]
+
+    gen_loose = make_generate(
+        Peaked(), eos_id=eos, pad_id=pad, reply_type_id=7, max_new=4,
+        temperature=3.0, top_p=1.0,
+    )
+    picks = set()
+    for s in range(8):
+        out, _ = gen_loose(
+            None, jnp.asarray(ids), jnp.asarray(ids), jnp.asarray(prompt_len),
+            jax.random.PRNGKey(s),
+        )
+        picks.update(np.asarray(out)[0, 2:6].tolist())
+    assert len(picks) > 1
+
+
+def test_word_f1():
+    assert word_f1("the cat runs", "the cat runs") == 1.0
+    assert word_f1("dog", "cat") == 0.0
+    assert word_f1("", "") == 1.0
+    assert word_f1("", "cat") == 0.0
+    # normalization: case + punctuation
+    assert word_f1("The CAT, runs!", "the cat runs") == 1.0
+    # partial: pred {a b}, gold {a c} -> P=R=1/2, F1=1/2
+    assert abs(word_f1("a b", "a c") - 0.5) < 1e-9
+    # multiset semantics: repeated words only count to their gold multiplicity
+    assert abs(word_f1("a a", "a b") - 0.5) < 1e-9
+
+
+def test_decode_examples_prompt_and_gold_align():
+    from commefficient_tpu.data.personachat import load_personachat_fed
+
+    _, valid, tok = load_personachat_fed(num_clients=20, seq_len=64, seed=0)
+    ids, types, labels = valid.decode_examples(4)
+    assert ids.shape == types.shape == labels.shape
+    for row_ids, row_lab in zip(ids, labels):
+        m = row_lab != -100
+        assert m.any()
+        p0 = int(np.argmax(m))
+        # the packed buffer carries the gold reply at the labelled positions
+        np.testing.assert_array_equal(row_ids[m], row_lab[m])
+        # prompt ends with the reply speaker token
+        assert row_ids[p0 - 1] == tok.speaker2_id
+
+
+def test_gpt2_train_eval_f1_end_to_end(tmp_path):
+    import gpt2_train
+
+    log = tmp_path / "log.jsonl"
+    gpt2_train.main([
+        "--model_size", "tiny", "--mode", "uncompressed", "--num_clients", "16",
+        "--num_workers", "4", "--num_rounds", "2", "--eval_every", "2",
+        "--seq_len", "48", "--local_batch_size", "2", "--eval_batch_size", "8",
+        "--eval_f1", "3", "--decode_max_new", "4", "--log_jsonl", str(log),
+    ])
+    import json
+
+    rows = [json.loads(ln) for ln in log.read_text().splitlines()]
+    assert rows and "val_f1" in rows[-1]
+    assert 0.0 <= rows[-1]["val_f1"] <= 1.0

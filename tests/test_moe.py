@@ -136,13 +136,13 @@ def test_moe_checkpoint_roundtrip(tmp_path):
         "--checkpoint_dir", str(tmp_path),
     ]
     args = resolve_defaults(make_parser("gpt2").parse_args(argv))
-    session, _ = gpt2_train.build(args)
+    session = gpt2_train.build(args)[0]
     for _ in range(2):
         session.run_round(0.05)
     ckpt.save(str(tmp_path), session)
     want = np.asarray(ravel_pytree(session.state["params"])[0])
 
-    session2, _ = gpt2_train.build(args)
+    session2 = gpt2_train.build(args)[0]
     ckpt.restore(ckpt.latest(str(tmp_path)), session2)
     got = np.asarray(ravel_pytree(session2.state["params"])[0])
     np.testing.assert_array_equal(got, want)
