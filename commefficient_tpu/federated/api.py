@@ -45,9 +45,11 @@ class FederatedSession:
         mesh=None,
         dp_clip: float = 0.0,
         dp_noise: float = 0.0,
+        client_dropout: float = 0.0,
     ):
         self.cfg = engine.EngineConfig(
-            mode=mode_cfg, weight_decay=weight_decay, dp_clip=dp_clip, dp_noise=dp_noise
+            mode=mode_cfg, weight_decay=weight_decay, dp_clip=dp_clip,
+            dp_noise=dp_noise, client_dropout=client_dropout,
         )
         self.train_set = train_set
         self.num_workers = min(num_workers, train_set.num_clients)
@@ -132,6 +134,12 @@ class FederatedSession:
         m = jax.tree.map(float, jax.device_get(metrics))
         m["lr"] = float(lr)
         m.update(self.comm_per_round)
+        # dropped clients never transmit: charge uplink for survivors only
+        # (the static comm_per_round assumes all num_workers upload). The
+        # down-link broadcast still reaches the whole next cohort.
+        if self.cfg.client_dropout > 0 and "participants" in m:
+            m["comm_up_mb"] *= m["participants"] / self.num_workers
+            m["comm_total_mb"] = m["comm_up_mb"] + m["comm_down_mb"]
         if "down_support" in m:
             # local_topk: replace the static worst-case down-link estimate
             # with the round's measured broadcast support; past the sparse/

@@ -47,8 +47,21 @@ class EngineConfig:
     # sampled clients and dp_clip for agg_op="sum".
     dp_clip: float = 0.0
     dp_noise: float = 0.0
+    # Straggler / client-dropout simulation (rebuild-side robustness knob;
+    # the reference has none — SURVEY.md §5 "a dead worker hangs the run").
+    # Each round every sampled client independently drops with this
+    # probability BEFORE its update is aggregated: aggregation becomes a
+    # survivor-weighted mean/sum, metrics count survivors only, dropped
+    # clients keep their persistent local-state rows, and DP noise
+    # calibrates to the surviving cohort. A fully-dropped round contributes
+    # a zero aggregate (momentum still decays, the round still counts).
+    client_dropout: float = 0.0
 
     def __post_init__(self):
+        if not 0.0 <= self.client_dropout < 1.0:
+            raise ValueError(
+                f"client_dropout must be in [0, 1), got {self.client_dropout}"
+            )
         if self.dp_noise > 0 and self.dp_clip <= 0:
             raise ValueError("dp_noise > 0 requires dp_clip > 0 (unbounded "
                              "sensitivity has no meaningful noise scale)")
@@ -86,6 +99,17 @@ def init_server_state(cfg: EngineConfig, params: Any, net_state: Any) -> dict:
         "mode_state": modes.init_server_state(cfg.mode),
         "round": jnp.zeros((), dtype=jnp.int32),
     }
+
+
+def participation_mask(rng, num_sampled: int, dropout: float) -> jnp.ndarray:
+    """[W] float 0/1 survivor mask: each sampled client independently drops
+    with probability `dropout`. Pure function of (rng, W, dropout) so tests
+    and the engine derive identical masks."""
+    if dropout <= 0.0:
+        return jnp.ones((num_sampled,), jnp.float32)
+    return (
+        jax.random.uniform(rng, (num_sampled,)) >= jnp.float32(dropout)
+    ).astype(jnp.float32)
 
 
 def make_round_step(
@@ -149,8 +173,10 @@ def make_round_step(
         # rng that client keys are split from would collide with client
         # fold_in(rng, 0x0D9)=217's stream at large cohorts — voiding noise
         # independence exactly when DP matters. Split first, then derive.
-        crng, noise_rng = jax.random.split(rng)
+        crng, noise_rng, drop_rng = jax.random.split(rng, 3)
         client_rngs = jax.random.split(crng, num_sampled)
+        part = participation_mask(drop_rng, num_sampled, cfg.client_dropout)
+        n_live = jnp.maximum(part.sum(), 1.0)
 
         if mcfg.uses_weight_delta:
             updates, nstates, metrics = jax.vmap(
@@ -173,15 +199,25 @@ def make_round_step(
         if modes.is_linear(mcfg) and not mcfg.needs_local_state:
             # sketching/reduction commute (linearity) — compress once on the
             # reduced update instead of per client. Exactly equal, much cheaper.
-            reduce = jnp.sum if mcfg.agg_op == "sum" else jnp.mean
-            agg, _ = modes.client_compress(mcfg, reduce(updates, axis=0), {})
+            # Participation weighting folds into the same reduction: survivor
+            # mean = sum(part * u) / count(part), survivor sum drops the /.
+            weighted = (updates * part[:, None]).sum(axis=0)
+            if mcfg.agg_op != "sum":
+                weighted = weighted / n_live
+            agg, _ = modes.client_compress(mcfg, weighted, {})
             agg = modes.aggregate(mcfg, jax.tree.map(lambda x: x[None], agg))
             new_rows = client_rows
         else:
-            wires, new_rows = jax.vmap(lambda u, row: modes.client_compress(mcfg, u, row))(
+            wires, vrows = jax.vmap(lambda u, row: modes.client_compress(mcfg, u, row))(
                 updates, client_rows
             )
-            agg = modes.aggregate(mcfg, wires)
+            agg = modes.aggregate(mcfg, wires, weights=part)
+            # dropped clients never transmitted: their persistent local state
+            # (error/momentum rows) stays exactly as it was
+            new_rows = jax.tree.map(
+                lambda new, old: jnp.where(modes.bcast(part, new) > 0, new, old),
+                vrows, client_rows,
+            )
 
         if cfg.dp_noise > 0:
             # central DP: noise the aggregated dense wire. Over W L2-clipped
@@ -189,7 +225,10 @@ def make_round_step(
             # aggregation and dp_clip for sum. (Sketch tables are rejected in
             # EngineConfig — their worst-case sensitivity under an L2 clip is
             # l1-scale, not dp_clip.)
-            sens = cfg.dp_clip if mcfg.agg_op == "sum" else cfg.dp_clip / num_sampled
+            # mean aggregation divides by the SURVIVING count, so sensitivity
+            # must too — noising by /num_sampled would under-deliver privacy
+            # whenever clients drop
+            sens = cfg.dp_clip if mcfg.agg_op == "sum" else cfg.dp_clip / n_live
             std = jnp.float32(cfg.dp_noise * sens)
             agg = {
                 k: v + std * jax.random.normal(jax.random.fold_in(noise_rng, i), v.shape, v.dtype)
@@ -202,15 +241,25 @@ def make_round_step(
         server_lr = jnp.float32(mcfg.server_lr) if mcfg.uses_weight_delta else lr
         delta, mode_state = modes.server_step(mcfg, agg, state["mode_state"], server_lr)
         new_params = unravel(pflat - delta)
-        # mutable model collections (BN stats): average the per-client results
-        new_net_state = jax.tree.map(lambda s: jnp.mean(s, axis=0), nstates)
+        # mutable model collections (BN stats): average the SURVIVING clients'
+        # results (with no survivors, keep the previous stats)
+        new_net_state = jax.tree.map(
+            lambda s, prev: jnp.where(
+                part.sum() > 0, (s * modes.bcast(part, s)).sum(0) / n_live, prev
+            ),
+            nstates, net_state,
+        )
         new_state = {
             "params": new_params,
             "net_state": new_net_state,
             "mode_state": mode_state,
             "round": state["round"] + 1,
         }
-        out_metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+        out_metrics = jax.tree.map(
+            lambda m: jnp.sum(m * modes.bcast(part, m), axis=0), metrics
+        )
+        # survivors this round — run_round scales the measured uplink by it
+        out_metrics["participants"] = part.sum()
         if mcfg.mode == "local_topk":
             # support of the actually-broadcast delta (SURVEY.md §6 row 4):
             # the union of client supports when momentum keeps nothing extra

@@ -133,19 +133,36 @@ def client_compress(cfg: ModeConfig, update: jnp.ndarray, cstate: dict) -> tuple
 # ------------------------------------------------------------- aggregation
 
 
-def aggregate(cfg: ModeConfig, wires: dict) -> dict:
+def bcast(w: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast a [W] per-client weight vector against [W, ...] data."""
+    return w.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+
+
+def aggregate(cfg: ModeConfig, wires: dict, weights=None) -> dict:
     """Combine the W client wires (leading axis W) with cfg.agg_op (mean by
     default; sum reproduces FetchSGD Alg. 1's Σ-of-sketches with the scaling
     in the lr — see ModeConfig.agg_op). Sparse wires are densified then
     reduced — in the simulator the sparse form exists for faithful semantics
-    + communication accounting, not for saving FLOPs."""
-    op = jnp.sum if cfg.agg_op == "sum" else jnp.mean
+    + communication accounting, not for saving FLOPs.
+
+    `weights` (optional) must be a [W] 0/1 participation mask (engine
+    client-dropout simulation): mean divides by the SURVIVOR COUNT, clamped
+    to 1 so an all-dropped round aggregates to zero. Fractional importance
+    weights are NOT supported — the clamp would silently mis-normalize
+    masses below 1. None = all participate."""
+
+    def op(x):
+        if weights is None:
+            return jnp.sum(x, 0) if cfg.agg_op == "sum" else jnp.mean(x, 0)
+        s = (x * bcast(weights, x)).sum(0)
+        return s if cfg.agg_op == "sum" else s / jnp.maximum(weights.sum(), 1.0)
+
     if cfg.mode == "sketch":
-        return {"table": op(wires["table"], axis=0)}
+        return {"table": op(wires["table"])}
     if cfg.mode == "local_topk":
         dense = jax.vmap(lambda i, v: csvec.to_dense(cfg.d, i, v))(wires["idx"], wires["vals"])
-        return {"dense": op(dense, axis=0)}
-    return {"dense": op(wires["dense"], axis=0)}
+        return {"dense": op(dense)}
+    return {"dense": op(wires["dense"])}
 
 
 # ------------------------------------------------------------- server side

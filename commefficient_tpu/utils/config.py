@@ -54,6 +54,10 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
     p.add_argument("--dp_noise", type=float, default=0.0,
                    help="central-DP noise multiplier on the aggregate (needs --dp_clip)")
     # run plumbing
+    p.add_argument("--client_dropout", type=float, default=0.0,
+                   help="per-round probability each sampled client drops "
+                        "before aggregation (straggler simulation; the "
+                        "reference has none — a dead worker hangs it)")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible")
     p.add_argument("--eval_batch_size", type=int, default=512)

@@ -72,6 +72,7 @@ def build(args):
         mesh=mesh,
         dp_clip=args.dp_clip,
         dp_noise=args.dp_noise,
+        client_dropout=args.client_dropout,
     )
     return session, test_set
 

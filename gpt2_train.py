@@ -142,6 +142,7 @@ def build(args):
         mesh=mesh,
         dp_clip=args.dp_clip,
         dp_noise=args.dp_noise,
+        client_dropout=args.client_dropout,
     )
     if args.attn_impl == "ring" and session.mesh is None:
         raise SystemExit(

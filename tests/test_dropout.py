@@ -1,0 +1,168 @@
+"""Client-dropout (straggler simulation) tests. The reference has NO failure
+handling (SURVEY.md §5: "a dead worker hangs the run"); EngineConfig.
+client_dropout is rebuild-side robustness: each sampled client independently
+drops before aggregation, survivors are mean/sum-weighted, metrics count
+survivors only, and stateful modes keep dropped clients' rows untouched."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.flatten_util import ravel_pytree
+
+from commefficient_tpu.federated import engine
+from commefficient_tpu.modes import modes
+from commefficient_tpu.modes.config import ModeConfig
+
+from test_engine import _data, _ucfg, init_mlp, mlp_loss
+
+
+def _step(cfg_kw, **eng_kw):
+    params = init_mlp(jax.random.PRNGKey(0))
+    d = ravel_pytree(params)[0].size
+    cfg = engine.EngineConfig(mode=ModeConfig(**{**cfg_kw, "d": d}), **eng_kw)
+    state = engine.init_server_state(cfg, params, {})
+    return cfg, state, jax.jit(engine.make_round_step(mlp_loss, cfg))
+
+
+def _batch(key, W, n=4):
+    data = _data(key, W * n)
+    return jax.tree.map(lambda a: a.reshape((W, n) + a.shape[1:]), data)
+
+
+def test_dropout_zero_is_identity():
+    batch = _batch(jax.random.PRNGKey(1), 8)
+    lr, rng = jnp.float32(0.1), jax.random.PRNGKey(7)
+    _, s0, step0 = _step(_ucfg())
+    _, s1, step1 = _step(_ucfg(), client_dropout=0.0)
+    a, _, ma = step0(s0, batch, {}, lr, rng)
+    b, _, mb = step1(s1, batch, {}, lr, rng)
+    for x, y in zip(jax.tree.leaves(a["params"]), jax.tree.leaves(b["params"])):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert ma["count"] == mb["count"]
+
+
+def _expected_mask(cfg, rng, W):
+    """Reproduce the engine's mask derivation (same pure function + streams)."""
+    _, _, drop_rng = jax.random.split(rng, 3)
+    return np.asarray(engine.participation_mask(drop_rng, W, cfg.client_dropout))
+
+
+@pytest.mark.parametrize("mode_kw", [
+    _ucfg(),
+    dict(mode="sketch", k=16, num_rows=3, num_cols=1024,
+         hash_family="rotation", momentum_type="virtual", error_type="virtual"),
+])
+def test_dropout_equals_survivor_only_round(mode_kw):
+    """A dropped round must equal the round run on ONLY the survivors (mean
+    aggregation is survivor-normalized, so the dropped clients' data can have
+    no influence at all)."""
+    W, lr, rng = 8, jnp.float32(0.1), jax.random.PRNGKey(3)
+    batch = _batch(jax.random.PRNGKey(1), W)
+    cfg, state, step = _step(mode_kw, client_dropout=0.4)
+    mask = _expected_mask(cfg, rng, W)
+    assert 0 < mask.sum() < W  # the seed produces a non-trivial mask
+
+    out, _, metrics = step(state, batch, {}, lr, rng)
+
+    # survivor-only reference: replicate survivors' updates via a plain mean.
+    # Same per-client rngs as the engine (split of the same crng stream), so
+    # gradient noise/dropout inside loss_fn matches client-for-client.
+    crng, _, _ = jax.random.split(rng, 3)
+    client_rngs = jax.random.split(crng, W)
+    params = init_mlp(jax.random.PRNGKey(0))
+    pflat, unravel = ravel_pytree(params)
+
+    def gflat(cb, r):
+        g = jax.grad(lambda p: mlp_loss(p, {}, cb, r)[0])(params)
+        return ravel_pytree(g)[0]
+
+    upds = jnp.stack([
+        gflat(jax.tree.map(lambda a: a[i], batch), client_rngs[i])
+        for i in range(W)
+    ])
+    surv_mean = (upds * mask[:, None]).sum(0) / mask.sum()
+    mcfg = cfg.mode
+    agg, _ = modes.client_compress(mcfg, surv_mean, {})
+    agg = modes.aggregate(mcfg, jax.tree.map(lambda x: x[None], agg))
+    delta, _ = modes.server_step(
+        mcfg, agg, modes.init_server_state(mcfg), lr
+    )
+    want = unravel(pflat - delta)
+    got = out["params"]
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+
+    # metrics count only the survivors' examples (4 per client)
+    assert float(metrics["count"]) == pytest.approx(mask.sum() * 4)
+
+
+def test_dropout_preserves_dropped_local_state():
+    """local_topk with local error: a dropped client's persistent error row
+    must come back bit-identical; survivors' rows must change."""
+    W = 8
+    cfg_kw = dict(mode="local_topk", k=8, momentum_type="none", error_type="local")
+    cfg, state, step = _step(cfg_kw, client_dropout=0.5)
+    batch = _batch(jax.random.PRNGKey(2), W)
+    rng = jnp.asarray(jax.random.PRNGKey(11))
+    mask = _expected_mask(cfg, rng, W)
+    assert 0 < mask.sum() < W
+
+    d = cfg.mode.d
+    rows = {"error": jnp.arange(W * d, dtype=jnp.float32).reshape(W, d)}
+    _, new_rows, _ = step(state, batch, rows, jnp.float32(0.1), rng)
+    for i in range(W):
+        same = np.array_equal(np.asarray(new_rows["error"][i]), np.asarray(rows["error"][i]))
+        assert same == (mask[i] == 0.0), (i, mask[i])
+
+
+def test_full_dropout_round_is_a_noop_update():
+    """All clients dropped: zero aggregate, so uncompressed/no-momentum params
+    are unchanged, and metrics are all zero."""
+    W = 4
+    cfg, state, step = _step(_ucfg(), client_dropout=0.999999)
+    batch = _batch(jax.random.PRNGKey(1), W)
+    out, _, metrics = step(state, batch, {}, jnp.float32(0.5), jax.random.PRNGKey(0))
+    p0 = init_mlp(jax.random.PRNGKey(0))
+    for a, b in zip(jax.tree.leaves(out["params"]), jax.tree.leaves(p0)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(metrics["count"]) == 0.0
+
+
+def test_invalid_dropout_rejected():
+    with pytest.raises(ValueError):
+        _step(_ucfg(), client_dropout=1.0)
+    with pytest.raises(ValueError):
+        _step(_ucfg(), client_dropout=-0.1)
+
+
+def test_dropout_comm_accounting_charges_survivors_only():
+    """run_round's uplink must scale with the surviving cohort; down-link
+    (broadcast) still reaches everyone."""
+    from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+    from commefficient_tpu.federated.api import FederatedSession
+
+    rngd = np.random.RandomState(0)
+    n, din, dout = 64, 10, 4
+    x = rngd.normal(size=(n, din)).astype(np.float32)
+    y = rngd.randint(0, dout, size=n).astype(np.int32)
+    ds = FedDataset(x, y, shard_iid(n, 16, rngd))
+    params = init_mlp(jax.random.PRNGKey(0))
+    d = ravel_pytree(params)[0].size
+
+    def make(dropout):
+        return FederatedSession(
+            train_loss_fn=mlp_loss, eval_loss_fn=mlp_loss,
+            params=jax.tree.map(jnp.copy, params),  # the step donates state
+            net_state={}, mode_cfg=ModeConfig(**_ucfg(d=d)), train_set=ds,
+            num_workers=8, local_batch_size=2, seed=5, client_dropout=dropout,
+        )
+
+    base = make(0.0).run_round(0.1)
+    drop_sess = make(0.5)
+    m = drop_sess.run_round(0.1)
+    surv = m["participants"]
+    assert 0 < surv < 8
+    assert m["comm_up_mb"] == pytest.approx(base["comm_up_mb"] * surv / 8)
+    assert m["comm_down_mb"] == pytest.approx(base["comm_down_mb"])
+    assert m["comm_total_mb"] == pytest.approx(m["comm_up_mb"] + m["comm_down_mb"])
