@@ -297,6 +297,7 @@ def _make_step(loss_fn, sketch_kw, d):
 
     mode_cfg = ModeConfig(
         mode="sketch", d=d, momentum_type="virtual", error_type="virtual",
+        topk_impl=os.environ.get("BENCH_TOPK_IMPL", "exact"),
         **sketch_kw,
     )
     cfg = engine.EngineConfig(mode=mode_cfg, weight_decay=5e-4)

@@ -33,6 +33,11 @@ class ModeConfig:
     num_clients: int = 0  # total virtual clients (for local state allocation)
     hash_family: str = "rotation"  # sketch bucket-hash family (see CSVecSpec);
     # "rotation" is the TPU-fast default, "random" the reference-like one
+    topk_impl: str = "exact"  # server/client top-k selection: "exact"
+    # (lax.top_k) or "approx" (lax.approx_max_k, TPU PartialReduce lowering
+    # at 0.95 recall; exact elsewhere). Top-k compression is itself a
+    # heuristic, so approx preserves semantics while dodging the TPU
+    # sort-based top_k at d in the millions.
     agg_op: str = "mean"  # how client wires combine: "mean" | "sum".
     # FetchSGD Alg. 1 writes the round sketch as a sum over client sketches
     # (SURVEY.md §3.1) with the scaling absorbed into the learning rate; this
@@ -55,6 +60,8 @@ class ModeConfig:
             raise ValueError("mode=sketch requires num_cols > 0 and k > 0")
         if self.mode in ("true_topk", "local_topk") and self.k <= 0:
             raise ValueError(f"mode={self.mode} requires k > 0")
+        if self.topk_impl not in ("exact", "approx"):
+            raise ValueError(f"bad topk_impl {self.topk_impl!r}")
         if self.momentum_type not in ("none", "virtual", "local"):
             raise ValueError(f"bad momentum_type {self.momentum_type!r}")
         if self.error_type not in ("none", "virtual", "local"):

@@ -29,6 +29,9 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
     p.add_argument("--hash_family", default="rotation", choices=["rotation", "random"],
                    help="sketch bucket-hash family: rotation = TPU-fast roll-based "
                         "(default), random = reference-like per-coordinate hashing")
+    p.add_argument("--topk_impl", default="exact", choices=["exact", "approx"],
+                   help="top-k selection: exact (lax.top_k) or approx "
+                        "(lax.approx_max_k, TPU-fast at 0.95 recall)")
     p.add_argument("--agg_op", default="mean", choices=["mean", "sum"],
                    help="client-wire aggregation: mean (cohort-size-independent "
                         "default) or sum (FetchSGD Alg. 1 semantics — use with "
@@ -154,4 +157,5 @@ def mode_config_from_args(args: argparse.Namespace, d: int) -> ModeConfig:
         num_clients=args.num_clients,
         hash_family=args.hash_family,
         agg_op=args.agg_op,
+        topk_impl=args.topk_impl,
     )
