@@ -88,6 +88,24 @@ WARMUP_ROUNDS = int(os.environ.get("BENCH_WARMUP", 3))
 BENCH_DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
 if BENCH_DTYPE not in ("float32", "bfloat16"):  # models silently f32 otherwise
     raise SystemExit(f"BENCH_DTYPE must be float32|bfloat16, got {BENCH_DTYPE!r}")
+# Engine sketch path: "oracle" (default) pins the round step to the pure-JAX
+# sketch; "auto" lets the library route to the Pallas kernels when eligible.
+# Oracle is the default because the ONLY compile that has ever wedged the
+# axon tunnel is the full engine module with Pallas custom-calls inlined
+# (ROUND3_NOTES.md) — an unattended driver bench must not risk taking the
+# chip down for hours. The kernel microbench below times the Pallas kernels
+# directly regardless, so the artifact still carries hardware kernel numbers.
+# Flip to auto once scripts/tpu_round3.sh step 5 proves the composition.
+BENCH_ENGINE_SKETCH = os.environ.get("BENCH_ENGINE_SKETCH", "oracle")
+if BENCH_ENGINE_SKETCH not in ("oracle", "auto"):
+    raise SystemExit(f"BENCH_ENGINE_SKETCH must be oracle|auto, got {BENCH_ENGINE_SKETCH!r}")
+# The knob is authoritative over any inherited COMMEFFICIENT_NO_PALLAS value
+# (an empty-string "unset" must not silently re-enable the wedge-prone
+# compile in oracle mode; a stale =1 export must not silently undermine auto)
+if BENCH_ENGINE_SKETCH == "oracle":
+    os.environ["COMMEFFICIENT_NO_PALLAS"] = "1"
+else:
+    os.environ.pop("COMMEFFICIENT_NO_PALLAS", None)
 # timed work = BENCH_CHAINS chains of BENCH_CHAIN_LEN dependent rounds, one
 # device_get sync per chain (>= 30 rounds total for stable percentiles)
 CHAIN_LEN = int(os.environ.get("BENCH_CHAIN_LEN", 10))

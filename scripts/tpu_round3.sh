@@ -156,7 +156,8 @@ fi
 # If this wedges the tunnel, everything above is already collected.
 if want 5; then
 probe_chip || { echo "CHIP DEAD before step 5"; exit 105; }
-BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
+BENCH_ENGINE_SKETCH=auto \
+    BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
     BENCH_WARMUP=0 BENCH_SCALE_CHECK=0 BENCH_MICRO_CHAIN=2 \
     timeout 1800 python -u bench.py 2>&1 \
     | tee results/logs/step5_pallas_engine_probe.log \
@@ -186,11 +187,19 @@ if [ ! -f results/logs/step5.ok ]; then
     FAIL=8
 else
 probe_chip || { echo "CHIP DEAD before step 6"; exit 106; }
-timeout 2400 python -u bench.py 2>&1 \
+BENCH_ENGINE_SKETCH=auto timeout 2400 python -u bench.py 2>&1 \
     | tee results/logs/step6_bench_pallas.log | grep -v WARNING | tail -8
-if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step6.ok; else echo "STEP 6 FAILED"; FAIL=8; fi
-# a pallas-engine flagship number supersedes the oracle-engine one
-install_json results/logs/step6_bench_pallas.log BENCH_flagship_r03.json
+# the library falls back to the oracle SILENTLY if this process's Mosaic
+# probe fails — verify the JSON actually took the pallas path (as step 5
+# does) before installing it as the pallas flagship number
+if [ "${PIPESTATUS[0]}" -eq 0 ] && grep -q '"engine_sketch_path": "pallas"' \
+        results/logs/step6_bench_pallas.log; then
+    touch results/logs/step6.ok
+    # a pallas-engine flagship number supersedes the oracle-engine one
+    install_json results/logs/step6_bench_pallas.log BENCH_flagship_r03.json
+else
+    echo "STEP 6 FAILED (rc or oracle fallback; see the log)"; FAIL=8
+fi
 fi
 fi
 
