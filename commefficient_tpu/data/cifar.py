@@ -39,10 +39,26 @@ def _load_cifar10_pickles(root: str):
     return np.concatenate(xs), np.concatenate(ys), xte, yte
 
 
-def _synthetic(num_train: int, num_test: int, num_classes: int, seed: int = 0):
+def _prototypes(rng: np.random.RandomState, num_classes: int,
+                separation: float) -> np.ndarray:
+    """The synthetic task's true class means — first draw of the stream.
+    Exposed so tests can apply the exact Bayes rule without replaying
+    private RNG internals."""
+    return separation * rng.normal(
+        0, 1.0, size=(num_classes, 32, 32, 3)
+    ).astype(np.float32)
+
+
+def _synthetic(num_train: int, num_test: int, num_classes: int, seed: int = 0,
+               separation: float = 1.0):
+    """Class-conditional Gaussian images. `separation` scales the class
+    prototypes against the fixed pixel noise (sigma 0.5): at the default 1.0
+    the task is trivially separable (Bayes accuracy ~1.0 — any model
+    saturates, fine for smoke tests); ~0.025 puts the Bayes-optimal
+    (nearest-prototype) accuracy near 0.86, so accuracy-vs-communication
+    trade-off curves have headroom to differ (results/README.md)."""
     rng = np.random.RandomState(seed)
-    # class-conditional means so that learning is possible (loss can fall)
-    protos = rng.normal(0, 1.0, size=(num_classes, 32, 32, 3)).astype(np.float32)
+    protos = _prototypes(rng, num_classes, separation)
     def make(n):
         y = rng.randint(0, num_classes, size=n).astype(np.int32)
         x = protos[y] + rng.normal(0, 0.5, size=(n, 32, 32, 3)).astype(np.float32)
@@ -62,6 +78,7 @@ def load_cifar_fed(
     seed: int = 0,
     synthetic_train: int = 10000,
     synthetic_test: int = 2000,
+    synthetic_separation: float = 1.0,
 ) -> tuple[FedDataset, FedDataset, int]:
     """Returns (train FedDataset, test FedDataset, num_classes). Test set is
     sharded trivially (1 shard) — eval never uses client structure."""
@@ -71,7 +88,10 @@ def load_cifar_fed(
         xtr_u8, ytr, xte_u8, yte = loaded
         xtr, xte = _normalize(xtr_u8), _normalize(xte_u8)
     else:
-        xtr, ytr, xte, yte = _synthetic(synthetic_train, synthetic_test, num_classes, seed)
+        xtr, ytr, xte, yte = _synthetic(
+            synthetic_train, synthetic_test, num_classes, seed,
+            separation=synthetic_separation,
+        )
 
     rng = np.random.RandomState(seed)
     shards = shard_iid(len(xtr), num_clients, rng) if iid else shard_by_label(ytr, num_clients)

@@ -43,7 +43,8 @@ def build(args):
         sample_shape = (1, 28, 28, 1)
     else:
         train_set, test_set, num_classes = load_cifar_fed(
-            args.dataset, args.num_clients, args.iid, args.data_root, args.seed
+            args.dataset, args.num_clients, args.iid, args.data_root, args.seed,
+            synthetic_separation=args.synthetic_separation,
         )
         model = ResNet9(num_classes=num_classes, dtype=args.dtype)
         sample_shape = (1, 32, 32, 3)
