@@ -52,6 +52,7 @@ def make_generate(
     max_new: int,
     temperature: float = 0.0,
     top_p: float = 0.9,
+    last_logit_only: bool = True,
 ):
     """Build a jitted decode fn for a GPT2LMHead-style model.
 
@@ -67,10 +68,22 @@ def make_generate(
       end at <eos>).
     """
 
-    def step_logits(params, ids, types):
+    def step_logits(params, ids, types, pos):
+        """[B, V] logits at each row's position `pos` (predicting pos+1).
+        GPT2LMHead's logit_positions fast path computes the vocab einsum at
+        the one needed position per row; models without that kwarg (e.g.
+        test stubs) take last_logit_only=False and gather from [B, T, V]."""
+        if last_logit_only:
+            return model.apply(
+                {"params": params}, ids, train=False, token_type_ids=types,
+                logit_positions=pos,
+            )
         out = model.apply({"params": params}, ids, train=False, token_type_ids=types)
         # with_mc_head models return just lm_logits when mc_positions is None
-        return out[0] if isinstance(out, tuple) else out
+        out = out[0] if isinstance(out, tuple) else out
+        from .gpt2 import gather_at
+
+        return gather_at(out, pos)
 
     @jax.jit
     def generate(params, ids, types, prompt_len, rng):
@@ -79,10 +92,10 @@ def make_generate(
 
         def body(carry, step_rng):
             ids, types, cur, done = carry
-            logits = step_logits(params, ids, types)  # [B, T, V]
             # logits at position cur-1 predict the token at cur
             nxt = _nucleus_pick(
-                logits[rows, jnp.maximum(cur - 1, 0)], step_rng, temperature, top_p
+                step_logits(params, ids, types, jnp.maximum(cur - 1, 0)),
+                step_rng, temperature, top_p,
             ).astype(ids.dtype)
             in_range = cur < T
             write = (~done) & in_range

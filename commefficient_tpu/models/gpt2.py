@@ -55,6 +55,12 @@ class GPT2Config:
         return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
 
 
+def gather_at(x: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    """[B, ...rest] rows of x[B, T, ...rest] at per-row positions pos[B]."""
+    idx = pos.astype(jnp.int32).reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.take_along_axis(x, idx, axis=1)[:, 0]
+
+
 TINY = GPT2Config(vocab_size=512, n_positions=128, n_embd=64, n_layer=2, n_head=2, dropout=0.0)
 SMALL = GPT2Config()  # GPT-2 small: 124M params, the reference's NLP model
 
@@ -162,7 +168,8 @@ class GPT2LMHead(nn.Module):
 
     @nn.compact
     def __call__(
-        self, input_ids, train: bool = True, token_type_ids=None, mc_positions=None
+        self, input_ids, train: bool = True, token_type_ids=None,
+        mc_positions=None, logit_positions=None,
     ):
         cfg = self.cfg
         B, T = input_ids.shape
@@ -187,6 +194,13 @@ class GPT2LMHead(nn.Module):
             use_moe = cfg.moe_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
             x = block(cfg, use_moe, name=f"h_{i}")(x, train)
         x = nn.LayerNorm(epsilon=cfg.ln_eps, name="ln_f")(x)
+        if logit_positions is not None:
+            # decode fast path (models/generate.py): logits at ONE position
+            # per row — [B, V] instead of [B, T, V]. With GPT-2's 50k vocab
+            # the per-step head einsum shrinks T-fold; everything upstream
+            # (the transformer stack) is unchanged.
+            x_at = gather_at(x, logit_positions)
+            return jnp.einsum("bc,vc->bv", x_at.astype(jnp.float32), wte)
         # tied LM head; logits in float32 for a stable softmax
         lm_logits = jnp.einsum("btc,vc->btv", x.astype(jnp.float32), wte)
         if not cfg.with_mc_head:
@@ -198,7 +212,6 @@ class GPT2LMHead(nn.Module):
         )
         if mc_positions is None:
             return lm_logits
-        h_last = jnp.take_along_axis(
-            x.astype(jnp.float32), mc_positions[:, None, None], axis=1
-        )[:, 0]  # [B, E] hidden at each sequence's mc token
+        h_last = gather_at(x.astype(jnp.float32), mc_positions)
+        # [B, E] hidden at each sequence's mc token
         return lm_logits, h_last @ mc_w

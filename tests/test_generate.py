@@ -85,7 +85,8 @@ def test_eos_stops_row_and_length_excludes_eos():
     # row 0 emits 2 tokens then eos; row 1 never emits eos
     stub = _StubModel([[2, 3, eos, 4, 4], [4, 4, 4, 4, 4]], prompt_len, V)
     gen = make_generate(
-        stub, eos_id=eos, pad_id=pad, reply_type_id=7, max_new=5, temperature=0.0
+        stub, eos_id=eos, pad_id=pad, reply_type_id=7, max_new=5, temperature=0.0,
+        last_logit_only=False,
     )
     ids = np.zeros((2, 12), np.int32)
     ids[:, :3] = 2
@@ -109,7 +110,8 @@ def test_overflow_clamps_at_buffer_end():
     prompt_len = np.array([6], np.int32)
     stub = _StubModel([[3] * 10], prompt_len, V)
     gen = make_generate(
-        stub, eos_id=eos, pad_id=pad, reply_type_id=7, max_new=10, temperature=0.0
+        stub, eos_id=eos, pad_id=pad, reply_type_id=7, max_new=10, temperature=0.0,
+        last_logit_only=False,
     )
     ids = np.zeros((1, 8), np.int32)
     ids[:, :6] = 2
@@ -135,7 +137,7 @@ def test_nucleus_sampling_stays_in_nucleus():
 
     gen_tight = make_generate(
         Peaked(), eos_id=eos, pad_id=pad, reply_type_id=7, max_new=4,
-        temperature=1.0, top_p=0.5,
+        temperature=1.0, top_p=0.5, last_logit_only=False,
     )
     ids = np.zeros((1, 10), np.int32)
     ids[:, :2] = 1
@@ -147,7 +149,7 @@ def test_nucleus_sampling_stays_in_nucleus():
 
     gen_loose = make_generate(
         Peaked(), eos_id=eos, pad_id=pad, reply_type_id=7, max_new=4,
-        temperature=3.0, top_p=1.0,
+        temperature=3.0, top_p=1.0, last_logit_only=False,
     )
     picks = set()
     for s in range(8):
@@ -203,3 +205,28 @@ def test_gpt2_train_eval_f1_end_to_end(tmp_path):
     rows = [json.loads(ln) for ln in log.read_text().splitlines()]
     assert rows and "val_f1" in rows[-1]
     assert 0.0 <= rows[-1]["val_f1"] <= 1.0
+
+
+def test_last_logit_fast_path_matches_full_logits():
+    """GPT2LMHead.logit_positions (decode fast path: [B, V] head einsum at
+    one position) must produce the same decode as the full [B, T, V] path."""
+    cfg = dataclasses.replace(TINY, n_positions=24, dropout=0.0)
+    model = GPT2LMHead(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 24), jnp.int32), train=False
+    )["params"]
+    prompt_len = np.array([4, 7], np.int32)
+    rng = np.random.RandomState(1)
+    ids = np.zeros((2, 24), np.int32)
+    types = np.zeros((2, 24), np.int32)
+    for b in range(2):
+        ids[b, : prompt_len[b]] = rng.randint(1, cfg.vocab_size, prompt_len[b])
+    kw = dict(eos_id=-1, pad_id=0, reply_type_id=9, max_new=5, temperature=0.0)
+    fast = make_generate(model, last_logit_only=True, **kw)
+    slow = make_generate(model, last_logit_only=False, **kw)
+    a = fast(params, jnp.asarray(ids), jnp.asarray(types),
+             jnp.asarray(prompt_len), jax.random.PRNGKey(0))
+    b = slow(params, jnp.asarray(ids), jnp.asarray(types),
+             jnp.asarray(prompt_len), jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
