@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Render the accuracy-vs-communication table in results/README.md from the
+per-arm JSONL logs (cv_train --log_jsonl output).
+
+    python scripts/tradeoff_table.py results/cifar10_hard_*.jsonl
+
+Prints a markdown table: one row per eval round, one (test_acc, comm_mb)
+column pair per arm, plus a footer with each arm's best accuracy and the
+communication spent to FIRST reach within 1% of the worst arm's best (the
+equal-accuracy comparison point the FetchSGD paper's headline uses)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def load(path: str) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def main(paths: list[str]) -> None:
+    arms = {}
+    for p in paths:
+        name = os.path.basename(p).rsplit(".", 1)[0].split("_")[-1]
+        arms[name] = load(p)
+    if not arms:
+        raise SystemExit("no jsonl files given")
+
+    rounds = sorted({r["round"] for rows in arms.values() for r in rows})
+    by_round = {
+        name: {r["round"]: r for r in rows} for name, rows in arms.items()
+    }
+    names = sorted(arms)
+    head = "| round | " + " | ".join(
+        f"{n} acc | {n} comm (MB)" for n in names
+    ) + " |"
+    print(head)
+    print("|" + "---|" * (1 + 2 * len(names)))
+    for rnd in rounds:
+        cells = []
+        for n in names:
+            row = by_round[n].get(rnd)
+            cells += (
+                [f"{row['test_acc']:.3f}", f"{row['comm_mb']:.0f}"]
+                if row else ["-", "-"]
+            )
+        print(f"| {rnd} | " + " | ".join(cells) + " |")
+
+    best = {n: max(r["test_acc"] for r in rows) for n, rows in arms.items()}
+    target = min(best.values()) - 0.01  # within 1% of the WORST arm's best
+    print()
+    for n in names:
+        hit = next(
+            (r for r in sorted(arms[n], key=lambda r: r["round"])
+             if r["test_acc"] >= target), None
+        )
+        at = (f"reaches {target:.3f} at round {hit['round']} "
+              f"({hit['comm_mb']:.0f} MB)") if hit else "never reaches target"
+        print(f"- **{n}**: best test_acc {best[n]:.3f}; {at}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
