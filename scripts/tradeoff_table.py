@@ -17,17 +17,33 @@ import sys
 
 
 def load(path: str) -> list[dict]:
+    rows = []
     with open(path) as f:
-        return [json.loads(ln) for ln in f if ln.strip()]
+        for ln in f:
+            if not ln.strip():
+                continue
+            try:
+                rows.append(json.loads(ln))
+            except json.JSONDecodeError:
+                # a run killed mid-append leaves a truncated final line;
+                # keep everything before it
+                print(f"<!-- {path}: skipped malformed line -->", file=sys.stderr)
+    return rows
 
 
 def main(paths: list[str]) -> None:
     arms = {}
     for p in paths:
         name = os.path.basename(p).rsplit(".", 1)[0].split("_")[-1]
-        arms[name] = load(p)
+        if name in arms:  # same suffix from different prefixes: keep both
+            name = os.path.basename(p).rsplit(".", 1)[0]
+        rows = load(p)
+        if not rows:
+            print(f"<!-- {p}: no rows; skipped -->", file=sys.stderr)
+            continue
+        arms[name] = rows
     if not arms:
-        raise SystemExit("no jsonl files given")
+        raise SystemExit("no usable jsonl files given")
 
     rounds = sorted({r["round"] for rows in arms.values() for r in rows})
     by_round = {
