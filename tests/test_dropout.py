@@ -166,3 +166,27 @@ def test_dropout_comm_accounting_charges_survivors_only():
     assert m["comm_up_mb"] == pytest.approx(base["comm_up_mb"] * surv / 8)
     assert m["comm_down_mb"] == pytest.approx(base["comm_down_mb"])
     assert m["comm_total_mb"] == pytest.approx(m["comm_up_mb"] + m["comm_down_mb"])
+
+
+def test_dropout_sharded_equals_unsharded():
+    """The participation mask derives from the step's rng INSIDE the compiled
+    program; over the 8-device client mesh it must replicate identically, so
+    sharded == unsharded holds with dropout active (same contract as
+    test_engine.py::test_sharded_equals_unsharded)."""
+    from commefficient_tpu.parallel import mesh as meshlib
+    from test_engine import _data as edata
+
+    mesh = meshlib.make_mesh(8)
+    data = edata(jax.random.PRNGKey(5), 64)
+    w8 = jax.tree.map(lambda a: a.reshape((8, 8) + a.shape[1:]), data)
+    lr, rng = jnp.float32(0.1), jax.random.PRNGKey(4)
+    cfg, state, step = _step(_ucfg(), client_dropout=0.4)
+    mask = _expected_mask(cfg, rng, 8)
+    assert 0 < mask.sum() < 8
+
+    ref, _, mref = step(state, w8, {}, lr, rng)
+    _, state2, step2 = _step(_ucfg(), client_dropout=0.4)
+    got, _, mgot = step2(state2, meshlib.shard_client_batch(mesh, w8), {}, lr, rng)
+    for a, b in zip(jax.tree.leaves(got["params"]), jax.tree.leaves(ref["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+    assert float(mgot["participants"]) == float(mref["participants"]) == mask.sum()
