@@ -1,0 +1,78 @@
+"""Multi-host bootstrap — the rebuild's answer to "an NCCL/MPI backend that
+scales to multi-host" (build brief; the reference itself is single-host
+torch.multiprocessing — SURVEY.md §2 "Distributed comm backend", so this is
+rebuild-side scale headroom, not a parity item).
+
+On JAX the entire "backend" is: every host process calls
+`jax.distributed.initialize` (on TPU pods the coordinator/process count/
+process id all auto-detect from the TPU metadata environment), after which
+`jax.devices()` spans the whole pod and the SAME single-process program —
+`parallel.mesh.make_mesh` shardings, XLA collectives over ICI/DCN — runs
+SPMD across hosts. No queues, no sends: the engine code is untouched.
+
+    from commefficient_tpu.parallel import distributed, mesh
+    distributed.initialize()          # no-op off-pod / single process
+    m = mesh.make_mesh(num_slices=jax.device_count() // 8 // ...)
+
+Both CLIs call `initialize()` up front (--multihost forces it; the default
+auto mode only initializes when a multi-host environment is detected, so
+laptops/CI never touch the distributed runtime)."""
+
+from __future__ import annotations
+
+import os
+
+_INITIALIZED = False
+
+# environment markers that identify a multi-host launch: TPU pod metadata
+# (cloud TPU VMs), an explicit JAX coordinator, or a MegaScale/multislice
+# launcher. Any of these => jax.distributed.initialize() can auto-configure.
+_MULTIHOST_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_WORKER_ID",
+)
+
+
+def detected() -> bool:
+    """Whether the process environment looks like one host of a multi-host
+    launch."""
+    return any(os.environ.get(v) for v in _MULTIHOST_ENV_VARS)
+
+
+def initialize(force: bool = False, **kwargs) -> bool:
+    """Join the multi-host cluster (idempotent). Returns True if the
+    distributed runtime is (now) initialized.
+
+    - auto mode (force=False): initialize only when `detected()` — a plain
+      single-host run never touches the distributed service.
+    - force=True: initialize unconditionally (kwargs pass through to
+      `jax.distributed.initialize`, e.g. coordinator_address/num_processes/
+      process_id for non-TPU clusters where auto-detection has nothing to
+      read).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    if not (force or detected()):
+        return False
+    import jax
+
+    jax.distributed.initialize(**kwargs)
+    _INITIALIZED = True
+    return True
+
+
+def process_info() -> dict:
+    """Host-level topology summary for logs: which process this is, how many
+    there are, and the local/global device split."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": jax.device_count(),
+    }

@@ -61,6 +61,19 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                    help="per-round probability each sampled client drops "
                         "before aggregation (straggler simulation; the "
                         "reference has none — a dead worker hangs it)")
+    p.add_argument("--multihost", action="store_true",
+                   help="force jax.distributed.initialize() at startup "
+                        "(auto-detected multi-host environments initialize "
+                        "without this flag; see parallel/distributed.py)")
+    p.add_argument("--coordinator_address", default=None,
+                   help="host:port of process 0 for --multihost on clusters "
+                        "without auto-detection (non-TPU)")
+    p.add_argument("--num_processes", type=int, default=None,
+                   help="total hosts for --multihost (with "
+                        "--coordinator_address)")
+    p.add_argument("--process_id", type=int, default=None,
+                   help="this host's rank for --multihost (with "
+                        "--coordinator_address)")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible")
     p.add_argument("--eval_batch_size", type=int, default=512)

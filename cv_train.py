@@ -80,6 +80,14 @@ def build(args):
 
 def main(argv=None):
     args = resolve_defaults(make_parser("cv").parse_args(argv))
+    from commefficient_tpu.parallel import distributed
+    cluster_kw = {
+        k: v for k, v in (("coordinator_address", args.coordinator_address),
+                          ("num_processes", args.num_processes),
+                          ("process_id", args.process_id)) if v is not None
+    }
+    if distributed.initialize(force=args.multihost, **cluster_kw):
+        print(f"multihost: {distributed.process_info()}", flush=True)
     session, test_set = build(args)
 
     rounds_per_epoch = max(1, math.ceil(args.num_clients / session.num_workers))

@@ -1,0 +1,62 @@
+"""Multi-host bootstrap tests (parallel/distributed.py). Real multi-process
+launches can't run here; what IS testable: the auto-detection contract (a
+plain host never touches the distributed runtime), and a forced single-
+process initialize in a SUBPROCESS (the distributed service binds for the
+life of a process — keep it out of the shared pytest process)."""
+
+import os
+import subprocess
+import sys
+
+from commefficient_tpu.parallel import distributed
+
+
+def test_auto_mode_is_noop_without_multihost_env(monkeypatch):
+    for v in distributed._MULTIHOST_ENV_VARS:
+        monkeypatch.delenv(v, raising=False)
+    assert not distributed.detected()
+    assert distributed.initialize() is False  # no env -> no init
+    assert distributed._INITIALIZED is False
+
+
+def test_detection_markers(monkeypatch):
+    for v in distributed._MULTIHOST_ENV_VARS:
+        monkeypatch.delenv(v, raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+    assert distributed.detected()
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    assert distributed.detected()
+
+
+def test_forced_single_process_initialize_subprocess():
+    """force=True with an explicit localhost coordinator: a 1-process
+    'cluster' initializes, and the engine's mesh/devices view is unchanged."""
+    import socket
+
+    with socket.socket() as sk:  # ephemeral port: concurrent runs can't collide
+        sk.bind(("localhost", 0))
+        port = sk.getsockname()[1]
+    code = f"""
+from commefficient_tpu.utils.hermetic import force_hermetic_cpu
+force_hermetic_cpu(4)  # >= 4 devices (an inherited XLA_FLAGS count wins)
+from commefficient_tpu.parallel import distributed, mesh
+ok = distributed.initialize(
+    force=True, coordinator_address="localhost:{port}",
+    num_processes=1, process_id=0,
+)
+assert ok and distributed.initialize()  # idempotent
+info = distributed.process_info()
+assert info['process_index'] == 0 and info['process_count'] == 1
+assert info['local_devices'] == info['global_devices'] >= 4
+m = mesh.make_mesh(4)
+print("OK", info)
+"""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=240, env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK" in out.stdout
