@@ -24,45 +24,81 @@ import os
 
 _INITIALIZED = False
 
-# environment markers that identify a multi-host launch: TPU pod metadata
-# (cloud TPU VMs), an explicit JAX coordinator, or a MegaScale/multislice
-# launcher. Any of these => jax.distributed.initialize() can auto-configure.
-_MULTIHOST_ENV_VARS = (
+# explicit-coordinator markers: any of these means a launcher configured a
+# cluster and jax.distributed.initialize() can auto-configure from them
+_COORDINATOR_ENV_VARS = (
     "JAX_COORDINATOR_ADDRESS",
     "COORDINATOR_ADDRESS",
     "MEGASCALE_COORDINATOR_ADDRESS",
-    "TPU_WORKER_HOSTNAMES",
-    "TPU_WORKER_ID",
 )
 
 
 def detected() -> bool:
-    """Whether the process environment looks like one host of a multi-host
-    launch."""
-    return any(os.environ.get(v) for v in _MULTIHOST_ENV_VARS)
+    """Whether the process environment looks like one host of a MULTI-host
+    launch. An explicit coordinator address counts; TPU_WORKER_HOSTNAMES
+    counts only when it lists 2+ hosts — single-host TPU VMs (and this
+    machine's tunnel plugin) set it with one entry, and initializing the
+    distributed service there is pointless env-marker noise."""
+    if any(os.environ.get(v) for v in _COORDINATOR_ENV_VARS):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) >= 2
 
 
 def initialize(force: bool = False, **kwargs) -> bool:
     """Join the multi-host cluster (idempotent). Returns True if the
     distributed runtime is (now) initialized.
 
-    - auto mode (force=False): initialize only when `detected()` — a plain
-      single-host run never touches the distributed service.
-    - force=True: initialize unconditionally (kwargs pass through to
-      `jax.distributed.initialize`, e.g. coordinator_address/num_processes/
-      process_id for non-TPU clusters where auto-detection has nothing to
-      read).
+    - auto mode (force=False): initialize only when `detected()`, and any
+      failure (backend already up, incomplete metadata) degrades to a
+      warned single-host run — auto mode must never kill a job that would
+      have run fine on one host.
+    - force=True: initialize unconditionally and propagate failures
+      (kwargs pass through to `jax.distributed.initialize`, e.g.
+      coordinator_address/num_processes/process_id for non-TPU clusters
+      where auto-detection has nothing to read).
     """
     global _INITIALIZED
     if _INITIALIZED:
         return True
     if not (force or detected()):
         return False
+    import sys
+
     import jax
 
-    jax.distributed.initialize(**kwargs)
+    from ..utils.hermetic import backends_initialized
+
+    if backends_initialized():
+        # too late to join a cluster; a forced request is a caller bug
+        msg = ("distributed.initialize called after the JAX backend "
+               "initialized; running single-host")
+        if force:
+            raise RuntimeError(msg)
+        print(f"warning: {msg}", file=sys.stderr, flush=True)
+        return False
+    try:
+        jax.distributed.initialize(**kwargs)
+    except Exception as e:  # noqa: BLE001 — auto mode degrades, forced raises
+        if force:
+            raise
+        print(f"warning: multi-host auto-init failed ({type(e).__name__}: {e}); "
+              "running single-host", file=sys.stderr, flush=True)
+        return False
     _INITIALIZED = True
     return True
+
+
+def initialize_from_args(args) -> bool:
+    """CLI adapter: explicit cluster flags imply force (a user who typed a
+    coordinator address wants a cluster — silently training single-host on
+    each node would be the worst failure mode)."""
+    cluster_kw = {
+        k: v for k, v in (("coordinator_address", args.coordinator_address),
+                          ("num_processes", args.num_processes),
+                          ("process_id", args.process_id)) if v is not None
+    }
+    return initialize(force=args.multihost or bool(cluster_kw), **cluster_kw)
 
 
 def process_info() -> dict:

@@ -14,6 +14,19 @@ from __future__ import annotations
 import os
 
 
+def backends_initialized() -> bool:
+    """Whether any JAX backend has initialized (too late to join a
+    cluster). The jax._src.xla_bridge private-API touchpoint stays in this
+    module only; unknown JAX internals degrade to "assume initialized" —
+    the safe answer for every caller."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:  # noqa: BLE001 — private API; fail safe
+        return True
+
+
 def force_hermetic_cpu(n_devices: int | None = None) -> None:
     """Pin this process's JAX to the CPU backend; optionally force an
     n_devices virtual-device mesh (xla_force_host_platform_device_count).

@@ -81,12 +81,7 @@ def build(args):
 def main(argv=None):
     args = resolve_defaults(make_parser("cv").parse_args(argv))
     from commefficient_tpu.parallel import distributed
-    cluster_kw = {
-        k: v for k, v in (("coordinator_address", args.coordinator_address),
-                          ("num_processes", args.num_processes),
-                          ("process_id", args.process_id)) if v is not None
-    }
-    if distributed.initialize(force=args.multihost, **cluster_kw):
+    if distributed.initialize_from_args(args):
         print(f"multihost: {distributed.process_info()}", flush=True)
     session, test_set = build(args)
 

@@ -209,12 +209,7 @@ def make_f1_eval(args, model, tok, valid_set):
 def main(argv=None):
     args = resolve_defaults(make_parser("gpt2").parse_args(argv))
     from commefficient_tpu.parallel import distributed
-    cluster_kw = {
-        k: v for k, v in (("coordinator_address", args.coordinator_address),
-                          ("num_processes", args.num_processes),
-                          ("process_id", args.process_id)) if v is not None
-    }
-    if distributed.initialize(force=args.multihost, **cluster_kw):
+    if distributed.initialize_from_args(args):
         print(f"multihost: {distributed.process_info()}", flush=True)
     session, valid_set, extras = build(args)
     f1_eval = (

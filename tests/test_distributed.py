@@ -11,22 +11,45 @@ import sys
 from commefficient_tpu.parallel import distributed
 
 
-def test_auto_mode_is_noop_without_multihost_env(monkeypatch):
-    for v in distributed._MULTIHOST_ENV_VARS:
+def _clear(monkeypatch):
+    for v in distributed._COORDINATOR_ENV_VARS + ("TPU_WORKER_HOSTNAMES",):
         monkeypatch.delenv(v, raising=False)
+
+
+def test_auto_mode_is_noop_without_multihost_env(monkeypatch):
+    _clear(monkeypatch)
     assert not distributed.detected()
     assert distributed.initialize() is False  # no env -> no init
     assert distributed._INITIALIZED is False
 
 
 def test_detection_markers(monkeypatch):
-    for v in distributed._MULTIHOST_ENV_VARS:
-        monkeypatch.delenv(v, raising=False)
+    _clear(monkeypatch)
+    # a SINGLE worker hostname (single-host TPU VMs, this machine's tunnel
+    # plugin) must NOT read as a cluster
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0")
+    assert not distributed.detected()
     monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
     assert distributed.detected()
     monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")
     assert distributed.detected()
+
+
+def test_auto_mode_degrades_when_backend_already_up(monkeypatch):
+    """The pytest process has live CPU backends; auto mode must warn and
+    run single-host, NOT raise (a launcher env var must never kill a job
+    that works on one host)."""
+    import jax
+
+    jax.devices()  # ensure backends are up
+    _clear(monkeypatch)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    assert distributed.initialize() is False
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError):
+        distributed.initialize(force=True)
 
 
 def test_forced_single_process_initialize_subprocess():
@@ -60,3 +83,23 @@ print("OK", info)
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "OK" in out.stdout
+
+
+def test_initialize_from_args_forces_on_explicit_cluster_flags(monkeypatch):
+    """Explicit --coordinator_address without --multihost must still attempt
+    the cluster join (and, with backends already up in this process, raise
+    rather than silently train single-host on every node)."""
+    import argparse
+
+    import jax
+    import pytest as _pytest
+
+    jax.devices()
+    _clear(monkeypatch)
+    args = argparse.Namespace(multihost=False, coordinator_address="h0:1",
+                              num_processes=2, process_id=0)
+    with _pytest.raises(RuntimeError):
+        distributed.initialize_from_args(args)
+    plain = argparse.Namespace(multihost=False, coordinator_address=None,
+                               num_processes=None, process_id=None)
+    assert distributed.initialize_from_args(plain) is False
