@@ -190,3 +190,48 @@ def test_dropout_sharded_equals_unsharded():
     for a, b in zip(jax.tree.leaves(got["params"]), jax.tree.leaves(ref["params"])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
     assert float(mgot["participants"]) == float(mref["participants"]) == mask.sum()
+
+
+def test_dropout_session_persistent_state_roundtrip():
+    """Session-level composition: local_topk with client-local error state +
+    dropout. The gather/scatter cycle must write dropped clients' rows back
+    bit-identical while survivors' rows change."""
+    from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+    from commefficient_tpu.federated.api import FederatedSession
+
+    rngd = np.random.RandomState(1)
+    n = 48
+    x = rngd.normal(size=(n, 10)).astype(np.float32)
+    y = rngd.randint(0, 4, size=n).astype(np.int32)
+    ds = FedDataset(x, y, shard_iid(n, 12, rngd))
+    params = init_mlp(jax.random.PRNGKey(0))
+    d = ravel_pytree(params)[0].size
+    sess = FederatedSession(
+        train_loss_fn=mlp_loss, eval_loss_fn=mlp_loss, params=params,
+        net_state={}, train_set=ds, num_workers=8, local_batch_size=2,
+        seed=9, client_dropout=0.5,
+        mode_cfg=ModeConfig(mode="local_topk", d=d, k=8, momentum_type="none",
+                            error_type="local", num_clients=12),
+    )
+    # seed the persistent state with recognizable values
+    marked = jax.tree.map(
+        lambda a: jnp.arange(a.size, dtype=a.dtype).reshape(a.shape) * 1e-3,
+        sess.client_state,
+    )
+    sess.client_state = marked
+    before = np.asarray(marked["error"])
+
+    # reproduce the round's sampled ids and mask (session rng protocol)
+    ids = sess.train_set.sample_clients(np.random.RandomState(9), 8)
+    m = sess.run_round(0.1)
+    after = np.asarray(sess.client_state["error"])
+
+    surv = int(m["participants"])
+    assert 0 < surv < 8
+    changed = {i for i in range(12) if not np.array_equal(before[i], after[i])}
+    # exactly the surviving sampled clients changed
+    assert changed <= set(ids.tolist())
+    assert len(changed) == surv
+    # unsampled clients untouched
+    for i in set(range(12)) - set(ids.tolist()):
+        np.testing.assert_array_equal(before[i], after[i])
