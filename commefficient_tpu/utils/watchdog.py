@@ -1,0 +1,89 @@
+"""Hung-round detection (SURVEY.md §5 "Failure detection: none — a dead
+worker hangs the run"; the rebuild's runtime equivalent of that missing
+subsystem, motivated concretely by this repo's tunnelled-TPU outages where a
+wedged device claim stalls a training loop silently for hours).
+
+A `RoundWatchdog` wraps the per-round host loop. It learns the typical round
+wall-time online (median of completed rounds) and, from a daemon timer
+thread, emits ONE alert per stall once the in-flight round exceeds
+`factor x median` (with an absolute floor so compile-length first rounds
+don't trip it). It cannot interrupt a hung XLA call — nothing can from
+Python — but it turns "the job has printed nothing for 3 hours" into an
+immediate, attributable diagnosis with the stall duration and round number,
+which is exactly what the bench.py stage markers do for benchmarks.
+
+    wd = RoundWatchdog()
+    for rnd in range(rounds):
+        with wd.round(rnd):
+            metrics = model(lr)
+
+Thread-safety: the timer thread only reads monotonic timestamps written
+before it is armed; arming/disarming happens on the training thread.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+
+
+class RoundWatchdog:
+    def __init__(
+        self,
+        factor: float = 10.0,
+        min_history: int = 3,
+        floor_s: float = 120.0,
+        alert=None,
+    ):
+        """factor: stall threshold as a multiple of the median round time.
+        min_history: completed rounds before the watchdog arms (first rounds
+        include compiles). floor_s: never alert before this many seconds,
+        whatever the median says. alert: callable(str) (default: stderr)."""
+        self.factor = factor
+        self.min_history = min_history
+        self.floor_s = floor_s
+        self.alert = alert or (
+            lambda msg: print(msg, file=sys.stderr, flush=True)
+        )
+        self._times: list[float] = []
+        self._timer: threading.Timer | None = None
+        self.stalls_detected = 0
+
+    def _median(self) -> float:
+        s = sorted(self._times)
+        return s[len(s) // 2]
+
+    def threshold_s(self) -> float | None:
+        """Current stall threshold, or None while unarmed."""
+        if len(self._times) < self.min_history:
+            return None
+        return max(self.factor * self._median(), self.floor_s)
+
+    @contextlib.contextmanager
+    def round(self, round_index: int):
+        thr = self.threshold_s()
+        start = time.monotonic()
+        if thr is not None:
+            def fire():
+                self.stalls_detected += 1
+                self.alert(
+                    f"WATCHDOG: round {round_index} has run "
+                    f"{time.monotonic() - start:.0f}s, > {thr:.0f}s "
+                    f"(median round {self._median():.1f}s x {self.factor}). "
+                    "The device op is likely hung (dead interconnect / wedged "
+                    "device claim); the loop cannot be interrupted from "
+                    "Python — investigate or kill the job."
+                )
+
+            self._timer = threading.Timer(thr, fire)
+            self._timer.daemon = True
+            self._timer.start()
+        try:
+            yield
+        finally:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            self._times.append(time.monotonic() - start)

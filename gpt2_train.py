@@ -31,6 +31,7 @@ from commefficient_tpu.parallel import mesh as meshlib, tp
 from commefficient_tpu.utils import checkpoint as ckpt
 from commefficient_tpu.utils.config import make_parser, mode_config_from_args, resolve_defaults
 from commefficient_tpu.utils.logging import TableLogger, Timer
+from commefficient_tpu.utils.watchdog import RoundWatchdog
 from commefficient_tpu.utils.schedules import triangular
 
 
@@ -239,8 +240,10 @@ def main(argv=None):
     acc_loss = acc_count = acc_mc_correct = acc_mc_count = 0.0
     # cumulative from round 0 — derived, so checkpoint resume stays consistent
     comm_mb = session.round * session.comm_per_round["comm_total_mb"]
+    watchdog = RoundWatchdog()  # hung-round alerts (utils/watchdog.py)
     for rnd in range(session.round, total_rounds):
-        m = model(opt.lr)
+        with watchdog.round(rnd):
+            m = model(opt.lr)
         opt.step()
         acc_loss += m["loss_sum"]
         acc_count += m["count"]

@@ -1,0 +1,51 @@
+"""Hung-round watchdog tests (utils/watchdog.py — the failure-detection
+subsystem the reference lacks, SURVEY.md §5)."""
+
+import time
+
+from commefficient_tpu.utils.watchdog import RoundWatchdog
+
+
+def test_unarmed_until_history():
+    wd = RoundWatchdog(min_history=3)
+    assert wd.threshold_s() is None
+    for i in range(3):
+        with wd.round(i):
+            pass
+    assert wd.threshold_s() is not None
+
+
+def test_fast_rounds_never_alert():
+    alerts = []
+    wd = RoundWatchdog(factor=10.0, min_history=2, floor_s=0.5, alert=alerts.append)
+    for i in range(6):
+        with wd.round(i):
+            time.sleep(0.01)
+    assert alerts == [] and wd.stalls_detected == 0
+
+
+def test_stalled_round_alerts_once_with_diagnosis():
+    alerts = []
+    wd = RoundWatchdog(factor=3.0, min_history=2, floor_s=0.05, alert=alerts.append)
+    for i in range(3):
+        with wd.round(i):
+            time.sleep(0.02)
+    with wd.round(99):
+        time.sleep(0.4)  # >> 3 x ~0.02s median, > floor
+    assert wd.stalls_detected == 1
+    assert len(alerts) == 1
+    assert "round 99" in alerts[0] and "hung" in alerts[0]
+    # recovery: the long round joins the history; the next fast round is fine
+    with wd.round(100):
+        pass
+    assert wd.stalls_detected == 1
+
+
+def test_floor_suppresses_early_alerts():
+    alerts = []
+    wd = RoundWatchdog(factor=2.0, min_history=1, floor_s=10.0, alert=alerts.append)
+    with wd.round(0):
+        time.sleep(0.01)
+    with wd.round(1):
+        time.sleep(0.1)  # 10x the median but far under the 10s floor
+    assert alerts == []
