@@ -10,3 +10,23 @@ force_hermetic_cpu(8)
 import jax  # noqa: E402
 
 jax.config.update("jax_threefry_partitionable", True)
+
+
+def hermetic_subprocess_env() -> dict:
+    """Env for SUBPROCESS tests: strip the axon plugin trigger and pin the
+    8-device CPU mesh — the one shared copy of the dance (also used by
+    test_distributed / test_determinism; in-process tests are already
+    hermetic via force_hermetic_cpu above)."""
+    import os
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return env
+
+
+def repo_root() -> str:
+    import os
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

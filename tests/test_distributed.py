@@ -75,11 +75,15 @@ assert info['local_devices'] == info['global_devices'] >= 4
 m = mesh.make_mesh(4)
 print("OK", info)
 """
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    from conftest import hermetic_subprocess_env, repo_root
+
+    env = hermetic_subprocess_env()
+    # this test pins its own device count via force_hermetic_cpu inside the
+    # child; drop the mesh pin so the two don't fight
+    del env["XLA_FLAGS"], env["JAX_PLATFORMS"]
     out = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
-        timeout=240, env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=240, env=env, cwd=repo_root(),
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "OK" in out.stdout
