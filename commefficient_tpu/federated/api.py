@@ -55,14 +55,31 @@ class FederatedSession:
         self.num_workers = min(num_workers, train_set.num_clients)
         self.local_batch_size = local_batch_size
         if mesh is not None and self.num_workers % meshlib.client_shards(mesh) != 0:
-            # the sampled-client axis must split evenly over the mesh; fall
-            # back to single-device execution rather than failing mid-run
+            # The sampled-client axis must split evenly over the mesh. The old
+            # behavior (silently dropping to a single device) is a silent
+            # n_devices-x slowdown on a pod — the exact failure class the
+            # watchdog exists to catch. Instead, round the cohort to the
+            # nearest viable multiple (documented, loud), and raise when no
+            # multiple exists at all.
+            shards = meshlib.client_shards(mesh)
+            up = -(-self.num_workers // shards) * shards
+            adjusted = up if up <= train_set.num_clients else (
+                train_set.num_clients // shards) * shards
+            if adjusted <= 0:
+                raise ValueError(
+                    f"num_workers={self.num_workers} cannot be sharded over the "
+                    f"{shards}-way client mesh: the dataset has only "
+                    f"{train_set.num_clients} clients, fewer than one per shard. "
+                    f"Reduce the mesh (--num_devices) or add clients."
+                )
             print(
-                f"warning: num_workers={self.num_workers} not divisible by "
-                f"{meshlib.client_shards(mesh)}-way client mesh; running unsharded",
+                f"note: num_workers={self.num_workers} not divisible by the "
+                f"{shards}-way client mesh; rounding the cohort to {adjusted} "
+                f"so the round stays sharded (pass a multiple of {shards} to "
+                f"silence this)",
                 flush=True,
             )
-            mesh = None
+            self.num_workers = adjusted
         self.mesh = mesh
         self.rng = np.random.RandomState(seed)
         self._rng_key = jax.random.PRNGKey(seed)
@@ -104,6 +121,11 @@ class FederatedSession:
         self.round = 0
         # analytic wire-cost of one round (SURVEY.md §6 row 4 accounting)
         self.comm_per_round = round_comm_mb(mode_cfg, self.num_workers)
+        # cumulative measured wire-cost since round 0. Summed from the
+        # per-round figures (which scale with survivors under dropout and use
+        # the measured down-link for local_topk), checkpointed, and restored —
+        # deriving it as round * static-estimate overstates resumed runs.
+        self.comm_mb_total = 0.0
 
     def _mesh_ctx(self):
         """jax.set_mesh context for steps when the mesh carries axes that ops
@@ -152,6 +174,7 @@ class FederatedSession:
             down = per_client * self.num_workers / 1e6
             m["comm_down_mb"] = down
             m["comm_total_mb"] = m["comm_up_mb"] + down
+        self.comm_mb_total += m["comm_total_mb"]
         return m
 
     # -- evaluation (SURVEY.md §3.4: forward-only, no compression) -----------

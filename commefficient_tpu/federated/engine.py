@@ -229,7 +229,10 @@ def make_round_step(
             # must too — noising by /num_sampled would under-deliver privacy
             # whenever clients drop
             sens = cfg.dp_clip if mcfg.agg_op == "sum" else cfg.dp_clip / n_live
-            std = jnp.float32(cfg.dp_noise * sens)
+            # a fully-dropped cohort transmits nothing, so it must release
+            # nothing: without the gate an empty round would inject pure noise
+            # at full sens=dp_clip (~num_workers x a normal round's std)
+            std = jnp.float32(cfg.dp_noise) * sens * (part.sum() > 0)
             agg = {
                 k: v + std * jax.random.normal(jax.random.fold_in(noise_rng, i), v.shape, v.dtype)
                 for i, (k, v) in enumerate(sorted(agg.items()))

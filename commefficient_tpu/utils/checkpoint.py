@@ -5,6 +5,7 @@ run can resume mid-schedule at the exact round)."""
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any
 
@@ -37,6 +38,13 @@ def save(ckpt_dir: str, session, keep: int = 3):
     np.save(os.path.join(path, "host_rng.npy"),
             np.array([rng_state[0], rng_state[1].tolist(), rng_state[2], rng_state[3],
                       rng_state[4]], dtype=object), allow_pickle=True)
+    # measured cumulative communication: per-round figures vary with dropout
+    # survivors and local_topk's measured down-link, so round * static-estimate
+    # would overstate resumed runs. num_workers makes a cohort-size change
+    # across the checkpoint boundary loud at restore (it breaks exact replay).
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"comm_mb_total": float(session.comm_mb_total),
+                   "num_workers": session.num_workers}, f)
     _prune(ckpt_dir, keep)
     return path
 
@@ -84,6 +92,24 @@ def restore(path: str, session) -> None:
         s = np.load(rng_file, allow_pickle=True)
         session.rng.set_state((s[0], np.asarray(s[1], dtype=np.uint32), int(s[2]),
                                int(s[3]), float(s[4])))
+    meta_file = os.path.join(path, "meta.json")
+    if os.path.exists(meta_file):
+        with open(meta_file) as f:
+            meta = json.load(f)
+        session.comm_mb_total = float(meta["comm_mb_total"])
+        saved_w = meta.get("num_workers")
+        if saved_w is not None and saved_w != session.num_workers:
+            print(
+                f"warning: checkpoint {path} was written with num_workers="
+                f"{saved_w} but this session runs {session.num_workers} "
+                "(mesh rounding or a flag change?); the resumed run will NOT "
+                "replay the uninterrupted client sequence exactly",
+                flush=True,
+            )
+    else:
+        # pre-meta checkpoint: fall back to the static per-round estimate
+        # (exact when every round is uniform; overstates under dropout)
+        session.comm_mb_total = session.round * session.comm_per_round["comm_total_mb"]
 
 
 def _prune(ckpt_dir: str, keep: int):

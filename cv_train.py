@@ -106,8 +106,6 @@ def main(argv=None):
     timer = Timer()
     eval_every = args.eval_every or rounds_per_epoch
     acc_loss = acc_count = acc_correct = 0.0
-    # cumulative from round 0 — derived, so checkpoint resume stays consistent
-    comm_mb = session.round * session.comm_per_round["comm_total_mb"]
     watchdog = RoundWatchdog()  # hung-round alerts (utils/watchdog.py)
     for rnd in range(session.round, total_rounds):
         with watchdog.round(rnd):
@@ -116,7 +114,6 @@ def main(argv=None):
         acc_loss += m["loss_sum"]
         acc_count += m["count"]
         acc_correct += m["correct"]
-        comm_mb += m["comm_total_mb"]
         if args.checkpoint_every and args.checkpoint_dir and (rnd + 1) % args.checkpoint_every == 0:
             ckpt.save(args.checkpoint_dir, session)
         if (rnd + 1) % eval_every == 0 or rnd + 1 == total_rounds:
@@ -129,7 +126,9 @@ def main(argv=None):
                 "train_acc": acc_correct / max(acc_count, 1),
                 "test_loss": ev["loss_sum"] / max(ev["count"], 1),
                 "test_acc": ev["correct"] / max(ev["count"], 1),
-                "comm_mb": comm_mb,
+                # measured cumulative wire-cost (checkpointed/restored by the
+                # session, so resumed runs stay exact under dropout)
+                "comm_mb": session.comm_mb_total,
                 "time_s": timer(),
             })
             acc_loss = acc_count = acc_correct = 0.0

@@ -147,10 +147,9 @@ def build(args):
     )
     if args.attn_impl == "ring" and session.mesh is None:
         raise SystemExit(
-            "--attn_impl ring: the session dropped the seq mesh (num_workers "
-            "not divisible by the client shards — see warning above), which "
-            "would silently degrade ring attention to dense; fix num_workers "
-            "or --seq_parallel"
+            "--attn_impl ring: the session has no seq mesh, which would "
+            "silently degrade ring attention to dense; check --seq_parallel "
+            "and the device count"
         )
     return session, valid_set, {"model": model, "tok": tok}
 
@@ -238,8 +237,6 @@ def main(argv=None):
     timer = Timer()
     eval_every = args.eval_every or min(rounds_per_epoch, 200)
     acc_loss = acc_count = acc_mc_correct = acc_mc_count = 0.0
-    # cumulative from round 0 — derived, so checkpoint resume stays consistent
-    comm_mb = session.round * session.comm_per_round["comm_total_mb"]
     watchdog = RoundWatchdog()  # hung-round alerts (utils/watchdog.py)
     for rnd in range(session.round, total_rounds):
         with watchdog.round(rnd):
@@ -249,7 +246,6 @@ def main(argv=None):
         acc_count += m["count"]
         acc_mc_correct += m.get("mc_correct", 0.0)
         acc_mc_count += m.get("mc_count", 0.0)
-        comm_mb += m["comm_total_mb"]
         if args.checkpoint_every and args.checkpoint_dir and (rnd + 1) % args.checkpoint_every == 0:
             ckpt.save(args.checkpoint_dir, session)
         if (rnd + 1) % eval_every == 0 or rnd + 1 == total_rounds:
@@ -264,7 +260,9 @@ def main(argv=None):
                 "train_ppl": math.exp(min(train_nll, 20)),
                 "val_nll": val_nll,
                 "val_ppl": math.exp(min(val_nll, 20)),
-                "comm_mb": comm_mb,
+                # measured cumulative wire-cost (checkpointed/restored by the
+                # session, so resumed runs stay exact under dropout)
+                "comm_mb": session.comm_mb_total,
                 "time_s": timer(),
             }
             if args.mc_coef > 0:

@@ -23,12 +23,48 @@ def load(path: str) -> list[dict]:
             if not ln.strip():
                 continue
             try:
-                rows.append(json.loads(ln))
+                row = json.loads(ln)
             except json.JSONDecodeError:
                 # a run killed mid-append leaves a truncated final line;
                 # keep everything before it
                 print(f"<!-- {path}: skipped malformed line -->", file=sys.stderr)
+                continue
+            if rows and row.get("round", 0) <= rows[-1].get("round", 0):
+                rows = _handle_rewind(path, rows, row)
+            rows.append(row)
     return rows
+
+
+def _handle_rewind(path: str, rows: list[dict], row: dict) -> list[dict]:
+    """The file's round counter went backwards: either a NEW run was appended
+    (lr sweep — discard the stale history so table and footer describe one
+    run) or a crash-RESUMED run is re-logging rounds it already covered
+    (keep the pre-resume history; post-resume rows win the overlap).
+
+    Discriminators, in order: a rewind to (or before) the first logged round
+    is a fresh start; otherwise comm_mb decides — it is cumulative and
+    checkpoint-restored, so a resume re-logs round r at roughly the same
+    comm_mb while a fresh run restarts accumulation under its own config
+    (ratio threshold 0.5 tolerates dropout stochasticity). Two appended runs
+    with similar comm curves ARE indistinguishable from a resume here — but
+    then the mixed table is also numerically indistinguishable per round."""
+    kept = [r for r in rows if r.get("round", 0) < row.get("round", 0)]
+    prior = next(
+        (r for r in reversed(rows) if r.get("round", 0) <= row.get("round", 0)
+         and "comm_mb" in r), None,
+    )
+    fresh_start = row.get("round", 0) <= rows[0].get("round", 0)
+    comm_restarted = (
+        prior is not None and prior.get("comm_mb", 0) > 0
+        and row.get("comm_mb", 0) < 0.5 * prior["comm_mb"]
+    )
+    if fresh_start or comm_restarted:
+        print(f"<!-- {path}: round reset at round={row.get('round')}; "
+              "keeping only the final appended run -->", file=sys.stderr)
+        return []
+    print(f"<!-- {path}: resume overlap at round={row.get('round')}; "
+          "post-resume rows win -->", file=sys.stderr)
+    return kept
 
 
 def main(paths: list[str]) -> None:

@@ -60,6 +60,54 @@ def test_save_restore_resume_equivalence(small_session, tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
 
+def test_comm_mb_total_checkpointed_under_dropout(small_session, tmp_path):
+    """Cumulative communication is MEASURED (survivor-scaled under dropout),
+    so a resumed run must restore the measured sum — deriving it as
+    round * static-per-round-estimate overstates it (ADVICE r3)."""
+    args = _args(tmp_path, extra=("--client_dropout", "0.5"))
+    s, _ = cv_train.build(args)
+    measured = 0.0
+    dropped_any = False
+    for _ in range(6):
+        m = s.run_round(0.05)
+        measured += m["comm_total_mb"]
+        dropped_any = dropped_any or m["participants"] < s.num_workers
+    assert dropped_any  # the seed produces at least one non-full round
+    assert s.comm_mb_total == pytest.approx(measured)
+    static = s.round * s.comm_per_round["comm_total_mb"]
+    assert s.comm_mb_total < static  # the distinction is non-trivial here
+
+    path = ckpt.save(str(tmp_path / "ck"), s)
+    s2, _ = cv_train.build(_args(tmp_path, extra=("--client_dropout", "0.5")))
+    ckpt.restore(path, s2)
+    assert s2.comm_mb_total == pytest.approx(measured)
+    # and it keeps accumulating measured figures after resume
+    m = s2.run_round(0.05)
+    assert s2.comm_mb_total == pytest.approx(measured + m["comm_total_mb"])
+
+
+def test_cohort_size_change_across_checkpoint_warns(small_session, tmp_path, capsys):
+    """Restoring into a session with a different num_workers (mesh rounding
+    or a flag change) silently breaks exact client-sequence replay — the
+    restore must say so loudly."""
+    s, _ = cv_train.build(_args(tmp_path))
+    s.run_round(0.05)
+    path = ckpt.save(str(tmp_path / "ck"), s)
+    # the 8-way mesh rounds every cohort to a multiple of 8; 32 clients with
+    # --num_workers 16 stays 16, vs the saved session's 8
+    s2, _ = cv_train.build(
+        _args(tmp_path, extra=("--num_clients", "32", "--num_workers", "16"))
+    )
+    capsys.readouterr()
+    ckpt.restore(path, s2)
+    assert "will NOT replay" in capsys.readouterr().out
+    # same cohort: no warning
+    s3, _ = cv_train.build(_args(tmp_path))
+    capsys.readouterr()
+    ckpt.restore(path, s3)
+    assert "will NOT replay" not in capsys.readouterr().out
+
+
 def test_latest_and_prune(small_session, tmp_path):
     args = _args(tmp_path)
     s, _ = cv_train.build(args)

@@ -129,6 +129,40 @@ def test_full_dropout_round_is_a_noop_update():
     assert float(metrics["count"]) == 0.0
 
 
+def test_full_dropout_with_dp_noise_applies_no_update():
+    """An empty cohort transmits nothing, so with DP noise active a fully-
+    dropped round must release NOTHING — not a pure-noise update at full
+    clip sensitivity (ADVICE r3: ungated noise there is ~num_workers x a
+    normal round's std injected into params)."""
+    W = 4
+    cfg, state, step = _step(
+        _ucfg(), client_dropout=0.999999, dp_clip=1.0, dp_noise=2.0
+    )
+    batch = _batch(jax.random.PRNGKey(1), W)
+    out, _, metrics = step(state, batch, {}, jnp.float32(0.5), jax.random.PRNGKey(0))
+    p0 = init_mlp(jax.random.PRNGKey(0))
+    for a, b in zip(jax.tree.leaves(out["params"]), jax.tree.leaves(p0)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(metrics["participants"]) == 0.0
+
+
+def test_partial_dropout_with_dp_noise_still_noises():
+    """The empty-cohort gate must not disable noise on normal rounds."""
+    W = 8
+    batch = _batch(jax.random.PRNGKey(1), W)
+    lr, rng = jnp.float32(0.1), jax.random.PRNGKey(3)
+    _, s_noise, step_noise = _step(
+        _ucfg(), client_dropout=0.4, dp_clip=1.0, dp_noise=1.0
+    )
+    _, s_clean, step_clean = _step(_ucfg(), client_dropout=0.4, dp_clip=1.0)
+    a, _, ma = step_noise(s_noise, batch, {}, lr, rng)
+    b, _, _ = step_clean(s_clean, batch, {}, lr, rng)
+    assert 0 < float(ma["participants"]) < W
+    flat_a = ravel_pytree(a["params"])[0]
+    flat_b = ravel_pytree(b["params"])[0]
+    assert not np.allclose(np.asarray(flat_a), np.asarray(flat_b))
+
+
 def test_invalid_dropout_rejected():
     with pytest.raises(ValueError):
         _step(_ucfg(), client_dropout=1.0)

@@ -1,0 +1,88 @@
+"""scripts/tradeoff_table.py: the results-table renderer must describe ONE
+run per arm even when several runs were appended to the same JSONL file (an
+lr sweep appends; the table and best-acc footer must not mix arms)."""
+
+import json
+import subprocess
+import sys
+
+from conftest import repo_root
+
+
+def _run(paths):
+    out = subprocess.run(
+        [sys.executable, f"{repo_root()}/scripts/tradeoff_table.py", *paths],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    return out.stdout, out.stderr
+
+
+def _write(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_round_reset_keeps_only_final_run(tmp_path):
+    """Three concatenated runs: an early run's 0.9 best-acc must not leak
+    into the footer while the table shows the final run's 0.5/0.6."""
+    p = tmp_path / "cifar10_hard_sketch.jsonl"
+    _write(p, [
+        {"round": 8, "test_acc": 0.9, "comm_mb": 10.0},   # run 1 (stale)
+        {"round": 16, "test_acc": 0.95, "comm_mb": 20.0},
+        {"round": 8, "test_acc": 0.2, "comm_mb": 10.0},   # run 2 (stale)
+        {"round": 8, "test_acc": 0.5, "comm_mb": 10.0},   # run 3 (final)
+        {"round": 16, "test_acc": 0.6, "comm_mb": 20.0},
+    ])
+    stdout, stderr = _run([str(p)])
+    assert "round reset" in stderr
+    assert "best test_acc 0.600" in stdout  # footer from the final run only
+    assert "0.950" not in stdout and "0.900" not in stdout
+
+
+def test_resume_overlap_keeps_early_history(tmp_path):
+    """A crash-resumed run re-appends rounds it already logged; the early
+    rounds must survive and the post-resume duplicates must win."""
+    p = tmp_path / "cifar10_hard_localtopk.jsonl"
+    _write(p, [
+        {"round": 8, "test_acc": 0.3, "comm_mb": 5.0},
+        {"round": 16, "test_acc": 0.5, "comm_mb": 10.0},   # pre-crash
+        {"round": 16, "test_acc": 0.55, "comm_mb": 10.0},  # post-resume dup
+        {"round": 24, "test_acc": 0.7, "comm_mb": 15.0},
+    ])
+    stdout, stderr = _run([str(p)])
+    assert "resume overlap" in stderr
+    assert "| 8 | 0.300" in stdout        # early history preserved
+    assert "0.550" in stdout              # post-resume row wins the overlap
+    assert "0.500" not in stdout
+    assert "best test_acc 0.700" in stdout
+
+
+def test_new_run_with_coarser_eval_cadence_detected(tmp_path):
+    """A fresh appended run whose first eval round lands MID-history (larger
+    eval_every) must still be detected as a new run: its cumulative comm_mb
+    restarts, while a resume would continue at the same comm level."""
+    p = tmp_path / "cifar10_hard_fedavg.jsonl"
+    _write(p, [
+        {"round": 8, "test_acc": 0.9, "comm_mb": 10.0},   # run 1 (stale)
+        {"round": 16, "test_acc": 0.95, "comm_mb": 20.0},
+        {"round": 24, "test_acc": 0.97, "comm_mb": 30.0},
+        {"round": 16, "test_acc": 0.3, "comm_mb": 4.0},   # run 2: comm restarted
+        {"round": 32, "test_acc": 0.4, "comm_mb": 8.0},
+    ])
+    stdout, stderr = _run([str(p)])
+    assert "round reset" in stderr
+    assert "best test_acc 0.400" in stdout
+    assert "0.970" not in stdout and "0.950" not in stdout
+
+
+def test_single_run_untouched(tmp_path):
+    p = tmp_path / "cifar10_hard_uncompressed.jsonl"
+    _write(p, [
+        {"round": 8, "test_acc": 0.4, "comm_mb": 5.0},
+        {"round": 16, "test_acc": 0.7, "comm_mb": 10.0},
+    ])
+    stdout, stderr = _run([str(p)])
+    assert "round reset" not in stderr
+    assert "best test_acc 0.700" in stdout
