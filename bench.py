@@ -106,6 +106,14 @@ if BENCH_ENGINE_SKETCH == "oracle":
     os.environ["COMMEFFICIENT_NO_PALLAS"] = "1"
 else:
     os.environ.pop("COMMEFFICIENT_NO_PALLAS", None)
+# Engine compile shape: "fused" (default) is one XLA program per round;
+# "split" compiles the sketch server step (the only Mosaic-bearing part when
+# BENCH_ENGINE_SKETCH=auto) as its own small module — the wedge-avoidance
+# path (engine.make_split_round_step); one extra dispatch per round.
+BENCH_ENGINE_COMPILE = os.environ.get("BENCH_ENGINE_COMPILE", "fused")
+if BENCH_ENGINE_COMPILE not in ("fused", "split"):
+    raise SystemExit(
+        f"BENCH_ENGINE_COMPILE must be fused|split, got {BENCH_ENGINE_COMPILE!r}")
 # timed work = BENCH_CHAINS chains of BENCH_CHAIN_LEN dependent rounds, one
 # device_get sync per chain (>= 30 rounds total for stable percentiles)
 CHAIN_LEN = int(os.environ.get("BENCH_CHAIN_LEN", 10))
@@ -319,6 +327,13 @@ def _make_step(loss_fn, sketch_kw, d):
         **sketch_kw,
     )
     cfg = engine.EngineConfig(mode=mode_cfg, weight_decay=5e-4)
+    if BENCH_ENGINE_COMPILE == "split":
+        client_p, server_p = engine.make_split_round_step(loss_fn, cfg)
+        cstep = jax.jit(client_p)
+        sstep = jax.jit(server_p, donate_argnums=(0,))
+        step = engine.compose_split(cstep, sstep)
+        step._parts = (cstep, sstep)  # _flops_per_round lowers each half
+        return engine, mode_cfg, cfg, step
     # donate the server state, as a real training loop would (every call site
     # rebinds: state, _, _ = step(state, ...)); keeps GPT-2-scale state 1x HBM
     step = jax.jit(engine.make_round_step(loss_fn, cfg), donate_argnums=(0,))
@@ -351,18 +366,27 @@ def _timed_chains(step, state, batch, num_chains, chain_len, rt_ms):
 
 def _flops_per_round(step, state, batch):
     """XLA's own cost analysis of the compiled round step (flops for the
-    whole round: W clients fwd+bwd + sketch accumulate/query + server step)."""
+    whole round: W clients fwd+bwd + sketch accumulate/query + server step).
+    For the split engine, the round is two programs — sum both."""
     import jax
     import jax.numpy as jnp
 
-    try:
-        lowered = step.lower(
-            state, batch, {}, jnp.float32(0.01), jax.random.PRNGKey(0)
-        )
+    def cost_of(lowered):
         cost = lowered.compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
-        return float(cost.get("flops", 0.0)) or None
+        return float(cost.get("flops", 0.0))
+
+    try:
+        lr, rng = jnp.float32(0.01), jax.random.PRNGKey(0)
+        if hasattr(step, "_parts"):
+            cstep, sstep = step._parts
+            f1 = cost_of(cstep.lower(state, batch, lr, rng))
+            w, nns, met, nrng = jax.eval_shape(cstep, state, batch, lr, rng)
+            f2 = cost_of(sstep.lower(state, w, nns, met["participants"], lr, nrng))
+            return (f1 + f2) or None
+        lowered = step.lower(state, batch, {}, lr, rng)
+        return cost_of(lowered) or None
     except Exception:
         return None
 
@@ -439,6 +463,9 @@ def run_bench(platform: str) -> dict:
         # still times the Pallas kernels directly either way)
         "engine_sketch_path": (
             "pallas" if csvec._use_pallas(mode_cfg.sketch_spec) else "oracle"),
+        # fused = one XLA program per round; split = Mosaic-isolating
+        # two-program round (engine.make_split_round_step)
+        "engine_compile": BENCH_ENGINE_COMPILE,
         "round_ms": round(round_ms, 2),
         "round_ms_percentiles": {
             "min": round(min(per_round_ms), 2),

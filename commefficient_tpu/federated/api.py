@@ -46,6 +46,7 @@ class FederatedSession:
         dp_clip: float = 0.0,
         dp_noise: float = 0.0,
         client_dropout: float = 0.0,
+        split_compile: bool = False,
     ):
         self.cfg = engine.EngineConfig(
             mode=mode_cfg, weight_decay=weight_decay, dp_clip=dp_clip,
@@ -87,7 +88,16 @@ class FederatedSession:
         self.state = engine.init_server_state(self.cfg, params, net_state)
         self.client_state = modes.init_client_state(mode_cfg, train_set.num_clients)
 
-        self._step = jax.jit(engine.make_round_step(train_loss_fn, self.cfg), donate_argnums=(0,))
+        if split_compile:
+            # two XLA programs per round: the Pallas/Mosaic sketch server step
+            # compiles separately from the big vmapped grad module (see
+            # engine.make_split_round_step for why)
+            client_p, server_p = engine.make_split_round_step(train_loss_fn, self.cfg)
+            self._step = engine.compose_split(
+                jax.jit(client_p), jax.jit(server_p, donate_argnums=(0,))
+            )
+        else:
+            self._step = jax.jit(engine.make_round_step(train_loss_fn, self.cfg), donate_argnums=(0,))
         self._eval = jax.jit(engine.make_eval_step(eval_loss_fn))
         if self.client_state is not None:
             gather = lambda st, ids: jax.tree.map(lambda a: a[ids], st)  # noqa: E731

@@ -112,6 +112,75 @@ def participation_mask(rng, num_sampled: int, dropout: float) -> jnp.ndarray:
     ).astype(jnp.float32)
 
 
+def _clip_updates(cfg: EngineConfig, updates: jnp.ndarray) -> jnp.ndarray:
+    """Per-client L2 clip (DP): nonlinear, so it must happen before the
+    client mean — the linear-mode shortcut stays exact."""
+    if cfg.dp_clip <= 0:
+        return updates
+
+    def clip(u):
+        nrm = jnp.linalg.norm(u)
+        return u * jnp.minimum(1.0, cfg.dp_clip / jnp.maximum(nrm, 1e-12))
+
+    return jax.vmap(clip)(updates)
+
+
+def _dp_noise_agg(cfg: EngineConfig, agg: dict, participants, noise_rng) -> dict:
+    """Central DP: noise the aggregated dense wire. Over W L2-clipped updates
+    the aggregate's L2 sensitivity is dp_clip/W for mean aggregation and
+    dp_clip for sum — and mean divides by the SURVIVING count, so sensitivity
+    must too (noising by /num_sampled would under-deliver privacy whenever
+    clients drop). A fully-dropped cohort transmits nothing, so it must
+    release nothing: without the (participants > 0) gate an empty round
+    would inject pure noise at full sens=dp_clip. (Sketch tables are
+    rejected in EngineConfig — their worst-case sensitivity under an L2
+    clip is l1-scale, not dp_clip.)"""
+    n_live = jnp.maximum(participants, 1.0)
+    sens = cfg.dp_clip if cfg.mode.agg_op == "sum" else cfg.dp_clip / n_live
+    std = jnp.float32(cfg.dp_noise) * sens * (participants > 0)
+    return {
+        k: v + std * jax.random.normal(
+            jax.random.fold_in(noise_rng, i), v.shape, v.dtype)
+        for i, (k, v) in enumerate(sorted(agg.items()))
+    }
+
+
+def _merge_net_state(nstates, net_state, part) -> Any:
+    """Mutable model collections (BN stats): average the SURVIVING clients'
+    results; with no survivors, keep the previous stats."""
+    n_live = jnp.maximum(part.sum(), 1.0)
+    return jax.tree.map(
+        lambda s, prev: jnp.where(
+            part.sum() > 0, (s * modes.bcast(part, s)).sum(0) / n_live, prev
+        ),
+        nstates, net_state,
+    )
+
+
+def _survivor_metrics(metrics, part) -> dict:
+    """Metric sums over the surviving cohort + the participants count that
+    run_round uses to scale the measured uplink."""
+    out = jax.tree.map(lambda m: jnp.sum(m * modes.bcast(part, m), axis=0), metrics)
+    out["participants"] = part.sum()
+    return out
+
+
+def _make_grad_client(loss_fn: Callable, cfg: EngineConfig) -> Callable:
+    """One client's contribution for grad-based modes: flat gradient (+ weight
+    decay, applied client-side as in the reference workers — SURVEY.md §3.1),
+    new mutable collections, metric sums."""
+
+    def grad_client(params, pflat, net_state, cbatch, rng):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, net_state, cbatch, rng
+        )
+        gflat, _ = ravel_pytree(grads)
+        gflat = gflat + cfg.weight_decay * pflat
+        return gflat, aux["net_state"], aux["metrics"]
+
+    return grad_client
+
+
 def make_round_step(
     loss_fn: Callable, cfg: EngineConfig
 ) -> Callable[[dict, Any, dict, jnp.ndarray, jnp.ndarray], tuple[dict, dict, dict]]:
@@ -130,14 +199,7 @@ def make_round_step(
     - metrics are summed over clients (and local iters); caller normalises.
     """
     mcfg = cfg.mode
-
-    def grad_client(params, pflat, net_state, cbatch, rng):
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, net_state, cbatch, rng
-        )
-        gflat, _ = ravel_pytree(grads)
-        gflat = gflat + cfg.weight_decay * pflat
-        return gflat, aux["net_state"], aux["metrics"]
+    grad_client = _make_grad_client(loss_fn, cfg)
 
     def local_sgd_client(params, pflat, net_state, cbatch, rng, lr):
         _, unravel = ravel_pytree(params)
@@ -187,14 +249,7 @@ def make_round_step(
                 lambda cb, r: grad_client(params, pflat, net_state, cb, r)
             )(batch, client_rngs)
 
-        if cfg.dp_clip > 0:
-            # per-client L2 clip; nonlinear, so it must happen before the
-            # client mean — the linear-mode shortcut below stays exact.
-            def clip(u):
-                nrm = jnp.linalg.norm(u)
-                return u * jnp.minimum(1.0, cfg.dp_clip / jnp.maximum(nrm, 1e-12))
-
-            updates = jax.vmap(clip)(updates)
+        updates = _clip_updates(cfg, updates)
 
         if modes.is_linear(mcfg) and not mcfg.needs_local_state:
             # sketching/reduction commute (linearity) — compress once on the
@@ -220,49 +275,20 @@ def make_round_step(
             )
 
         if cfg.dp_noise > 0:
-            # central DP: noise the aggregated dense wire. Over W L2-clipped
-            # updates the aggregate's L2 sensitivity is dp_clip/W for mean
-            # aggregation and dp_clip for sum. (Sketch tables are rejected in
-            # EngineConfig — their worst-case sensitivity under an L2 clip is
-            # l1-scale, not dp_clip.)
-            # mean aggregation divides by the SURVIVING count, so sensitivity
-            # must too — noising by /num_sampled would under-deliver privacy
-            # whenever clients drop
-            sens = cfg.dp_clip if mcfg.agg_op == "sum" else cfg.dp_clip / n_live
-            # a fully-dropped cohort transmits nothing, so it must release
-            # nothing: without the gate an empty round would inject pure noise
-            # at full sens=dp_clip (~num_workers x a normal round's std)
-            std = jnp.float32(cfg.dp_noise) * sens * (part.sum() > 0)
-            agg = {
-                k: v + std * jax.random.normal(jax.random.fold_in(noise_rng, i), v.shape, v.dtype)
-                for i, (k, v) in enumerate(sorted(agg.items()))
-            }
+            agg = _dp_noise_agg(cfg, agg, part.sum(), noise_rng)
 
         # weight-delta modes: local steps already carry the client lr; the
         # server applies the averaged delta at the configured server rate
         # ("slowmo" when combined with virtual momentum)
         server_lr = jnp.float32(mcfg.server_lr) if mcfg.uses_weight_delta else lr
         delta, mode_state = modes.server_step(mcfg, agg, state["mode_state"], server_lr)
-        new_params = unravel(pflat - delta)
-        # mutable model collections (BN stats): average the SURVIVING clients'
-        # results (with no survivors, keep the previous stats)
-        new_net_state = jax.tree.map(
-            lambda s, prev: jnp.where(
-                part.sum() > 0, (s * modes.bcast(part, s)).sum(0) / n_live, prev
-            ),
-            nstates, net_state,
-        )
         new_state = {
-            "params": new_params,
-            "net_state": new_net_state,
+            "params": unravel(pflat - delta),
+            "net_state": _merge_net_state(nstates, net_state, part),
             "mode_state": mode_state,
             "round": state["round"] + 1,
         }
-        out_metrics = jax.tree.map(
-            lambda m: jnp.sum(m * modes.bcast(part, m), axis=0), metrics
-        )
-        # survivors this round — run_round scales the measured uplink by it
-        out_metrics["participants"] = part.sum()
+        out_metrics = _survivor_metrics(metrics, part)
         if mcfg.mode == "local_topk":
             # support of the actually-broadcast delta (SURVEY.md §6 row 4):
             # the union of client supports when momentum keeps nothing extra
@@ -272,6 +298,93 @@ def make_round_step(
             # float cost a real server would switch to past the crossover.
             out_metrics["down_support"] = jnp.count_nonzero(delta).astype(jnp.float32)
         return new_state, new_rows, out_metrics
+
+    return step
+
+
+def make_split_round_step(
+    loss_fn: Callable, cfg: EngineConfig
+) -> tuple[Callable, Callable]:
+    """The same round as `make_round_step`, split into TWO jittable programs:
+
+        client_step(state, batch, lr, rng) -> (weighted[d], net_state',
+                                               metrics, noise_rng)
+        server_step(state, weighted, net_state', participants, lr, noise_rng)
+            -> state'
+
+    Why it exists: the ONLY compile that has ever wedged the tunnelled TPU is
+    the fused engine module with the Pallas sketch custom-calls inlined
+    (ROUND3_NOTES.md). Splitting keeps the Mosaic custom-calls in a small
+    dedicated XLA module (compress + FetchSGD server algebra) while the big
+    vmapped fwd/bwd module stays Mosaic-free; the cost is one extra host
+    dispatch per round, noise at TPU round times. Bit-equal to the fused step
+    (tests/test_engine.py pins it): both derive the same rng streams, and
+    both take the linear-mode shortcut — which is also the supported scope
+    (linear mode, no client-local state, no weight-delta local loop), exactly
+    the flagship sketch configuration.
+    """
+    mcfg = cfg.mode
+    if not (modes.is_linear(mcfg) and not mcfg.needs_local_state
+            and not mcfg.uses_weight_delta):
+        raise ValueError(
+            "split round step supports linear grad modes without client-local "
+            f"state (the flagship sketch config); mode={mcfg.mode!r} "
+            f"error_type={mcfg.error_type!r} momentum_type="
+            f"{mcfg.momentum_type!r} needs the fused make_round_step"
+        )
+    grad_client = _make_grad_client(loss_fn, cfg)
+
+    def client_step(state, batch, lr, rng):
+        params, net_state = state["params"], state["net_state"]
+        pflat, _ = ravel_pytree(params)
+        num_sampled = jax.tree.leaves(batch)[0].shape[0]
+        # identical stream derivation to the fused step (see its comment on
+        # fold_in collisions), so split == fused holds bit-for-bit
+        crng, noise_rng, drop_rng = jax.random.split(rng, 3)
+        client_rngs = jax.random.split(crng, num_sampled)
+        part = participation_mask(drop_rng, num_sampled, cfg.client_dropout)
+        n_live = jnp.maximum(part.sum(), 1.0)
+
+        updates, nstates, metrics = jax.vmap(
+            lambda cb, r: grad_client(params, pflat, net_state, cb, r)
+        )(batch, client_rngs)
+        updates = _clip_updates(cfg, updates)
+        weighted = (updates * part[:, None]).sum(axis=0)
+        if mcfg.agg_op != "sum":
+            weighted = weighted / n_live
+        return (weighted, _merge_net_state(nstates, net_state, part),
+                _survivor_metrics(metrics, part), noise_rng)
+
+    def server_step(state, weighted, new_net_state, participants, lr, noise_rng):
+        pflat, unravel = ravel_pytree(state["params"])
+        agg, _ = modes.client_compress(mcfg, weighted, {})
+        agg = modes.aggregate(mcfg, jax.tree.map(lambda x: x[None], agg))
+        if cfg.dp_noise > 0:
+            agg = _dp_noise_agg(cfg, agg, participants, noise_rng)
+        delta, mode_state = modes.server_step(mcfg, agg, state["mode_state"], lr)
+        return {
+            "params": unravel(pflat - delta),
+            "net_state": new_net_state,
+            "mode_state": mode_state,
+            "round": state["round"] + 1,
+        }
+
+    return client_step, server_step
+
+
+def compose_split(client_step: Callable, server_step: Callable) -> Callable:
+    """Adapt a (client_step, server_step) pair back to the fused-step
+    signature `(state, batch, client_rows, lr, rng) -> (state', rows,
+    metrics)`, so call sites (session, bench) stay agnostic of the
+    two-program protocol. client_rows pass through untouched — the split
+    scope has no client-local state."""
+
+    def step(state, batch, client_rows, lr, rng):
+        weighted, net_state, metrics, noise_rng = client_step(state, batch, lr, rng)
+        new_state = server_step(
+            state, weighted, net_state, metrics["participants"], lr, noise_rng
+        )
+        return new_state, client_rows, metrics
 
     return step
 

@@ -61,6 +61,10 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                    help="per-round probability each sampled client drops "
                         "before aggregation (straggler simulation; the "
                         "reference has none — a dead worker hangs it)")
+    p.add_argument("--split_compile", action="store_true",
+                   help="compile the round as TWO XLA programs (client grads "
+                        "| sketch server step) so Pallas custom-calls stay in "
+                        "a small dedicated module; linear grad modes only")
     p.add_argument("--multihost", action="store_true",
                    help="force jax.distributed.initialize() at startup "
                         "(auto-detected multi-host environments initialize "

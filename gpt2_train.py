@@ -144,6 +144,7 @@ def build(args):
         dp_clip=args.dp_clip,
         dp_noise=args.dp_noise,
         client_dropout=args.client_dropout,
+        split_compile=args.split_compile,
     )
     if args.attn_impl == "ring" and session.mesh is None:
         raise SystemExit(

@@ -1,0 +1,3 @@
+#!/bin/bash
+cd "$(dirname "$0")/.."
+exec bash scripts/wait_tpu.sh 39600 > results/logs/wait_tpu_r04_s1.log 2>&1
