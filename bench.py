@@ -176,6 +176,21 @@ def _pallas_status() -> dict:
 
 MICROBENCH_D = int(os.environ.get("BENCH_MICRO_D", 6_500_000))
 MICRO_CHAIN = int(os.environ.get("BENCH_MICRO_CHAIN", 20))
+# Per-phase timing (VERDICT r3 #4): time the client fwd/bwd+reduce program
+# and the sketch-server program (accumulate + FetchSGD algebra + the d-length
+# unsketch_topk) as separate data-dependent chains. Default on for gpt2 —
+# at d=124M, c=2^20 the unsketch median query is the suspected wall; measure
+# it, don't guess. (Two extra Mosaic-free compiles; BENCH_PHASE_TIMING=0/1
+# overrides.)
+PHASE_TIMING = os.environ.get(
+    "BENCH_PHASE_TIMING", "1" if BENCH_MODEL == "gpt2" else "0") == "1"
+PHASE_CHAIN = int(os.environ.get("BENCH_PHASE_CHAIN", 6))
+# vs_baseline derivation from a measurement (VERDICT r3 #7): time ONE
+# client's fwd+bwd at batch 8 in f32 on this chip, so the JSON carries the
+# arithmetic behind the baseline multiple instead of only a remembered
+# constant. resnet9 (the flagship metric) only.
+BASELINE_BASIS = os.environ.get(
+    "BENCH_BASELINE_BASIS", "1" if BENCH_MODEL == "resnet9" else "0") == "1"
 
 
 def _kernel_microbench(platform: str, rt_ms: float) -> dict:
@@ -398,6 +413,135 @@ def _analytic_resnet9_flops(workers: int, local_batch: int) -> float:
     return workers * local_batch * fwd_per_image * 3.0
 
 
+def _phase_timing(loss_fn, cfg, state, batch, rt_ms) -> dict:
+    """Client-phase vs server-phase wall-clock via the split-engine programs
+    (engine.make_split_round_step): the client program is the vmapped
+    fwd/bwd + survivor reduce; the server program is compress(weighted) +
+    aggregate + FetchSGD momentum/error + unsketch_topk — i.e. the entire
+    sketch algebra including the d-length median query. Each phase runs as
+    its own in-jit lax.scan chain with a real data dependency and ONE
+    device_get sync; never raises."""
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.federated import engine
+
+    out: dict = {}
+    try:
+        client_p, server_p = engine.make_split_round_step(loss_fn, cfg)
+        lr = jnp.float32(0.01)
+        n = PHASE_CHAIN
+
+        def client_chain(st, b, rng):
+            def body(carry, i):
+                w, _, met, _ = client_p(carry, b, lr, jax.random.fold_in(rng, i))
+                pflat, unravel = ravel_pytree(carry["params"])
+                nxt = dict(carry)
+                nxt["params"] = unravel(pflat - lr * w)  # real SGD dependency
+                return nxt, met["loss_sum"]
+
+            final, _ = jax.lax.scan(body, st, jnp.arange(n))
+            return ravel_pytree(final["params"])[0][0]
+
+        def server_chain(st, w0, rng):
+            def body(carry, _):
+                cst, w = carry
+                new = server_p(cst, w, cst["net_state"], jnp.float32(NUM_WORKERS),
+                               lr, rng)
+                # next round's reduced update = -delta (k-sparse but dense-
+                # shaped): a real dependency at realistic magnitude
+                w2 = ravel_pytree(new["params"])[0] - ravel_pytree(cst["params"])[0]
+                return (new, w2), ()
+
+            (final, _), _ = jax.lax.scan(body, (st, w0), None, length=n)
+            return ravel_pytree(final["params"])[0][0]
+
+        def time_chain(f, *args):
+            g = jax.jit(f)
+            _ = jax.device_get(g(*args))  # compile + warm
+            t0 = time.perf_counter()
+            _ = jax.device_get(g(*args))
+            return max((time.perf_counter() - t0) * 1e3 - rt_ms, 0.0) / n
+
+        rng = jax.random.PRNGKey(5)
+        st = jax.tree.map(jnp.copy, state)
+        out["client_ms"] = round(time_chain(client_chain, st, batch, rng), 2)
+        d = cfg.mode.d
+        w0 = jax.random.normal(jax.random.PRNGKey(6), (d,), jnp.float32) * 1e-3
+        st2 = jax.tree.map(jnp.copy, state)
+        out["server_ms"] = round(time_chain(server_chain, st2, w0, rng), 2)
+        out["chain_len"] = n
+        out["note"] = ("server_ms = sketch accumulate + FetchSGD algebra + "
+                       "unsketch_topk over d (the suspected wall at GPT-2 "
+                       "dims); client_ms = vmapped fwd/bwd + reduce")
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _baseline_basis(rt_ms) -> dict:
+    """Measure ONE simulated client's cost on THIS chip — ResNet-9 fwd+bwd at
+    batch 8 in f32 (the reference's per-client unit of work, which its
+    single-GPU workers run sequentially) — and publish the arithmetic that
+    turns it into the vs_baseline denominator. Never raises."""
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.models.losses import make_classification_loss
+    from commefficient_tpu.models.resnet9 import ResNet9
+
+    out: dict = {
+        "reference_client_updates_per_sec": REFERENCE_CLIENT_UPDATES_PER_SEC,
+        "reference_derivation": (
+            "no published reference numbers exist (BASELINE.md); estimate: "
+            "cifar10-fast ResNet-9 fwd+bwd ~4-6k img/s on a V100-class GPU "
+            "=> ~600 client-updates/s at 8 img/client, minus sketching "
+            "overhead => 500/s"),
+    }
+    try:
+        model = ResNet9(num_classes=10, dtype="float32")
+        x0 = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x0, train=False)
+        params = variables["params"]
+        net_state = {k: v for k, v in variables.items() if k != "params"}
+        loss_fn = make_classification_loss(model, train=True)
+        batch = {
+            "x": jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3)),
+            "y": jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 10),
+            "mask": jnp.ones((8,), jnp.float32),
+        }
+        n = 10
+
+        def chain(p):
+            def body(carry, i):
+                g = jax.grad(
+                    lambda q: loss_fn(q, net_state, batch, jax.random.PRNGKey(0))[0]
+                )(carry)
+                return jax.tree.map(lambda a, b: a - 1e-3 * b, carry, g), ()
+
+            final, _ = jax.lax.scan(body, p, jnp.arange(n))
+            return ravel_pytree(final)[0][0]
+
+        f = jax.jit(chain)
+        _ = jax.device_get(f(params))
+        t0 = time.perf_counter()
+        _ = jax.device_get(f(params))
+        ms = max((time.perf_counter() - t0) * 1e3 - rt_ms, 0.0) / n
+        out["measured_single_client_fwd_bwd_ms_f32_b8"] = round(ms, 3)
+        out["single_client_updates_per_sec_this_chip_f32"] = round(1e3 / ms, 4)
+        out["chip_vs_reference_serial_ratio"] = round(
+            (1e3 / ms) / REFERENCE_CLIENT_UPDATES_PER_SEC, 6)
+        out["note"] = ("vs_baseline = engine updates/s / 500; the serial "
+                       "ratio above isolates the hardware factor, so "
+                       "(vs_baseline / ratio) is the engine's batching/"
+                       "parallelism contribution")
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def run_bench(platform: str) -> dict:
     import jax
     import jax.numpy as jnp
@@ -486,6 +630,24 @@ def run_bench(platform: str) -> dict:
         result["flops_per_round_analytic"] = _analytic_resnet9_flops(
             workers, LOCAL_BATCH
         )
+    if PHASE_TIMING:
+        if (result["engine_sketch_path"] == "pallas"
+                and os.environ.get("BENCH_PHASE_TIMING") != "1"):
+            # the server chain would be a NEW Mosaic-bearing scan module — an
+            # unproven compile shape on the wedge-prone chip, attempted AFTER
+            # the main result exists but before the JSON prints. Opt in
+            # explicitly (BENCH_PHASE_TIMING=1) to take that risk.
+            result["phase_timing"] = {
+                "skipped": "pallas engine routed; set BENCH_PHASE_TIMING=1 "
+                           "to compile the Mosaic-bearing phase chains"}
+        else:
+            _stage("phase timing (client | sketch-server chains) ...")
+            result["phase_timing"] = _phase_timing(loss_fn, cfg, state, batch, rt_ms)
+            _stage(f"phase timing: {result['phase_timing']}")
+    if BASELINE_BASIS:
+        _stage("baseline basis (single-client f32 fwd+bwd) ...")
+        result["vs_baseline_basis"] = _baseline_basis(rt_ms)
+        _stage(f"baseline basis: {result['vs_baseline_basis']}")
 
     if SCALE_CHECK and BENCH_MODEL == "resnet9":
         _stage("scale check (2x workers) ...")
@@ -519,16 +681,20 @@ def _shrink_for_cpu():
     for name, small in [("NUM_WORKERS", 8), ("CHAIN_LEN", 3), ("NUM_CHAINS", 2),
                         ("WARMUP_ROUNDS", 1), ("MICROBENCH_D", 2_000_000),
                         ("MICRO_CHAIN", 3), ("SKETCH_COLS", 65_536),
-                        ("TOPK", 8_192)]:
+                        ("TOPK", 8_192), ("PHASE_CHAIN", 2)]:
         env_name = {"NUM_WORKERS": "BENCH_WORKERS", "CHAIN_LEN": "BENCH_CHAIN_LEN",
                     "NUM_CHAINS": "BENCH_CHAINS", "WARMUP_ROUNDS": "BENCH_WARMUP",
                     "MICROBENCH_D": "BENCH_MICRO_D",
                     "MICRO_CHAIN": "BENCH_MICRO_CHAIN",
-                    "SKETCH_COLS": "BENCH_COLS", "TOPK": "BENCH_TOPK"}[name]
+                    "SKETCH_COLS": "BENCH_COLS", "TOPK": "BENCH_TOPK",
+                    "PHASE_CHAIN": "BENCH_PHASE_CHAIN"}[name]
         if env_name not in os.environ:
             g[name] = small
     if "BENCH_SCALE_CHECK" not in os.environ:
         g["SCALE_CHECK"] = False
+    if "BENCH_BASELINE_BASIS" not in os.environ:
+        # ~20 ResNet-9 fwd+bwd executions for a number only meaningful on-chip
+        g["BASELINE_BASIS"] = False
 
 
 def main():

@@ -1,0 +1,272 @@
+#!/bin/bash
+# Round-4 TPU validation batch — run when the axon tunnel is alive.
+#
+# SAFE-FIRST ORDER (round-3 lesson): the one compile that has ever wedged
+# the tunnel is the FUSED engine round step with the Pallas kernels inlined.
+# Steps 2-4 collect every must-have artifact on the oracle engine
+# (COMMEFFICIENT_NO_PALLAS=1; the in-bench kernel microbench still times the
+# Pallas kernels directly on the chip). Step 5 then tries the SPLIT engine
+# (engine.make_split_round_step): the Mosaic custom-calls live in a small
+# dedicated XLA module much closer to the standalone kernel compile that is
+# PROVEN on this chip (04:48 r3 probe) — this is the designed wedge-avoidance
+# path. Only steps 7-8 attempt the suspect fused compile, isolated, LAST,
+# and with an XLA dump so a hang leaves root-cause evidence.
+#
+# Each step probes chip liveness first, logs raw unbuffered output to
+# results/logs/<step>.log, and steps can be cherry-picked:
+#   scripts/tpu_round4.sh 2 4
+# Exit codes: 0 = every requested step succeeded; 8 = at least one step
+# failed but the batch ran to the end; 10N = the chip-liveness gate before
+# step N failed (tunnel wedged; steps >= N never ran); 64 = bad arguments.
+# Steps:
+#   1. pallas probe + library routing check on the real chip
+#   2. BENCH_flagship_r04.json (ResNet-9 bf16, MFU + forensics + baseline
+#      basis; oracle engine)
+#   3. BENCH_gpt2_r04.json (GPT-2-small d~124M, c=2^20, 20 blocks; oracle
+#      engine + per-phase timing)
+#   4. results/cifar10_smoke_tpu.jsonl (48-round cv_train smoke; oracle)
+#   5. SPLIT-engine pallas probe (tiny dims; Mosaic module isolated)
+#   6. full flagship bench, split engine + pallas (only if 5 passed)
+#   7. FUSED pallas-in-engine minimal probe (the suspect; XLA dump captured)
+#   8. full flagship bench, fused pallas engine (only if 7 passed)
+#   9. reduced-signal tradeoff study: 3 arms at synthetic_separation 0.025
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p results/logs
+
+probe_chip() {
+    # A wedged tunnel hangs the device claim; a live one answers in seconds.
+    # Asserts the claimed backend really is the TPU — a silent CPU fallback
+    # must not pass the gate.
+    timeout 180 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
+x = jnp.ones((256, 256))
+print('chip alive:', float(jax.device_get((x @ x).sum())), jax.devices())
+" 2>&1 | grep -v WARNING
+    return ${PIPESTATUS[0]}
+}
+
+want() {
+    if [ ${#STEPS[@]} -gt 0 ] && [[ " ${STEPS[*]} " != *" $1 "* ]]; then
+        return 1
+    fi
+    if [ "${RESUME:-0}" = 1 ] && [ -f "results/logs/step$1.ok" ]; then
+        echo "step $1 already succeeded (results/logs/step$1.ok); skipping"
+        return 1
+    fi
+    return 0
+}
+
+# Install the bench JSON line from a log into $2 — only when one exists, is
+# a real TPU measurement (not a CPU fallback), and is not the top-level
+# error-fallback record.
+install_json() {
+    python - "$1" "$2" <<'PY'
+import json, sys
+log, dst = sys.argv[1], sys.argv[2]
+line = None
+for ln in open(log, errors="replace"):
+    if ln.startswith("{"):
+        line = ln.strip()
+if line is None:
+    sys.exit(print(f"no JSON line in {log}; keeping existing {dst}") or 0)
+obj = json.loads(line)
+if "error" in obj or obj.get("platform") not in ("tpu", "axon"):
+    sys.exit(print(f"JSON in {log} is a fallback/error record "
+                   f"(platform={obj.get('platform')}); keeping {dst}") or 0)
+open(dst, "w").write(line + "\n")
+print(f"installed {dst}: value={obj.get('value')} {obj.get('unit')}")
+PY
+}
+
+STEPS=("$@")
+for s in "${STEPS[@]}"; do
+    [[ "$s" =~ ^[1-9]$ ]] || { echo "unknown step '$s' (valid: 1-9)"; exit 64; }
+done
+
+# A CPU-fallback bench number is useless here; fail fast with the error JSON.
+export BENCH_NO_RETRY=1
+
+if [ "${RESUME:-0}" != 1 ]; then
+    rm -f results/logs/step*.ok
+fi
+
+FAIL=0
+
+# 1. probe + routing
+if want 1; then
+probe_chip || { echo "CHIP DEAD before step 1"; exit 101; }
+timeout 600 python -u -c "
+import jax
+from commefficient_tpu.sketch import csvec
+from commefficient_tpu.sketch.csvec import CSVecSpec
+from commefficient_tpu.sketch import pallas_kernels as pk
+spec = CSVecSpec(d=6_500_000, c=524_288, r=5, family='rotation')
+print('use_pallas(flagship):', csvec._use_pallas(spec))
+print('probe:', pk.probe_status())
+" 2>&1 | tee results/logs/step1_probe.log | grep -v WARNING
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step1.ok; else echo "STEP 1 FAILED"; FAIL=8; fi
+fi
+
+# 2. flagship bench, oracle engine (kernel microbench + baseline basis ride along)
+if want 2; then
+probe_chip || { echo "CHIP DEAD before step 2"; exit 102; }
+COMMEFFICIENT_NO_PALLAS=1 timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/step2_bench.log | grep -v WARNING | tail -8
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step2.ok; else echo "STEP 2 FAILED"; FAIL=8; fi
+install_json results/logs/step2_bench.log BENCH_flagship_r04.json
+fi
+
+# 3. GPT-2 bench, oracle engine (+ per-phase timing: client vs sketch-server)
+if want 3; then
+probe_chip || { echo "CHIP DEAD before step 3"; exit 103; }
+COMMEFFICIENT_NO_PALLAS=1 BENCH_MODEL=gpt2 timeout 2400 python -u bench.py \
+    2>&1 | tee results/logs/step3_bench_gpt2.log | grep -v WARNING | tail -5
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step3.ok; else echo "STEP 3 FAILED"; FAIL=8; fi
+install_json results/logs/step3_bench_gpt2.log BENCH_gpt2_r04.json
+fi
+
+# 4. cv_train smoke on the real chip, oracle engine
+if want 4; then
+probe_chip || { echo "CHIP DEAD before step 4"; exit 104; }
+rm -f results/cifar10_smoke_tpu.jsonl   # TableLogger appends
+COMMEFFICIENT_NO_PALLAS=1 timeout 2400 python -u cv_train.py \
+    --dataset cifar10 --mode sketch \
+    --k 50000 --num_cols 524288 --num_rows 5 --num_blocks 4 \
+    --momentum_type virtual --error_type virtual \
+    --num_clients 100 --num_workers 8 --num_rounds 48 --num_epochs 4 \
+    --eval_every 8 --lr_scale 0.4 --seed 42 --dtype bfloat16 \
+    --profile_dir /tmp/tpu_trace \
+    --log_jsonl results/cifar10_smoke_tpu.jsonl 2>&1 \
+    | tee results/logs/step4_cvtrain.log | grep -v WARNING | tail -10
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step4.ok; else echo "STEP 4 FAILED"; FAIL=8; fi
+fi
+
+# 5. SPLIT engine + pallas, tiny dims: the designed wedge-avoidance path.
+# The Mosaic-bearing server program is structurally the standalone-kernel
+# compile (proven on this chip) plus top-k — far from the suspect fused
+# module. If THIS wedges, the split theory is wrong and we learn it cheaply.
+if want 5; then
+probe_chip || { echo "CHIP DEAD before step 5"; exit 105; }
+BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=split \
+    BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
+    BENCH_WARMUP=0 BENCH_SCALE_CHECK=0 BENCH_MICRO_CHAIN=2 \
+    BENCH_BASELINE_BASIS=0 \
+    timeout 1800 python -u bench.py 2>&1 \
+    | tee results/logs/step5_split_pallas_probe.log \
+    | grep -v WARNING | tail -8
+rc=${PIPESTATUS[0]}
+if [ "$rc" -eq 0 ] && grep -q '"engine_sketch_path": "pallas"' \
+        results/logs/step5_split_pallas_probe.log; then
+    echo "SPLIT PALLAS ENGINE OK"
+    touch results/logs/step5.ok
+else
+    echo "STEP 5 FAILED (rc=$rc) — split+pallas did not prove out; see log"
+    FAIL=8
+fi
+fi
+
+# 6. full flagship bench, split engine + pallas (only after 5 proved it)
+if want 6; then
+if [ ! -f results/logs/step5.ok ]; then
+    echo "STEP 6 SKIPPED: step 5 did not prove split+pallas"
+    FAIL=8
+else
+probe_chip || { echo "CHIP DEAD before step 6"; exit 106; }
+BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=split \
+    timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/step6_bench_split_pallas.log | grep -v WARNING | tail -8
+if [ "${PIPESTATUS[0]}" -eq 0 ] && grep -q '"engine_sketch_path": "pallas"' \
+        results/logs/step6_bench_split_pallas.log; then
+    touch results/logs/step6.ok
+    # a pallas-engine flagship number supersedes the oracle-engine one
+    install_json results/logs/step6_bench_split_pallas.log BENCH_flagship_r04.json
+else
+    echo "STEP 6 FAILED (rc or oracle fallback; see the log)"; FAIL=8
+fi
+fi
+fi
+
+# 7. THE SUSPECT, isolated and LAST: ONE fused engine round with the Pallas
+# kernels inlined, tiny client batch, XLA dump captured so a hang leaves
+# which-phase evidence (VERDICT r3 #2a).
+if want 7; then
+probe_chip || { echo "CHIP DEAD before step 7"; exit 107; }
+rm -rf results/logs/xla_dump_step7 && mkdir -p results/logs/xla_dump_step7
+XLA_FLAGS="--xla_dump_to=results/logs/xla_dump_step7 --xla_dump_hlo_pass_re=.*" \
+    BENCH_ENGINE_SKETCH=auto \
+    BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
+    BENCH_WARMUP=0 BENCH_SCALE_CHECK=0 BENCH_MICRO_CHAIN=2 \
+    BENCH_BASELINE_BASIS=0 \
+    timeout 1800 python -u bench.py 2>&1 \
+    | tee results/logs/step7_fused_pallas_probe.log \
+    | grep -v WARNING | tail -8
+rc=${PIPESTATUS[0]}
+# keep the dump small: drop everything but the largest module's final passes
+find results/logs/xla_dump_step7 -name '*.txt' -size -2k -delete 2>/dev/null
+if [ "$rc" -eq 0 ] && grep -q '"engine_sketch_path": "pallas"' \
+        results/logs/step7_fused_pallas_probe.log; then
+    echo "FUSED PALLAS ENGINE OK"
+    touch results/logs/step7.ok
+else
+    echo "STEP 7 FAILED (rc=$rc) — fused pallas-in-engine remains the wedge"
+    echo "trigger; the XLA dump in results/logs/xla_dump_step7 shows how far"
+    echo "compilation got. The split path (steps 5-6) is the shipping answer."
+    FAIL=8
+fi
+fi
+
+# 8. full flagship bench with the FUSED pallas engine — only after 7
+if want 8; then
+if [ ! -f results/logs/step7.ok ]; then
+    echo "STEP 8 SKIPPED: step 7 did not prove fused pallas-in-engine"
+    FAIL=8
+else
+probe_chip || { echo "CHIP DEAD before step 8"; exit 108; }
+BENCH_ENGINE_SKETCH=auto timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/step8_bench_fused_pallas.log | grep -v WARNING | tail -8
+if [ "${PIPESTATUS[0]}" -eq 0 ] && grep -q '"engine_sketch_path": "pallas"' \
+        results/logs/step8_bench_fused_pallas.log; then
+    touch results/logs/step8.ok
+    install_json results/logs/step8_bench_fused_pallas.log BENCH_flagship_r04.json
+else
+    echo "STEP 8 FAILED (rc or oracle fallback; see the log)"; FAIL=8
+fi
+fi
+fi
+
+# 9. Reduced-signal accuracy-vs-communication study (VERDICT r3 #3): three
+# arms on the synthetic-CIFAR task with Bayes acc ~0.86, few hundred rounds
+# each — the first non-degenerate tradeoff table (SURVEY.md §6 rows 1/4).
+# Paper-ish dims: d=6.57M, sketch c=2^19 => ~12.5x table compression.
+if want 9; then
+probe_chip || { echo "CHIP DEAD before step 9"; exit 109; }
+run_arm() {  # name, extra flags...
+    local name="$1"; shift
+    rm -f "results/tradeoff_${name}.jsonl"
+    COMMEFFICIENT_NO_PALLAS=1 timeout 3000 python -u cv_train.py \
+        --dataset cifar10 --synthetic_separation 0.025 \
+        --num_clients 1000 --num_workers 16 --local_batch_size 8 \
+        --num_rounds 300 --num_epochs 5 --eval_every 25 \
+        --lr_scale 0.3 --seed 42 --dtype bfloat16 \
+        --log_jsonl "results/tradeoff_${name}.jsonl" "$@" 2>&1 \
+        | tee "results/logs/step9_${name}.log" | grep -v WARNING | tail -4
+    return ${PIPESTATUS[0]}
+}
+ok9=1
+run_arm uncompressed --mode uncompressed || ok9=0
+run_arm sketch --mode sketch --k 50000 --num_cols 524288 --num_rows 5 \
+    --num_blocks 4 --momentum_type virtual --error_type virtual || ok9=0
+run_arm localtopk --mode local_topk --k 50000 \
+    --momentum_type none --error_type virtual || ok9=0
+if [ "$ok9" -eq 1 ]; then
+    python scripts/tradeoff_table.py results/tradeoff_*.jsonl \
+        > results/tradeoff_table_r04.md 2> results/logs/step9_table.log
+    touch results/logs/step9.ok
+else
+    echo "STEP 9 FAILED (an arm crashed/timed out; see logs)"; FAIL=8
+fi
+fi
+
+exit "$FAIL"

@@ -1,6 +1,6 @@
 #!/bin/bash
-# Loop-probe the TPU tunnel; on recovery run the round-3 batch (remaining
-# args are passed through as step selections, e.g. `wait_tpu.sh 3600 2 4`).
+# Loop-probe the TPU tunnel; on recovery run the current round batch
+# (remaining args pass through as step selections: `wait_tpu.sh 3600 2 4`).
 # If the batch dies at a CHIP DEAD gate (exit 10N: the tunnel answered one
 # probe then wedged again), resume probing and retry with RESUME=1 — the
 # batch's own results/logs/stepN.ok markers skip steps that SUCCEEDED and
@@ -18,9 +18,10 @@ assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
 x = jnp.ones((128,128))
 print('tunnel alive:', float(jax.device_get((x@x).sum())))" 2>/dev/null | grep -q "tunnel alive"; then
         echo "=== tunnel recovered at $(date -u +%H:%M:%S) — running batch (steps: ${*:-all}, resume=$RESUME_FLAG) ==="
-        RESUME=$RESUME_FLAG bash scripts/tpu_round3.sh "$@" 2>&1
+        RESUME=$RESUME_FLAG bash scripts/tpu_round4.sh "$@" 2>&1
         rc=$?
-        if [ "$rc" -lt 101 ] || [ "$rc" -gt 106 ]; then
+        # 101-109 = the batch's per-step CHIP DEAD gates (tpu_round4.sh)
+        if [ "$rc" -lt 101 ] || [ "$rc" -gt 109 ]; then
             exit "$rc"
         fi
         RESUME_FLAG=1

@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from commefficient_tpu.parallel import distributed
 
 
@@ -87,6 +89,116 @@ print("OK", info)
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "OK" in out.stdout
+
+
+_TWO_PROC_CHILD = """
+import sys
+port, pid_ = sys.argv[1], int(sys.argv[2])
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {repo!r} + "/tests")
+from commefficient_tpu.utils.hermetic import force_hermetic_cpu
+force_hermetic_cpu(4)  # 4 local devices per process -> 8 global
+from commefficient_tpu.parallel import distributed, mesh as meshlib
+ok = distributed.initialize(force=True,
+                            coordinator_address="localhost:" + port,
+                            num_processes=2, process_id=pid_)
+import jax, jax.numpy as jnp
+info = distributed.process_info()
+assert ok and info["process_count"] == 2, info
+assert info["local_devices"] == 4 and info["global_devices"] == 8, info
+from jax.flatten_util import ravel_pytree
+from commefficient_tpu.federated import engine
+from commefficient_tpu.modes.config import ModeConfig
+from test_engine import _data, init_mlp, mlp_loss
+mesh = meshlib.make_mesh(8)  # GLOBAL mesh spanning both processes
+params = init_mlp(jax.random.PRNGKey(0))
+d = ravel_pytree(params)[0].size
+cfg = engine.EngineConfig(mode=ModeConfig(
+    mode="sketch", d=d, k=16, num_rows=3, num_cols=1024,
+    hash_family="rotation", momentum_type="virtual", error_type="virtual"))
+state = engine.init_server_state(cfg, params, {{}})
+data = _data(jax.random.PRNGKey(5), 64)
+batch = jax.tree.map(lambda a: a.reshape((8, 8) + a.shape[1:]), data)
+gbatch = meshlib.shard_client_batch(mesh, batch)  # global sharded arrays
+step = jax.jit(engine.make_round_step(mlp_loss, cfg))
+for i in range(2):
+    state, _, metrics = step(state, gbatch, {{}}, jnp.float32(0.1),
+                             jax.random.PRNGKey(i))
+psum = float(jnp.asarray(ravel_pytree(state["params"])[0]).sum())
+print("RESULT", pid_, float(metrics["loss_sum"]), psum, flush=True)
+"""
+
+
+def test_two_process_cluster_round_matches_single_process():
+    """VERDICT r3 #8: TWO real processes (4 CPU devices each) form a cluster
+    via jax.distributed, build one GLOBAL 8-device mesh, and run two sketch
+    rounds SPMD — both processes must agree with each other and with the
+    single-process 8-device run (the detection/bootstrap path was previously
+    reasoned-but-unobserved for the >= 2 case)."""
+    import socket
+
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.federated import engine as eng
+    from commefficient_tpu.modes.config import ModeConfig
+    from commefficient_tpu.parallel import mesh as meshlib
+
+    from conftest import hermetic_subprocess_env, repo_root
+    from test_engine import _data, init_mlp, mlp_loss
+
+    with socket.socket() as sk:
+        sk.bind(("localhost", 0))
+        port = sk.getsockname()[1]
+    env = hermetic_subprocess_env()
+    # children pin their own 4-device count via force_hermetic_cpu
+    del env["XLA_FLAGS"], env["JAX_PLATFORMS"]
+    code = _TWO_PROC_CHILD.format(repo=repo_root())
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(port), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        )
+        for i in range(2)
+    ]
+    results = {}
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, err[-2000:]
+            line = next(ln for ln in out.splitlines() if ln.startswith("RESULT"))
+            _, pid_, loss, psum = line.split()
+            results[int(pid_)] = (float(loss), float(psum))
+    finally:
+        # one child dying leaves its peer blocked in the coordinator join —
+        # never leak it into the rest of the pytest run
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    assert results[0] == results[1]  # SPMD: both controllers see one program
+
+    # single-process 8-device reference (this pytest process's CPU mesh)
+    mesh = meshlib.make_mesh(8)
+    params = init_mlp(jax.random.PRNGKey(0))
+    d = ravel_pytree(params)[0].size
+    cfg = eng.EngineConfig(mode=ModeConfig(
+        mode="sketch", d=d, k=16, num_rows=3, num_cols=1024,
+        hash_family="rotation", momentum_type="virtual", error_type="virtual"))
+    state = eng.init_server_state(cfg, params, {})
+    data = _data(jax.random.PRNGKey(5), 64)
+    batch = jax.tree.map(lambda a: a.reshape((8, 8) + a.shape[1:]), data)
+    gbatch = meshlib.shard_client_batch(mesh, batch)
+    step = jax.jit(eng.make_round_step(mlp_loss, cfg))
+    for i in range(2):
+        state, _, metrics = step(state, gbatch, {}, jnp.float32(0.1),
+                                 jax.random.PRNGKey(i))
+    ref_loss = float(metrics["loss_sum"])
+    ref_psum = float(jnp.asarray(ravel_pytree(state["params"])[0]).sum())
+    got_loss, got_psum = results[0]
+    assert got_loss == pytest.approx(ref_loss, rel=1e-5)
+    assert got_psum == pytest.approx(ref_psum, rel=1e-4)
 
 
 def test_initialize_from_args_forces_on_explicit_cluster_flags(monkeypatch):
