@@ -341,7 +341,12 @@ def _make_step(loss_fn, sketch_kw, d):
         topk_impl=os.environ.get("BENCH_TOPK_IMPL", "exact"),
         **sketch_kw,
     )
-    cfg = engine.EngineConfig(mode=mode_cfg, weight_decay=5e-4)
+    # BENCH_CLIENT_CHUNK > 0 scans grads in client chunks (HBM ceiling for
+    # big-cohort GPT-2 rounds; engine._weighted_client_reduce)
+    cfg = engine.EngineConfig(
+        mode=mode_cfg, weight_decay=5e-4,
+        client_chunk=int(os.environ.get("BENCH_CLIENT_CHUNK", 0)),
+    )
     if BENCH_ENGINE_COMPILE == "split":
         client_p, server_p = engine.make_split_round_step(loss_fn, cfg)
         cstep = jax.jit(client_p)

@@ -12,6 +12,7 @@ it — there are no workers to spawn, no shared memory to allocate.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 from typing import Any, Callable
 
 import numpy as np
@@ -47,10 +48,12 @@ class FederatedSession:
         dp_noise: float = 0.0,
         client_dropout: float = 0.0,
         split_compile: bool = False,
+        client_chunk: int = 0,
     ):
         self.cfg = engine.EngineConfig(
             mode=mode_cfg, weight_decay=weight_decay, dp_clip=dp_clip,
             dp_noise=dp_noise, client_dropout=client_dropout,
+            client_chunk=client_chunk,
         )
         self.train_set = train_set
         self.num_workers = min(num_workers, train_set.num_clients)
@@ -82,6 +85,21 @@ class FederatedSession:
             )
             self.num_workers = adjusted
         self.mesh = mesh
+        if client_chunk and self.num_workers % client_chunk:
+            # the cohort may have been clamped to num_clients or rounded for
+            # the mesh above — a chunk that divided the REQUESTED cohort may
+            # no longer divide; failing at the first jit trace would be a
+            # far worse place to find out. Use the largest viable chunk.
+            viable = next(
+                c for c in range(min(client_chunk, self.num_workers), 0, -1)
+                if self.num_workers % c == 0
+            )
+            print(
+                f"note: client_chunk={client_chunk} does not divide the "
+                f"cohort ({self.num_workers}); using client_chunk={viable}",
+                flush=True,
+            )
+            self.cfg = dataclasses.replace(self.cfg, client_chunk=viable)
         self.rng = np.random.RandomState(seed)
         self._rng_key = jax.random.PRNGKey(seed)
 

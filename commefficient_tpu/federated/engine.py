@@ -56,11 +56,23 @@ class EngineConfig:
     # calibrates to the surviving cohort. A fully-dropped round contributes
     # a zero aggregate (momentum still decays, the round still counts).
     client_dropout: float = 0.0
+    # HBM ceiling for large models (SURVEY.md §7 hard part (e)): > 0 runs
+    # the per-client grads as a lax.scan over chunks of this many clients,
+    # accumulating the weighted reduce additively — W full [d] gradients
+    # never coexist in memory, so GPT-2-scale rounds can sample far larger
+    # cohorts per chip. Linearity makes the chunk accumulation exact;
+    # applies to linear grad modes without client-local state (elsewhere
+    # the per-client wires are needed all at once and the knob is ignored).
+    client_chunk: int = 0
 
     def __post_init__(self):
         if not 0.0 <= self.client_dropout < 1.0:
             raise ValueError(
                 f"client_dropout must be in [0, 1), got {self.client_dropout}"
+            )
+        if self.client_chunk < 0:
+            raise ValueError(
+                f"client_chunk must be >= 0, got {self.client_chunk}"
             )
         if self.dp_noise > 0 and self.dp_clip <= 0:
             raise ValueError("dp_noise > 0 requires dp_clip > 0 (unbounded "
@@ -165,6 +177,76 @@ def _survivor_metrics(metrics, part) -> dict:
     return out
 
 
+def _weighted_client_reduce(
+    cfg: EngineConfig, grad_client: Callable,
+    params, pflat, net_state, batch, client_rngs, part,
+):
+    """Participation-weighted SUMS over the sampled clients of (clipped)
+    updates, mutable-collection contributions, and metric values — the whole
+    client phase of a linear-mode round before normalization.
+
+    One vmap when cfg.client_chunk is 0; otherwise a lax.scan over chunks of
+    client_chunk clients (each chunk vmapped), accumulating additively, so at
+    most client_chunk full [d] gradients coexist in HBM (SURVEY.md §7 hard
+    part (e)). Linearity of the weighted sum makes chunking exact up to fp
+    summation order."""
+
+    def chunk(cb, crngs, cpart):
+        updates, nstates, metrics = jax.vmap(
+            lambda b, r: grad_client(params, pflat, net_state, b, r)
+        )(cb, crngs)
+        updates = _clip_updates(cfg, updates)
+        wsum = (updates * cpart[:, None]).sum(axis=0)
+        ns_sum = jax.tree.map(lambda s: (s * modes.bcast(cpart, s)).sum(0), nstates)
+        m_sum = jax.tree.map(lambda m: jnp.sum(m * modes.bcast(cpart, m), axis=0), metrics)
+        return wsum, ns_sum, m_sum
+
+    W = part.shape[0]
+    C = cfg.client_chunk
+    if not C or C >= W:
+        return chunk(batch, client_rngs, part)
+    if W % C:
+        raise ValueError(
+            f"client_chunk={C} must divide the sampled cohort ({W})"
+        )
+    re = lambda a: a.reshape((W // C, C) + a.shape[1:])  # noqa: E731
+    xs = (jax.tree.map(re, batch),
+          client_rngs.reshape((W // C, C) + client_rngs.shape[1:]),
+          part.reshape(W // C, C))
+    shapes = jax.eval_shape(chunk, *jax.tree.map(lambda a: a[0], xs))
+    init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def body(carry, x):
+        return jax.tree.map(jnp.add, carry, chunk(*x)), None
+
+    acc, _ = jax.lax.scan(body, init, xs)
+    return acc
+
+
+def _finalize_client_reduce(mcfg: ModeConfig, wsum, ns_sum, m_sum, net_state, part):
+    """Normalize the weighted SUMS from `_weighted_client_reduce`: the reduced
+    update (survivor mean unless agg_op=sum), the survivor-mean mutable
+    collections (previous stats when no survivors), and the metrics dict with
+    the participants count. One place, so the fused and split steps cannot
+    drift apart."""
+    n_live = jnp.maximum(part.sum(), 1.0)
+    weighted = wsum if mcfg.agg_op == "sum" else wsum / n_live
+    new_net_state = jax.tree.map(
+        lambda s, prev: jnp.where(part.sum() > 0, s / n_live, prev),
+        ns_sum, net_state,
+    )
+    out_metrics = dict(m_sum)
+    out_metrics["participants"] = part.sum()
+    return weighted, new_net_state, out_metrics
+
+
+def _compress_reduced(mcfg: ModeConfig, weighted) -> dict:
+    """Compress the reduced update once and lift it to the aggregate wire —
+    the linearity shortcut's server-side entry point."""
+    agg, _ = modes.client_compress(mcfg, weighted, {})
+    return modes.aggregate(mcfg, jax.tree.map(lambda x: x[None], agg))
+
+
 def _make_grad_client(loss_fn: Callable, cfg: EngineConfig) -> Callable:
     """One client's contribution for grad-based modes: flat gradient (+ weight
     decay, applied client-side as in the reference workers — SURVEY.md §3.1),
@@ -240,39 +322,56 @@ def make_round_step(
         part = participation_mask(drop_rng, num_sampled, cfg.client_dropout)
         n_live = jnp.maximum(part.sum(), 1.0)
 
-        if mcfg.uses_weight_delta:
-            updates, nstates, metrics = jax.vmap(
-                lambda cb, r: local_sgd_client(params, pflat, net_state, cb, r, lr)
-            )(batch, client_rngs)
-        else:
-            updates, nstates, metrics = jax.vmap(
-                lambda cb, r: grad_client(params, pflat, net_state, cb, r)
-            )(batch, client_rngs)
-
-        updates = _clip_updates(cfg, updates)
-
-        if modes.is_linear(mcfg) and not mcfg.needs_local_state:
-            # sketching/reduction commute (linearity) — compress once on the
-            # reduced update instead of per client. Exactly equal, much cheaper.
-            # Participation weighting folds into the same reduction: survivor
-            # mean = sum(part * u) / count(part), survivor sum drops the /.
-            weighted = (updates * part[:, None]).sum(axis=0)
-            if mcfg.agg_op != "sum":
-                weighted = weighted / n_live
-            agg, _ = modes.client_compress(mcfg, weighted, {})
-            agg = modes.aggregate(mcfg, jax.tree.map(lambda x: x[None], agg))
+        if (modes.is_linear(mcfg) and not mcfg.needs_local_state
+                and not mcfg.uses_weight_delta):
+            # grad modes on the linearity shortcut: sketching/reduction
+            # commute, so compress once on the reduced update instead of per
+            # client — exactly equal, much cheaper. Participation weighting
+            # folds into the same reduction (survivor mean = sum(part·u) /
+            # count(part); sum drops the /), and the reduce itself may run
+            # chunked (cfg.client_chunk) so W full gradients never coexist.
+            wsum, ns_sum, m_sum = _weighted_client_reduce(
+                cfg, grad_client, params, pflat, net_state, batch,
+                client_rngs, part,
+            )
+            weighted, new_net_state, out_metrics = _finalize_client_reduce(
+                mcfg, wsum, ns_sum, m_sum, net_state, part
+            )
+            agg = _compress_reduced(mcfg, weighted)
             new_rows = client_rows
         else:
-            wires, vrows = jax.vmap(lambda u, row: modes.client_compress(mcfg, u, row))(
-                updates, client_rows
-            )
-            agg = modes.aggregate(mcfg, wires, weights=part)
-            # dropped clients never transmitted: their persistent local state
-            # (error/momentum rows) stays exactly as it was
-            new_rows = jax.tree.map(
-                lambda new, old: jnp.where(modes.bcast(part, new) > 0, new, old),
-                vrows, client_rows,
-            )
+            if mcfg.uses_weight_delta:
+                updates, nstates, metrics = jax.vmap(
+                    lambda cb, r: local_sgd_client(params, pflat, net_state, cb, r, lr)
+                )(batch, client_rngs)
+            else:
+                updates, nstates, metrics = jax.vmap(
+                    lambda cb, r: grad_client(params, pflat, net_state, cb, r)
+                )(batch, client_rngs)
+            updates = _clip_updates(cfg, updates)
+
+            if modes.is_linear(mcfg) and not mcfg.needs_local_state:
+                # weight-delta modes (fedavg/localSGD) on the shortcut: the
+                # local-iteration scan already holds per-client state, so no
+                # chunked reduce — just the survivor-weighted mean of deltas
+                weighted = (updates * part[:, None]).sum(axis=0)
+                if mcfg.agg_op != "sum":
+                    weighted = weighted / n_live
+                agg = _compress_reduced(mcfg, weighted)
+                new_rows = client_rows
+            else:
+                wires, vrows = jax.vmap(lambda u, row: modes.client_compress(mcfg, u, row))(
+                    updates, client_rows
+                )
+                agg = modes.aggregate(mcfg, wires, weights=part)
+                # dropped clients never transmitted: their persistent local
+                # state (error/momentum rows) stays exactly as it was
+                new_rows = jax.tree.map(
+                    lambda new, old: jnp.where(modes.bcast(part, new) > 0, new, old),
+                    vrows, client_rows,
+                )
+            new_net_state = _merge_net_state(nstates, net_state, part)
+            out_metrics = _survivor_metrics(metrics, part)
 
         if cfg.dp_noise > 0:
             agg = _dp_noise_agg(cfg, agg, part.sum(), noise_rng)
@@ -284,11 +383,10 @@ def make_round_step(
         delta, mode_state = modes.server_step(mcfg, agg, state["mode_state"], server_lr)
         new_state = {
             "params": unravel(pflat - delta),
-            "net_state": _merge_net_state(nstates, net_state, part),
+            "net_state": new_net_state,
             "mode_state": mode_state,
             "round": state["round"] + 1,
         }
-        out_metrics = _survivor_metrics(metrics, part)
         if mcfg.mode == "local_topk":
             # support of the actually-broadcast delta (SURVEY.md §6 row 4):
             # the union of client supports when momentum keeps nothing extra
@@ -345,20 +443,17 @@ def make_split_round_step(
         part = participation_mask(drop_rng, num_sampled, cfg.client_dropout)
         n_live = jnp.maximum(part.sum(), 1.0)
 
-        updates, nstates, metrics = jax.vmap(
-            lambda cb, r: grad_client(params, pflat, net_state, cb, r)
-        )(batch, client_rngs)
-        updates = _clip_updates(cfg, updates)
-        weighted = (updates * part[:, None]).sum(axis=0)
-        if mcfg.agg_op != "sum":
-            weighted = weighted / n_live
-        return (weighted, _merge_net_state(nstates, net_state, part),
-                _survivor_metrics(metrics, part), noise_rng)
+        wsum, ns_sum, m_sum = _weighted_client_reduce(
+            cfg, grad_client, params, pflat, net_state, batch, client_rngs, part
+        )
+        weighted, new_net_state, out_metrics = _finalize_client_reduce(
+            mcfg, wsum, ns_sum, m_sum, net_state, part
+        )
+        return weighted, new_net_state, out_metrics, noise_rng
 
     def server_step(state, weighted, new_net_state, participants, lr, noise_rng):
         pflat, unravel = ravel_pytree(state["params"])
-        agg, _ = modes.client_compress(mcfg, weighted, {})
-        agg = modes.aggregate(mcfg, jax.tree.map(lambda x: x[None], agg))
+        agg = _compress_reduced(mcfg, weighted)
         if cfg.dp_noise > 0:
             agg = _dp_noise_agg(cfg, agg, participants, noise_rng)
         delta, mode_state = modes.server_step(mcfg, agg, state["mode_state"], lr)

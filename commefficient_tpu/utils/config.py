@@ -61,6 +61,11 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                    help="per-round probability each sampled client drops "
                         "before aggregation (straggler simulation; the "
                         "reference has none — a dead worker hangs it)")
+    p.add_argument("--client_chunk", type=int, default=0,
+                   help="> 0 scans the per-client grads in chunks of this "
+                        "many clients (must divide --num_workers), so at "
+                        "most client_chunk full gradients coexist in HBM — "
+                        "lets GPT-2-scale rounds sample big cohorts per chip")
     p.add_argument("--split_compile", action="store_true",
                    help="compile the round as TWO XLA programs (client grads "
                         "| sketch server step) so Pallas custom-calls stay in "

@@ -76,6 +76,7 @@ def build(args):
         dp_noise=args.dp_noise,
         client_dropout=args.client_dropout,
         split_compile=args.split_compile,
+        client_chunk=args.client_chunk,
     )
     return session, test_set
 

@@ -145,6 +145,7 @@ def build(args):
         dp_noise=args.dp_noise,
         client_dropout=args.client_dropout,
         split_compile=args.split_compile,
+        client_chunk=args.client_chunk,
     )
     if args.attn_impl == "ring" and session.mesh is None:
         raise SystemExit(

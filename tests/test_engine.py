@@ -484,3 +484,101 @@ def test_split_session_matches_fused_session():
         np.asarray(ravel_pytree(a.state["params"])[0]),
         np.asarray(ravel_pytree(b.state["params"])[0]),
     )
+
+
+@pytest.mark.parametrize("chunk", [2, 4, 8])
+def test_client_chunked_reduce_matches_unchunked(chunk):
+    """cfg.client_chunk scans the grads in chunks accumulating additively —
+    equal to the one-shot vmap up to fp summation order, for both the fused
+    and the split step, with dropout active."""
+    W = 8
+    data = _data(jax.random.PRNGKey(1), W * 4)
+    batch = jax.tree.map(lambda a: a.reshape((W, 4) + a.shape[1:]), data)
+    lr, rng = jnp.float32(0.1), jax.random.PRNGKey(9)
+    kw = dict(mode="sketch", k=16, num_rows=3, num_cols=1024,
+              hash_family="rotation", momentum_type="virtual", error_type="virtual")
+
+    cfg0, s0, step0 = _make(dict(kw), wd=5e-4, client_dropout=0.3)
+    cfgC, sC, stepC = _make(dict(kw), wd=5e-4, client_dropout=0.3,
+                            client_chunk=chunk)
+    a, _, ma = step0(s0, batch, {}, lr, rng)
+    b, _, mb = stepC(sC, batch, {}, lr, rng)
+    assert float(ma["participants"]) == float(mb["participants"])
+    np.testing.assert_allclose(float(ma["loss_sum"]), float(mb["loss_sum"]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ravel_pytree(a["params"])[0]),
+        np.asarray(ravel_pytree(b["params"])[0]), rtol=1e-5, atol=1e-7,
+    )
+
+    # split step honors the same knob
+    client_p, server_p = engine.make_split_round_step(
+        mlp_loss, engine.EngineConfig(mode=ModeConfig(**{**kw, "d": cfg0.mode.d}),
+                                      weight_decay=5e-4, client_dropout=0.3,
+                                      client_chunk=chunk))
+    _, sS, _ = _make(dict(kw), wd=5e-4, client_dropout=0.3)
+    w, nns, ms, nrng = jax.jit(client_p)(sS, batch, lr, rng)
+    sS = jax.jit(server_p)(sS, w, nns, ms["participants"], lr, nrng)
+    np.testing.assert_allclose(
+        np.asarray(ravel_pytree(a["params"])[0]),
+        np.asarray(ravel_pytree(sS["params"])[0]), rtol=1e-5, atol=1e-7,
+    )
+
+
+def test_client_chunk_must_divide_cohort():
+    W = 8
+    data = _data(jax.random.PRNGKey(1), W * 4)
+    batch = jax.tree.map(lambda a: a.reshape((W, 4) + a.shape[1:]), data)
+    _, state, step = _make(_ucfg(), client_chunk=3)
+    with pytest.raises(ValueError, match="divide"):
+        step(state, batch, {}, jnp.float32(0.1), jax.random.PRNGKey(0))
+
+
+def test_client_chunked_sharded_matches_unsharded():
+    """Chunking composes with the client mesh: each chunk's vmap stays
+    sharded over the client axis."""
+    from commefficient_tpu.parallel import mesh as meshlib
+
+    mesh = meshlib.make_mesh(8)
+    data = _data(jax.random.PRNGKey(5), 64)
+    w16 = jax.tree.map(lambda a: a.reshape((16, 4) + a.shape[1:]), data)
+    lr, rng = jnp.float32(0.1), jax.random.PRNGKey(4)
+    _, s_ref, step_ref = _make(_ucfg(), client_chunk=4)
+    ref, _, mref = step_ref(s_ref, w16, {}, lr, rng)
+    _, s_m, step_m = _make(_ucfg(), client_chunk=4)
+    got, _, mgot = step_m(s_m, meshlib.shard_client_batch(mesh, w16), {}, lr, rng)
+    np.testing.assert_allclose(
+        np.asarray(ravel_pytree(got["params"])[0]),
+        np.asarray(ravel_pytree(ref["params"])[0]), rtol=1e-5, atol=1e-6,
+    )
+    assert float(mgot["count"]) == float(mref["count"])
+
+
+def test_session_adjusts_client_chunk_to_cohort():
+    """Constructor-time safety: cohort clamping/rounding can invalidate the
+    requested chunk; the session must adjust it (largest viable divisor)
+    rather than crash at the first jit trace."""
+    from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+    from commefficient_tpu.federated.api import FederatedSession
+
+    rngd = np.random.RandomState(0)
+    n = 64
+    x = rngd.normal(size=(n, 10)).astype(np.float32)
+    y = rngd.randint(0, 4, size=n).astype(np.int32)
+    params = init_mlp(jax.random.PRNGKey(0))
+    d = ravel_pytree(params)[0].size
+    s = FederatedSession(
+        train_loss_fn=mlp_loss, eval_loss_fn=mlp_loss, params=params,
+        net_state={}, mode_cfg=ModeConfig(**_ucfg(d=d)),
+        train_set=FedDataset(x, y, shard_iid(n, 16, rngd)),
+        num_workers=12, local_batch_size=2,
+        mesh=meshlib.make_mesh(8),  # rounds cohort 12 -> 16
+        client_chunk=6,             # divided 12; no longer divides 16
+    )
+    assert s.num_workers == 16 and s.cfg.client_chunk == 4
+    m = s.run_round(0.1)  # and the round actually runs chunked
+    assert np.isfinite(m["loss_sum"])
+
+
+def test_negative_client_chunk_rejected():
+    with pytest.raises(ValueError, match="client_chunk"):
+        _make(_ucfg(), client_chunk=-2)
