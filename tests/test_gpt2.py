@@ -69,3 +69,55 @@ def test_tp_specs_and_sharded_forward():
     sharded = tp.shard_params(mesh, params)
     out = jax.jit(lambda p, i: model.apply({"params": p}, i, train=False))(sharded, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_tp_sharded_sketch_federated_round_matches_unsharded():
+    """The flagship compression (mode=sketch, FetchSGD algebra) composed with
+    Megatron-style tensor parallelism on a (clients, model) mesh: the round
+    must equal the unsharded round — the sketch of the raveled TP-sharded
+    grads is the same math, GSPMD just places it."""
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.federated import engine
+    from commefficient_tpu.models.losses import make_lm_loss
+    from commefficient_tpu.modes.config import ModeConfig
+
+    cfg_m = dataclasses.replace(TINY, n_positions=16, dropout=0.0)
+    model = GPT2LMHead(cfg_m)
+    ids0 = jnp.zeros((1, 16), dtype=jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids0, train=False)["params"]
+    d = ravel_pytree(params)[0].size
+    mode_cfg = ModeConfig(
+        mode="sketch", d=d, k=64, num_rows=3, num_cols=4096,
+        hash_family="rotation", momentum_type="virtual", error_type="virtual",
+    )
+    cfg = engine.EngineConfig(mode=mode_cfg, weight_decay=1e-4)
+    loss_fn = make_lm_loss(model, train=True)
+    W = 4
+    ids = jax.random.randint(jax.random.PRNGKey(1), (W, 2, 16), 0,
+                             cfg_m.vocab_size, jnp.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    lr = jnp.float32(0.1)
+
+    def run(shard):
+        p = jax.tree.map(jnp.copy, params)
+        if shard:
+            mesh = meshlib.make_mesh(8, model_parallel=2)  # clients=4 x model=2
+            p = tp.shard_params(mesh, p)
+        state = engine.init_server_state(cfg, p, {})
+        step = jax.jit(engine.make_round_step(loss_fn, cfg))
+        b = batch
+        if shard:
+            b = jax.device_put(
+                b, jax.sharding.NamedSharding(
+                    mesh, P(meshlib.CLIENT_AXIS)))
+        for i in range(2):
+            state, _, metrics = step(state, b, {}, lr, jax.random.PRNGKey(i))
+        return ravel_pytree(state["params"])[0], metrics
+
+    ref, mref = run(False)
+    got, mgot = run(True)
+    np.testing.assert_allclose(float(mgot["loss_sum"]), float(mref["loss_sum"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
