@@ -307,20 +307,33 @@ def topk_abs(x: jnp.ndarray, k: int, approx: bool) -> jnp.ndarray:
     return idx.astype(jnp.int32)
 
 
+# Single-shot unsketch ceiling: when the [d] estimates transient fits in
+# this many bytes, materialize it and take ONE (approx_)top_k instead of the
+# memory-bounding sequential slab scan — far fewer sequential steps on TPU,
+# and with impl="approx" a single PartialReduce pass over d instead of a
+# per-chunk preselect. 1 GiB covers GPT-2-small at f32 (d≈124M) with
+# headroom on any TPU generation; set to 0 to force the scan (tests do).
+UNSKETCH_SINGLE_SHOT_BYTES = 1 << 30
+
+
 def unsketch_topk(
     spec: CSVecSpec, table: jnp.ndarray, k: int, impl: str = "exact"
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k heavy hitters by |estimate|: (idx[k], vals[k]) (CSVec.unSketch(k)).
 
-    Scans the d-axis in blocks, keeping a running top-k in the carry, so peak
+    Rotation family: single-shot when the [d] estimates transient is
+    affordable (UNSKETCH_SINGLE_SHOT_BYTES, or whenever the Pallas kernel —
+    which materializes the estimates anyway — is routed); otherwise scans
+    the d-axis in blocks, keeping a running top-k in the carry, so peak
     transient memory is O(r * block_size) regardless of d.
 
-    impl="approx" (ModeConfig.topk_impl): the single-shot (Pallas) path uses
-    one `lax.approx_max_k` over all d estimates; the chunked oracle path uses
-    approx only to PRESELECT k candidates within each chunk and merges the
-    carry exactly — each coordinate faces exactly one approximate pass (its
-    own chunk), so overall recall stays ~the 0.95 target instead of
-    compounding per chunk.
+    impl="approx" (ModeConfig.topk_impl): the single-shot path uses one
+    `lax.approx_max_k` over all d estimates; the chunked path uses approx
+    only to PRESELECT k candidates within each chunk and merges the carry
+    exactly — each coordinate faces exactly one approximate pass (its own
+    chunk), so overall recall stays ~the 0.95 target instead of compounding
+    per chunk. Exact results are path-independent (the same top-k set, up
+    to ties in |estimate|).
     """
     if k > spec.d:
         raise ValueError(f"k={k} > d={spec.d}")
@@ -330,12 +343,8 @@ def unsketch_topk(
         # chunk = slab (the rotation family's structural unit)
         chunks = jnp.arange(spec.num_slabs, dtype=jnp.int32)
 
-        if _use_pallas(spec):
-            # the kernel already materializes all d estimates, so the
-            # memory-bounding slab scan would only add work — one top_k.
-            from . import pallas_kernels
-
-            est = pallas_kernels.query_all(spec, table, interpret=_pallas_interpret())
+        if _use_pallas(spec) or spec.d * 4 <= UNSKETCH_SINGLE_SHOT_BYTES:
+            est = query_all(spec, table)  # routes Pallas/oracle internally
             top_idx = topk_abs(est, k, approx)
             return top_idx, est[top_idx]
 

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from commefficient_tpu.sketch import csvec as csvec_mod
 from commefficient_tpu.sketch import (
     CSVecSpec,
     query,
@@ -240,3 +241,22 @@ def test_jit_and_vmap():
     )
     idx, vals = jax.jit(lambda t: unsketch_topk(spec, t, 10))(summed)
     assert idx.shape == (10,) and vals.shape == (10,)
+
+
+def test_unsketch_single_shot_matches_chunked_scan(monkeypatch):
+    """The single-shot unsketch (affordable [d] transient) and the
+    memory-bounding slab scan must recover the same top-k set with the same
+    values — exact path, both rotation-family routes."""
+    spec = CSVecSpec(d=10000, c=1024, r=3, seed=3, family="rotation")
+    rng = np.random.RandomState(4)
+    v = rng.normal(0, 0.01, size=spec.d).astype(np.float32)
+    v[rng.choice(spec.d, 30, replace=False)] = 25.0
+    t = sketch_vec(spec, jnp.asarray(v))
+
+    i_single, v_single = unsketch_topk(spec, t, 30)  # d*4 well under ceiling
+    monkeypatch.setattr(csvec_mod, "UNSKETCH_SINGLE_SHOT_BYTES", 0)
+    i_scan, v_scan = unsketch_topk(spec, t, 30)
+    assert set(np.asarray(i_single).tolist()) == set(np.asarray(i_scan).tolist())
+    np.testing.assert_allclose(
+        np.sort(np.asarray(v_single)), np.sort(np.asarray(v_scan)), rtol=1e-6
+    )
