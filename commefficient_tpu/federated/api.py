@@ -106,6 +106,12 @@ class FederatedSession:
         self.state = engine.init_server_state(self.cfg, params, net_state)
         self.client_state = modes.init_client_state(mode_cfg, train_set.num_clients)
 
+        self._train_loss_fn = train_loss_fn
+        self._multi = None  # lazy: jitted by the first run_rounds block
+        # split sessions exist to keep Mosaic OUT of the big fused module;
+        # a multi-round scan over the fused step would reintroduce it, so
+        # run_rounds falls back to per-round dispatch there
+        self._split = split_compile
         if split_compile:
             # two XLA programs per round: the Pallas/Mosaic sketch server step
             # compiles separately from the big vmapped grad module (see
@@ -180,8 +186,13 @@ class FederatedSession:
             )
         if self.client_state is not None:
             self.client_state = self._scatter(self.client_state, ids_dev, new_rows)
-        self.round += 1
-        m = jax.tree.map(float, jax.device_get(metrics))
+        return self._finalize_metrics(jax.device_get(metrics), lr)
+
+    def _finalize_metrics(self, metrics_host: dict, lr: float) -> dict:
+        """Host-side per-round bookkeeping shared by run_round/run_rounds:
+        comm accounting (survivor-scaled uplink, measured local_topk
+        down-link), cumulative totals, and the round counter."""
+        m = {k: float(v) for k, v in metrics_host.items()}
         m["lr"] = float(lr)
         m.update(self.comm_per_round)
         # dropped clients never transmit: charge uplink for survivors only
@@ -203,7 +214,58 @@ class FederatedSession:
             m["comm_down_mb"] = down
             m["comm_total_mb"] = m["comm_up_mb"] + down
         self.comm_mb_total += m["comm_total_mb"]
+        self.round += 1
         return m
+
+    @property
+    def supports_block_dispatch(self) -> bool:
+        """Whether run_rounds can actually fuse a block into one dispatch:
+        per-client-state modes need the host gather/scatter between rounds,
+        and split sessions exist to keep Mosaic OUT of big fused modules."""
+        return self.client_state is None and not self._split
+
+    # -- a block of rounds in one dispatch (SURVEY.md §7 hard part (d)) ------
+    def run_rounds(self, lrs) -> list[dict]:
+        """Run len(lrs) rounds with ONE device dispatch and ONE host sync —
+        a lax.scan over the round step (engine.make_multi_round_step). On
+        the tunnelled TPU the per-round host round-trip is tens of ms, so
+        blocks amortize it K-fold. Sampling and rng streams are IDENTICAL
+        to sequential run_round calls (pinned by tests); per-client-state
+        modes and split-compile sessions fall back to per-round dispatch."""
+        lrs = list(lrs)
+        if not self.supports_block_dispatch or len(lrs) <= 1:
+            return [self.run_round(lr) for lr in lrs]
+        if self._multi is None:
+            self._multi = jax.jit(
+                engine.make_multi_round_step(self._train_loss_fn, self.cfg),
+                donate_argnums=(0,),
+            )
+        batches, subs = [], []
+        for _ in lrs:
+            ids = self.train_set.sample_clients(self.rng, self.num_workers)
+            batches.append(self.train_set.client_batch(
+                self.rng, ids, self.local_batch_size, self.cfg.mode.num_local_iters
+            ))
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            subs.append(sub)
+        # stack on the HOST: jnp.stack would commit the full [K, W, ...]
+        # block to the default device before resharding — a K-round HBM
+        # spike on one chip, defeating the memory story this feature and
+        # client_chunk exist for. device transfer happens once, sharded.
+        stacked = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches
+        )
+        if self.mesh is not None:
+            stacked = meshlib.shard_stacked_client_batch(self.mesh, stacked)
+        with self._mesh_ctx():
+            self.state, ms = self._multi(
+                self.state, stacked, jnp.asarray(lrs, jnp.float32), jnp.stack(subs)
+            )
+        ms = jax.device_get(ms)  # the block's one sync
+        return [
+            self._finalize_metrics({k: v[i] for k, v in ms.items()}, lr)
+            for i, lr in enumerate(lrs)
+        ]
 
     # -- evaluation (SURVEY.md §3.4: forward-only, no compression) -----------
     def evaluate(self, dataset: FedDataset, batch_size: int = 512) -> dict:
@@ -251,6 +313,27 @@ class FedModel:
     @property
     def params(self):
         return self.session.state["params"]
+
+
+def plan_block(
+    opt: "FedOptimizer", rnd: int, total_rounds: int, eval_every: int,
+    checkpoint_every: int, rounds_per_dispatch: int,
+) -> list[float]:
+    """Per-round lrs for the next dispatch block, truncated at the run end
+    and at any eval/checkpoint boundary so the logging/saving cadence is
+    block-size-invariant. Advances the optimizer schedule. Shared by both
+    training CLIs — the boundary arithmetic is subtle enough to live once."""
+    block = min(
+        max(rounds_per_dispatch, 1), total_rounds - rnd,
+        eval_every - rnd % eval_every,
+        *((checkpoint_every - rnd % checkpoint_every,)
+          if checkpoint_every else ()),
+    )
+    lrs = []
+    for _ in range(block):
+        lrs.append(opt.lr)
+        opt.step()
+    return lrs
 
 
 class FedOptimizer:

@@ -467,6 +467,41 @@ def make_split_round_step(
     return client_step, server_step
 
 
+def make_multi_round_step(loss_fn: Callable, cfg: EngineConfig) -> Callable:
+    """K federated rounds as ONE compiled program — a lax.scan over the
+    single-round step:
+
+        multi(state, batches, lrs, rngs) -> (state', stacked_metrics)
+
+    with `batches` a pytree whose leaves are [K, W, ...], `lrs` [K], `rngs`
+    [K] PRNG keys. One dispatch and one host sync per K rounds instead of
+    per round — on the tunnelled TPU the per-round host round-trip is tens
+    of ms, comparable to a small round itself (SURVEY.md §7 hard part (d):
+    keep the host off the round boundary without stalling steps). Client
+    sampling stays on the host: the caller pre-samples K cohorts and stacks
+    their batches. Modes with per-client persistent state need the host
+    gather/scatter between rounds and fall back to per-round dispatch
+    (FederatedSession.run_rounds does this automatically)."""
+    if cfg.mode.needs_local_state:
+        raise ValueError(
+            "multi-round dispatch requires a mode without per-client "
+            "persistent state (the host gathers/scatters those rows between "
+            "rounds); use per-round run_round for "
+            f"mode={cfg.mode.mode!r} error_type={cfg.mode.error_type!r}"
+        )
+    step = make_round_step(loss_fn, cfg)
+
+    def multi(state, batches, lrs, rngs):
+        def body(st, xs):
+            b, lr, rng = xs
+            st, _, m = step(st, b, {}, lr, rng)
+            return st, m
+
+        return jax.lax.scan(body, state, (batches, lrs, rngs))
+
+    return multi
+
+
 def compose_split(client_step: Callable, server_step: Callable) -> Callable:
     """Adapt a (client_step, server_step) pair back to the fused-step
     signature `(state, batch, client_rows, lr, rng) -> (state', rows,

@@ -61,6 +61,11 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                    help="per-round probability each sampled client drops "
                         "before aggregation (straggler simulation; the "
                         "reference has none — a dead worker hangs it)")
+    p.add_argument("--rounds_per_dispatch", type=int, default=1,
+                   help="> 1 compiles this many rounds into one program "
+                        "(lax.scan) with a single host sync per block — "
+                        "amortizes the host round-trip; stateless modes only "
+                        "(others silently run per-round)")
     p.add_argument("--client_chunk", type=int, default=0,
                    help="> 0 scans the per-client grads in chunks of this "
                         "many clients (must divide --num_workers), so at "

@@ -23,7 +23,9 @@ from jax.flatten_util import ravel_pytree
 
 from commefficient_tpu.data.cifar import load_cifar_fed
 from commefficient_tpu.data.femnist import load_femnist_fed
-from commefficient_tpu.federated.api import FederatedSession, FedModel, FedOptimizer
+from commefficient_tpu.federated.api import (
+    FederatedSession, FedModel, FedOptimizer, plan_block,
+)
 from commefficient_tpu.models.femnist_cnn import FEMNISTCNN
 from commefficient_tpu.models.losses import make_classification_loss
 from commefficient_tpu.models.resnet9 import ResNet9
@@ -109,20 +111,34 @@ def main(argv=None):
     eval_every = args.eval_every or rounds_per_epoch
     acc_loss = acc_count = acc_correct = 0.0
     watchdog = RoundWatchdog()  # hung-round alerts (utils/watchdog.py)
-    for rnd in range(session.round, total_rounds):
-        with watchdog.round(rnd):
-            m = model(opt.lr)
-        opt.step()
-        acc_loss += m["loss_sum"]
-        acc_count += m["count"]
-        acc_correct += m["correct"]
-        if args.checkpoint_every and args.checkpoint_dir and (rnd + 1) % args.checkpoint_every == 0:
+    rnd = session.round
+    while rnd < total_rounds:
+        lrs = plan_block(opt, rnd, total_rounds, eval_every,
+                         args.checkpoint_every, args.rounds_per_dispatch)
+        if len(lrs) > 1 and session.supports_block_dispatch:
+            # one dispatch for the block; the watchdog times the block
+            with watchdog.round(rnd):
+                ms = session.run_rounds(lrs)
+        else:
+            # per-round dispatch (stateful/split fallback): keep the
+            # watchdog per-round so a hang is detected at round, not
+            # block, granularity
+            ms = []
+            for j, lr in enumerate(lrs):
+                with watchdog.round(rnd + j):
+                    ms.append(session.run_round(lr))
+        for m in ms:
+            acc_loss += m["loss_sum"]
+            acc_count += m["count"]
+            acc_correct += m["correct"]
+        rnd += len(lrs)
+        if args.checkpoint_every and args.checkpoint_dir and rnd % args.checkpoint_every == 0:
             ckpt.save(args.checkpoint_dir, session)
-        if (rnd + 1) % eval_every == 0 or rnd + 1 == total_rounds:
+        if rnd % eval_every == 0 or rnd == total_rounds:
             ev = model.eval(test_set, args.eval_batch_size)
             logger.append({
-                "round": rnd + 1,
-                "epoch": (rnd + 1) / rounds_per_epoch,
+                "round": rnd,
+                "epoch": rnd / rounds_per_epoch,
                 "lr": m["lr"],
                 "train_loss": acc_loss / max(acc_count, 1),
                 "train_acc": acc_correct / max(acc_count, 1),

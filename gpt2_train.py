@@ -24,7 +24,9 @@ import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
 from commefficient_tpu.data.personachat import load_personachat_fed
-from commefficient_tpu.federated.api import FederatedSession, FedModel, FedOptimizer
+from commefficient_tpu.federated.api import (
+    FederatedSession, FedModel, FedOptimizer, plan_block,
+)
 from commefficient_tpu.models.gpt2 import SMALL, TINY, GPT2Config, GPT2LMHead
 from commefficient_tpu.models.losses import make_lm_loss
 from commefficient_tpu.parallel import mesh as meshlib, tp
@@ -240,23 +242,37 @@ def main(argv=None):
     eval_every = args.eval_every or min(rounds_per_epoch, 200)
     acc_loss = acc_count = acc_mc_correct = acc_mc_count = 0.0
     watchdog = RoundWatchdog()  # hung-round alerts (utils/watchdog.py)
-    for rnd in range(session.round, total_rounds):
-        with watchdog.round(rnd):
-            m = model(opt.lr)
-        opt.step()
-        acc_loss += m["loss_sum"]
-        acc_count += m["count"]
-        acc_mc_correct += m.get("mc_correct", 0.0)
-        acc_mc_count += m.get("mc_count", 0.0)
-        if args.checkpoint_every and args.checkpoint_dir and (rnd + 1) % args.checkpoint_every == 0:
+    rnd = session.round
+    while rnd < total_rounds:
+        lrs = plan_block(opt, rnd, total_rounds, eval_every,
+                         args.checkpoint_every, args.rounds_per_dispatch)
+        if len(lrs) > 1 and session.supports_block_dispatch:
+            # one dispatch for the block; the watchdog times the block
+            with watchdog.round(rnd):
+                ms = session.run_rounds(lrs)
+        else:
+            # per-round dispatch (stateful/split fallback): keep the
+            # watchdog per-round so a hang is detected at round, not
+            # block, granularity
+            ms = []
+            for j, lr in enumerate(lrs):
+                with watchdog.round(rnd + j):
+                    ms.append(session.run_round(lr))
+        for m in ms:
+            acc_loss += m["loss_sum"]
+            acc_count += m["count"]
+            acc_mc_correct += m.get("mc_correct", 0.0)
+            acc_mc_count += m.get("mc_count", 0.0)
+        rnd += len(lrs)
+        if args.checkpoint_every and args.checkpoint_dir and rnd % args.checkpoint_every == 0:
             ckpt.save(args.checkpoint_dir, session)
-        if (rnd + 1) % eval_every == 0 or rnd + 1 == total_rounds:
+        if rnd % eval_every == 0 or rnd == total_rounds:
             ev = model.eval(valid_set, args.eval_batch_size)
             train_nll = acc_loss / max(acc_count, 1)
             val_nll = ev["loss_sum"] / max(ev["count"], 1)
             row = {
-                "round": rnd + 1,
-                "epoch": (rnd + 1) / rounds_per_epoch,
+                "round": rnd,
+                "epoch": rnd / rounds_per_epoch,
                 "lr": m["lr"],
                 "train_nll": train_nll,
                 "train_ppl": math.exp(min(train_nll, 20)),
@@ -271,7 +287,7 @@ def main(argv=None):
                 row["mc_acc"] = acc_mc_correct / max(acc_mc_count, 1)
                 row["val_mc_acc"] = ev.get("mc_correct", 0.0) / max(ev.get("mc_count", 0.0), 1)
             if f1_eval is not None:
-                row["val_f1"] = f1_eval(model.params, rnd + 1)
+                row["val_f1"] = f1_eval(model.params, rnd)
             logger.append(row)
             acc_loss = acc_count = acc_mc_correct = acc_mc_count = 0.0
 

@@ -137,6 +137,7 @@ COMMEFFICIENT_NO_PALLAS=1 timeout 2400 python -u cv_train.py \
     --momentum_type virtual --error_type virtual \
     --num_clients 100 --num_workers 8 --num_rounds 48 --num_epochs 4 \
     --eval_every 8 --lr_scale 0.4 --seed 42 --dtype bfloat16 \
+    --rounds_per_dispatch 8 \
     --profile_dir /tmp/tpu_trace \
     --log_jsonl results/cifar10_smoke_tpu.jsonl 2>&1 \
     | tee results/logs/step4_cvtrain.log | grep -v WARNING | tail -10
@@ -249,6 +250,7 @@ run_arm() {  # name, extra flags...
         --dataset cifar10 --synthetic_separation 0.025 \
         --num_clients 1000 --num_workers 16 --local_batch_size 8 \
         --num_rounds 300 --num_epochs 5 --eval_every 25 \
+        --rounds_per_dispatch 25 \
         --lr_scale 0.3 --seed 42 --dtype bfloat16 \
         --log_jsonl "results/tradeoff_${name}.jsonl" "$@" 2>&1 \
         | tee "results/logs/step9_${name}.log" | grep -v WARNING | tail -4

@@ -582,3 +582,98 @@ def test_session_adjusts_client_chunk_to_cohort():
 def test_negative_client_chunk_rejected():
     with pytest.raises(ValueError, match="client_chunk"):
         _make(_ucfg(), client_chunk=-2)
+
+
+def test_multi_round_dispatch_matches_sequential():
+    """engine.make_multi_round_step: K rounds in one lax.scan == K sequential
+    step calls, bit-for-bit (same rng streams via the caller)."""
+    kw = dict(mode="sketch", k=16, num_rows=3, num_cols=1024,
+              hash_family="rotation", momentum_type="virtual", error_type="virtual")
+    W, K = 4, 3
+    data = _data(jax.random.PRNGKey(1), W * 4 * K)
+    all_b = jax.tree.map(lambda a: a.reshape((K, W, 4) + a.shape[1:]), data)
+    lrs = jnp.asarray([0.1, 0.2, 0.05], jnp.float32)
+    rngs = jax.random.split(jax.random.PRNGKey(7), K)
+
+    cfg, state_s, step = _make(dict(kw), wd=5e-4)
+    _, state_m, _ = _make(dict(kw), wd=5e-4)
+    seq_metrics = []
+    for i in range(K):
+        b = jax.tree.map(lambda a: a[i], all_b)
+        state_s, _, m = step(state_s, b, {}, lrs[i], rngs[i])
+        seq_metrics.append(m)
+    multi = jax.jit(engine.make_multi_round_step(mlp_loss, cfg))
+    state_m, ms = multi(state_m, all_b, lrs, rngs)
+    for a, b in zip(jax.tree.leaves(state_s["params"]), jax.tree.leaves(state_m["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for i, m in enumerate(seq_metrics):
+        for k2, v in m.items():
+            np.testing.assert_allclose(float(v), float(ms[k2][i]), rtol=1e-6)
+
+
+def test_multi_round_rejects_local_state_modes():
+    params = init_mlp(jax.random.PRNGKey(0))
+    d = ravel_pytree(params)[0].size
+    cfg = engine.EngineConfig(mode=ModeConfig(
+        mode="local_topk", d=d, k=8, momentum_type="none", error_type="local",
+        num_clients=4))
+    with pytest.raises(ValueError, match="run_round"):
+        engine.make_multi_round_step(mlp_loss, cfg)
+
+
+def test_session_run_rounds_matches_run_round():
+    """FederatedSession.run_rounds: identical sampling/rng/metrics/comm to
+    sequential run_round calls, on the sharded mesh, one dispatch."""
+    from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+    from commefficient_tpu.federated.api import FederatedSession
+
+    rngd = np.random.RandomState(0)
+    n = 64
+    x = rngd.normal(size=(n, 10)).astype(np.float32)
+    y = rngd.randint(0, 4, size=n).astype(np.int32)
+
+    def make():
+        params = init_mlp(jax.random.PRNGKey(0))
+        d = ravel_pytree(params)[0].size
+        return FederatedSession(
+            train_loss_fn=mlp_loss, eval_loss_fn=mlp_loss,
+            params=jax.tree.map(jnp.copy, params), net_state={},
+            mode_cfg=ModeConfig(mode="sketch", d=d, k=16, num_rows=3,
+                                num_cols=1024, hash_family="rotation",
+                                momentum_type="virtual", error_type="virtual"),
+            train_set=FedDataset(x, y, shard_iid(n, 16, np.random.RandomState(1))),
+            num_workers=8, local_batch_size=2, seed=7,
+            mesh=meshlib.make_mesh(8), client_dropout=0.25,
+        )
+
+    a, b = make(), make()
+    seq = [a.run_round(lr) for lr in (0.1, 0.2, 0.05, 0.1)]
+    blk = b.run_rounds([0.1, 0.2, 0.05, 0.1])
+    assert len(blk) == 4
+    for ma, mb in zip(seq, blk):
+        assert set(ma) == set(mb)
+        for k2 in ma:
+            np.testing.assert_allclose(ma[k2], mb[k2], rtol=1e-5)
+    assert a.round == b.round == 4
+    np.testing.assert_allclose(a.comm_mb_total, b.comm_mb_total, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ravel_pytree(a.state["params"])[0]),
+        np.asarray(ravel_pytree(b.state["params"])[0]), rtol=1e-5, atol=1e-7,
+    )
+
+
+def test_plan_block_boundaries():
+    """plan_block truncates at run end and eval/checkpoint boundaries and
+    advances the schedule exactly once per planned round."""
+    from commefficient_tpu.federated.api import FedOptimizer, plan_block
+
+    opt = FedOptimizer(lambda e: 0.1, rounds_per_epoch=4)
+    # eval boundary at 8: from rnd=6 with k=8 the block is 2
+    assert len(plan_block(opt, 6, 100, 8, 0, 8)) == 2
+    assert opt.round == 2
+    # checkpoint boundary at 3 binds tighter than eval at 8 from rnd=1
+    assert len(plan_block(opt, 1, 100, 8, 3, 8)) == 2
+    # run end binds from rnd=98
+    assert len(plan_block(opt, 98, 100, 8, 0, 8)) == 2
+    # k=1 is always a single round
+    assert len(plan_block(opt, 0, 100, 8, 0, 1)) == 1
