@@ -90,6 +90,16 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "--coordinator_address)")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible")
+    # reference-CLI compatibility no-ops (SURVEY.md §5.6): the reference's
+    # process/queue machinery needs them; the TPU engine has no worker
+    # processes to pin or ports to bind. Accepted so reference launch
+    # commands run unmodified; a note is printed if set.
+    p.add_argument("--share_ps_gpu", action="store_true",
+                   help="accepted for reference-CLI compatibility; no-op "
+                        "(no parameter-server process exists here)")
+    p.add_argument("--port", type=int, default=0,
+                   help="accepted for reference-CLI compatibility; no-op "
+                        "(no torch.multiprocessing rendezvous here)")
     p.add_argument("--eval_batch_size", type=int, default=512)
     p.add_argument("--eval_every", type=int, default=0, help="rounds; 0 = once per epoch")
     p.add_argument("--num_rounds", type=int, default=0,
@@ -169,6 +179,9 @@ def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
         }.get(args.mode, "none")
     if args.mode in ("fedavg", "localSGD") and args.num_local_iters < 1:
         args.num_local_iters = 1
+    if getattr(args, "share_ps_gpu", False) or getattr(args, "port", 0):
+        print("note: --share_ps_gpu/--port are reference-CLI compatibility "
+              "no-ops (the TPU engine has no worker processes)", flush=True)
     return args
 
 
