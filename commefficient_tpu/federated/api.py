@@ -19,9 +19,6 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.flatten_util import ravel_pytree
-from jax.sharding import NamedSharding, PartitionSpec as P
-
 from ..data.fed_dataset import FedDataset
 from ..modes import modes
 from ..modes.config import ModeConfig

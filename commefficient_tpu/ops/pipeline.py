@@ -25,7 +25,6 @@ t - (S-1), written into the output buffer when valid. Bubble fraction is
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax

@@ -27,7 +27,7 @@ from commefficient_tpu.data.personachat import load_personachat_fed
 from commefficient_tpu.federated.api import (
     FederatedSession, FedModel, FedOptimizer, plan_block,
 )
-from commefficient_tpu.models.gpt2 import SMALL, TINY, GPT2Config, GPT2LMHead
+from commefficient_tpu.models.gpt2 import SMALL, TINY, GPT2LMHead
 from commefficient_tpu.models.losses import make_lm_loss
 from commefficient_tpu.parallel import mesh as meshlib, tp
 from commefficient_tpu.utils import checkpoint as ckpt
