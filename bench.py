@@ -654,16 +654,13 @@ def run_bench(platform: str) -> dict:
         result["vs_baseline_basis"] = _baseline_basis(rt_ms)
         _stage(f"baseline basis: {result['vs_baseline_basis']}")
 
-    if SCALE_CHECK and BENCH_MODEL == "resnet9":
+    if SCALE_CHECK:
         _stage("scale check (2x workers) ...")
         # physical-consistency check: double the client count, round time
         # should roughly double (compute-bound vmap). A flat time would mean
-        # the timing is still an async illusion.
-        batch2 = {
-            "x": jnp.concatenate([batch["x"]] * 2, axis=0),
-            "y": jnp.concatenate([batch["y"]] * 2, axis=0),
-            "mask": jnp.concatenate([batch["mask"]] * 2, axis=0),
-        }
+        # the timing is still an async illusion. Workload-agnostic: every
+        # batch leaf has the client axis leading.
+        batch2 = jax.tree.map(lambda a: jnp.concatenate([a] * 2, axis=0), batch)
         state2 = engine.init_server_state(
             cfg, jax.tree.map(jnp.copy, params), jax.tree.map(jnp.copy, net_state)
         )
@@ -676,6 +673,15 @@ def run_bench(platform: str) -> dict:
             "workers_x2_round_ms_ratio": round(ratio, 2),
             "plausible": bool(1.3 <= ratio <= 3.0),
         }
+        if ratio < 1.3:
+            # flat scaling has two honest readings — distinguish before
+            # condemning the timing: the fixed server step (sketch algebra +
+            # unsketch over d, independent of W) can dominate small cohorts.
+            result["scale_check"]["note"] = (
+                "ratio < 1.3: either async-illusion timing OR a "
+                "server-dominated round (the sketch server step's cost is "
+                "independent of W); phase_timing's client_ms vs server_ms "
+                "distinguishes the two")
     return result
 
 
