@@ -21,6 +21,8 @@ def _args(tmp, extra=()):
 
 @pytest.fixture()
 def small_session(tmp_path, monkeypatch):
+    import flax.linen as nn
+
     import commefficient_tpu.data.cifar as cifar_mod
 
     orig = cifar_mod.load_cifar_fed
@@ -30,6 +32,21 @@ def small_session(tmp_path, monkeypatch):
         return orig(*a, **kw)
 
     monkeypatch.setattr(cv_train, "load_cifar_fed", tiny)
+
+    # checkpoint logic is model-agnostic; a 2-layer MLP compiles in seconds
+    # where ResNet-9 takes ~40-80 s per session on this 1-core box (the
+    # real model's CLI path is covered by test_determinism/test_golden)
+    class _TinyNet(nn.Module):
+        num_classes: int = 10
+        dtype: str = "float32"
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(self.num_classes)(x)
+
+    monkeypatch.setattr(cv_train, "ResNet9", _TinyNet)
     return tmp_path
 
 
