@@ -714,3 +714,36 @@ def test_session_run_rounds_hybrid_mesh():
         np.asarray(ravel_pytree(a.state["params"])[0]),
         np.asarray(ravel_pytree(b.state["params"])[0]), rtol=1e-5, atol=1e-6,
     )
+
+
+def test_run_rounds_local_topk_virtual_downlink_accounting():
+    """Block dispatch with local_topk (error_type=virtual — stateless, so
+    eligible): the per-round measured down_support must fold into comm
+    accounting identically to sequential rounds."""
+    from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+    from commefficient_tpu.federated.api import FederatedSession
+
+    rngd = np.random.RandomState(0)
+    n = 64
+    x = rngd.normal(size=(n, 10)).astype(np.float32)
+    y = rngd.randint(0, 4, size=n).astype(np.int32)
+
+    def make():
+        params = init_mlp(jax.random.PRNGKey(0))
+        d = ravel_pytree(params)[0].size
+        return FederatedSession(
+            train_loss_fn=mlp_loss, eval_loss_fn=mlp_loss,
+            params=jax.tree.map(jnp.copy, params), net_state={},
+            mode_cfg=ModeConfig(mode="local_topk", d=d, k=16,
+                                momentum_type="none", error_type="virtual"),
+            train_set=FedDataset(x, y, shard_iid(n, 16, np.random.RandomState(1))),
+            num_workers=8, local_batch_size=2, seed=7,
+        )
+
+    a, b = make(), make()
+    seq = [a.run_round(0.1) for _ in range(3)]
+    blk = b.run_rounds([0.1, 0.1, 0.1])
+    for ma, mb in zip(seq, blk):
+        assert "down_support" not in mb  # folded into the comm figures
+        np.testing.assert_allclose(ma["comm_down_mb"], mb["comm_down_mb"], rtol=1e-6)
+        np.testing.assert_allclose(ma["comm_total_mb"], mb["comm_total_mb"], rtol=1e-6)
