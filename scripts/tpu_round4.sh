@@ -249,8 +249,8 @@ run_arm() {  # name, extra flags...
     COMMEFFICIENT_NO_PALLAS=1 timeout 3000 python -u cv_train.py \
         --dataset cifar10 --synthetic_separation 0.025 \
         --num_clients 1000 --num_workers 16 --local_batch_size 8 \
-        --num_rounds 300 --num_epochs 5 --eval_every 25 \
-        --rounds_per_dispatch 25 \
+        --num_rounds 600 --num_epochs 10 --eval_every 50 \
+        --rounds_per_dispatch 50 \
         --lr_scale 0.3 --seed 42 --dtype bfloat16 \
         --log_jsonl "results/tradeoff_${name}.jsonl" "$@" 2>&1 \
         | tee "results/logs/step9_${name}.log" | grep -v WARNING | tail -4
