@@ -229,6 +229,12 @@ PHASE_TIMING = os.environ.get("BENCH_PHASE_TIMING", "1") == "1"
 # that says whether that's the W-independent oracle sketch server step —
 # expected — or an async-timing illusion)
 PHASE_CHAIN = int(os.environ.get("BENCH_PHASE_CHAIN", 6))
+# Finer server attribution (accumulate | estimates | top-k exact vs approx),
+# each at the engine's real sketch dims — at GPT-2 scale the exact
+# `lax.top_k` over d=124M is the suspected wall inside server_ms, and the
+# approx number quantifies the ModeConfig.topk_impl="approx" remedy in the
+# same JSON. BENCH_SERVER_SPLIT=0/1 overrides.
+SERVER_SPLIT = os.environ.get("BENCH_SERVER_SPLIT", "1") == "1"
 # vs_baseline derivation from a measurement (VERDICT r3 #7): time ONE
 # client's fwd+bwd at batch 8 in f32 on this chip, so the JSON carries the
 # arithmetic behind the baseline multiple instead of only a remembered
@@ -470,6 +476,70 @@ def _analytic_resnet9_flops(workers: int, local_batch: int) -> float:
     return workers * local_batch * fwd_per_image * 3.0
 
 
+def _server_split(mode_cfg, rt_ms) -> dict:
+    """Per-op attribution of the sketch-server wall at the workload's REAL
+    dims: accumulate (sketch_vec over d), estimates (the d-length median
+    query), and the final top-k over d — timed BOTH exact and approx, so the
+    JSON itself says whether `lax.top_k` over d is the wall and what
+    `approx_max_k` (ModeConfig.topk_impl="approx") would buy. Each op runs
+    as its own data-dependent in-jit chain with one device_get sync (the
+    same discipline as every timer here); never raises."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.sketch import csvec
+
+    spec, k = mode_cfg.sketch_spec, mode_cfg.k
+    out: dict = {"d": spec.d, "k": k, "topk_impl_engine": mode_cfg.topk_impl}
+    try:
+        v0 = jax.random.normal(jax.random.PRNGKey(7), (spec.d,), jnp.float32)
+        t0 = csvec.sketch_vec(spec, v0)
+        e0 = csvec.query_all(spec, t0)
+
+        def acc_chain(v, n):
+            def body(x, _):
+                table = csvec.sketch_vec(spec, x)
+                # scalar feedback keeps rounds dependent without extra d-work
+                return x * (1.0 + 1e-12 * table[0, 0]), ()
+            x, _ = jax.lax.scan(body, v, None, length=n)
+            return x[0]
+
+        def est_chain(table, n):
+            def body(t, _):
+                est = csvec.query_all(spec, t)
+                return t + 1e-12 * est[0], ()
+            t, _ = jax.lax.scan(body, table, None, length=n)
+            return t[0, 0]
+
+        def topk_chain(approx):
+            def chain(est, n):
+                def body(x, _):
+                    idx = csvec.topk_abs(x, k, approx)
+                    return x + 1e-12 * x[idx[0]], ()
+                x, _ = jax.lax.scan(body, est, None, length=n)
+                return x[0]
+            return chain
+
+        for label, fn, arg in (
+            ("accumulate_ms", acc_chain, v0),
+            ("estimates_ms", est_chain, t0),
+            ("topk_exact_ms", topk_chain(False), e0),
+            ("topk_approx_ms", topk_chain(True), e0),
+        ):
+            per, n, rtt_dominated = _time_adaptive(
+                lambda n, f=fn: (lambda a_: f(a_, n)), (arg,),
+                PHASE_CHAIN, rt_ms)
+            out[label] = round(per, 2)
+            if rtt_dominated:
+                out.setdefault("rtt_dominated", []).append(label)
+        out["note"] = ("ops timed in isolation at the engine's sketch spec; "
+                      "server_ms - (accumulate+estimates+topk) ~= FetchSGD "
+                      "algebra + sketch_sparse/query/to_dense remainder")
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def _phase_timing(loss_fn, cfg, state, batch, rt_ms) -> dict:
     """Client-phase vs server-phase wall-clock via the split-engine programs
     (engine.make_split_round_step): the client program is the vmapped
@@ -706,6 +776,19 @@ def run_bench(platform: str) -> dict:
             _stage("phase timing (client | sketch-server chains) ...")
             result["phase_timing"] = _phase_timing(loss_fn, cfg, state, batch, rt_ms)
             _stage(f"phase timing: {result['phase_timing']}")
+    if SERVER_SPLIT:
+        if (result["engine_sketch_path"] == "pallas"
+                and os.environ.get("BENCH_SERVER_SPLIT") != "1"):
+            # query_all/sketch_vec route Pallas when it's on — these chains
+            # would be new Mosaic-bearing scan modules (same caveat as
+            # phase_timing above); opt in explicitly to take that risk.
+            result["server_split"] = {
+                "skipped": "pallas engine routed; set BENCH_SERVER_SPLIT=1 "
+                           "to compile the Mosaic-bearing op chains"}
+        else:
+            _stage("server split (accumulate | estimates | topk) ...")
+            result["server_split"] = _server_split(mode_cfg, rt_ms)
+            _stage(f"server split: {result['server_split']}")
     if BASELINE_BASIS:
         _stage("baseline basis (single-client f32 fwd+bwd) ...")
         result["vs_baseline_basis"] = _baseline_basis(rt_ms)
@@ -766,6 +849,8 @@ def _shrink_for_cpu():
     if "BENCH_PHASE_TIMING" not in os.environ:
         # two extra split-engine compiles — minutes on a 1-core CPU fallback
         g["PHASE_TIMING"] = False
+    if "BENCH_SERVER_SPLIT" not in os.environ:
+        g["SERVER_SPLIT"] = False  # four more chains; on-chip question only
 
 
 def main():

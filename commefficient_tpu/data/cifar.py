@@ -43,10 +43,21 @@ def _prototypes(rng: np.random.RandomState, num_classes: int,
                 separation: float) -> np.ndarray:
     """The synthetic task's true class means — first draw of the stream.
     Exposed so tests can apply the exact Bayes rule without replaying
-    private RNG internals."""
-    return separation * rng.normal(
-        0, 1.0, size=(num_classes, 32, 32, 3)
-    ).astype(np.float32)
+    private RNG internals.
+
+    Drawn at 8x8 and nearest-neighbor upsampled to 32x32: per-pixel iid
+    prototypes are adversarial to a weight-sharing conv net (pooling
+    averages independent per-location signals to ~zero — measured: ResNet-9
+    sat at random accuracy for 600 rounds on the iid variant at separation
+    0.025 while the nearest-prototype Bayes rule scored 0.86). Piecewise-
+    constant 4x4 blocks carry the same total signal energy (each of the
+    8*8*3 draws replicated 16x) and the identical class-conditional
+    Gaussian structure — the exact Bayes rule is still nearest-prototype —
+    but the signal now survives convolution and pooling, so accuracy-vs-
+    communication studies measure the compression scheme, not an
+    architecture-task mismatch."""
+    low = rng.normal(0, 1.0, size=(num_classes, 8, 8, 3))
+    return separation * low.repeat(4, axis=1).repeat(4, axis=2).astype(np.float32)
 
 
 def _synthetic(num_train: int, num_test: int, num_classes: int, seed: int = 0,

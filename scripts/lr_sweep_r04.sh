@@ -1,0 +1,25 @@
+#!/bin/bash
+# LR sweep for the reduced-signal tradeoff study (sep 0.025, smooth
+# prototypes): at lr_scale 0.3 the task diverges (train loss 3-5, above the
+# ln10 floor — results/logs/step9_localtopk.log), so find the stable lr with
+# short uncompressed runs before spending a tunnel window on the 3-arm study.
+# Persistent XLA compile cache makes retries after a tunnel wedge cheap.
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p results/logs .jax_cache
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+
+for lr in 0.03 0.08 0.15; do
+    rm -f "results/lr_sweep_${lr}.jsonl"
+    COMMEFFICIENT_NO_PALLAS=1 timeout 900 python -u cv_train.py \
+        --dataset cifar10 --synthetic_separation 0.025 \
+        --num_clients 1000 --num_workers 16 --local_batch_size 8 \
+        --num_rounds 300 --num_epochs 5 --eval_every 50 \
+        --rounds_per_dispatch 50 \
+        --lr_scale "$lr" --seed 42 --dtype bfloat16 \
+        --mode uncompressed \
+        --log_jsonl "results/lr_sweep_${lr}.jsonl" 2>&1 \
+        | tee "results/logs/lr_sweep_${lr}.log" | grep -v WARNING | tail -3 \
+        || echo "lr=$lr arm FAILED/timed out"
+done
+echo "sweep done"
