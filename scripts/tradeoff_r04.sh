@@ -1,0 +1,52 @@
+#!/bin/bash
+# Round-4 reduced-signal accuracy-vs-communication study (VERDICT r3 #3),
+# wedge-resilient edition: the tunnel's uptime windows are ~20-40 min
+# (observed: the 03:5x wedge hit the ORACLE path mid-arm at round 450), so
+# every arm checkpoints every 100 rounds and resumes, completed arms leave
+# a .done sentinel, and the XLA compile cache persists across retries.
+# Re-running this script after a wedge loses at most 100 rounds of one arm.
+#
+# Task: synthetic CIFAR at --synthetic_separation 0.025 (smooth 8x8
+# prototypes, Bayes ~0.865 — data/cifar.py), 1000 non-iid clients.
+# TRADEOFF_LR overrides the peak lr (default from scripts/lr_sweep_r04.sh).
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p results/logs .jax_cache
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+LR="${TRADEOFF_LR:-0.08}"
+
+run_arm() {  # name, extra flags...
+    local name="$1"; shift
+    [ -f "results/logs/tradeoff_${name}.done" ] && {
+        echo "arm $name already complete"; return 0; }
+    # fresh start only when there is no checkpoint to resume (TableLogger
+    # appends; a stale jsonl without a checkpoint would double-log round 0)
+    [ -d "ckpt_tradeoff_${name}" ] || rm -f "results/tradeoff_${name}.jsonl"
+    COMMEFFICIENT_NO_PALLAS=1 timeout 3000 python -u cv_train.py \
+        --dataset cifar10 --synthetic_separation 0.025 \
+        --num_clients 1000 --num_workers 16 --local_batch_size 8 \
+        --num_rounds 600 --num_epochs 10 --eval_every 50 \
+        --rounds_per_dispatch 50 \
+        --checkpoint_dir "ckpt_tradeoff_${name}" --checkpoint_every 100 \
+        --resume \
+        --lr_scale "$LR" --seed 42 --dtype bfloat16 \
+        --log_jsonl "results/tradeoff_${name}.jsonl" "$@" 2>&1 \
+        | tee -a "results/logs/tradeoff_${name}.log" | grep -v WARNING | tail -4
+    local rc=${PIPESTATUS[0]}
+    [ "$rc" -eq 0 ] && touch "results/logs/tradeoff_${name}.done"
+    return "$rc"
+}
+
+FAIL=0
+run_arm uncompressed --mode uncompressed || FAIL=1
+run_arm sketch --mode sketch --k 50000 --num_cols 524288 --num_rows 5 \
+    --num_blocks 4 --momentum_type virtual --error_type virtual || FAIL=1
+run_arm localtopk --mode local_topk --k 50000 \
+    --momentum_type none --error_type virtual || FAIL=1
+
+if [ "$FAIL" -eq 0 ]; then
+    python scripts/tradeoff_table.py results/tradeoff_*.jsonl \
+        > results/tradeoff_table_r04.md 2> results/logs/tradeoff_table.log
+    echo "TRADEOFF STUDY COMPLETE"
+fi
+exit "$FAIL"
