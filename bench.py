@@ -733,7 +733,8 @@ def run_bench(platform: str) -> dict:
         "device_kind": device_kind,
         "compute_dtype": BENCH_DTYPE,
         "sketch": {"rows": mode_cfg.num_rows, "cols": mode_cfg.num_cols,
-                   "k": mode_cfg.k, "blocks": mode_cfg.num_blocks, "d": int(d)},
+                   "k": mode_cfg.k, "blocks": mode_cfg.num_blocks, "d": int(d),
+                   "topk_impl": mode_cfg.topk_impl},
         # which accumulate/query implementation the round step itself compiled
         # (COMMEFFICIENT_NO_PALLAS=1 forces "oracle"; the microbench below
         # still times the Pallas kernels directly either way)

@@ -1,0 +1,29 @@
+"""Pick the tradeoff-study lr from the lr-sweep JSONLs (scripts/lr_sweep_r04.sh).
+
+Prints the winning lr to stdout. Rules: an arm is STABLE when its final
+train_loss stays below the ln(10) random floor (a diverging weak-signal run
+sits above it — observed at lr 0.3); among stable arms take the one with the
+best final test_acc; no stable arms -> 0.08 (mid of the sweep grid).
+"""
+import glob
+import json
+import math
+import re
+import sys
+
+best_lr, best_acc = None, -1.0
+for path in sorted(glob.glob("results/lr_sweep_*.jsonl")):
+    m = re.search(r"lr_sweep_([0-9.]+)\.jsonl", path)
+    if not m:
+        continue
+    rows = [json.loads(ln) for ln in open(path) if ln.strip()]
+    if not rows:
+        continue
+    last = rows[-1]
+    stable = last.get("train_loss", 99.0) < math.log(10.0)
+    acc = last.get("test_acc", 0.0)
+    print(f"# {path}: final train_loss={last.get('train_loss'):.4f} "
+          f"test_acc={acc:.4f} stable={stable}", file=sys.stderr)
+    if stable and acc > best_acc:
+        best_lr, best_acc = m.group(1), acc
+print(best_lr or "0.08")

@@ -32,7 +32,10 @@
 #   9. reduced-signal tradeoff study: 3 arms at synthetic_separation 0.025
 set -x
 cd "$(dirname "$0")/.."
-mkdir -p results/logs
+mkdir -p results/logs .jax_cache
+# persistent compile cache: a retry after a tunnel wedge skips straight to
+# execution instead of re-paying the 1-2 min XLA compile inside the window
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 
 probe_chip() {
     # A wedged tunnel hangs the device claim; a live one answers in seconds.
@@ -150,7 +153,10 @@ fi
 # module. If THIS wedges, the split theory is wrong and we learn it cheaply.
 if want 5; then
 probe_chip || { echo "CHIP DEAD before step 5"; exit 105; }
-BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=split \
+# cache disabled: this step PROBES whether the split compile wedges — a
+# persistent-cache hit would skip the compile and make the probe vacuous
+JAX_COMPILATION_CACHE_DIR= \
+    BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=split \
     BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
     BENCH_WARMUP=0 BENCH_SCALE_CHECK=0 BENCH_MICRO_CHAIN=2 \
     BENCH_BASELINE_BASIS=0 \
@@ -195,7 +201,10 @@ fi
 if want 7; then
 probe_chip || { echo "CHIP DEAD before step 7"; exit 107; }
 rm -rf results/logs/xla_dump_step7 && mkdir -p results/logs/xla_dump_step7
-XLA_FLAGS="--xla_dump_to=results/logs/xla_dump_step7 --xla_dump_hlo_pass_re=.*" \
+# cache disabled: the whole point is to exercise (and dump) the suspect
+# fused compile — a cache hit would fake an OK without compiling anything
+JAX_COMPILATION_CACHE_DIR= \
+    XLA_FLAGS="--xla_dump_to=results/logs/xla_dump_step7 --xla_dump_hlo_pass_re=.*" \
     BENCH_ENGINE_SKETCH=auto \
     BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
     BENCH_WARMUP=0 BENCH_SCALE_CHECK=0 BENCH_MICRO_CHAIN=2 \
