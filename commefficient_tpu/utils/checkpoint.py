@@ -50,10 +50,13 @@ def save(ckpt_dir: str, session, keep: int = 3):
 
 
 def latest(ckpt_dir: str) -> str | None:
+    # absolute: orbax's tensorstore kvstore REJECTS relative paths at
+    # restore time (save() already abspaths), so a relative --checkpoint_dir
+    # would save fine and then crash every --resume
     if not os.path.isdir(ckpt_dir):
         return None
     rounds = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("round_"))
-    return os.path.join(ckpt_dir, rounds[-1]) if rounds else None
+    return os.path.abspath(os.path.join(ckpt_dir, rounds[-1])) if rounds else None
 
 
 def restore(path: str, session) -> None:

@@ -137,3 +137,26 @@ def test_latest_and_prune(small_session, tmp_path):
     remaining = sorted(os.listdir(tmp_path / "ck"))
     assert len(remaining) == 2
     assert ckpt.latest(str(tmp_path / "ck")).endswith(remaining[-1])
+
+
+def test_restore_via_relative_checkpoint_dir(small_session, tmp_path, monkeypatch):
+    """`--checkpoint_dir ck` (relative, as every CLI example uses): orbax's
+    tensorstore rejects relative paths at RESTORE time while save() abspaths,
+    so latest() must return an absolute path — the asymmetry let a run save
+    for hours and then crash the --resume (observed round 4, session 3)."""
+    import os
+
+    args = _args(tmp_path)
+    s, _ = cv_train.build(args)
+    for _ in range(2):
+        s.run_round(0.05)
+    monkeypatch.chdir(tmp_path)
+    ckpt.save("ck_rel", s)
+    path = ckpt.latest("ck_rel")
+    assert os.path.isabs(path), path
+    s2, _ = cv_train.build(_args(tmp_path))
+    ckpt.restore(path, s2)  # raised ValueError before the fix
+    assert s2.round == s.round
+    np.testing.assert_array_equal(
+        np.asarray(s2.state["round"]), np.asarray(s.state["round"])
+    )
