@@ -43,10 +43,30 @@ run_arm sketch --mode sketch --k 50000 --num_cols 524288 --num_rows 5 \
     --num_blocks 4 --momentum_type virtual --error_type virtual || FAIL=1
 run_arm localtopk --mode local_topk --k 50000 \
     --momentum_type none --error_type virtual || FAIL=1
+# the paper's other comparator (SURVEY.md §6 row 1: "local_topk/fedavg
+# degrade notably under non-iid"); best-effort — its failure must not fail
+# the study (the 3 planned arms above are the deliverable)
+run_arm fedavg --mode fedavg --num_local_iters 5 \
+    || echo "fedavg arm failed (best-effort; study unaffected)"
 
-if [ "$FAIL" -eq 0 ]; then
-    python scripts/tradeoff_table.py results/tradeoff_*.jsonl \
-        > results/tradeoff_table_r04.md 2> results/logs/tradeoff_table.log
-    echo "TRADEOFF STUDY COMPLETE"
+# render whatever completed — a 3-arm table beats no table after a wedge
+done_files=$(for f in results/tradeoff_*.jsonl; do
+    n=$(basename "$f" .jsonl); n=${n#tradeoff_}
+    [ -f "results/logs/tradeoff_${n}.done" ] && echo "$f"
+done)
+if [ -n "$done_files" ]; then
+    # render to a temp file first: a tradeoff_table.py crash must neither
+    # truncate a previously-good table nor count as success
+    # shellcheck disable=SC2086
+    if python scripts/tradeoff_table.py $done_files \
+            > results/tradeoff_table_r04.md.tmp 2> results/logs/tradeoff_table.log; then
+        mv results/tradeoff_table_r04.md.tmp results/tradeoff_table_r04.md
+        echo "TRADEOFF TABLE RENDERED ($(echo $done_files | wc -w) arms)"
+    else
+        rm -f results/tradeoff_table_r04.md.tmp
+        echo "TABLE RENDER FAILED (see results/logs/tradeoff_table.log)"
+        FAIL=1
+    fi
 fi
+[ "$FAIL" -eq 0 ] && echo "TRADEOFF STUDY COMPLETE"
 exit "$FAIL"
