@@ -212,3 +212,15 @@ def test_synthetic_separation_controls_bayes_accuracy():
     assert bayes(1.0) > 0.99
     hard = bayes(0.025)
     assert 0.70 < hard < 0.95, hard
+
+
+def test_cifar100_loader():
+    """--dataset cifar100 (SURVEY.md §2 L0a: "CIFAR10/100"): the synthetic
+    fallback really is 100-class. The full cv_train round on this dataset is
+    covered in test_checkpoint.py::test_cifar100_build_path_round."""
+    train, test, num_classes = load_cifar_fed(
+        "cifar100", num_clients=20, iid=False, data_root="/nonexistent",
+        synthetic_train=200, synthetic_test=100)
+    assert num_classes == 100
+    assert train.y.max() < 100 and len(np.unique(train.y)) > 10
+    assert train.num_clients == 20
