@@ -43,11 +43,15 @@ run_arm sketch --mode sketch --k 50000 --num_cols 524288 --num_rows 5 \
     --num_blocks 4 --momentum_type virtual --error_type virtual || FAIL=1
 run_arm localtopk --mode local_topk --k 50000 \
     --momentum_type none --error_type virtual || FAIL=1
-# the paper's other comparator (SURVEY.md §6 row 1: "local_topk/fedavg
-# degrade notably under non-iid"); best-effort — its failure must not fail
-# the study (the 3 planned arms above are the deliverable)
+# the paper's other comparators (SURVEY.md §6 row 1: "local_topk/fedavg
+# degrade notably under non-iid"; true_topk is FetchSGD's idealized
+# upper-bound control); best-effort — their failure must not fail the
+# study (the 3 planned arms above are the deliverable)
 run_arm fedavg --mode fedavg --num_local_iters 5 \
     || echo "fedavg arm failed (best-effort; study unaffected)"
+run_arm truetopk --mode true_topk --k 50000 \
+    --momentum_type virtual --error_type virtual \
+    || echo "true_topk arm failed (best-effort; study unaffected)"
 
 # render whatever completed — a 3-arm table beats no table after a wedge
 done_files=$(for f in results/tradeoff_*.jsonl; do
