@@ -747,3 +747,63 @@ def test_run_rounds_local_topk_virtual_downlink_accounting():
         assert "down_support" not in mb  # folded into the comm figures
         np.testing.assert_allclose(ma["comm_down_mb"], mb["comm_down_mb"], rtol=1e-6)
         np.testing.assert_allclose(ma["comm_total_mb"], mb["comm_total_mb"], rtol=1e-6)
+
+
+def test_localsgd_single_iter_matches_uncompressed():
+    """mode=localSGD (SURVEY.md §2 L2: the sixth mode — zero coverage until
+    round 4): with 1 local iteration and no momentum anywhere, the client's
+    weight delta is exactly lr*grad and the server applies the survivor mean
+    at unit rate — bit-for-bit the uncompressed control on the same rounds."""
+    data = _data(jax.random.PRNGKey(11), 24)
+    batch = jax.tree.map(lambda a: a.reshape((3, 1, 8) + a.shape[1:]), data)
+    lr = jnp.float32(0.15)
+    cfg_l, state_l, step_l = _make(
+        dict(mode="localSGD", momentum_type="none", error_type="none",
+             num_local_iters=1))
+    cfg_u, state_u, step_u = _make(_ucfg(momentum_type="none"))
+    ubatch = jax.tree.map(lambda a: a.reshape((3, 8) + a.shape[1:]), data)
+    for i in range(3):
+        state_l, _, _ = step_l(state_l, batch, {}, lr, jax.random.PRNGKey(i))
+        state_u, _, _ = step_u(state_u, ubatch, {}, lr, jax.random.PRNGKey(i))
+    for a, b in zip(jax.tree.leaves(state_l["params"]),
+                    jax.tree.leaves(state_u["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_localsgd_virtual_momentum_multi_iter():
+    """localSGD's own niche vs fedavg: SERVER (virtual) momentum over
+    multi-iter weight deltas — V = rho*V + mean(delta), applied at
+    server_lr=1. Pinned against a manual replay of the algebra."""
+    data = _data(jax.random.PRNGKey(12), 12)
+    micro = jax.tree.map(lambda a: a.reshape((1, 3, 4) + a.shape[1:]), data)
+    lr, rho = jnp.float32(0.1), 0.6
+    cfg, state, step = _make(
+        dict(mode="localSGD", momentum_type="virtual", momentum=rho,
+             error_type="none", num_local_iters=3))
+    p0 = jax.tree.map(jnp.copy, state["params"])
+    s1, _, _ = step(state, micro, {}, lr, jax.random.PRNGKey(0))
+    s2, _, _ = step(s1, micro, {}, lr, jax.random.PRNGKey(1))
+
+    # manual: delta_t = 3-step local SGD from the server params; V accumulates
+    from jax.flatten_util import ravel_pytree as rav
+
+    def local_delta(params, rng):
+        pflat, unravel = rav(params)
+        p = pflat
+        rngs = jax.random.split(rng, 3)
+        for j in range(3):
+            mb = jax.tree.map(lambda a: a[0, j], micro)
+            g = jax.grad(lambda q: mlp_loss(unravel(q), {}, mb, rngs[j])[0])(p)
+            p = p - lr * g
+        return pflat - p
+
+    pflat0, unravel = rav(p0)
+    V = jnp.zeros_like(pflat0)
+    p = pflat0
+    for i in range(2):
+        V = rho * V + local_delta(unravel(p), jax.random.split(
+            jax.random.split(jax.random.PRNGKey(i), 3)[0], 1)[0])
+        p = p - V
+    for a, b in zip(jax.tree.leaves(s2["params"]), jax.tree.leaves(unravel(p))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
