@@ -407,6 +407,11 @@ def test_sharded_eval_matches_unsharded():
      {}),
     (dict(mode="uncompressed", d=0, momentum_type="virtual", error_type="none"),
      dict(dp_clip=1.0, dp_noise=0.5, client_dropout=0.3)),
+    # chunked client phase under the split engine: the composition the
+    # GPT-2-scale bench relies on (BENCH_CLIENT_CHUNK + split compile)
+    (dict(mode="sketch", k=16, num_rows=3, num_cols=1024,
+          hash_family="rotation", momentum_type="virtual", error_type="virtual"),
+     dict(client_chunk=4)),
 ])
 def test_split_round_step_matches_fused(mode_kw, eng_kw):
     """The two-program split (Mosaic-isolating) round must equal the fused
