@@ -3,13 +3,37 @@ without TPU hardware (SURVEY.md §4 "Distributed without a cluster"). The
 hermetic dance (axon-plugin strip + platform pin) lives in
 commefficient_tpu.utils.hermetic, shared with bench.py and __graft_entry__."""
 
+import os
+
 from commefficient_tpu.utils.hermetic import force_hermetic_cpu
 
 force_hermetic_cpu(8)
 
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# Persistent XLA compile cache for the compile-bound suite on this 1-core
+# box. Two hooks are BOTH required: the env var alone is latched by
+# jax._src.config at ITS import time, which on this box happens in
+# sitecustomize (axon plugin registration) before conftest runs — so the
+# in-process suite needs the explicit config.update below, while subprocess
+# CLI tests (fresh interpreters) pick the cache up from the inherited env
+# var. Opt out with JAX_COMPILATION_CACHE_DIR="" (empty disables).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(repo_root(), ".jax_cache")
+)
+if not os.environ["JAX_COMPILATION_CACHE_DIR"]:
+    del os.environ["JAX_COMPILATION_CACHE_DIR"]
+
 import jax  # noqa: E402
 
 jax.config.update("jax_threefry_partitionable", True)
+if "JAX_COMPILATION_CACHE_DIR" in os.environ:
+    jax.config.update(
+        "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+    )
 
 
 def hermetic_subprocess_env() -> dict:
@@ -24,9 +48,3 @@ def hermetic_subprocess_env() -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     return env
-
-
-def repo_root() -> str:
-    import os
-
-    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
