@@ -23,16 +23,16 @@ axon tunnel being down) degrades to a CPU run flagged "platform": "cpu"
 rather than a crash or a hang. A CPU number can therefore never masquerade as
 a TPU number.
 
-vs_baseline normalises against REFERENCE_CLIENT_UPDATES_PER_SEC, an estimate
-of the reference implementation's single-GPU simulated-client throughput on
-the same workload. BASELINE.json's `published` field is empty (no hard
-numbers exist in the reference repo — see BASELINE.md); the estimate is
-derived from paper-era figures: cifar10-fast ResNet-9 forward+backward at
-batch 8 on a V100-class GPU ≈ 4-6k img/s ≈ 600 client-updates/s at 8
-imgs/client, minus sketching overhead ≈ 500/s. Re-derive when a populated
-reference mount allows measuring directly. The sketch column count is
-recorded in the JSON (c=2^19 vs the paper's 500k — +4.9% sketch size) so
-cross-run comparisons stay explicit about the changed dims.
+vs_baseline normalises against a PER-WORKLOAD estimate of the reference
+implementation's single-GPU simulated-client throughput on the same workload
+(_REFERENCE_BY_MODEL — a GPT-2 client update costs ~1000x a CIFAR one, so a
+single constant would make one of the two numbers meaningless).
+BASELINE.json's `published` field is empty (no hard numbers exist in the
+reference repo — see BASELINE.md); each estimate's derivation is embedded in
+the JSON (`vs_baseline_reference`). Re-derive when a populated reference
+mount allows measuring directly. The sketch column count is recorded in the
+JSON (c=2^19 vs the paper's 500k — +4.9% sketch size) so cross-run
+comparisons stay explicit about the changed dims.
 """
 
 from __future__ import annotations
@@ -43,7 +43,26 @@ import subprocess
 import sys
 import time
 
-REFERENCE_CLIENT_UPDATES_PER_SEC = 500.0
+# Per-workload: a GPT-2 client update costs ~1000x a CIFAR one, so dividing
+# the gpt2 throughput by the ResNet-9 constant made vs_baseline meaningless
+# for that workload (r4 first run recorded 0.011 against the wrong yardstick).
+# gpt2 estimate: 8 seqs x 256 tok through d=124M fwd+bwd ~ 1.5 TFLOP/client;
+# a V100-class GPU at a realistic 30-40 TFLOP/s delivered => ~40-60 ms/client
+# => ~15/s serial, and the reference's queue/shm round trip + unsketch at
+# c=2^20 eats some of it => ~15/s.
+_REFERENCE_BY_MODEL = {
+    "resnet9": (500.0,
+                "no published reference numbers exist (BASELINE.md); "
+                "estimate: cifar10-fast ResNet-9 fwd+bwd ~4-6k img/s on a "
+                "V100-class GPU => ~600 client-updates/s at 8 img/client, "
+                "minus sketching overhead => 500/s"),
+    "gpt2": (15.0,
+             "no published reference numbers exist (BASELINE.md); estimate: "
+             "~1.5 TFLOP/client (8 seq x 256 tok, d=124M, fwd+bwd) on a "
+             "V100-class GPU at 30-40 TFLOP/s delivered => ~40-60 ms/client "
+             "=> ~15 client-updates/s incl. queue/shm + unsketch overhead"),
+}
+# resolved below, right after BENCH_MODEL is validated
 
 
 def _stage(msg: str) -> None:
@@ -74,6 +93,9 @@ _PEAK_BF16 = [
 # (PersonaChat-scale: GPT-2-small d~124M, paper config #4 sketch dims —
 # num_cols 2^20, num_blocks 20; run manually, the driver measures resnet9)
 BENCH_MODEL = os.environ.get("BENCH_MODEL", "resnet9")
+if BENCH_MODEL not in ("resnet9", "gpt2"):
+    raise SystemExit(f"BENCH_MODEL must be resnet9|gpt2, got {BENCH_MODEL!r}")
+REFERENCE_CLIENT_UPDATES_PER_SEC, REFERENCE_DERIVATION = _REFERENCE_BY_MODEL[BENCH_MODEL]
 NUM_WORKERS = int(os.environ.get("BENCH_WORKERS", 64))  # sampled clients/round
 LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH", 8))  # images per client
 SKETCH_ROWS = int(os.environ.get("BENCH_ROWS", 5))
@@ -617,6 +639,11 @@ def _baseline_basis(rt_ms) -> dict:
     batch 8 in f32 (the reference's per-client unit of work, which its
     single-GPU workers run sequentially) — and publish the arithmetic that
     turns it into the vs_baseline denominator. Never raises."""
+    if BENCH_MODEL != "resnet9":
+        # the measurement below is ResNet-9-specific; dividing it by another
+        # workload's reference constant would mix workloads in one ratio
+        return {"skipped": "baseline basis is a ResNet-9 measurement; "
+                           f"BENCH_MODEL={BENCH_MODEL} has no basis probe"}
     import jax
     import jax.numpy as jnp
     from jax.flatten_util import ravel_pytree
@@ -626,11 +653,7 @@ def _baseline_basis(rt_ms) -> dict:
 
     out: dict = {
         "reference_client_updates_per_sec": REFERENCE_CLIENT_UPDATES_PER_SEC,
-        "reference_derivation": (
-            "no published reference numbers exist (BASELINE.md); estimate: "
-            "cifar10-fast ResNet-9 fwd+bwd ~4-6k img/s on a V100-class GPU "
-            "=> ~600 client-updates/s at 8 img/client, minus sketching "
-            "overhead => 500/s"),
+        "reference_derivation": REFERENCE_DERIVATION,
     }
     try:
         model = ResNet9(num_classes=10, dtype="float32")
@@ -665,7 +688,8 @@ def _baseline_basis(rt_ms) -> dict:
         out["single_client_updates_per_sec_this_chip_f32"] = round(1e3 / ms, 4)
         out["chip_vs_reference_serial_ratio"] = round(
             (1e3 / ms) / REFERENCE_CLIENT_UPDATES_PER_SEC, 6)
-        out["note"] = ("vs_baseline = engine updates/s / 500; the serial "
+        out["note"] = ("vs_baseline = engine updates/s / "
+                       f"{REFERENCE_CLIENT_UPDATES_PER_SEC:g}; the serial "
                        "ratio above isolates the hardware factor, so "
                        "(vs_baseline / ratio) is the engine's batching/"
                        "parallelism contribution")
@@ -729,6 +753,10 @@ def run_bench(platform: str) -> dict:
         "value": round(updates_per_sec_per_chip, 2),
         "unit": "client-updates/sec/chip",
         "vs_baseline": round(updates_per_sec_per_chip / REFERENCE_CLIENT_UPDATES_PER_SEC, 3),
+        "vs_baseline_reference": {
+            "client_updates_per_sec": REFERENCE_CLIENT_UPDATES_PER_SEC,
+            "derivation": REFERENCE_DERIVATION,
+        },
         "platform": platform,
         "device_kind": device_kind,
         "compute_dtype": BENCH_DTYPE,

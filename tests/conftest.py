@@ -41,8 +41,6 @@ def hermetic_subprocess_env() -> dict:
     8-device CPU mesh — the one shared copy of the dance (also used by
     test_distributed / test_determinism; in-process tests are already
     hermetic via force_hermetic_cpu above)."""
-    import os
-
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
