@@ -3,7 +3,7 @@
 Prints the winning lr to stdout. Rules: an arm is STABLE when its final
 train_loss stays below the ln(10) random floor (a diverging weak-signal run
 sits above it — observed at lr 0.3); among stable arms take the one with the
-best final test_acc; no stable arms -> 0.08 (mid of the sweep grid).
+best final test_acc; no stable arms -> 0.03 (mid of the sweep grid).
 """
 import glob
 import json
@@ -26,4 +26,4 @@ for path in sorted(glob.glob("results/lr_sweep_*.jsonl")):
           f"test_acc={acc:.4f} stable={stable}", file=sys.stderr)
     if stable and acc > best_acc:
         best_lr, best_acc = m.group(1), acc
-print(best_lr or "0.08")
+print(best_lr or "0.03")

@@ -4,7 +4,8 @@
 # up — flakiness under sustained load, not only Mosaic). This orchestrator
 # banks artifacts in strict value/risk order, with a chip gate before each
 # phase and .done sentinels so a re-run after a wedge resumes where it died:
-#   A. lr sweep (safe, 12 min)        -> pick TRADEOFF_LR automatically
+#   A2. lr sweep (safe, 12 min)       -> pick TRADEOFF_LR automatically
+#       (suffix = grid revision; pass "A2" when cherry-picking phases)
 #   B. tradeoff study (safe, resumable ~20 min) -> tradeoff_table_r04.md
 #   C. GPT-2 oracle bench rerun (safe ~15 min)  -> BENCH_gpt2_r04.json with
 #      server_split attribution (exact vs approx top-k at d=124M)
@@ -63,10 +64,12 @@ PY
 
 FAIL=0
 
-# A. lr sweep (skips arms whose jsonl already has a final row? cheap; rerun)
-if want A 101; then
-if bash scripts/lr_sweep_r04.sh; then touch results/logs/window_A.done
-else echo "PHASE A FAILED"; FAIL=8; fi
+# A2. lr sweep — sentinel suffix encodes the GRID REVISION ({0.01,0.03,
+# 0.06} triangle), so a done-marker from the old {0.03,0.08,0.15} pure-ramp
+# sweep can never satisfy the revised phase
+if want A2 101; then
+if bash scripts/lr_sweep_r04.sh; then touch results/logs/window_A2.done
+else echo "PHASE A2 FAILED"; FAIL=8; fi
 fi
 
 # B. tradeoff study at the picked lr (internally resumable per arm)

@@ -13,7 +13,7 @@ set -x
 cd "$(dirname "$0")/.."
 mkdir -p results/logs .jax_cache
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
-LR="${TRADEOFF_LR:-0.08}"
+LR="${TRADEOFF_LR:-0.03}"  # CPU preview: ramps past ~0.04 destabilize
 
 run_arm() {  # name, extra flags...
     local name="$1"; shift
