@@ -12,13 +12,24 @@ import pytest
 @pytest.fixture()
 def bench_mod(monkeypatch):
     import importlib
+    import os
     import sys
 
     monkeypatch.setenv("BENCH_MODEL", "resnet9")
+    # importing bench in its default (oracle) mode SETS
+    # COMMEFFICIENT_NO_PALLAS=1 process-wide (bench.py's engine-routing
+    # knob); without restore, every later in-process test sees the pallas
+    # library force-disabled — test_pallas's routing assertions fail by
+    # test ORDER, not by code (observed: 187/188 with this fixture first)
+    prior = os.environ.get("COMMEFFICIENT_NO_PALLAS")
     sys.modules.pop("bench", None)
     mod = importlib.import_module("bench")
     yield mod
     sys.modules.pop("bench", None)
+    if prior is None:
+        os.environ.pop("COMMEFFICIENT_NO_PALLAS", None)
+    else:
+        os.environ["COMMEFFICIENT_NO_PALLAS"] = prior
 
 
 def test_time_adaptive_measures_real_compute(bench_mod):
