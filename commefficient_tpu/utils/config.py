@@ -121,6 +121,11 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                             "fallback: 1.0 = trivially separable (smoke "
                             "tests); ~0.025 puts Bayes accuracy near 0.86 "
                             "so accuracy-vs-comm trade-offs are meaningful")
+        p.add_argument("--synthetic_train", type=int, default=10000,
+                       help="synthetic-CIFAR fallback train-set size; 50000 "
+                            "matches real CIFAR so paper-scale cohorts "
+                            "(10,000 sort-by-label clients) get the same 5 "
+                            "images/client as BASELINE config #2")
     else:  # gpt2
         p.add_argument("--dataset", default="personachat", choices=["personachat"])
         p.add_argument("--seq_len", type=int, default=256)

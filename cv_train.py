@@ -48,6 +48,7 @@ def build(args):
         train_set, test_set, num_classes = load_cifar_fed(
             args.dataset, args.num_clients, args.iid, args.data_root, args.seed,
             synthetic_separation=args.synthetic_separation,
+            synthetic_train=args.synthetic_train,
         )
         model = ResNet9(num_classes=num_classes, dtype=args.dtype)
         sample_shape = (1, 32, 32, 3)

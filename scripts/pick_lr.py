@@ -16,13 +16,23 @@ for path in sorted(glob.glob("results/lr_sweep_*.jsonl")):
     m = re.search(r"lr_sweep_([0-9.]+)\.jsonl", path)
     if not m:
         continue
-    rows = [json.loads(ln) for ln in open(path) if ln.strip()]
+    rows = []
+    for ln in open(path):
+        # a run killed mid-append leaves a truncated final line — skip it,
+        # as scripts/tradeoff_table.py does, instead of crashing (and then
+        # silently falling back to ${TRADEOFF_LR:-0.03} in the window script)
+        try:
+            rows.append(json.loads(ln))
+        except json.JSONDecodeError:
+            continue
     if not rows:
         continue
     last = rows[-1]
-    stable = last.get("train_loss", 99.0) < math.log(10.0)
+    loss = last.get("train_loss")
+    stable = loss is not None and loss < math.log(10.0)
     acc = last.get("test_acc", 0.0)
-    print(f"# {path}: final train_loss={last.get('train_loss'):.4f} "
+    print(f"# {path}: final train_loss="
+          f"{'n/a' if loss is None else format(loss, '.4f')} "
           f"test_acc={acc:.4f} stable={stable}", file=sys.stderr)
     if stable and acc > best_acc:
         best_lr, best_acc = m.group(1), acc

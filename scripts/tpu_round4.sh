@@ -205,7 +205,7 @@ rm -rf results/logs/xla_dump_step7 && mkdir -p results/logs/xla_dump_step7
 # fused compile — a cache hit would fake an OK without compiling anything
 JAX_COMPILATION_CACHE_DIR= \
     XLA_FLAGS="--xla_dump_to=results/logs/xla_dump_step7 --xla_dump_hlo_pass_re=.*" \
-    BENCH_ENGINE_SKETCH=auto \
+    BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=fused \
     BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
     BENCH_WARMUP=0 BENCH_SCALE_CHECK=0 BENCH_MICRO_CHAIN=2 \
     BENCH_BASELINE_BASIS=0 \

@@ -1,0 +1,222 @@
+#!/bin/bash
+# Round-5 tunnel-window playbook, ordered by VERDICT r4's ranking (not
+# safe-first: round 4 proved the split+pallas compile and the kernels at
+# flagship dims on this chip, so phase D's residual risk is tunnel
+# flakiness — which kills any phase equally). Tunnel uptime comes in
+# ~20-40 min windows; every phase leaves a .done sentinel and a re-run
+# resumes where it died.
+#   D. flagship bench, split+pallas engine      -> BENCH_flagship_r05.json
+#      (VERDICT #1: the Pallas-path flagship number, 4 rounds overdue)
+#   C. GPT-2 bench, oracle + --topk_impl approx -> BENCH_gpt2_r05.json
+#      (VERDICT #2: the measured server-wall remedy; server_split attributes
+#      accumulate | estimates | top-k at d=124M, exact AND approx)
+#   E. GPT-2 bench, split+pallas + approx       -> supersedes gpt2 JSON
+#   A2. lr sweep (safe)                          -> picks TRADEOFF_LR
+#   B. converged 5-arm tradeoff study (safe, resumable ~25 min)
+#      (VERDICT #3)                              -> tradeoff_table_r05.md
+#   G. paper-scale cohort: 10,000 sort-by-label clients, W=100, 24 epochs
+#      (VERDICT #4; BASELINE config #2)          -> paper_scale_r05.jsonl
+#   P. flagship phase split on-chip + W-scaling (VERDICT #5)
+#   F. fused pallas-in-engine probe w/ XLA dump (VERDICT #6; the wedge
+#      suspect, LAST)
+# Exit: 0 all phases done, 8 some failed, 10N chip dead before phase N
+# (1=D 2=C 3=E 4=A2 5=B 6=G 7=P 8=F) — wait-loop gate range 101-109.
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p results/logs .jax_cache
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export BENCH_NO_RETRY=1
+PHASES=("$@")
+
+probe_chip() {
+    timeout 180 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
+x = jnp.ones((256, 256))
+print('chip alive:', float(jax.device_get((x @ x).sum())), jax.devices())
+" 2>&1 | grep -v WARNING
+    return ${PIPESTATUS[0]}
+}
+
+want() {  # phase letter, gate number
+    if [ ${#PHASES[@]} -gt 0 ] && [[ " ${PHASES[*]} " != *" $1 "* ]]; then
+        return 1
+    fi
+    [ -f "results/logs/window5_$1.done" ] && {
+        echo "phase $1 already done"; return 1; }
+    probe_chip || { echo "CHIP DEAD before phase $1"; exit "$2"; }
+    return 0
+}
+
+install_json() {  # log, dst [, required-grep]
+    if [ -n "$3" ] && ! grep -q "$3" "$1"; then
+        echo "not installing $2: $1 lacks $3"; return 1
+    fi
+    python - "$1" "$2" <<'PY'
+import json, sys
+log, dst = sys.argv[1], sys.argv[2]
+line = None
+for ln in open(log, errors="replace"):
+    if ln.startswith("{"):
+        line = ln.strip()
+if line is None:
+    sys.exit(print(f"no JSON line in {log}; keeping existing {dst}") or 0)
+obj = json.loads(line)
+if "error" in obj or obj.get("platform") not in ("tpu", "axon"):
+    sys.exit(print(f"JSON in {log} is a fallback/error record "
+                   f"(platform={obj.get('platform')}); keeping {dst}") or 0)
+open(dst, "w").write(line + "\n")
+print(f"installed {dst}: value={obj.get('value')} {obj.get('unit')}")
+PY
+}
+
+FAIL=0
+
+# D. flagship bench on the split+pallas engine (VERDICT r4 #1). The round-4
+# microbench proved the kernel pair at THESE dims on THIS chip (5.96x the
+# oracle pair) and the split compile ran clean at tiny dims; this is the
+# two facts composed at flagship dims.
+if want D 101; then
+BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=split \
+    timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/window5_D_flagship_pallas.log | grep -v WARNING | tail -6
+if [ "${PIPESTATUS[0]}" -eq 0 ] && install_json \
+        results/logs/window5_D_flagship_pallas.log BENCH_flagship_r05.json \
+        '"engine_sketch_path": "pallas"'; then
+    touch results/logs/window5_D.done
+else echo "PHASE D FAILED (rc or oracle fallback)"; FAIL=8; fi
+fi
+
+# C. GPT-2 bench with the server-wall remedy routed (VERDICT r4 #2):
+# --topk_impl approx makes the d=124M server step a single approx_max_k
+# PartialReduce via the single-shot unsketch; server_split times
+# accumulate | estimates | top-k for exact AND approx in the same JSON, so
+# the remedy's win is attributed, not implied. Oracle path — no Mosaic.
+if want C 102; then
+# BENCH_ENGINE_SKETCH=oracle is REQUIRED, not belt-and-braces: bench.py
+# (default auto since round 5) pops any inherited COMMEFFICIENT_NO_PALLAS
+BENCH_ENGINE_SKETCH=oracle BENCH_MODEL=gpt2 BENCH_TOPK_IMPL=approx \
+    timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/window5_C_gpt2_approx.log | grep -v WARNING | tail -6
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then
+    touch results/logs/window5_C.done
+    install_json results/logs/window5_C_gpt2_approx.log BENCH_gpt2_r05.json
+else echo "PHASE C FAILED"; FAIL=8; fi
+fi
+
+# E. GPT-2 bench on the split+pallas engine + approx top-k (the compounding
+# remedy: Pallas query kernel for estimates, single-shot approx for top-k)
+if want E 103; then
+BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=split BENCH_MODEL=gpt2 \
+    BENCH_TOPK_IMPL=approx timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/window5_E_gpt2_pallas.log | grep -v WARNING | tail -6
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then
+    touch results/logs/window5_E.done
+    # install only if it beats the phase-C number (same unit); a Pallas
+    # regression must not overwrite the banked remedy measurement
+    python - <<'PY' && install_json results/logs/window5_E_gpt2_pallas.log \
+        BENCH_gpt2_r05.json '"engine_sketch_path": "pallas"'
+import json, sys
+try:
+    cur = json.load(open("BENCH_gpt2_r05.json"))
+except Exception:
+    sys.exit(0)
+line = [l for l in open("results/logs/window5_E_gpt2_pallas.log",
+                        errors="replace") if l.startswith("{")][-1]
+new = json.loads(line)
+sys.exit(0 if new.get("value", 0) > cur.get("value", 0) else 1)
+PY
+else echo "PHASE E FAILED"; FAIL=8; fi
+fi
+
+# A2. lr sweep for the study task (sentinel suffix = grid revision)
+if want A2 104; then
+if bash scripts/lr_sweep_r04.sh; then touch results/logs/window5_A2.done
+else echo "PHASE A2 FAILED"; FAIL=8; fi
+fi
+
+# B. converged 5-arm tradeoff study at the picked lr (VERDICT r4 #3)
+if want B 105; then
+LR=$(python scripts/pick_lr.py)
+echo "picked TRADEOFF_LR=$LR"
+if TRADEOFF_LR="$LR" bash scripts/tradeoff_r05.sh; then
+    touch results/logs/window5_B.done
+else echo "PHASE B FAILED"; FAIL=8; fi
+fi
+
+# G. paper-scale cohort (VERDICT r4 #4; BASELINE config #2): 10,000
+# sort-by-label clients (synthetic pixels, 50k train -> 5 images/client
+# exactly like real CIFAR), W=100 ~ 1% participation, 24 epochs = 2400
+# rounds. client_chunk bounds HBM to 25 full [d] gradients; 50-round
+# dispatch blocks amortize the tunnel RTT. Checkpoint/resume: a wedge
+# costs <=200 rounds.
+if want G 106; then
+LR=$(python scripts/pick_lr.py 2>/dev/null || echo 0.03)
+COMMEFFICIENT_NO_PALLAS=1 timeout 3000 python -u cv_train.py \
+    --dataset cifar10 --synthetic_separation 0.025 --synthetic_train 50000 \
+    --num_clients 10000 --num_workers 100 --local_batch_size 5 \
+    --num_epochs 24 --eval_every 100 --rounds_per_dispatch 50 \
+    --client_chunk 25 \
+    --mode sketch --k 50000 --num_cols 524288 --num_rows 5 --num_blocks 4 \
+    --momentum_type virtual --error_type virtual \
+    --checkpoint_dir ckpt_paper_scale --checkpoint_every 200 --resume \
+    --lr_scale "$LR" --seed 42 --dtype bfloat16 \
+    --log_jsonl results/paper_scale_r05.jsonl 2>&1 \
+    | tee -a results/logs/window5_G_paper_scale.log | grep -v WARNING | tail -6
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/window5_G.done
+else echo "PHASE G FAILED/partial (curve still banked)"; FAIL=8; fi
+fi
+
+# P. flagship phase split on-chip + W-scaling (VERDICT r4 #5): phase
+# timing with the pallas engine routed compiles a NEW Mosaic-bearing
+# server chain — the explicit opt-in. Then W=128/256 push toward
+# compute-bound; side JSONs, the canonical W=64 artifact stays comparable.
+if want P 107; then
+BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=split BENCH_PHASE_TIMING=1 \
+    timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/window5_P_flagship_phases.log | grep -v WARNING | tail -6
+if [ "${PIPESTATUS[0]}" -eq 0 ] && install_json \
+        results/logs/window5_P_flagship_phases.log BENCH_flagship_r05.json \
+        '"engine_sketch_path": "pallas"'; then
+    for W in 128 256; do
+        BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=split \
+            BENCH_PHASE_TIMING=1 BENCH_WORKERS=$W BENCH_CLIENT_CHUNK=64 \
+            timeout 2400 python -u bench.py 2>&1 \
+            | tee "results/logs/window5_P_flagship_w${W}.log" \
+            | grep -v WARNING | tail -4
+        install_json "results/logs/window5_P_flagship_w${W}.log" \
+            "BENCH_flagship_w${W}_r05.json" '"engine_sketch_path": "pallas"' \
+            || true
+    done
+    touch results/logs/window5_P.done
+else echo "PHASE P FAILED"; FAIL=8; fi
+fi
+
+# F. the historical wedge suspect, isolated and LAST: one fused
+# pallas-in-engine round, tiny dims, XLA dump for which-phase evidence
+if want F 108; then
+rm -rf results/logs/xla_dump_F && mkdir -p results/logs/xla_dump_F
+# cache disabled: F probes whether the fused compile itself wedges — a
+# persistent-cache hit would skip the compile and fake an OK
+JAX_COMPILATION_CACHE_DIR= \
+    XLA_FLAGS="--xla_dump_to=results/logs/xla_dump_F --xla_dump_hlo_pass_re=.*" \
+    BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=fused \
+    BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
+    BENCH_WARMUP=0 BENCH_SCALE_CHECK=0 BENCH_MICRO_CHAIN=2 \
+    BENCH_BASELINE_BASIS=0 BENCH_SERVER_SPLIT=0 BENCH_PHASE_TIMING=0 \
+    timeout 1800 python -u bench.py 2>&1 \
+    | tee results/logs/window5_F_fused_probe.log | grep -v WARNING | tail -6
+rc=${PIPESTATUS[0]}
+find results/logs/xla_dump_F -name '*.txt' -size -2k -delete 2>/dev/null
+if [ "$rc" -eq 0 ] && grep -q '"engine_sketch_path": "pallas"' \
+        results/logs/window5_F_fused_probe.log; then
+    touch results/logs/window5_F.done
+    echo "FUSED PALLAS ENGINE OK"
+else
+    echo "PHASE F FAILED (rc=$rc) — fused pallas-in-engine remains the"
+    echo "wedge trigger; the split path (phases D/E) is the shipping answer."
+    FAIL=8
+fi
+fi
+
+exit "$FAIL"

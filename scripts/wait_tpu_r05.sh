@@ -1,0 +1,35 @@
+#!/bin/bash
+# Loop-probe the TPU tunnel; on recovery run the round-5 window playbook
+# (remaining args pass through as phase selections). If the playbook dies
+# at a CHIP DEAD gate (exit 101-109: the tunnel answered one probe then
+# wedged again), resume probing and retry — the playbook's own
+# results/logs/window5_X.done sentinels skip phases that SUCCEEDED.
+# Exit: the playbook's exit code (0 = all phases, 8 = some failed but the
+# playbook finished); 7 = still wedged when the budget expired.
+cd "$(dirname "$0")/.."
+# budget must be numeric: `wait_tpu_r05.sh D` (phases only) must not turn
+# into DEADLINE=now+$D=now and exit-7 before the first probe
+case "${1:-}" in
+    ''|*[!0-9]*) BUDGET=41400 ;;
+    *) BUDGET=$1; shift ;;
+esac
+DEADLINE=$(( $(date +%s) + BUDGET ))
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if timeout 75 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
+x = jnp.ones((128,128))
+print('tunnel alive:', float(jax.device_get((x@x).sum())))" 2>/dev/null | grep -q "tunnel alive"; then
+        echo "=== tunnel recovered at $(date -u +%H:%M:%S) — running window (phases: ${*:-all}) ==="
+        bash scripts/tpu_window_r05.sh "$@" 2>&1
+        rc=$?
+        # 101-109 = the playbook's per-phase CHIP DEAD gates
+        if [ "$rc" -lt 101 ] || [ "$rc" -gt 109 ]; then
+            exit "$rc"
+        fi
+        echo "=== CHIP DEAD gate (rc=$rc) at $(date -u +%H:%M:%S); resuming probe loop ==="
+    fi
+    sleep 20
+done
+echo "still wedged at $(date -u +%H:%M:%S)"
+exit 7
