@@ -1,0 +1,81 @@
+#!/bin/bash
+# CPU-mesh fallback for the round-5 converged tradeoff study (VERDICT r4
+# #3): while the TPU tunnel is wedged, advance the SAME arms / SAME
+# checkpoint dirs / SAME jsonl files as scripts/tradeoff_r05.sh, in
+# interleaved 50-round slices, so (a) matched-round comparisons exist
+# across all arms at every slice boundary rather than one arm finishing
+# while the rest never start, and (b) a recovered tunnel's phase B simply
+# resumes each arm's checkpoint and finishes the 600 rounds on-chip
+# (orbax checkpoints are platform-portable; lr pinned 0.03 everywhere).
+#
+# Cooperative handoff: phase B touches results/logs/stop_cpu_slicer and
+# kills the pid in results/logs/cpu_slicer_child.pid; this script checks
+# the stop file between slices and exits. cv_train checkpoints every 50
+# rounds AND at clean exit, so a kill costs <50 rounds.
+#
+# fedavg is deliberately NOT rotated here: its per-client state forces
+# per-round dispatch and 5 local iters (~5x the per-round cost on this
+# 1-core box) — it runs on the TPU window only.
+set -x
+cd "$(dirname "$0")/.."
+. scripts/tradeoff_arms.sh
+mkdir -p results/logs .jax_cache
+rm -f results/logs/stop_cpu_slicer
+LR="${TRADEOFF_LR:-0.03}"
+SLICE=50
+TARGET=600
+
+run_slice() {  # name, target_rounds, extra flags...
+    local name="$1" target="$2"; shift 2
+    [ -f "results/logs/tradeoff_r05_${name}.done" ] && return 0
+    [ -d "ckpt_tradeoff_${name}" ] || rm -f "results/tradeoff_${name}.jsonl"
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache" \
+    COMMEFFICIENT_NO_PALLAS=1 \
+    nice -n 10 env -u PALLAS_AXON_POOL_IPS timeout 7200 \
+        python -u cv_train.py \
+        --dataset cifar10 --synthetic_separation 0.025 \
+        --num_clients 1000 --num_workers 16 --local_batch_size 8 \
+        --num_rounds "$target" --num_epochs 10 --eval_every 50 \
+        --rounds_per_dispatch 50 \
+        --checkpoint_dir "ckpt_tradeoff_${name}" --checkpoint_every 50 \
+        --resume \
+        --lr_scale "$LR" --seed 42 --dtype bfloat16 \
+        --log_jsonl "results/tradeoff_${name}.jsonl" "$@" \
+        >> "results/logs/tradeoff_${name}.log" 2>&1 &
+    local child=$!
+    echo "$child" > results/logs/cpu_slicer_child.pid
+    # close the TOCTOU window: if phase B raised the stop flag between our
+    # pre-spawn check and the pidfile write, it found no pid to kill — kill
+    # our own child now so two writers never share a checkpoint dir
+    if [ -f results/logs/stop_cpu_slicer ]; then
+        kill "$child" 2>/dev/null
+    fi
+    wait "$child"
+    local rc=$?
+    rm -f results/logs/cpu_slicer_child.pid
+    # mark complete only at the full 600-round target (phase B's criterion)
+    if [ "$rc" -eq 0 ] && [ "$target" -ge "$TARGET" ]; then
+        touch "results/logs/tradeoff_r05_${name}.done"
+    fi
+    return "$rc"
+}
+
+for pass in $(seq 1 12); do
+    upto=$(( pass * SLICE ))
+    [ "$upto" -gt "$TARGET" ] && upto=$TARGET
+    for arm in sketch uncompressed localtopk truetopk; do
+        [ -f results/logs/stop_cpu_slicer ] && { echo "stopped"; exit 0; }
+        # shellcheck disable=SC2046
+        run_slice "$arm" "$upto" $(arm_flags "$arm") \
+            || echo "arm $arm slice to $upto failed (continuing rotation)"
+    done
+    # render a fresh partial table each pass (same safety as tradeoff_r05.sh)
+    if python scripts/tradeoff_table.py results/tradeoff_*.jsonl \
+            > results/tradeoff_table_r05.md.tmp 2>> results/logs/tradeoff_table.log; then
+        mv results/tradeoff_table_r05.md.tmp results/tradeoff_table_r05.md
+    else
+        rm -f results/tradeoff_table_r05.md.tmp
+    fi
+done
+echo "SLICER COMPLETE (all arms at $TARGET or stopped)"

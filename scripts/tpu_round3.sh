@@ -118,7 +118,7 @@ fi
 # 2. flagship bench, oracle engine (kernel microbench still times Pallas)
 if want 2; then
 probe_chip || { echo "CHIP DEAD before step 2"; exit 102; }
-COMMEFFICIENT_NO_PALLAS=1 timeout 2400 python -u bench.py 2>&1 \
+BENCH_ENGINE_SKETCH=oracle COMMEFFICIENT_NO_PALLAS=1 timeout 2400 python -u bench.py 2>&1 \
     | tee results/logs/step2_bench.log | grep -v WARNING | tail -8
 if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step2.ok; else echo "STEP 2 FAILED"; FAIL=8; fi
 # Distinct name: the driver writes its own wrapper to BENCH_r03.json at round
@@ -130,7 +130,7 @@ fi
 # 3. GPT-2 bench, oracle engine
 if want 3; then
 probe_chip || { echo "CHIP DEAD before step 3"; exit 103; }
-COMMEFFICIENT_NO_PALLAS=1 BENCH_MODEL=gpt2 timeout 2400 python -u bench.py \
+BENCH_ENGINE_SKETCH=oracle COMMEFFICIENT_NO_PALLAS=1 BENCH_MODEL=gpt2 timeout 2400 python -u bench.py \
     2>&1 | tee results/logs/step3_bench_gpt2.log | grep -v WARNING | tail -5
 if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step3.ok; else echo "STEP 3 FAILED"; FAIL=8; fi
 install_json results/logs/step3_bench_gpt2.log BENCH_gpt2_r03.json
@@ -156,7 +156,9 @@ fi
 # If this wedges the tunnel, everything above is already collected.
 if want 5; then
 probe_chip || { echo "CHIP DEAD before step 5"; exit 105; }
-BENCH_ENGINE_SKETCH=auto \
+# fused pinned explicitly: the bench default flipped to split in round 5,
+# and this step exists to probe the FUSED (suspect) compile
+BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=fused \
     BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
     BENCH_WARMUP=0 BENCH_SCALE_CHECK=0 BENCH_MICRO_CHAIN=2 \
     timeout 1800 python -u bench.py 2>&1 \
@@ -187,7 +189,8 @@ if [ ! -f results/logs/step5.ok ]; then
     FAIL=8
 else
 probe_chip || { echo "CHIP DEAD before step 6"; exit 106; }
-BENCH_ENGINE_SKETCH=auto timeout 2400 python -u bench.py 2>&1 \
+BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=fused \
+    timeout 2400 python -u bench.py 2>&1 \
     | tee results/logs/step6_bench_pallas.log | grep -v WARNING | tail -8
 # the library falls back to the oracle SILENTLY if this process's Mosaic
 # probe fails — verify the JSON actually took the pallas path (as step 5

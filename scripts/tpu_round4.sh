@@ -115,7 +115,7 @@ fi
 # 2. flagship bench, oracle engine (kernel microbench + baseline basis ride along)
 if want 2; then
 probe_chip || { echo "CHIP DEAD before step 2"; exit 102; }
-COMMEFFICIENT_NO_PALLAS=1 timeout 2400 python -u bench.py 2>&1 \
+BENCH_ENGINE_SKETCH=oracle COMMEFFICIENT_NO_PALLAS=1 timeout 2400 python -u bench.py 2>&1 \
     | tee results/logs/step2_bench.log | grep -v WARNING | tail -8
 if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step2.ok; else echo "STEP 2 FAILED"; FAIL=8; fi
 install_json results/logs/step2_bench.log BENCH_flagship_r04.json
@@ -124,7 +124,7 @@ fi
 # 3. GPT-2 bench, oracle engine (+ per-phase timing: client vs sketch-server)
 if want 3; then
 probe_chip || { echo "CHIP DEAD before step 3"; exit 103; }
-COMMEFFICIENT_NO_PALLAS=1 BENCH_MODEL=gpt2 timeout 2400 python -u bench.py \
+BENCH_ENGINE_SKETCH=oracle COMMEFFICIENT_NO_PALLAS=1 BENCH_MODEL=gpt2 timeout 2400 python -u bench.py \
     2>&1 | tee results/logs/step3_bench_gpt2.log | grep -v WARNING | tail -5
 if [ "${PIPESTATUS[0]}" -eq 0 ]; then touch results/logs/step3.ok; else echo "STEP 3 FAILED"; FAIL=8; fi
 install_json results/logs/step3_bench_gpt2.log BENCH_gpt2_r04.json
@@ -234,7 +234,7 @@ if [ ! -f results/logs/step7.ok ]; then
     FAIL=8
 else
 probe_chip || { echo "CHIP DEAD before step 8"; exit 108; }
-BENCH_ENGINE_SKETCH=auto timeout 2400 python -u bench.py 2>&1 \
+BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=fused timeout 2400 python -u bench.py 2>&1 \
     | tee results/logs/step8_bench_fused_pallas.log | grep -v WARNING | tail -8
 if [ "${PIPESTATUS[0]}" -eq 0 ] && grep -q '"engine_sketch_path": "pallas"' \
         results/logs/step8_bench_fused_pallas.log; then

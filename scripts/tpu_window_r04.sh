@@ -83,7 +83,7 @@ fi
 
 # C. GPT-2 oracle bench with server_split attribution (safe: no Mosaic)
 if want C 103; then
-COMMEFFICIENT_NO_PALLAS=1 BENCH_MODEL=gpt2 timeout 2400 python -u bench.py \
+BENCH_ENGINE_SKETCH=oracle COMMEFFICIENT_NO_PALLAS=1 BENCH_MODEL=gpt2 timeout 2400 python -u bench.py \
     2>&1 | tee results/logs/window_C_gpt2_bench.log | grep -v WARNING | tail -6
 if [ "${PIPESTATUS[0]}" -eq 0 ]; then
     touch results/logs/window_C.done
@@ -127,7 +127,7 @@ rm -rf results/logs/xla_dump_F && mkdir -p results/logs/xla_dump_F
 # persistent-cache hit would skip the compile and fake an OK
 JAX_COMPILATION_CACHE_DIR= \
     XLA_FLAGS="--xla_dump_to=results/logs/xla_dump_F --xla_dump_hlo_pass_re=.*" \
-    BENCH_ENGINE_SKETCH=auto \
+    BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=fused \
     BENCH_WORKERS=2 BENCH_LOCAL_BATCH=2 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 \
     BENCH_WARMUP=0 BENCH_SCALE_CHECK=0 BENCH_MICRO_CHAIN=2 \
     BENCH_BASELINE_BASIS=0 BENCH_SERVER_SPLIT=0 \

@@ -11,16 +11,18 @@
 #      (VERDICT #2: the measured server-wall remedy; server_split attributes
 #      accumulate | estimates | top-k at d=124M, exact AND approx)
 #   E. GPT-2 bench, split+pallas + approx       -> supersedes gpt2 JSON
-#   A2. lr sweep (safe)                          -> picks TRADEOFF_LR
 #   B. converged 5-arm tradeoff study (safe, resumable ~25 min)
 #      (VERDICT #3)                              -> tradeoff_table_r05.md
+#      lr PINNED at 0.03 (round-4 CPU evidence: ramps past ~0.04
+#      destabilize) so TPU resumes of CPU-progressed arms share one
+#      schedule — scripts/cpu_slicer_r05.sh advances the same checkpoints
 #   G. paper-scale cohort: 10,000 sort-by-label clients, W=100, 24 epochs
 #      (VERDICT #4; BASELINE config #2)          -> paper_scale_r05.jsonl
 #   P. flagship phase split on-chip + W-scaling (VERDICT #5)
 #   F. fused pallas-in-engine probe w/ XLA dump (VERDICT #6; the wedge
 #      suspect, LAST)
 # Exit: 0 all phases done, 8 some failed, 10N chip dead before phase N
-# (1=D 2=C 3=E 4=A2 5=B 6=G 7=P 8=F) — wait-loop gate range 101-109.
+# (1=D 2=C 3=E 4=B 5=G 6=P 7=F) — wait-loop gate range 101-109.
 set -x
 cd "$(dirname "$0")/.."
 mkdir -p results/logs .jax_cache
@@ -129,17 +131,23 @@ PY
 else echo "PHASE E FAILED"; FAIL=8; fi
 fi
 
-# A2. lr sweep for the study task (sentinel suffix = grid revision)
-if want A2 104; then
-if bash scripts/lr_sweep_r04.sh; then touch results/logs/window5_A2.done
-else echo "PHASE A2 FAILED"; FAIL=8; fi
-fi
-
-# B. converged 5-arm tradeoff study at the picked lr (VERDICT r4 #3)
-if want B 105; then
-LR=$(python scripts/pick_lr.py)
-echo "picked TRADEOFF_LR=$LR"
-if TRADEOFF_LR="$LR" bash scripts/tradeoff_r05.sh; then
+# B. converged 5-arm tradeoff study (VERDICT r4 #3). The CPU slicer
+# (scripts/cpu_slicer_r05.sh) may be advancing the same arms' checkpoints
+# while the tunnel is down — stop it first (it honors the stop file
+# between slices; its in-flight cv_train is killed by pidfile, costing
+# <=100 rounds to the last checkpoint) so two writers never share a
+# checkpoint dir.
+if want B 104; then
+touch results/logs/stop_cpu_slicer
+# kill any in-flight slicer child, then POLL until it is gone (the slicer
+# kills its own child if it raced past our stop flag; pidfile removal is
+# its last act per slice) — bounded at 60s before proceeding anyway
+for _ in $(seq 12); do
+    [ -f results/logs/cpu_slicer_child.pid ] || break
+    kill "$(cat results/logs/cpu_slicer_child.pid)" 2>/dev/null
+    sleep 5
+done
+if TRADEOFF_LR="${TRADEOFF_LR:-0.03}" bash scripts/tradeoff_r05.sh; then
     touch results/logs/window5_B.done
 else echo "PHASE B FAILED"; FAIL=8; fi
 fi
@@ -150,8 +158,9 @@ fi
 # rounds. client_chunk bounds HBM to 25 full [d] gradients; 50-round
 # dispatch blocks amortize the tunnel RTT. Checkpoint/resume: a wedge
 # costs <=200 rounds.
-if want G 106; then
-LR=$(python scripts/pick_lr.py 2>/dev/null || echo 0.03)
+if want G 105; then
+# same pinned lr as phase B (round-4 CPU evidence; no sweep dependency)
+LR="${TRADEOFF_LR:-0.03}"
 COMMEFFICIENT_NO_PALLAS=1 timeout 3000 python -u cv_train.py \
     --dataset cifar10 --synthetic_separation 0.025 --synthetic_train 50000 \
     --num_clients 10000 --num_workers 100 --local_batch_size 5 \
@@ -171,7 +180,7 @@ fi
 # timing with the pallas engine routed compiles a NEW Mosaic-bearing
 # server chain — the explicit opt-in. Then W=128/256 push toward
 # compute-bound; side JSONs, the canonical W=64 artifact stays comparable.
-if want P 107; then
+if want P 106; then
 BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=split BENCH_PHASE_TIMING=1 \
     timeout 2400 python -u bench.py 2>&1 \
     | tee results/logs/window5_P_flagship_phases.log | grep -v WARNING | tail -6
@@ -194,7 +203,7 @@ fi
 
 # F. the historical wedge suspect, isolated and LAST: one fused
 # pallas-in-engine round, tiny dims, XLA dump for which-phase evidence
-if want F 108; then
+if want F 107; then
 rm -rf results/logs/xla_dump_F && mkdir -p results/logs/xla_dump_F
 # cache disabled: F probes whether the fused compile itself wedges — a
 # persistent-cache hit would skip the compile and fake an OK

@@ -11,6 +11,7 @@
 # over the lr_sweep_r04.sh grid).
 set -x
 cd "$(dirname "$0")/.."
+. scripts/tradeoff_arms.sh
 mkdir -p results/logs .jax_cache
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 LR="${TRADEOFF_LR:-0.03}"  # CPU preview: ramps past ~0.04 destabilize
@@ -38,14 +39,10 @@ run_arm() {  # name, extra flags...
 }
 
 FAIL=0
-run_arm uncompressed --mode uncompressed || FAIL=1
-run_arm sketch --mode sketch --k 50000 --num_cols 524288 --num_rows 5 \
-    --num_blocks 4 --momentum_type virtual --error_type virtual || FAIL=1
-run_arm localtopk --mode local_topk --k 50000 \
-    --momentum_type none --error_type virtual || FAIL=1
-run_arm fedavg --mode fedavg --num_local_iters 5 || FAIL=1
-run_arm truetopk --mode true_topk --k 50000 \
-    --momentum_type virtual --error_type virtual || FAIL=1
+for arm in uncompressed sketch localtopk fedavg truetopk; do
+    # shellcheck disable=SC2046
+    run_arm "$arm" $(arm_flags "$arm") || FAIL=1
+done
 
 # render whatever completed — a partial table beats no table after a wedge
 done_files=$(for f in results/tradeoff_*.jsonl; do
