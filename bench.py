@@ -97,7 +97,35 @@ if BENCH_MODEL not in ("resnet9", "gpt2"):
     raise SystemExit(f"BENCH_MODEL must be resnet9|gpt2, got {BENCH_MODEL!r}")
 REFERENCE_CLIENT_UPDATES_PER_SEC, REFERENCE_DERIVATION = _REFERENCE_BY_MODEL[BENCH_MODEL]
 NUM_WORKERS = int(os.environ.get("BENCH_WORKERS", 64))  # sampled clients/round
-LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH", 8))  # images per client
+# per-client unit of work: images (resnet9) or sequences (gpt2) per client
+LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH",
+                                 8 if BENCH_MODEL == "resnet9" else 2))
+if BENCH_MODEL == "gpt2":
+    # The 15/s estimate above is for the paper-ish 8 seq x 256 tok client.
+    # This bench's default gpt2 client is SMALLER (2 seq x BENCH_SEQ tok), so
+    # vs_baseline must compare per-client units of the SAME token count:
+    # scale the reference linearly in tokens/client (fwd+bwd cost is linear
+    # in tokens at fixed d). Round 4's committed 5.27/s was at the 2x256
+    # unit, i.e. 0.088 of the token-normalized reference, not the 0.351 a
+    # unit-blind division suggests — this scaling makes the JSON carry the
+    # honest ratio automatically.
+    _GPT2_SEQ = int(os.environ.get("BENCH_SEQ", 256))
+    _ref_tokens, _our_tokens = 8 * 256, LOCAL_BATCH * _GPT2_SEQ
+    _base_ref = REFERENCE_CLIENT_UPDATES_PER_SEC
+    REFERENCE_CLIENT_UPDATES_PER_SEC *= _ref_tokens / _our_tokens
+    REFERENCE_DERIVATION += (
+        f"; token-normalized to this bench's client unit ({LOCAL_BATCH} seq"
+        f" x {_GPT2_SEQ} tok): {_base_ref:g}/s x {_ref_tokens}/{_our_tokens}"
+        f" = {REFERENCE_CLIENT_UPDATES_PER_SEC:.3g}/s")
+    if os.environ.get("BENCH_GPT2_SIZE") == "tiny":
+        # tiny is a smoke/probe knob; its per-client cost has nothing to do
+        # with the d=124M reference estimate, so the ratio must not pretend
+        REFERENCE_CLIENT_UPDATES_PER_SEC = 0.0
+        REFERENCE_DERIVATION = (
+            "BENCH_GPT2_SIZE=tiny is a smoke/probe configuration with no "
+            "reference counterpart; vs_baseline is pinned 0 and the basis "
+            "probe is skipped (the d=124M estimate would be a different "
+            "workload)")
 SKETCH_ROWS = int(os.environ.get("BENCH_ROWS", 5))
 # 2^19 ≈ the paper's 500k, and 1024-aligned so the Pallas fast path is eligible
 SKETCH_COLS = int(os.environ.get("BENCH_COLS", 524_288))
@@ -263,11 +291,10 @@ PHASE_CHAIN = int(os.environ.get("BENCH_PHASE_CHAIN", 6))
 # same JSON. BENCH_SERVER_SPLIT=0/1 overrides.
 SERVER_SPLIT = os.environ.get("BENCH_SERVER_SPLIT", "1") == "1"
 # vs_baseline derivation from a measurement (VERDICT r3 #7): time ONE
-# client's fwd+bwd at batch 8 in f32 on this chip, so the JSON carries the
-# arithmetic behind the baseline multiple instead of only a remembered
-# constant. resnet9 (the flagship metric) only.
-BASELINE_BASIS = os.environ.get(
-    "BENCH_BASELINE_BASIS", "1" if BENCH_MODEL == "resnet9" else "0") == "1"
+# client's fwd+bwd in f32 on this chip (ResNet-9 at batch 8, or GPT-2 at
+# this bench's seqs-per-client), so the JSON carries the arithmetic behind
+# the baseline multiple instead of only a remembered constant.
+BASELINE_BASIS = os.environ.get("BENCH_BASELINE_BASIS", "1") == "1"
 
 
 def _kernel_microbench(platform: str, rt_ms: float) -> dict:
@@ -384,28 +411,41 @@ def _resnet9_workload():
     return params, net_state, batch, loss_fn, name, sketch_kw, workers
 
 
+def _gpt2_model(dtype):
+    """GPT-2 config+model shared by _gpt2_workload and _baseline_basis, so
+    the basis probe measures definitionally the same client as the headline
+    metric. BENCH_GPT2_SIZE=tiny exists for cheap smoke/probe runs (CPU
+    fallback, fused-compile forensics); the headline metric is always
+    "small" (and tiny pins the reference to 0 — see the knob block up top)."""
+    import dataclasses
+
+    from commefficient_tpu.models.gpt2 import SMALL, TINY, GPT2LMHead
+
+    seq = int(os.environ.get("BENCH_SEQ", 256))
+    base = TINY if os.environ.get("BENCH_GPT2_SIZE") == "tiny" else SMALL
+    cfg = dataclasses.replace(base, n_positions=seq, dropout=0.0, dtype=dtype)
+    size = "tiny" if base is TINY else "small"
+    return cfg, GPT2LMHead(cfg), seq, size
+
+
 def _gpt2_workload():
     """PersonaChat-scale: GPT-2-small (d ~ 124M), paper config #4 sketch dims
     (c = 2^20, 20 blocks). Heavier; workers/seq overridable via env."""
-    import dataclasses
-
     import jax
     import jax.numpy as jnp
 
-    from commefficient_tpu.models.gpt2 import SMALL, GPT2LMHead
     from commefficient_tpu.models.losses import make_lm_loss
 
     workers = int(os.environ.get("BENCH_WORKERS", 4))
-    seq = int(os.environ.get("BENCH_SEQ", 256))
-    cfg = dataclasses.replace(SMALL, n_positions=seq, dropout=0.0, dtype=BENCH_DTYPE)
-    model = GPT2LMHead(cfg)
+    cfg, model, seq, size = _gpt2_model(BENCH_DTYPE)
     ids0 = jnp.zeros((1, seq), dtype=jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids0, train=False)["params"]
     key = jax.random.PRNGKey(1)
-    ids = jax.random.randint(key, (workers, 2, seq), 0, cfg.vocab_size, jnp.int32)
+    ids = jax.random.randint(
+        key, (workers, LOCAL_BATCH, seq), 0, cfg.vocab_size, jnp.int32)
     batch = {"input_ids": ids, "labels": ids}
     loss_fn = make_lm_loss(model, train=True)
-    name = f"GPT-2-small PersonaChat seq={seq}"
+    name = f"GPT-2-{size} PersonaChat seq={seq} b={LOCAL_BATCH}"
     sketch_kw = dict(
         k=int(os.environ.get("BENCH_TOPK", 50_000)),
         num_rows=SKETCH_ROWS,
@@ -640,38 +680,52 @@ def _phase_timing(loss_fn, cfg, state, batch, rt_ms) -> dict:
 
 
 def _baseline_basis(rt_ms) -> dict:
-    """Measure ONE simulated client's cost on THIS chip — ResNet-9 fwd+bwd at
-    batch 8 in f32 (the reference's per-client unit of work, which its
-    single-GPU workers run sequentially) — and publish the arithmetic that
-    turns it into the vs_baseline denominator. Never raises."""
-    if BENCH_MODEL != "resnet9":
-        # the measurement below is ResNet-9-specific; dividing it by another
-        # workload's reference constant would mix workloads in one ratio
-        return {"skipped": "baseline basis is a ResNet-9 measurement; "
-                           f"BENCH_MODEL={BENCH_MODEL} has no basis probe"}
+    """Measure ONE simulated client's cost on THIS chip in f32 (the
+    reference's per-client unit of work, which its single-GPU workers run
+    sequentially): ResNet-9 fwd+bwd at batch 8, or GPT-2-small fwd+bwd at
+    this bench's seqs-per-client. Publishes the arithmetic that turns it
+    into the vs_baseline denominator. Never raises."""
     import jax
     import jax.numpy as jnp
     from jax.flatten_util import ravel_pytree
-
-    from commefficient_tpu.models.losses import make_classification_loss
-    from commefficient_tpu.models.resnet9 import ResNet9
 
     out: dict = {
         "reference_client_updates_per_sec": REFERENCE_CLIENT_UPDATES_PER_SEC,
         "reference_derivation": REFERENCE_DERIVATION,
     }
     try:
-        model = ResNet9(num_classes=10, dtype="float32")
-        x0 = jnp.zeros((1, 32, 32, 3), jnp.float32)
-        variables = model.init(jax.random.PRNGKey(0), x0, train=False)
-        params = variables["params"]
-        net_state = {k: v for k, v in variables.items() if k != "params"}
-        loss_fn = make_classification_loss(model, train=True)
-        batch = {
-            "x": jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3)),
-            "y": jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 10),
-            "mask": jnp.ones((8,), jnp.float32),
-        }
+        if BENCH_MODEL == "resnet9":
+            from commefficient_tpu.models.losses import make_classification_loss
+            from commefficient_tpu.models.resnet9 import ResNet9
+
+            model = ResNet9(num_classes=10, dtype="float32")
+            x0 = jnp.zeros((1, 32, 32, 3), jnp.float32)
+            variables = model.init(jax.random.PRNGKey(0), x0, train=False)
+            params = variables["params"]
+            net_state = {k: v for k, v in variables.items() if k != "params"}
+            loss_fn = make_classification_loss(model, train=True)
+            batch = {
+                "x": jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3)),
+                "y": jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 10),
+                "mask": jnp.ones((8,), jnp.float32),
+            }
+            unit = "f32_b8"
+        else:  # gpt2: one client = LOCAL_BATCH sequences of BENCH_SEQ tokens
+            from commefficient_tpu.models.losses import make_lm_loss
+
+            if not REFERENCE_CLIENT_UPDATES_PER_SEC:
+                # tiny smoke size: no comparable reference, no serial ratio
+                return {"skipped": REFERENCE_DERIVATION}
+            cfg, model, seq, _ = _gpt2_model("float32")
+            ids0 = jnp.zeros((1, seq), dtype=jnp.int32)
+            params = model.init(jax.random.PRNGKey(0), ids0, train=False)["params"]
+            net_state = {}
+            loss_fn = make_lm_loss(model, train=True)
+            ids = jax.random.randint(
+                jax.random.PRNGKey(1), (LOCAL_BATCH, seq), 0,
+                cfg.vocab_size, jnp.int32)
+            batch = {"input_ids": ids, "labels": ids}
+            unit = f"f32_seqs{LOCAL_BATCH}x{seq}"
         def chain(p, n):
             def body(carry, i):
                 g = jax.grad(
@@ -689,7 +743,7 @@ def _baseline_basis(rt_ms) -> dict:
             # this value becomes a denominator below — an error beats a lie
             raise RuntimeError("chain never dwarfed the tunnel RTT; "
                                "measurement would be jitter, not compute")
-        out["measured_single_client_fwd_bwd_ms_f32_b8"] = round(ms, 3)
+        out[f"measured_single_client_fwd_bwd_ms_{unit}"] = round(ms, 3)
         out["single_client_updates_per_sec_this_chip_f32"] = round(1e3 / ms, 4)
         out["chip_vs_reference_serial_ratio"] = round(
             (1e3 / ms) / REFERENCE_CLIENT_UPDATES_PER_SEC, 6)
@@ -757,7 +811,10 @@ def run_bench(platform: str) -> dict:
                   f"r={mode_cfg.num_rows} c={mode_cfg.num_cols} k={mode_cfg.k})",
         "value": round(updates_per_sec_per_chip, 2),
         "unit": "client-updates/sec/chip",
-        "vs_baseline": round(updates_per_sec_per_chip / REFERENCE_CLIENT_UPDATES_PER_SEC, 3),
+        # reference 0 = no comparable reference exists (tiny smoke size)
+        "vs_baseline": (
+            round(updates_per_sec_per_chip / REFERENCE_CLIENT_UPDATES_PER_SEC, 3)
+            if REFERENCE_CLIENT_UPDATES_PER_SEC else 0.0),
         "vs_baseline_reference": {
             "client_updates_per_sec": REFERENCE_CLIENT_UPDATES_PER_SEC,
             "derivation": REFERENCE_DERIVATION,
