@@ -13,9 +13,9 @@
 # the stop file between slices and exits. cv_train checkpoints every 50
 # rounds AND at clean exit, so a kill costs <50 rounds.
 #
-# fedavg is deliberately NOT rotated here: its per-client state forces
-# per-round dispatch and 5 local iters (~5x the per-round cost on this
-# 1-core box) — it runs on the TPU window only.
+# fedavg is deliberately NOT rotated here: its 5 local iterations make a
+# round ~5x the client compute (~2.5-3 min/round on this 1-core box, so a
+# 50-round slice alone would be ~2.2h) — it runs on the TPU window only.
 set -x
 cd "$(dirname "$0")/.."
 . scripts/tradeoff_arms.sh
