@@ -284,7 +284,8 @@ def _from_json(path: str, tok, seq_len: int, num_candidates: int = 1, seed: int 
     return build(blob["train"]), build(blob.get("valid", []))
 
 
-def _synthetic(num_clients: int, seq_len: int, tok, seed: int, num_candidates: int = 1):
+def _synthetic(num_clients: int, seq_len: int, tok, seed: int,
+               num_candidates: int = 1, hard_negatives: bool = False):
     """Persona-grouped synthetic corpus: each persona has a word-distribution
     'style' so per-client data is non-iid, as in the real set. Examples go
     through the same build_input_from_segments packing. With num_candidates >
@@ -313,9 +314,20 @@ def _synthetic(num_clients: int, seq_len: int, tok, seed: int, num_candidates: i
     # readable gold-vs-distractor signal instead, so the double-head
     # OBJECTIVE (joint loss, candidate batching, mc metrics) is testable
     # within a few rounds on a tiny model — a matching circuit is not
-    # learnable at that scale.
+    # learnable at that scale. `hard_negatives=True` switches to the real
+    # set's semantics: distractors are OTHER personas' replies from the SAME
+    # word pool, so vocabulary identity carries no signal and the MC head
+    # must match the reply against the persona sentence — mc_acc then starts
+    # at ~1/C chance and climbs only if a matching circuit forms (VERDICT r4
+    # weak #6: the easy corpus saturates mc_acc at 1.0, evidencing wiring,
+    # not discrimination).
     half = len(words) // 2
-    pool = half if num_candidates > 1 else len(words)
+    # easy MC reserves the upper half for distractors; hard MC needs
+    # DISTINGUISHABLE persona styles instead (6-of-8 favored sets would
+    # overlap ~4.5 words between any two personas, making matching
+    # hopeless), so it draws styles from the full vocabulary (expected
+    # overlap ~2.25 of 6)
+    pool = half if (num_candidates > 1 and not hard_negatives) else len(words)
     personas = []
     for c in range(num_clients):
         favored = rng.choice(pool, size=6, replace=False)
@@ -325,15 +337,44 @@ def _synthetic(num_clients: int, seq_len: int, tok, seed: int, num_candidates: i
     for c, (favored, texts) in enumerate(personas):
         if num_candidates > 1:
             persona_sents = [tok.encode("likes " + " ".join(words[i] for i in favored))]
+            # Replies must FIT next to the persona: pack_example's overflow
+            # policy truncates the persona before the reply, and with the
+            # byte tokenizer (~5 tokens/word) gen_text's seq_len//4-word cap
+            # overflows — measured 22% of rows losing the whole persona
+            # prefix at seq_len=256, which silently destroys the
+            # persona-matching signal hard_negatives exists to create.
+            # Budget: bos + persona + speaker + reply + eos <= seq_len.
+            reply_budget = seq_len - len(persona_sents[0]) - 3
+
+            def fit(text):
+                ws = text.split()
+                enc = tok.encode(" ".join(ws))
+                while ws and len(enc) > reply_budget:
+                    ws = ws[:-1]
+                    enc = tok.encode(" ".join(ws))
+                return enc
+
+            other_ids = [i for i in range(num_clients) if i != c] or [c]
             seqs = []
             for text in texts:
-                others = [
-                    gen_text(half + rng.choice(half, size=6, replace=False))
-                    for _ in range(num_candidates - 1)
-                ]
+                if hard_negatives:
+                    # distractors = replies in OTHER personas' styles from
+                    # the same full-vocabulary pool (see the pool comment
+                    # above): no vocabulary marker separates them from the
+                    # gold reply, matching the real set's random-other-
+                    # utterance semantics
+                    others = [
+                        gen_text(personas[o][0])
+                        for o in rng.choice(other_ids, size=num_candidates - 1)
+                    ]
+                else:
+                    others = [
+                        gen_text(half + rng.choice(half, size=6, replace=False))
+                        for _ in range(num_candidates - 1)
+                    ]
                 seqs.append(_pack_candidates(
-                    persona_sents, [], tok.encode(text),
-                    [tok.encode(o) for o in others], tok, seq_len, rng,
+                    persona_sents, [], fit(text),
+                    [fit(o) for o in others], tok, seq_len, rng,
                     num_candidates,
                 ))
         else:
@@ -379,16 +420,20 @@ def load_personachat_fed(
     seq_len: int = 256,
     seed: int = 0,
     num_candidates: int = 1,
+    mc_hard_negatives: bool = False,
 ):
     """Returns (train, valid, tokenizer): FedTextDataset for the LM-only
     objective (num_candidates == 1), FedTextMCDataset candidate sets for the
-    double-head LM+MC objective (num_candidates > 1)."""
+    double-head LM+MC objective (num_candidates > 1). `mc_hard_negatives`
+    only affects the synthetic fallback (the real json's distractors are
+    other utterances already — inherently hard)."""
     tok = get_tokenizer()
     path = _find_personachat_json(data_root)
     if path:
         train_p, valid_p = _from_json(path, tok, seq_len, num_candidates, seed)
     else:
-        train_p, valid_p = _synthetic(num_clients, seq_len, tok, seed, num_candidates)
+        train_p, valid_p = _synthetic(num_clients, seq_len, tok, seed,
+                                      num_candidates, mc_hard_negatives)
     valid = valid_p if valid_p else {k: v for k, v in list(train_p.items())[:10]}
     to = _to_fed_mc if num_candidates > 1 else _to_fed
     return to(train_p), to(valid), tok

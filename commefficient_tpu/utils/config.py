@@ -150,6 +150,13 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
         p.add_argument("--num_candidates", type=int, default=2,
                        help="candidates per example (gold + distractors) "
                             "when --mc_coef > 0")
+        p.add_argument("--mc_hard_negatives", action="store_true",
+                       help="synthetic corpus only: draw MC distractors "
+                            "from other personas' replies (same word pool) "
+                            "instead of a reserved vocabulary half — "
+                            "mc_acc then measures persona-reply matching, "
+                            "not token identity (real-json distractors are "
+                            "always hard)")
         p.add_argument("--moe_experts", type=int, default=0,
                        help="> 0 swaps every 2nd block's MLP for a "
                             "Switch-style top-1 MoE with this many experts "

@@ -49,6 +49,7 @@ def build(args):
     train_set, valid_set, tok = load_personachat_fed(
         args.data_root, args.num_clients, args.seq_len, args.seed,
         num_candidates=num_candidates,
+        mc_hard_negatives=args.mc_hard_negatives,
     )
     args.num_clients = train_set.num_clients
     if args.init_from:

@@ -135,3 +135,71 @@ def test_mc_eval_sharded_matches_unsharded():
     assert ref["mc_count"] > 0
     for k in ref:
         np.testing.assert_allclose(got[k], ref[k], rtol=1e-5)
+
+
+def test_mc_hard_negatives_corpus_structure():
+    """--mc_hard_negatives (VERDICT r4 weak #6): distractors come from OTHER
+    personas' replies in the SAME word pool, so token identity carries no
+    gold-vs-distractor signal; the only learnable signal is matching reply
+    words against the persona sentence. Pinned statistically: (a) in the
+    easy corpus, distractor rows are dominated by reserved upper-half
+    words; in the hard corpus they are not; (b) in the hard corpus, gold
+    replies share many more words with their persona sentence than
+    distractors do (the matching signal exists)."""
+    from commefficient_tpu.data.personachat import _synthetic
+    from commefficient_tpu.utils.tokenizer import get_tokenizer
+
+    tok = get_tokenizer()
+    words = ["the", "cat", "dog", "runs", "jumps", "likes", "hates", "sees",
+             "red", "blue", "big", "small", "fast", "slow", "happy", "sad"]
+    upper = set(words[8:])
+    # generous seq_len: replies must keep enough words next to the persona
+    # for the statistics to be meaningful (the fit() budget in _synthetic
+    # guarantees the persona survives packing at ANY seq_len; reply length
+    # is whatever budget remains)
+    seq_hard = 192
+
+    def stats(hard):
+        by_persona, _ = _synthetic(24, seq_hard, tok, seed=3,
+                                   num_candidates=C, hard_negatives=hard)
+        up_gold, up_distr, gold_overlap, distr_overlap = [], [], [], []
+        for seqs in by_persona.values():
+            for x, t, y, pos in seqs:
+                text = [tok.decode([i for i in row if i != tok.pad_id])
+                        for row in x]
+                for c in range(C):
+                    row_words = text[c].split()
+                    # the fit() budget guarantees every candidate row keeps
+                    # the full "likes w1..w6" persona prefix — a regression
+                    # that truncates it away must fail loudly here, because
+                    # it silently destroys the matching signal
+                    assert row_words and row_words[0] == "likes", (
+                        f"candidate row lost its persona prefix: {text[c]!r}")
+                    persona_words = set(row_words[1:7])
+                    reply_words = row_words[7:]
+                    ups = sum(w in upper for w in reply_words)
+                    overlap = sum(w in persona_words for w in reply_words)
+                    if c == pos:
+                        up_gold.append(ups)
+                        gold_overlap.append(overlap)
+                    else:
+                        up_distr.append(ups)
+                        distr_overlap.append(overlap)
+        mean = lambda xs: sum(xs) / max(len(xs), 1)
+        return (mean(up_gold), mean(up_distr),
+                mean(gold_overlap), mean(distr_overlap))
+
+    easy_ug, easy_ud, _, _ = stats(hard=False)
+    hard_ug, hard_ud, hard_gold, hard_distr = stats(hard=True)
+    # (a) the easy corpus is linearly separable by the reserved upper half
+    # (distractor rows dominated by it, gold rows nearly free of it); the
+    # hard corpus shows no such vocabulary marker between gold and distractor
+    assert easy_ud > 3.0 and easy_ud > 5 * (easy_ug + 0.1), (
+        f"easy marker missing: gold {easy_ug:.2f} vs distractor {easy_ud:.2f}")
+    assert hard_ud < 1.5 * (hard_ug + 0.1), (
+        f"hard corpus still vocab-separable: gold {hard_ug:.2f} "
+        f"vs distractor {hard_ud:.2f}")
+    # (b) the matching signal: gold replies overlap their persona's words
+    # far more than other-persona distractors do
+    assert hard_gold > 1.5 * hard_distr, (
+        f"no matching signal: gold {hard_gold:.2f} vs distractor {hard_distr:.2f}")
