@@ -13,6 +13,13 @@
 # the stop file between slices and exits. cv_train checkpoints every 50
 # rounds AND at clean exit, so a kill costs <50 rounds.
 #
+# Slice timeout is 4h, NOT 2h: the round-4 compile cache was built on a
+# different host CPU (AOT feature mismatch), so the FIRST slice of each
+# arm pays a fresh ~40-90 min compile of the 50-round scan module before
+# its ~35-60 min execution — and with one dispatch per slice there is no
+# intermediate checkpoint, so a timeout kill mid-dispatch banks nothing.
+# Subsequent slices hit the re-populated cache and run in execution time.
+#
 # fedavg is deliberately NOT rotated here: its 5 local iterations make a
 # round ~5x the client compute (~2.5-3 min/round on this 1-core box, so a
 # 50-round slice alone would be ~2.2h) — it runs on the TPU window only.
@@ -32,7 +39,7 @@ run_slice() {  # name, target_rounds, extra flags...
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache" \
     COMMEFFICIENT_NO_PALLAS=1 \
-    nice -n 10 env -u PALLAS_AXON_POOL_IPS timeout 7200 \
+    nice -n 10 env -u PALLAS_AXON_POOL_IPS timeout 14400 \
         python -u cv_train.py \
         --dataset cifar10 --synthetic_separation 0.025 \
         --num_clients 1000 --num_workers 16 --local_batch_size 8 \
