@@ -6,9 +6,11 @@
 # measured row instead of a paper citation. FedAvg sends dense weights
 # down + deltas up but takes 5 local iterations per round, so its
 # accuracy-per-round is high and its comm-per-accuracy is the interesting
-# column. 96 rounds matches the sketch arms' horizon; checkpoint/resume
-# so a kill costs <=24 rounds. Runs on the CPU mesh (femnist CNN rounds
-# are ~19s there; fedavg's 5 local iters make it ~60-100s).
+# column. Horizon is 32 rounds, not the sketch arms' 96: the uncompressed
+# control saturates (1.000) by round 48 and fedavg sees 5x the data per
+# round, so the equal-accuracy crossing lands well before 32 — and on the
+# round-5 host (~3-4x slower than round 4's, see ROUND5_NOTES.md) 96
+# fedavg rounds would take ~7h. Checkpoint/resume every 8.
 set -x
 cd "$(dirname "$0")/.."
 mkdir -p results/logs .jax_cache
@@ -19,9 +21,9 @@ JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache" COMMEFFICIENT_NO_PALLAS=1 \
 nice -n 10 env -u PALLAS_AXON_POOL_IPS timeout 14400 python -u cv_train.py \
     --dataset femnist --mode fedavg --num_local_iters 5 \
     --momentum_type virtual --momentum 0.9 --error_type none \
-    --num_clients 200 --num_workers 8 --num_rounds 96 --num_epochs 4 \
+    --num_clients 200 --num_workers 8 --num_rounds 32 --num_epochs 4 \
     --pivot_epoch 1 --eval_every 8 --lr_scale 0.03 --seed 42 \
-    --checkpoint_dir ckpt_femnist_fedavg --checkpoint_every 24 --resume \
+    --checkpoint_dir ckpt_femnist_fedavg --checkpoint_every 8 --resume \
     --log_jsonl results/femnist_smoke_fedavg.jsonl \
     >> results/logs/femnist_fedavg_r05.log 2>&1
 rc=$?

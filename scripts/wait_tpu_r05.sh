@@ -14,12 +14,14 @@ case "${1:-}" in
     *) BUDGET=$1; shift ;;
 esac
 DEADLINE=$(( $(date +%s) + BUDGET ))
+FAILS=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     if timeout 75 python -c "
 import jax, jax.numpy as jnp
 assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
 x = jnp.ones((128,128))
 print('tunnel alive:', float(jax.device_get((x@x).sum())))" 2>/dev/null | grep -q "tunnel alive"; then
+        FAILS=0
         echo "=== tunnel recovered at $(date -u +%H:%M:%S) — running window (phases: ${*:-all}) ==="
         bash scripts/tpu_window_r05.sh "$@" 2>&1
         rc=$?
@@ -28,8 +30,13 @@ print('tunnel alive:', float(jax.device_get((x@x).sum())))" 2>/dev/null | grep -
             exit "$rc"
         fi
         echo "=== CHIP DEAD gate (rc=$rc) at $(date -u +%H:%M:%S); resuming probe loop ==="
+    else
+        FAILS=$((FAILS + 1))
     fi
-    sleep 20
+    # each probe costs ~3s of the single core on jax import alone; after 30
+    # straight failures (~10 min down) back off to 60s — still catches a
+    # recovery window within a minute, stops starving the CPU-mesh studies
+    if [ "$FAILS" -ge 30 ]; then sleep 60; else sleep 20; fi
 done
 echo "still wedged at $(date -u +%H:%M:%S)"
 exit 7
