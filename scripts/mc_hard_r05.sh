@@ -15,12 +15,12 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache" COMMEFFICIENT_NO_PALLAS=1 \
 nice -n 10 env -u PALLAS_AXON_POOL_IPS timeout 7200 python -u gpt2_train.py \
     --model_size tiny --seq_len 128 --num_clients 64 --num_workers 8 \
-    --local_batch_size 2 --num_rounds 400 --num_epochs 8 --eval_every 40 \
+    --local_batch_size 2 --num_rounds 400 --num_epochs 50 --pivot_epoch 10 --eval_every 40 \
     --mc_coef 8 --num_candidates 4 --mc_hard_negatives \
-    --mode sketch --k 5000 --num_cols 16384 --num_rows 5 --num_blocks 2 \
-    --momentum_type virtual --error_type virtual \
+    --mode uncompressed \
+    --momentum_type virtual --error_type none \
     --checkpoint_dir ckpt_mc_hard --checkpoint_every 80 --resume \
-    --lr_scale 0.1 --seed 7 \
+    --lr_scale 0.04 --seed 7 \
     --log_jsonl results/personachat_mc_hard.jsonl \
     >> results/logs/mc_hard_r05.log 2>&1
 rc=$?
