@@ -28,6 +28,19 @@ def test_supported_layouts():
     assert not pk.supported(CSVecSpec(d=124_000_000, c=8_388_608, r=5, family="rotation"))
 
 
+def test_vmem_budget_selection():
+    """Flagship dims keep the 48 MiB scoped limit (compile-cache stability);
+    GPT-2 dims (c=2^20 r=5, whose accumulate kernel measures 48.21 MiB —
+    the round-5 phase-E OOM) get the 96 MiB limit; the model stays an upper
+    bound on Mosaic's measured footprint at the known calibration point."""
+    small = pk._compiler_params(524_288, 5).vmem_limit_bytes
+    large = pk._compiler_params(1_048_576, 5).vmem_limit_bytes
+    assert small == pk._VMEM_SMALL_BYTES
+    assert large == pk._VMEM_LARGE_BYTES
+    # calibration: measured 48.21 MiB at c=2^20 r=5 must fit under the model
+    assert pk._worst_case_vmem(1_048_576, 5) >= int(48.21 * 1024 * 1024)
+
+
 def test_accumulate_matches_oracle():
     v = _v(0, SPEC.d)
     got = pk.sketch_vec(SPEC, v, interpret=True)
