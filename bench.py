@@ -509,10 +509,18 @@ def _timed_chains(step, state, batch, num_chains, chain_len, rt_ms):
     return per_round_ms, state
 
 
-def _flops_per_round(step, state, batch):
+def _flops_per_round(step, state, batch, chunk_trips=1):
     """XLA's own cost analysis of the compiled round step (flops for the
     whole round: W clients fwd+bwd + sketch accumulate/query + server step).
-    For the split engine, the round is two programs — sum both."""
+    For the split engine, the round is two programs — sum both.
+
+    XLA's HLO cost analysis counts a while-loop (lax.scan) body ONCE, so
+    when the client step scans over client chunks (BENCH_CLIENT_CHUNK > 0,
+    W > chunk) the client flops come out divided by the trip count —
+    BENCH_flagship_w256_r05.json recorded the same flops as W=64 and an MFU
+    understated 4x. `chunk_trips` = W // chunk re-scales the client program
+    (its flops are ~entirely inside the scan body; the residue outside is
+    reduce/compress epsilon). Returns (flops, note_or_None)."""
     import jax
     import jax.numpy as jnp
 
@@ -522,18 +530,33 @@ def _flops_per_round(step, state, batch):
             cost = cost[0]
         return float(cost.get("flops", 0.0))
 
+    def note_for(scope):
+        if chunk_trips <= 1:
+            return None
+        return (
+            f"{scope} flops scaled x{chunk_trips}: XLA cost analysis "
+            "counts the client_chunk lax.scan body once"
+        )
+
     try:
         lr, rng = jnp.float32(0.01), jax.random.PRNGKey(0)
         if hasattr(step, "_parts"):
             cstep, sstep = step._parts
-            f1 = cost_of(cstep.lower(state, batch, lr, rng))
+            f1 = cost_of(cstep.lower(state, batch, lr, rng)) * chunk_trips
             w, nns, met, nrng = jax.eval_shape(cstep, state, batch, lr, rng)
             f2 = cost_of(sstep.lower(state, w, nns, met["participants"], lr, nrng))
-            return (f1 + f2) or None
+            total = f1 + f2
+            return (total, note_for("client-step")) if total else (None, None)
         lowered = step.lower(state, batch, {}, lr, rng)
-        return cost_of(lowered) or None
+        # fused: one program; the scan body holds the client convs, which
+        # dominate total flops, so whole-program scaling is a close upper
+        # bound (server sketch ops carry few flops — and the note says so)
+        total = cost_of(lowered) * chunk_trips
+        return (total, note_for(
+            "whole-program (server ops included; slight overcount)"
+        )) if total else (None, None)
     except Exception:
-        return None
+        return None, None
 
 
 def _analytic_resnet9_flops(workers: int, local_batch: int) -> float:
@@ -798,7 +821,10 @@ def run_bench(platform: str) -> dict:
     updates_per_sec_per_chip = workers / (round_ms / 1e3) / n_chips
 
     _stage("running XLA cost analysis ...")
-    flops = _flops_per_round(step, state, batch)
+    chunk_trips = (
+        workers // cfg.client_chunk
+        if cfg.client_chunk and workers > cfg.client_chunk else 1)
+    flops, flops_note = _flops_per_round(step, state, batch, chunk_trips)
     _stage("kernel microbench ...")
     microbench = _kernel_microbench(platform, rt_ms)
     _stage(f"microbench: {microbench}")
@@ -843,6 +869,7 @@ def run_bench(platform: str) -> dict:
         "sync_method": "device_get(scalar) per chain, tunnel round-trip "
                        f"{round(rt_ms, 2)} ms subtracted",
         "flops_per_round_xla": flops,
+        **({"flops_per_round_xla_note": flops_note} if flops_note else {}),
         "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
         "bf16_peak_tflops": round(peak / 1e12, 1) if peak else None,
         "mfu": round(mfu, 4) if mfu else None,

@@ -10,28 +10,39 @@ import jax.numpy as jnp
 import pytest
 
 
-@pytest.fixture()
-def bench_mod(monkeypatch):
+def _import_bench(monkeypatch, **env):
+    """Fresh bench import under `env`, with teardown that restores
+    COMMEFFICIENT_NO_PALLAS: importing bench mutates it process-wide
+    (bench.py's engine-routing knob: oracle mode SETS =1, the round-5
+    default auto mode POPS it); without restore, every later in-process
+    test sees the pallas library force-toggled — test_pallas's routing
+    assertions fail by test ORDER, not by code (observed: 187/188 with
+    this fixture first, in the oracle-default era)."""
     import importlib
     import os
     import sys
 
-    monkeypatch.setenv("BENCH_MODEL", "resnet9")
-    # importing bench mutates COMMEFFICIENT_NO_PALLAS process-wide
-    # (bench.py's engine-routing knob: oracle mode SETS =1, the round-5
-    # default auto mode POPS it); without restore, every later in-process
-    # test sees the pallas library force-toggled — test_pallas's routing
-    # assertions fail by test ORDER, not by code (observed: 187/188 with
-    # this fixture first, in the oracle-default era)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
     prior = os.environ.get("COMMEFFICIENT_NO_PALLAS")
     sys.modules.pop("bench", None)
     mod = importlib.import_module("bench")
+
+    def teardown():
+        sys.modules.pop("bench", None)
+        if prior is None:
+            os.environ.pop("COMMEFFICIENT_NO_PALLAS", None)
+        else:
+            os.environ["COMMEFFICIENT_NO_PALLAS"] = prior
+
+    return mod, teardown
+
+
+@pytest.fixture()
+def bench_mod(monkeypatch):
+    mod, teardown = _import_bench(monkeypatch, BENCH_MODEL="resnet9")
     yield mod
-    sys.modules.pop("bench", None)
-    if prior is None:
-        os.environ.pop("COMMEFFICIENT_NO_PALLAS", None)
-    else:
-        os.environ["COMMEFFICIENT_NO_PALLAS"] = prior
+    teardown()
 
 
 def test_time_adaptive_measures_real_compute(bench_mod):
@@ -112,3 +123,43 @@ def test_server_split_reports_all_ops(bench_mod, monkeypatch):
     for key in ("accumulate_ms", "estimates_ms", "topk_exact_ms", "topk_approx_ms"):
         assert key in out and out[key] >= 0.0, (key, out)
     assert out["d"] == 4096 and out["k"] == 64
+
+
+def test_flops_chunked_matches_unchunked(monkeypatch):
+    """XLA cost analysis counts a lax.scan body ONCE, so the chunked client
+    step (BENCH_CLIENT_CHUNK > 0) undercounts flops by the trip count —
+    BENCH_flagship_w256_r05.json carried W=64's flops at W=256 and an MFU
+    understated 4x. _flops_per_round's chunk_trips rescaling must bring the
+    chunked estimate back to the unchunked one (same W, same dims)."""
+    bench, teardown = _import_bench(
+        monkeypatch, BENCH_MODEL="resnet9", BENCH_WORKERS="4",
+        BENCH_LOCAL_BATCH="1", BENCH_COLS="256", BENCH_TOPK="32",
+        BENCH_BLOCKS="1", BENCH_DTYPE="float32",
+    )
+    try:
+        from jax.flatten_util import ravel_pytree
+
+        params, net_state, batch, loss_fn, _, sketch_kw, workers = (
+            bench._resnet9_workload())
+        d = ravel_pytree(params)[0].size
+
+        def build(chunk):
+            monkeypatch.setenv("BENCH_CLIENT_CHUNK", str(chunk))
+            eng, mode_cfg, cfg, step = bench._make_step(loss_fn, sketch_kw, d)
+            state = eng.init_server_state(
+                cfg, jax.tree.map(jnp.copy, params),
+                jax.tree.map(jnp.copy, net_state))
+            return cfg, step, state
+
+        _, step0, state0 = build(0)
+        f0, note0 = bench._flops_per_round(step0, state0, batch, 1)
+        cfg1, step1, state1 = build(2)
+        trips = workers // cfg1.client_chunk
+        assert trips == 2
+        f1, note1 = bench._flops_per_round(step1, state1, batch, trips)
+        assert note0 is None and note1 is not None
+        assert f0 and f1
+        # scan plumbing adds epsilon; the convs dominate, so within 10%
+        assert abs(f1 - f0) / f0 < 0.10, (f0, f1)
+    finally:
+        teardown()
