@@ -380,9 +380,10 @@ def make_round_step(
         # server applies the averaged delta at the configured server rate
         # ("slowmo" when combined with virtual momentum)
         server_lr = jnp.float32(mcfg.server_lr) if mcfg.uses_weight_delta else lr
-        delta, mode_state = modes.server_step(mcfg, agg, state["mode_state"], server_lr)
+        delta, mode_state = modes.server_step_sparse(
+            mcfg, agg, state["mode_state"], server_lr)
         new_state = {
-            "params": unravel(pflat - delta),
+            "params": unravel(modes.apply_delta(pflat, delta)),
             "net_state": new_net_state,
             "mode_state": mode_state,
             "round": state["round"] + 1,
@@ -394,7 +395,7 @@ def make_round_step(
             # rounds' coordinates, and DP noise densifies it entirely — the
             # accounting in run_round caps the pair encoding at the dense-
             # float cost a real server would switch to past the crossover.
-            out_metrics["down_support"] = jnp.count_nonzero(delta).astype(jnp.float32)
+            out_metrics["down_support"] = modes.delta_support(mcfg.d, delta)
         return new_state, new_rows, out_metrics
 
     return step
@@ -456,9 +457,10 @@ def make_split_round_step(
         agg = _compress_reduced(mcfg, weighted)
         if cfg.dp_noise > 0:
             agg = _dp_noise_agg(cfg, agg, participants, noise_rng)
-        delta, mode_state = modes.server_step(mcfg, agg, state["mode_state"], lr)
+        delta, mode_state = modes.server_step_sparse(
+            mcfg, agg, state["mode_state"], lr)
         return {
-            "params": unravel(pflat - delta),
+            "params": unravel(modes.apply_delta(pflat, delta)),
             "net_state": new_net_state,
             "mode_state": mode_state,
             "round": state["round"] + 1,

@@ -40,16 +40,18 @@ from .config import ModeConfig
 
 
 def topk_dense(
-    v: jnp.ndarray, k: int, impl: str = "exact"
+    v: jnp.ndarray, k: int, impl: str = "exact", recall: float = 0.95
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(idx[k], vals[k]) of the k largest-|.| coordinates of dense v.
 
-    impl="approx" uses `lax.approx_max_k` (TPU PartialReduce lowering,
-    recall_target 0.95; exact on backends without the lowering) — at
-    d in the millions the exact sort-based top_k is a wall-clock soft spot
-    on TPU, and top-k compression is itself a heuristic, so a 95%-recall
-    selection preserves the algorithm's semantics (ModeConfig.topk_impl)."""
-    idx = csvec.topk_abs(v, k, approx=impl == "approx")
+    impl="approx" uses `lax.approx_max_k` (TPU PartialReduce lowering at
+    `recall`; exact on backends without the lowering) — at d in the
+    millions the exact sort-based top_k is a wall-clock soft spot on TPU.
+    Top-k compression is itself a heuristic, but the recall target is NOT
+    free: the paper-scale sketch arm measured ~3-4 accuracy points lost at
+    recall 0.95 vs exact (results/paper_sketchapprox.jsonl), so
+    ModeConfig.topk_recall exposes the dial."""
+    idx = csvec.topk_abs(v, k, approx=impl == "approx", recall=recall)
     return idx, v[idx]
 
 
@@ -128,7 +130,7 @@ def client_compress(cfg: ModeConfig, update: jnp.ndarray, cstate: dict) -> tuple
             u = cstate["error"] + acc
         else:
             u = acc
-        idx, vals = topk_dense(u, cfg.k, cfg.topk_impl)
+        idx, vals = topk_dense(u, cfg.k, cfg.topk_impl, cfg.topk_recall)
         if cfg.error_type == "local":
             new_state["error"] = u - csvec.to_dense(cfg.d, idx, vals)
         return {"idx": idx, "vals": vals}, new_state
@@ -176,11 +178,20 @@ def aggregate(cfg: ModeConfig, wires: dict, weights=None) -> dict:
 # ------------------------------------------------------------- server side
 
 
-def server_step(
+def server_step_sparse(
     cfg: ModeConfig, agg: dict, sstate: dict, lr: jnp.ndarray
-) -> tuple[jnp.ndarray, dict]:
-    """Server momentum + error feedback; returns (delta[d], new_state).
-    New params are `params - delta`."""
+) -> tuple[dict, dict]:
+    """Server momentum + error feedback; returns (delta_wire, new_state)
+    with the delta in wire form: {"idx", "vals"} (k-sparse; sketch /
+    true_topk / local_topk-virtual) or {"dense"} (the other modes). New
+    params are `apply_delta(pflat, delta_wire)`.
+
+    Why wire form: at GPT-2 scale (d ~ 124M) densifying a 50k-sparse delta
+    just so the caller can subtract it costs ~1 GB of HBM traffic per round
+    (write d + read d); a k-element scatter-subtract is bit-identical
+    (x - 0.0 == x and x - v == x + (-v) in IEEE; top-k indices are unique)
+    and touches only the selected rows. The dense-state updates below use
+    the same scatter forms for the same reason."""
     rho = cfg.momentum if cfg.momentum_type == "virtual" else 0.0
 
     if cfg.mode == "sketch":
@@ -189,8 +200,8 @@ def server_step(
         S = agg["table"]
         V = rho * sstate["Vvelocity"] + S
         E = sstate["Verror"] + lr * V
-        idx, vals = csvec.unsketch_topk(spec, E, cfg.k, impl=cfg.topk_impl)
-        delta = csvec.to_dense(cfg.d, idx, vals)
+        idx, vals = csvec.unsketch_topk(spec, E, cfg.k, impl=cfg.topk_impl,
+                                        recall=cfg.topk_recall)
         E = E - csvec.sketch_sparse(spec, idx, vals)
         # Momentum factor masking, sketch-space: zero V's (estimated) mass at
         # the transmitted coordinates — the sketch analogue of true_topk's
@@ -199,7 +210,7 @@ def server_step(
         # lr-translatable (see ModeConfig.agg_op).
         vvals = csvec.query(spec, V, idx)
         V = V - csvec.sketch_sparse(spec, idx, vvals)
-        return delta, {"Vvelocity": V, "Verror": E}
+        return {"idx": idx, "vals": vals}, {"Vvelocity": V, "Verror": E}
 
     g = agg["dense"]
 
@@ -207,14 +218,12 @@ def server_step(
         V = rho * sstate["Vvelocity"] + g
         use_error = cfg.error_type != "none"
         E = sstate["Verror"] + lr * V if use_error else lr * V
-        idx, vals = topk_dense(E, cfg.k, cfg.topk_impl)
-        delta = csvec.to_dense(cfg.d, idx, vals)
+        idx, vals = topk_dense(E, cfg.k, cfg.topk_impl, cfg.topk_recall)
         # mask from the selected indices, not delta's values: a transmitted
         # coordinate whose value happens to be 0 must still be masked.
-        mask = csvec.to_dense(cfg.d, idx, jnp.ones((cfg.k,), dtype=V.dtype))
-        E = (E - delta) if use_error else sstate["Verror"]
-        V = V * (1.0 - mask)  # momentum factor masking
-        return delta, {"Vvelocity": V, "Verror": E}
+        E = E.at[idx].add(-vals) if use_error else sstate["Verror"]
+        V = V.at[idx].set(0.0)  # momentum factor masking
+        return {"idx": idx, "vals": vals}, {"Vvelocity": V, "Verror": E}
 
     if cfg.mode == "local_topk":
         # Clients already applied per-client top-k (and local momentum/error
@@ -226,18 +235,53 @@ def server_step(
         V = rho * sstate["Vvelocity"] + g
         if cfg.error_type == "virtual":
             E = sstate["Verror"] + lr * V
-            idx, vals = topk_dense(E, cfg.k, cfg.topk_impl)
-            delta = csvec.to_dense(cfg.d, idx, vals)
-            mask = csvec.to_dense(cfg.d, idx, jnp.ones((cfg.k,), dtype=V.dtype))
-            return delta, {"Vvelocity": V * (1.0 - mask), "Verror": E - delta}
-        return lr * V, {"Vvelocity": V, "Verror": sstate["Verror"]}
+            idx, vals = topk_dense(E, cfg.k, cfg.topk_impl, cfg.topk_recall)
+            return {"idx": idx, "vals": vals}, {
+                "Vvelocity": V.at[idx].set(0.0),
+                "Verror": E.at[idx].add(-vals),
+            }
+        return {"dense": lr * V}, {"Vvelocity": V, "Verror": sstate["Verror"]}
 
     if cfg.mode in ("fedavg", "localSGD"):
         # agg is the mean weight delta (w_start - w_local); local steps already
         # carry the client lr, so server lr defaults to 1 (slowmo via momentum).
         V = rho * sstate["Vvelocity"] + g
-        return lr * V, {"Vvelocity": V, "Verror": sstate["Verror"]}
+        return {"dense": lr * V}, {"Vvelocity": V, "Verror": sstate["Verror"]}
 
     # uncompressed: plain SGD with (virtual) momentum — the bit-for-bit control
     V = rho * sstate["Vvelocity"] + g
-    return lr * V, {"Vvelocity": V, "Verror": sstate["Verror"]}
+    return {"dense": lr * V}, {"Vvelocity": V, "Verror": sstate["Verror"]}
+
+
+def apply_delta(pflat: jnp.ndarray, delta: dict) -> jnp.ndarray:
+    """params - delta for a wire-form delta (see server_step_sparse).
+    Honors idx = -1 padding (zero contribution) like every other sparse
+    consumer (to_dense, sketch_sparse): clip + zero, since a raw -1 would
+    wrap to pflat[d-1] — harmless only while padded vals are 0.0."""
+    if "dense" in delta:
+        return pflat - delta["dense"]
+    idx = delta["idx"]
+    vals = delta["vals"].astype(pflat.dtype)
+    safe = jnp.clip(idx, 0, pflat.shape[0] - 1)
+    return pflat.at[safe].add(-jnp.where(idx >= 0, vals, 0.0))
+
+
+def delta_support(d: int, delta: dict) -> jnp.ndarray:
+    """Nonzero-coordinate count of the broadcast delta (local_topk downlink
+    accounting). Sparse wires have unique indices, so counting nonzero vals
+    equals counting the nonzero coordinates of the densified delta."""
+    target = delta["dense"] if "dense" in delta else delta["vals"]
+    return jnp.count_nonzero(target).astype(jnp.float32)
+
+
+def server_step(
+    cfg: ModeConfig, agg: dict, sstate: dict, lr: jnp.ndarray
+) -> tuple[jnp.ndarray, dict]:
+    """Server momentum + error feedback; returns (delta[d], new_state).
+    New params are `params - delta`. Densifying wrapper over
+    server_step_sparse — the engine's hot path uses the sparse form; this
+    form serves callers that want the dense delta (tests, analysis)."""
+    delta, new_state = server_step_sparse(cfg, agg, sstate, lr)
+    if "dense" in delta:
+        return delta["dense"], new_state
+    return csvec.to_dense(cfg.d, delta["idx"], delta["vals"]), new_state

@@ -250,3 +250,69 @@ def test_topk_impl_validation():
     with pytest.raises(ValueError):
         ModeConfig(mode="true_topk", d=100, k=5, momentum_type="none",
                    error_type="none", topk_impl="bogus")
+
+
+@pytest.mark.parametrize("mode_kw", [
+    dict(mode="sketch", k=4, num_rows=3, num_cols=64, d=256,
+         momentum_type="virtual", error_type="virtual"),
+    dict(mode="true_topk", k=4, d=256, momentum_type="virtual",
+         error_type="virtual"),
+    dict(mode="true_topk", k=4, d=256, momentum_type="virtual",
+         error_type="none"),
+    dict(mode="local_topk", k=4, d=256, momentum_type="none",
+         error_type="virtual"),
+    dict(mode="local_topk", k=4, d=256, momentum_type="none",
+         error_type="local"),
+    dict(mode="fedavg", d=256, num_local_iters=2),
+    dict(mode="uncompressed", d=256, momentum_type="virtual"),
+], ids=lambda kw: f"{kw['mode']}-{kw.get('error_type', 'none')}")
+def test_server_step_sparse_matches_dense(mode_kw):
+    """The engine's hot path (server_step_sparse + apply_delta scatter) must
+    be BIT-IDENTICAL to the dense contract (server_step + pflat - delta):
+    x - 0.0 == x and x + (-v) == x - v in IEEE, and top-k indices are
+    unique — so any drift here is a real bug, not float noise."""
+    d = mode_kw["d"]
+    cfg = _cfg(**mode_kw)
+    rng = np.random.RandomState(3)
+    g = jnp.asarray(rng.randn(d).astype(np.float32))
+    cstate = jax.tree.map(  # one client's slice of the per-client state
+        lambda x: x[0], modes.init_client_state(cfg, num_clients=1)) or {}
+    wire, _ = modes.client_compress(cfg, g, cstate)
+    agg = modes.aggregate(cfg, jax.tree.map(lambda x: x[None], wire))
+    pflat = jnp.asarray(rng.randn(d).astype(np.float32))
+    lr = jnp.float32(0.1)
+
+    # two rounds so momentum/error state differences would compound
+    s_dense = modes.init_server_state(cfg)
+    s_sparse = jax.tree.map(jnp.copy, s_dense)
+    for _ in range(2):
+        delta_dense, s_dense = modes.server_step(cfg, agg, s_dense, lr)
+        p_dense = pflat - delta_dense
+        delta_wire, s_sparse = modes.server_step_sparse(cfg, agg, s_sparse, lr)
+        p_sparse = modes.apply_delta(pflat, delta_wire)
+        np.testing.assert_array_equal(np.asarray(p_dense), np.asarray(p_sparse))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            s_dense, s_sparse)
+        # downlink support accounting must agree with the densified delta
+        np.testing.assert_array_equal(
+            np.asarray(modes.delta_support(d, delta_wire)),
+            np.count_nonzero(np.asarray(delta_dense)))
+        pflat = p_sparse
+
+
+def test_topk_recall_knob():
+    """topk_recall plumbing: validation bounds, and the recall kwarg reaches
+    approx_max_k (on CPU the lowering is exact regardless, so this pins the
+    wiring + exact-mode independence, not the recall behavior itself)."""
+    with pytest.raises(ValueError):
+        _cfg(mode="true_topk", k=2, topk_recall=1.5)
+    with pytest.raises(ValueError):
+        _cfg(mode="true_topk", k=2, topk_recall=0.0)
+    v = jnp.asarray(np.random.RandomState(0).randn(64).astype(np.float32))
+    i1, v1 = modes.topk_dense(v, 4, "approx", recall=0.99)
+    i2, v2 = modes.topk_dense(v, 4, "exact")
+    np.testing.assert_array_equal(np.sort(np.asarray(i1)), np.sort(np.asarray(i2)))
+    # values must be the ORIGINAL (signed) coordinates, not |.| scores
+    np.testing.assert_array_equal(np.sort(np.asarray(v1)), np.sort(np.asarray(v2)))
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v)[np.asarray(i1)])
