@@ -35,9 +35,14 @@ class ModeConfig:
     # "rotation" is the TPU-fast default, "random" the reference-like one
     topk_impl: str = "exact"  # server/client top-k selection: "exact"
     # (lax.top_k) or "approx" (lax.approx_max_k, TPU PartialReduce lowering
-    # at 0.95 recall; exact elsewhere). Top-k compression is itself a
-    # heuristic, so approx preserves semantics while dodging the TPU
-    # sort-based top_k at d in the millions.
+    # at topk_recall; exact elsewhere). Approx dodges the TPU sort-based
+    # top_k at d in the millions, but NOT for free: the paper-scale sketch
+    # arm lost ~3-4 accuracy points at recall 0.95 vs exact
+    # (results/paper_sketchapprox.jsonl) — the error-feedback loop does not
+    # fully absorb the missed heavy hitters at 1% participation.
+    topk_recall: float = 0.95  # approx_max_k recall_target when
+    # topk_impl="approx"; raise toward 0.99+ to trade speed back for the
+    # selection quality the study above measured.
     agg_op: str = "mean"  # how client wires combine: "mean" | "sum".
     # FetchSGD Alg. 1 writes the round sketch as a sum over client sketches
     # (SURVEY.md §3.1) with the scaling absorbed into the learning rate; this
@@ -62,6 +67,9 @@ class ModeConfig:
             raise ValueError(f"mode={self.mode} requires k > 0")
         if self.topk_impl not in ("exact", "approx"):
             raise ValueError(f"bad topk_impl {self.topk_impl!r}")
+        if not (0.0 < self.topk_recall <= 1.0):
+            raise ValueError(f"topk_recall must be in (0, 1], got "
+                             f"{self.topk_recall}")
         if self.momentum_type not in ("none", "virtual", "local"):
             raise ValueError(f"bad momentum_type {self.momentum_type!r}")
         if self.error_type not in ("none", "virtual", "local"):

@@ -296,12 +296,17 @@ def query_all(spec: CSVecSpec, table: jnp.ndarray) -> jnp.ndarray:
     return blocks.reshape(-1)[: spec.d]
 
 
-def topk_abs(x: jnp.ndarray, k: int, approx: bool) -> jnp.ndarray:
+def topk_abs(
+    x: jnp.ndarray, k: int, approx: bool, recall: float = 0.95
+) -> jnp.ndarray:
     """Indices of the k largest-|.| entries; approx uses lax.approx_max_k
-    (TPU PartialReduce, expected recall 0.95; exact lowering elsewhere).
-    Single home for the approx/exact branch (ModeConfig.topk_impl)."""
+    (TPU PartialReduce at `recall`; exact lowering elsewhere). Single home
+    for the approx/exact branch (ModeConfig.topk_impl / topk_recall —
+    the paper-scale study measured recall 0.95 costing ~3-4 accuracy
+    points vs exact on the sketch arm, results/paper_sketchapprox.jsonl,
+    so the recall target is a tunable, not a constant)."""
     if approx:
-        _, idx = jax.lax.approx_max_k(jnp.abs(x), k, recall_target=0.95)
+        _, idx = jax.lax.approx_max_k(jnp.abs(x), k, recall_target=recall)
     else:
         _, idx = jax.lax.top_k(jnp.abs(x), k)
     return idx.astype(jnp.int32)
@@ -317,7 +322,8 @@ UNSKETCH_SINGLE_SHOT_BYTES = 1 << 30
 
 
 def unsketch_topk(
-    spec: CSVecSpec, table: jnp.ndarray, k: int, impl: str = "exact"
+    spec: CSVecSpec, table: jnp.ndarray, k: int, impl: str = "exact",
+    recall: float = 0.95,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Top-k heavy hitters by |estimate|: (idx[k], vals[k]) (CSVec.unSketch(k)).
 
@@ -345,7 +351,7 @@ def unsketch_topk(
 
         if _use_pallas(spec) or spec.d * 4 <= UNSKETCH_SINGLE_SHOT_BYTES:
             est = query_all(spec, table)  # routes Pallas/oracle internally
-            top_idx = topk_abs(est, k, approx)
+            top_idx = topk_abs(est, k, approx, recall)
             return top_idx, est[top_idx]
 
         def chunk_estimates(slab):
@@ -365,7 +371,8 @@ def unsketch_topk(
         valid = idx < spec.d
         if approx and est.shape[0] > k:
             # within-chunk preselection (the one approximate pass)
-            pre = topk_abs(jnp.where(valid, est, 0.0), k, approx=True)
+            pre = topk_abs(jnp.where(valid, est, 0.0), k, approx=True,
+                           recall=recall)
             idx, est, valid = idx[pre], est[pre], valid[pre]
         cand_idx = jnp.concatenate([run_idx, idx])
         cand_vals = jnp.concatenate([run_vals, jnp.where(valid, est, 0.0)])

@@ -31,7 +31,11 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "(default), random = reference-like per-coordinate hashing")
     p.add_argument("--topk_impl", default="exact", choices=["exact", "approx"],
                    help="top-k selection: exact (lax.top_k) or approx "
-                        "(lax.approx_max_k, TPU-fast at 0.95 recall)")
+                        "(lax.approx_max_k, TPU-fast at --topk_recall; the "
+                        "paper-scale study measured ~3-4 acc points lost at "
+                        "recall 0.95 — results/paper_sketchapprox.jsonl)")
+    p.add_argument("--topk_recall", type=float, default=0.95,
+                   help="approx_max_k recall_target when --topk_impl approx")
     p.add_argument("--agg_op", default="mean", choices=["mean", "sum"],
                    help="client-wire aggregation: mean (cohort-size-independent "
                         "default) or sum (FetchSGD Alg. 1 semantics — use with "
@@ -215,4 +219,5 @@ def mode_config_from_args(args: argparse.Namespace, d: int) -> ModeConfig:
         hash_family=args.hash_family,
         agg_op=args.agg_op,
         topk_impl=args.topk_impl,
+        topk_recall=args.topk_recall,
     )
