@@ -436,7 +436,12 @@ def _gpt2_workload():
 
     from commefficient_tpu.models.losses import make_lm_loss
 
-    workers = int(os.environ.get("BENCH_WORKERS", 4))
+    # W=16 (was 4 through r5 session 2): the sketch-server step is
+    # W-independent (58 ms at d=124M, BENCH_gpt2_phases_r05.json), so the
+    # per-chip updates/s headline is server-wall-bound until the cohort
+    # amortizes it; client_chunk (default 4 for gpt2, _make_step) bounds
+    # HBM at 4 concurrent [d] grads (~2 GB) regardless of W.
+    workers = int(os.environ.get("BENCH_WORKERS", 16))
     cfg, model, seq, size = _gpt2_model(BENCH_DTYPE)
     ids0 = jnp.zeros((1, seq), dtype=jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids0, train=False)["params"]
@@ -464,13 +469,23 @@ def _make_step(loss_fn, sketch_kw, d):
     mode_cfg = ModeConfig(
         mode="sketch", d=d, momentum_type="virtual", error_type="virtual",
         topk_impl=os.environ.get("BENCH_TOPK_IMPL", "exact"),
+        topk_recall=float(os.environ.get("BENCH_TOPK_RECALL", 0.95)),
         **sketch_kw,
     )
     # BENCH_CLIENT_CHUNK > 0 scans grads in client chunks (HBM ceiling for
-    # big-cohort GPT-2 rounds; engine._weighted_client_reduce)
+    # big-cohort GPT-2 rounds; engine._weighted_client_reduce). gpt2
+    # defaults to gcd(4, W): W=16 unchunked would vmap 16 concurrent
+    # 124M-float grads (~8 GB) — half the chip — and the chunk must divide
+    # W (engine raises loudly otherwise), so a W=2 smoke degrades to
+    # chunk=2 instead of crashing.
+    if BENCH_MODEL == "gpt2":
+        import math
+        default_chunk = math.gcd(4, NUM_WORKERS)
+    else:
+        default_chunk = 0
     cfg = engine.EngineConfig(
         mode=mode_cfg, weight_decay=5e-4,
-        client_chunk=int(os.environ.get("BENCH_CLIENT_CHUNK", 0)),
+        client_chunk=int(os.environ.get("BENCH_CLIENT_CHUNK", default_chunk)),
     )
     if BENCH_ENGINE_COMPILE == "split":
         client_p, server_p = engine.make_split_round_step(loss_fn, cfg)
@@ -610,11 +625,66 @@ def _server_split(mode_cfg, rt_ms) -> dict:
                 return x[0]
             return chain
 
+        # -------- the former "~22 ms of unattributed algebra" (r5 GPT-2
+        # phase split): the sketch-space FetchSGD algebra, the delta apply
+        # (scatter vs densify+subtract — engine rides the scatter since the
+        # server_step_sparse change), and the params ravel/unravel pair.
+        k_idx = (jnp.arange(k, dtype=jnp.int32) * (spec.d // k)) % spec.d
+        k_vals = jnp.linspace(1.0, 2.0, k, dtype=jnp.float32)
+
+        def algebra_chain(table, n):
+            def body(carry, _):
+                V, E = carry
+                V = 0.9 * V + table
+                E = E + 0.01 * V
+                sv = csvec.query(spec, V, k_idx)
+                E = E - csvec.sketch_sparse(spec, k_idx, k_vals)
+                V = V - csvec.sketch_sparse(spec, k_idx, sv)
+                return (V, E), ()
+            (V, _), _ = jax.lax.scan(body, (table, table), None, length=n)
+            return V[0, 0]
+
+        def apply_sparse_chain(p, n):
+            def body(x, _):
+                x = x.at[k_idx].add(-(k_vals * (1.0 + 1e-12 * x[0])))
+                return x, ()
+            x, _ = jax.lax.scan(body, p, None, length=n)
+            return x[0]
+
+        def apply_dense_chain(p, n):
+            def body(x, _):
+                delta = csvec.to_dense(
+                    spec.d, k_idx, k_vals * (1.0 + 1e-12 * x[0]))
+                return x - delta, ()
+            x, _ = jax.lax.scan(body, p, None, length=n)
+            return x[0]
+
+        # ravel/unravel at the workload's d: a synthetic ~48-leaf pytree
+        # (GPT-2-small has ~148 param leaves; concat/split traffic is what
+        # matters, leaf count is second order)
+        from jax.flatten_util import ravel_pytree as _ravel
+        sizes = [spec.d // 48] * 47
+        sizes.append(spec.d - sum(sizes))
+        tree0 = {f"w{i}": jnp.ones((s,), jnp.float32)
+                 for i, s in enumerate(sizes)}
+        _, unravel = _ravel(tree0)
+
+        def ravel_chain(tree, n):
+            def body(t, _):
+                f, _ = _ravel(t)
+                return unravel(f * (1.0 + 1e-12 * f[0])), ()
+            t, _ = jax.lax.scan(body, tree, None, length=n)
+            return _ravel(t)[0][0]
+
         for label, fn, arg in (
             ("accumulate_ms", acc_chain, v0),
             ("estimates_ms", est_chain, t0),
             ("topk_exact_ms", topk_chain(False), e0),
             ("topk_approx_ms", topk_chain(True), e0),
+            ("algebra_sketch_ms", algebra_chain, t0),
+            ("delta_apply_sparse_ms", apply_sparse_chain, v0),
+            ("delta_apply_dense_ms", apply_dense_chain, v0),
+            ("ravel_unravel_ms", ravel_chain, tree0),
         ):
             per, n, rtt_dominated = _time_adaptive(
                 lambda n, f=fn: (lambda a_: f(a_, n)), (arg,),
@@ -623,8 +693,11 @@ def _server_split(mode_cfg, rt_ms) -> dict:
             if rtt_dominated:
                 out.setdefault("rtt_dominated", []).append(label)
         out["note"] = ("ops timed in isolation at the engine's sketch spec; "
-                      "server_ms - (accumulate+estimates+topk) ~= FetchSGD "
-                      "algebra + sketch_sparse/query/to_dense remainder")
+                      "accumulate+estimates+topk+algebra_sketch+"
+                      "delta_apply_sparse+ravel_unravel ~= the whole sketch "
+                      "server step (the engine applies deltas via the sparse "
+                      "scatter; delta_apply_dense_ms shows what the densify+"
+                      "subtract form would cost)")
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"
     return out
@@ -850,7 +923,9 @@ def run_bench(platform: str) -> dict:
         "compute_dtype": BENCH_DTYPE,
         "sketch": {"rows": mode_cfg.num_rows, "cols": mode_cfg.num_cols,
                    "k": mode_cfg.k, "blocks": mode_cfg.num_blocks, "d": int(d),
-                   "topk_impl": mode_cfg.topk_impl},
+                   "topk_impl": mode_cfg.topk_impl,
+                   **({"topk_recall": mode_cfg.topk_recall}
+                      if mode_cfg.topk_impl == "approx" else {})},
         # which accumulate/query implementation the round step itself compiled
         # (COMMEFFICIENT_NO_PALLAS=1 forces "oracle"; the microbench below
         # still times the Pallas kernels directly either way)
