@@ -616,10 +616,10 @@ def _server_split(mode_cfg, rt_ms) -> dict:
             t, _ = jax.lax.scan(body, table, None, length=n)
             return t[0, 0]
 
-        def topk_chain(approx):
+        def topk_chain(impl):
             def chain(est, n):
                 def body(x, _):
-                    idx = csvec.topk_abs(x, k, approx)
+                    idx = csvec.topk_abs(x, k, impl=impl)
                     return x + 1e-12 * x[idx[0]], ()
                 x, _ = jax.lax.scan(body, est, None, length=n)
                 return x[0]
@@ -679,8 +679,9 @@ def _server_split(mode_cfg, rt_ms) -> dict:
         for label, fn, arg in (
             ("accumulate_ms", acc_chain, v0),
             ("estimates_ms", est_chain, t0),
-            ("topk_exact_ms", topk_chain(False), e0),
-            ("topk_approx_ms", topk_chain(True), e0),
+            ("topk_exact_ms", topk_chain("exact"), e0),
+            ("topk_approx_ms", topk_chain("approx"), e0),
+            ("topk_oversample_ms", topk_chain("oversample"), e0),
             ("algebra_sketch_ms", algebra_chain, t0),
             ("delta_apply_sparse_ms", apply_sparse_chain, v0),
             ("delta_apply_dense_ms", apply_dense_chain, v0),
@@ -925,7 +926,8 @@ def run_bench(platform: str) -> dict:
                    "k": mode_cfg.k, "blocks": mode_cfg.num_blocks, "d": int(d),
                    "topk_impl": mode_cfg.topk_impl,
                    **({"topk_recall": mode_cfg.topk_recall}
-                      if mode_cfg.topk_impl == "approx" else {})},
+                      if mode_cfg.topk_impl in ("approx", "oversample")
+                      else {})},
         # which accumulate/query implementation the round step itself compiled
         # (COMMEFFICIENT_NO_PALLAS=1 forces "oracle"; the microbench below
         # still times the Pallas kernels directly either way)

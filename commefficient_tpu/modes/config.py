@@ -34,12 +34,15 @@ class ModeConfig:
     hash_family: str = "rotation"  # sketch bucket-hash family (see CSVecSpec);
     # "rotation" is the TPU-fast default, "random" the reference-like one
     topk_impl: str = "exact"  # server/client top-k selection: "exact"
-    # (lax.top_k) or "approx" (lax.approx_max_k, TPU PartialReduce lowering
-    # at topk_recall; exact elsewhere). Approx dodges the TPU sort-based
-    # top_k at d in the millions, but NOT for free: the paper-scale sketch
-    # arm lost ~3-4 accuracy points at recall 0.95 vs exact
-    # (results/paper_sketchapprox.jsonl) — the error-feedback loop does not
-    # fully absorb the missed heavy hitters at 1% participation.
+    # (lax.top_k), "approx" (lax.approx_max_k, TPU PartialReduce lowering
+    # at topk_recall; exact elsewhere), or "oversample" (approx preselect
+    # of 4k candidates + exact refine — near-exact at PartialReduce
+    # speed; csvec.topk_abs). Approx dodges the TPU sort-based top_k at d
+    # in the millions, but NOT for free: the paper-scale sketch arms lost
+    # ~3-4 accuracy points at recall 0.95 AND 0.99 vs exact
+    # (results/paper_sketchapprox*.jsonl) — the error-feedback loop does
+    # not fully absorb the missed heavy hitters at 1% participation;
+    # "oversample" exists to close exactly that gap.
     topk_recall: float = 0.95  # approx_max_k recall_target when
     # topk_impl="approx"; raise toward 0.99+ to trade speed back for the
     # selection quality the study above measured.
@@ -65,7 +68,7 @@ class ModeConfig:
             raise ValueError("mode=sketch requires num_cols > 0 and k > 0")
         if self.mode in ("true_topk", "local_topk") and self.k <= 0:
             raise ValueError(f"mode={self.mode} requires k > 0")
-        if self.topk_impl not in ("exact", "approx"):
+        if self.topk_impl not in ("exact", "approx", "oversample"):
             raise ValueError(f"bad topk_impl {self.topk_impl!r}")
         if not (0.0 < self.topk_recall <= 1.0):
             raise ValueError(f"topk_recall must be in (0, 1], got "

@@ -48,10 +48,17 @@ def topk_dense(
     `recall`; exact on backends without the lowering) — at d in the
     millions the exact sort-based top_k is a wall-clock soft spot on TPU.
     Top-k compression is itself a heuristic, but the recall target is NOT
-    free: the paper-scale sketch arm measured ~3-4 accuracy points lost at
-    recall 0.95 vs exact (results/paper_sketchapprox.jsonl), so
-    ModeConfig.topk_recall exposes the dial."""
-    idx = csvec.topk_abs(v, k, approx=impl == "approx", recall=recall)
+    free: the paper-scale sketch arms measured ~3-4 accuracy points lost
+    at recall 0.95 AND 0.99 vs exact (results/paper_sketchapprox*.jsonl),
+    so ModeConfig.topk_recall exposes the dial.
+
+    impl="oversample": approx preselect of 4k candidates + exact top_k
+    over them. approx_max_k's misses concentrate near the selection
+    boundary, so the true top-k (comfortably inside a 4x-oversampled
+    candidate set) survive preselection with probability ~1 — near-exact
+    selection at PartialReduce speed (the exact refine sorts only 4k
+    elements)."""
+    idx = csvec.topk_abs(v, k, impl=impl, recall=recall)
     return idx, v[idx]
 
 

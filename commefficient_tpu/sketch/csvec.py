@@ -296,16 +296,43 @@ def query_all(spec: CSVecSpec, table: jnp.ndarray) -> jnp.ndarray:
     return blocks.reshape(-1)[: spec.d]
 
 
+# impl="oversample" preselects this many x k candidates before the exact
+# refine; 4x puts the true top-k comfortably inside the candidate set
+# (approx_max_k's misses concentrate at the selection boundary)
+TOPK_OVERSAMPLE = 4
+
+
 def topk_abs(
-    x: jnp.ndarray, k: int, approx: bool, recall: float = 0.95
+    x: jnp.ndarray, k: int, approx: bool = False, recall: float = 0.95,
+    impl: str | None = None,
 ) -> jnp.ndarray:
-    """Indices of the k largest-|.| entries; approx uses lax.approx_max_k
-    (TPU PartialReduce at `recall`; exact lowering elsewhere). Single home
-    for the approx/exact branch (ModeConfig.topk_impl / topk_recall —
-    the paper-scale study measured recall 0.95 costing ~3-4 accuracy
-    points vs exact on the sketch arm, results/paper_sketchapprox.jsonl,
-    so the recall target is a tunable, not a constant)."""
-    if approx:
+    """Indices of the k largest-|.| entries. Single home for the top-k
+    selection branch (ModeConfig.topk_impl / topk_recall):
+
+    - "exact": `lax.top_k` (sort-based — a wall at d in the millions on
+      TPU: 442 ms at d=124M vs 4.4 ms approx, r5 server_split).
+    - "approx": `lax.approx_max_k` (TPU PartialReduce at `recall`; exact
+      lowering elsewhere). NOT free: the paper-scale arms measured ~3-4
+      accuracy points lost at recall 0.95 and 0.99 vs exact
+      (results/paper_sketchapprox*.jsonl).
+    - "oversample": approx preselect of TOPK_OVERSAMPLE*k candidates +
+      exact top_k over them — near-exact selection at PartialReduce
+      speed (the exact refine sorts only 4k elements).
+
+    `impl` supersedes the legacy `approx` bool when given."""
+    if impl is None:
+        impl = "approx" if approx else "exact"
+    if impl not in ("exact", "approx", "oversample"):
+        raise ValueError(f"bad impl {impl!r}")
+    if impl == "oversample":
+        kk = TOPK_OVERSAMPLE * k
+        if kk >= x.shape[0]:  # candidate set would be everything: go exact
+            impl = "exact"
+        else:
+            cand = topk_abs(x, kk, impl="approx", recall=recall)
+            sub = topk_abs(x[cand], k, impl="exact")
+            return cand[sub]
+    if impl == "approx":
         _, idx = jax.lax.approx_max_k(jnp.abs(x), k, recall_target=recall)
     else:
         _, idx = jax.lax.top_k(jnp.abs(x), k)
@@ -333,17 +360,17 @@ def unsketch_topk(
     the d-axis in blocks, keeping a running top-k in the carry, so peak
     transient memory is O(r * block_size) regardless of d.
 
-    impl="approx" (ModeConfig.topk_impl): the single-shot path uses one
-    `lax.approx_max_k` over all d estimates; the chunked path uses approx
-    only to PRESELECT k candidates within each chunk and merges the carry
-    exactly — each coordinate faces exactly one approximate pass (its own
-    chunk), so overall recall stays ~the 0.95 target instead of compounding
-    per chunk. Exact results are path-independent (the same top-k set, up
-    to ties in |estimate|).
+    impl (ModeConfig.topk_impl, see topk_abs): "approx"/"oversample" use
+    one PartialReduce pass over all d estimates on the single-shot path;
+    the chunked path uses them only to PRESELECT k candidates within each
+    chunk and merges the carry exactly — each coordinate faces exactly one
+    approximate pass (its own chunk), so overall recall stays ~the target
+    instead of compounding per chunk ("oversample" preselection refines
+    exactly, making the whole chunked path near-exact). Exact results are
+    path-independent (the same top-k set, up to ties in |estimate|).
     """
     if k > spec.d:
         raise ValueError(f"k={k} > d={spec.d}")
-    approx = impl == "approx"
 
     if spec.family == "rotation":
         # chunk = slab (the rotation family's structural unit)
@@ -351,7 +378,7 @@ def unsketch_topk(
 
         if _use_pallas(spec) or spec.d * 4 <= UNSKETCH_SINGLE_SHOT_BYTES:
             est = query_all(spec, table)  # routes Pallas/oracle internally
-            top_idx = topk_abs(est, k, approx, recall)
+            top_idx = topk_abs(est, k, recall=recall, impl=impl)
             return top_idx, est[top_idx]
 
         def chunk_estimates(slab):
@@ -369,10 +396,12 @@ def unsketch_topk(
         run_idx, run_vals = carry
         idx, est = chunk_estimates(chunk)
         valid = idx < spec.d
-        if approx and est.shape[0] > k:
-            # within-chunk preselection (the one approximate pass)
-            pre = topk_abs(jnp.where(valid, est, 0.0), k, approx=True,
-                           recall=recall)
+        if impl != "exact" and est.shape[0] > k:
+            # within-chunk preselection (the one approximate pass; for
+            # impl="oversample" the preselect itself refines exactly, so
+            # the whole chunked path is near-exact)
+            pre = topk_abs(jnp.where(valid, est, 0.0), k, recall=recall,
+                           impl=impl)
             idx, est, valid = idx[pre], est[pre], valid[pre]
         cand_idx = jnp.concatenate([run_idx, idx])
         cand_vals = jnp.concatenate([run_vals, jnp.where(valid, est, 0.0)])

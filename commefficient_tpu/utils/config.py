@@ -29,13 +29,17 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
     p.add_argument("--hash_family", default="rotation", choices=["rotation", "random"],
                    help="sketch bucket-hash family: rotation = TPU-fast roll-based "
                         "(default), random = reference-like per-coordinate hashing")
-    p.add_argument("--topk_impl", default="exact", choices=["exact", "approx"],
-                   help="top-k selection: exact (lax.top_k) or approx "
+    p.add_argument("--topk_impl", default="exact",
+                   choices=["exact", "approx", "oversample"],
+                   help="top-k selection: exact (lax.top_k), approx "
                         "(lax.approx_max_k, TPU-fast at --topk_recall; the "
                         "paper-scale study measured ~3-4 acc points lost at "
-                        "recall 0.95 — results/paper_sketchapprox.jsonl)")
+                        "recall 0.95 and 0.99 — results/README.md), or "
+                        "oversample (approx 4k-candidate preselect + exact "
+                        "refine: near-exact at approx speed)")
     p.add_argument("--topk_recall", type=float, default=0.95,
-                   help="approx_max_k recall_target when --topk_impl approx")
+                   help="approx_max_k recall_target for --topk_impl approx "
+                        "and for oversample's preselect pass")
     p.add_argument("--agg_op", default="mean", choices=["mean", "sum"],
                    help="client-wire aggregation: mean (cohort-size-independent "
                         "default) or sum (FetchSGD Alg. 1 semantics — use with "

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Fused vs split engine compile, measured at flagship dims. Session 2's
+# phase F proved the fused Pallas-in-engine module (the round-3/4 tunnel
+# wedge suspect) compiles and runs clean on this toolchain; this measures
+# whether it also buys anything over the shipping split default (split
+# costs one extra host dispatch per round but keeps the Mosaic
+# custom-calls in a small dedicated module). Installs nothing — produces
+# BENCH_flagship_fused_r05.json as a side artifact for the comparison.
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p results/logs .jax_cache
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+export BENCH_NO_RETRY=1
+
+timeout 180 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+print('chip alive:', float(jax.device_get((x @ x).sum())))
+" 2>&1 | grep -v WARNING || { echo "CHIP DEAD"; exit 101; }
+
+BENCH_ENGINE_SKETCH=auto BENCH_ENGINE_COMPILE=fused \
+    BENCH_PHASE_TIMING=0 BENCH_SERVER_SPLIT=0 \
+    timeout 2400 python -u bench.py 2>&1 \
+    | tee results/logs/fused_vs_split_fused.log | grep -v WARNING | tail -3
+rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ]; then echo "FUSED RUN FAILED rc=$rc"; exit 8; fi
+python - <<'PY'
+import json
+line = [l for l in open("results/logs/fused_vs_split_fused.log",
+                        errors="replace") if l.startswith("{")][-1]
+obj = json.loads(line)
+assert obj.get("platform") in ("tpu", "axon") and "error" not in obj, obj
+open("BENCH_flagship_fused_r05.json", "w").write(line)
+split = json.load(open("BENCH_flagship_r05.json"))
+print(f"fused: {obj['value']}/s round {obj['round_ms']} ms vs "
+      f"split (banked): {split['value']}/s round {split['round_ms']} ms")
+PY

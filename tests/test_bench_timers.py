@@ -121,7 +121,7 @@ def test_server_split_reports_all_ops(bench_mod, monkeypatch):
     out = bench_mod._server_split(cfg, rt_ms=0.0)
     assert "error" not in out, out
     for key in ("accumulate_ms", "estimates_ms", "topk_exact_ms",
-                "topk_approx_ms", "algebra_sketch_ms",
+                "topk_approx_ms", "topk_oversample_ms", "algebra_sketch_ms",
                 "delta_apply_sparse_ms", "delta_apply_dense_ms",
                 "ravel_unravel_ms"):
         assert key in out and out[key] >= 0.0, (key, out)

@@ -246,17 +246,23 @@ def test_jit_and_vmap():
 def test_unsketch_single_shot_matches_chunked_scan(monkeypatch):
     """The single-shot unsketch (affordable [d] transient) and the
     memory-bounding slab scan must recover the same top-k set with the same
-    values — exact path, both rotation-family routes."""
+    values — for every impl, both rotation-family routes (on CPU the
+    approx lowering is exact, so approx/oversample pin the PRESELECT
+    plumbing of the chunked path: masking, index mapping, carry merge)."""
     spec = CSVecSpec(d=10000, c=1024, r=3, seed=3, family="rotation")
     rng = np.random.RandomState(4)
     v = rng.normal(0, 0.01, size=spec.d).astype(np.float32)
     v[rng.choice(spec.d, 30, replace=False)] = 25.0
     t = sketch_vec(spec, jnp.asarray(v))
 
-    i_single, v_single = unsketch_topk(spec, t, 30)  # d*4 well under ceiling
-    monkeypatch.setattr(csvec_mod, "UNSKETCH_SINGLE_SHOT_BYTES", 0)
-    i_scan, v_scan = unsketch_topk(spec, t, 30)
-    assert set(np.asarray(i_single).tolist()) == set(np.asarray(i_scan).tolist())
-    np.testing.assert_allclose(
-        np.sort(np.asarray(v_single)), np.sort(np.asarray(v_scan)), rtol=1e-6
-    )
+    for impl in ("exact", "approx", "oversample"):
+        monkeypatch.setattr(
+            csvec_mod, "UNSKETCH_SINGLE_SHOT_BYTES", 1 << 30)
+        i_single, v_single = unsketch_topk(spec, t, 30, impl=impl)
+        monkeypatch.setattr(csvec_mod, "UNSKETCH_SINGLE_SHOT_BYTES", 0)
+        i_scan, v_scan = unsketch_topk(spec, t, 30, impl=impl)
+        assert set(np.asarray(i_single).tolist()) == \
+            set(np.asarray(i_scan).tolist()), impl
+        np.testing.assert_allclose(
+            np.sort(np.asarray(v_single)), np.sort(np.asarray(v_scan)),
+            rtol=1e-6)

@@ -316,3 +316,34 @@ def test_topk_recall_knob():
     # values must be the ORIGINAL (signed) coordinates, not |.| scores
     np.testing.assert_array_equal(np.sort(np.asarray(v1)), np.sort(np.asarray(v2)))
     np.testing.assert_array_equal(np.asarray(v1), np.asarray(v)[np.asarray(i1)])
+
+
+def test_topk_oversample_matches_exact():
+    """impl="oversample" (approx 4k-preselect + exact refine) must select
+    the exact top-k set whenever the preselect keeps the true top-k — on
+    CPU the approx lowering IS exact, so this pins the plumbing (index
+    mapping through the candidate gather, value gather, d <= 4k fallback);
+    the recall behavior itself is a TPU question answered by the
+    paper-scale arm."""
+    rng = np.random.RandomState(11)
+    v = jnp.asarray(rng.randn(4096).astype(np.float32))
+    i_o, v_o = modes.topk_dense(v, 32, "oversample")
+    i_e, v_e = modes.topk_dense(v, 32, "exact")
+    np.testing.assert_array_equal(np.sort(np.asarray(i_o)), np.sort(np.asarray(i_e)))
+    np.testing.assert_array_equal(np.asarray(v_o), np.asarray(v)[np.asarray(i_o)])
+    # 4k >= d: falls back to exact outright
+    i_s, _ = modes.topk_dense(v[:100], 32, "oversample")
+    i_x, _ = modes.topk_dense(v[:100], 32, "exact")
+    np.testing.assert_array_equal(np.sort(np.asarray(i_s)), np.sort(np.asarray(i_x)))
+    # and the sketch-space path accepts it end-to-end
+    cfg = _cfg(mode="sketch", d=2048, k=8, num_rows=3, num_cols=256,
+               momentum_type="virtual", error_type="virtual",
+               topk_impl="oversample")
+    sstate = modes.init_server_state(cfg)
+    g = np.zeros(2048, np.float32)
+    g[[5, 77, 900, 1500]] = [5.0, -6.0, 4.0, 3.0]
+    wire, _ = modes.client_compress(cfg, jnp.asarray(g), {})
+    agg = modes.aggregate(cfg, {"table": wire["table"][None]})
+    delta, _ = modes.server_step(cfg, agg, sstate, jnp.float32(1.0))
+    got = np.nonzero(np.asarray(delta))[0]
+    assert {5, 77, 900, 1500} <= set(got.tolist())
