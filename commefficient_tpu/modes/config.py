@@ -38,14 +38,13 @@ class ModeConfig:
     # at topk_recall; exact elsewhere), or "oversample" (approx preselect
     # of 4k candidates + exact refine — near-exact at PartialReduce
     # speed; csvec.topk_abs). Approx dodges the TPU sort-based top_k at d
-    # in the millions, but NOT for free: the paper-scale sketch arms lost
-    # ~3-4 accuracy points at recall 0.95 AND 0.99 vs exact
-    # (results/paper_sketchapprox*.jsonl) — the error-feedback loop does
-    # not fully absorb the missed heavy hitters at 1% participation;
-    # "oversample" exists to close exactly that gap.
-    topk_recall: float = 0.95  # approx_max_k recall_target when
-    # topk_impl="approx"; raise toward 0.99+ to trade speed back for the
-    # selection quality the study above measured.
+    # in the millions. Accuracy impact: the paper-scale 2x2 seed
+    # replication put exact-vs-approx@0.99 within seed variance
+    # (single-seed orderings inverted across seeds — results/README.md),
+    # so any recall cost is below that study's resolution; "oversample"
+    # makes the question moot by construction.
+    topk_recall: float = 0.95  # approx_max_k recall_target for
+    # topk_impl="approx" and for oversample's preselect pass.
     agg_op: str = "mean"  # how client wires combine: "mean" | "sum".
     # FetchSGD Alg. 1 writes the round sketch as a sum over client sketches
     # (SURVEY.md §3.1) with the scaling absorbed into the learning rate; this

@@ -47,10 +47,9 @@ def topk_dense(
     impl="approx" uses `lax.approx_max_k` (TPU PartialReduce lowering at
     `recall`; exact on backends without the lowering) — at d in the
     millions the exact sort-based top_k is a wall-clock soft spot on TPU.
-    Top-k compression is itself a heuristic, but the recall target is NOT
-    free: the paper-scale sketch arms measured ~3-4 accuracy points lost
-    at recall 0.95 AND 0.99 vs exact (results/paper_sketchapprox*.jsonl),
-    so ModeConfig.topk_recall exposes the dial.
+    The paper-scale 2x2 seed replication found exact-vs-approx@0.99
+    accuracy differences within seed variance (results/README.md);
+    ModeConfig.topk_recall exposes the dial.
 
     impl="oversample": approx preselect of 4k candidates + exact top_k
     over them. approx_max_k's misses concentrate near the selection

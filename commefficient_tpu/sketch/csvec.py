@@ -312,12 +312,14 @@ def topk_abs(
     - "exact": `lax.top_k` (sort-based — a wall at d in the millions on
       TPU: 442 ms at d=124M vs 4.4 ms approx, r5 server_split).
     - "approx": `lax.approx_max_k` (TPU PartialReduce at `recall`; exact
-      lowering elsewhere). NOT free: the paper-scale arms measured ~3-4
-      accuracy points lost at recall 0.95 and 0.99 vs exact
-      (results/paper_sketchapprox*.jsonl).
+      lowering elsewhere). Accuracy impact at paper scale is within seed
+      variance for recall 0.99 (2x2 seed replication inverted the
+      single-seed ordering — results/README.md); any cost is below that
+      study's resolution.
     - "oversample": approx preselect of TOPK_OVERSAMPLE*k candidates +
       exact top_k over them — near-exact selection at PartialReduce
-      speed (the exact refine sorts only 4k elements).
+      speed by construction (the exact refine sorts only 4k elements),
+      sidestepping the recall question entirely.
 
     `impl` supersedes the legacy `approx` bool when given."""
     if impl is None:

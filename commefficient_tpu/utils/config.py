@@ -32,11 +32,11 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
     p.add_argument("--topk_impl", default="exact",
                    choices=["exact", "approx", "oversample"],
                    help="top-k selection: exact (lax.top_k), approx "
-                        "(lax.approx_max_k, TPU-fast at --topk_recall; the "
-                        "paper-scale study measured ~3-4 acc points lost at "
-                        "recall 0.95 and 0.99 — results/README.md), or "
-                        "oversample (approx 4k-candidate preselect + exact "
-                        "refine: near-exact at approx speed)")
+                        "(lax.approx_max_k, TPU-fast at --topk_recall; "
+                        "paper-scale accuracy impact within seed variance "
+                        "at recall 0.99 — results/README.md), or oversample "
+                        "(approx 4k-candidate preselect + exact refine: "
+                        "near-exact at approx speed by construction)")
     p.add_argument("--topk_recall", type=float, default=0.95,
                    help="approx_max_k recall_target for --topk_impl approx "
                         "and for oversample's preselect pass")

@@ -73,10 +73,9 @@ PY
 FAIL=0
 
 # A. flagship at the exact defaults the driver's end-of-round capture uses
-# (split+pallas auto since session 1; top-k stays EXACT — the paper-scale
-# three-arm study measured approx costing real accuracy: exact 0.682 >
-# approx@0.99 0.652 > approx@0.95 0.644 best test acc, results/paper_sketch*
-# .jsonl — so the headline rides the accuracy-faithful config and the
+# (split+pallas auto since session 1; top-k stays EXACT as the
+# accuracy-faithful default — the later 2x2 seed replication put
+# exact-vs-approx@0.99 within seed variance, results/README.md — and the
 # sparse-delta/scatter server changes are where the speed comes from).
 if want A 101; then
 timeout 2400 python -u bench.py 2>&1 \
