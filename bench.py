@@ -72,6 +72,12 @@ def _stage(msg: str) -> None:
     print(f"# [{time.strftime('%H:%M:%S')}] bench: {msg}", file=sys.stderr,
           flush=True)
 
+# (d, k) pairs whose approx/oversample effective recall the on-chip probe
+# (scripts/topk_recall_probe.py) actually measured; the artifact's
+# topk_provenance string is gated on membership so overridden dims never
+# claim a measurement that does not exist
+_PROBED_TOPK_DIMS = {(6_573_130, 50_000), (123_849_984, 50_000)}
+
 # bf16 peak FLOP/s per chip by device_kind substring (public spec sheets);
 # used only to report MFU — unknown kinds record mfu: null
 _PEAK_BF16 = [
@@ -466,10 +472,20 @@ def _make_step(loss_fn, sketch_kw, d):
     from commefficient_tpu.federated import engine
     from commefficient_tpu.modes.config import ModeConfig
 
+    # Default selection: approx@0.99 — the on-chip probe
+    # (results/topk_recall_probe_r05.md) measured its effective recall at
+    # 1.0000 at flagship dims (the selected SET equals exact lax.top_k's;
+    # only boundary tie-breaking differs) and 0.9970 at GPT-2 dims, the
+    # 2x2-seed paper-scale study put any accuracy difference within seed
+    # variance, and it is +6% flagship round throughput / ~3x GPT-2 round
+    # throughput vs exact (the 442-vs-4.4 ms figure is the top-k OP cost;
+    # the round also carries client compute). The training CLIs keep
+    # exact as THEIR default; BENCH_TOPK_IMPL=exact reproduces the
+    # accuracy-faithful bench config.
     mode_cfg = ModeConfig(
         mode="sketch", d=d, momentum_type="virtual", error_type="virtual",
-        topk_impl=os.environ.get("BENCH_TOPK_IMPL", "exact"),
-        topk_recall=float(os.environ.get("BENCH_TOPK_RECALL", 0.95)),
+        topk_impl=os.environ.get("BENCH_TOPK_IMPL", "approx"),
+        topk_recall=float(os.environ.get("BENCH_TOPK_RECALL", 0.99)),
         **sketch_kw,
     )
     # BENCH_CLIENT_CHUNK > 0 scans grads in client chunks (HBM ceiling for
@@ -925,7 +941,14 @@ def run_bench(platform: str) -> dict:
         "sketch": {"rows": mode_cfg.num_rows, "cols": mode_cfg.num_cols,
                    "k": mode_cfg.k, "blocks": mode_cfg.num_blocks, "d": int(d),
                    "topk_impl": mode_cfg.topk_impl,
-                   **({"topk_recall": mode_cfg.topk_recall}
+                   **({"topk_recall": mode_cfg.topk_recall,
+                       "topk_provenance": (
+                           "effective recall measured on-chip at these "
+                           "workload dims: results/topk_recall_probe_r05.md"
+                           if (int(d), mode_cfg.k) in _PROBED_TOPK_DIMS else
+                           "effective recall NOT probed at these dims "
+                           "(probe covers flagship/GPT-2 defaults: "
+                           "results/topk_recall_probe_r05.md)")}
                       if mode_cfg.topk_impl in ("approx", "oversample")
                       else {})},
         # which accumulate/query implementation the round step itself compiled
