@@ -102,7 +102,15 @@ BENCH_MODEL = os.environ.get("BENCH_MODEL", "resnet9")
 if BENCH_MODEL not in ("resnet9", "gpt2"):
     raise SystemExit(f"BENCH_MODEL must be resnet9|gpt2, got {BENCH_MODEL!r}")
 REFERENCE_CLIENT_UPDATES_PER_SEC, REFERENCE_DERIVATION = _REFERENCE_BY_MODEL[BENCH_MODEL]
-NUM_WORKERS = int(os.environ.get("BENCH_WORKERS", 64))  # sampled clients/round
+# sampled clients/round. gpt2 defaults to W=32: the sketch-server step is
+# W-independent (58 ms at d=124M, BENCH_gpt2_phases_r05.json), so the
+# per-chip updates/s headline is server-wall-bound until the cohort
+# amortizes it — measured 40.77/s @W=4, 72.25 @W=16, 86.19 @W=32
+# (MFU 17.4%), approaching the ~109/s client-compute asymptote.
+# THE single source of the cohort size: workload builders, phase chains,
+# and _make_step's chunk default all read this.
+NUM_WORKERS = int(os.environ.get(
+    "BENCH_WORKERS", 64 if BENCH_MODEL == "resnet9" else 32))
 # per-client unit of work: images (resnet9) or sequences (gpt2) per client
 LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH",
                                  8 if BENCH_MODEL == "resnet9" else 2))
@@ -442,12 +450,10 @@ def _gpt2_workload():
 
     from commefficient_tpu.models.losses import make_lm_loss
 
-    # W=16 (was 4 through r5 session 2): the sketch-server step is
-    # W-independent (58 ms at d=124M, BENCH_gpt2_phases_r05.json), so the
-    # per-chip updates/s headline is server-wall-bound until the cohort
-    # amortizes it; client_chunk (default 4 for gpt2, _make_step) bounds
-    # HBM at 4 concurrent [d] grads (~2 GB) regardless of W.
-    workers = int(os.environ.get("BENCH_WORKERS", 16))
+    # cohort size: NUM_WORKERS (per-model default; see its comment).
+    # client_chunk (default gcd(4, NUM_WORKERS), _make_step) bounds HBM
+    # at <= 4 concurrent [d] grads (~2 GB) regardless of W.
+    workers = NUM_WORKERS
     cfg, model, seq, size = _gpt2_model(BENCH_DTYPE)
     ids0 = jnp.zeros((1, seq), dtype=jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids0, train=False)["params"]
