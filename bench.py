@@ -102,15 +102,15 @@ BENCH_MODEL = os.environ.get("BENCH_MODEL", "resnet9")
 if BENCH_MODEL not in ("resnet9", "gpt2"):
     raise SystemExit(f"BENCH_MODEL must be resnet9|gpt2, got {BENCH_MODEL!r}")
 REFERENCE_CLIENT_UPDATES_PER_SEC, REFERENCE_DERIVATION = _REFERENCE_BY_MODEL[BENCH_MODEL]
-# sampled clients/round. gpt2 defaults to W=32: the sketch-server step is
+# sampled clients/round. gpt2 defaults to W=64: the sketch-server step is
 # W-independent (58 ms at d=124M, BENCH_gpt2_phases_r05.json), so the
 # per-chip updates/s headline is server-wall-bound until the cohort
-# amortizes it — measured 40.77/s @W=4, 72.25 @W=16, 86.19 @W=32
-# (MFU 17.4%), approaching the ~109/s client-compute asymptote.
+# amortizes it — measured at client_chunk 8: 106.25/s @W=32, 121.03
+# @W=64 (MFU 24.4%), 129.85 @W=128 (MFU 26.2%; +7% per further
+# doubling at linearly growing bench wall — W=64 is the balance point).
 # THE single source of the cohort size: workload builders, phase chains,
 # and _make_step's chunk default all read this.
-NUM_WORKERS = int(os.environ.get(
-    "BENCH_WORKERS", 64 if BENCH_MODEL == "resnet9" else 32))
+NUM_WORKERS = int(os.environ.get("BENCH_WORKERS", 64))
 # per-client unit of work: images (resnet9) or sequences (gpt2) per client
 LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH",
                                  8 if BENCH_MODEL == "resnet9" else 2))
@@ -450,9 +450,9 @@ def _gpt2_workload():
 
     from commefficient_tpu.models.losses import make_lm_loss
 
-    # cohort size: NUM_WORKERS (per-model default; see its comment).
-    # client_chunk (default gcd(4, NUM_WORKERS), _make_step) bounds HBM
-    # at <= 4 concurrent [d] grads (~2 GB) regardless of W.
+    # cohort size: NUM_WORKERS (single source; see its comment).
+    # client_chunk (default gcd(8, NUM_WORKERS), _make_step) bounds HBM
+    # at <= 8 concurrent [d] grads (~4 GB) regardless of W.
     workers = NUM_WORKERS
     cfg, model, seq, size = _gpt2_model(BENCH_DTYPE)
     ids0 = jnp.zeros((1, seq), dtype=jnp.int32)
@@ -496,13 +496,14 @@ def _make_step(loss_fn, sketch_kw, d):
     )
     # BENCH_CLIENT_CHUNK > 0 scans grads in client chunks (HBM ceiling for
     # big-cohort GPT-2 rounds; engine._weighted_client_reduce). gpt2
-    # defaults to gcd(4, W): W=16 unchunked would vmap 16 concurrent
-    # 124M-float grads (~8 GB) — half the chip — and the chunk must divide
-    # W (engine raises loudly otherwise), so a W=2 smoke degrades to
-    # chunk=2 instead of crashing.
+    # defaults to gcd(8, W): 8 concurrent [d] grads (~4 GB) is the
+    # measured sweet spot — chunk 4 underfeeds the MXU (86/s @W=32),
+    # chunk 16's ~8 GB working set regresses to 88/s vs chunk 8's 106/s.
+    # The chunk must divide W (engine raises loudly otherwise), so a
+    # W=2 smoke degrades to chunk=2 instead of crashing.
     if BENCH_MODEL == "gpt2":
         import math
-        default_chunk = math.gcd(4, NUM_WORKERS)
+        default_chunk = math.gcd(8, NUM_WORKERS)
     else:
         default_chunk = 0
     cfg = engine.EngineConfig(
