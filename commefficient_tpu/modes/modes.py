@@ -208,14 +208,13 @@ def server_step_sparse(
         E = sstate["Verror"] + lr * V
         idx, vals = csvec.unsketch_topk(spec, E, cfg.k, impl=cfg.topk_impl,
                                         recall=cfg.topk_recall)
-        E = E - csvec.sketch_sparse(spec, idx, vals)
-        # Momentum factor masking, sketch-space: zero V's (estimated) mass at
-        # the transmitted coordinates — the sketch analogue of true_topk's
-        # V * (1 - mask). Subtracting V's own queried values (not lr-scaled
-        # delta) keeps units consistent, so agg_op sum/mean stay exactly
-        # lr-translatable (see ModeConfig.agg_op).
-        vvals = csvec.query(spec, V, idx)
-        V = V - csvec.sketch_sparse(spec, idx, vvals)
+        # Error subtract + momentum factor masking, sketch-space: zero V's
+        # (estimated) mass at the transmitted coordinates — the sketch
+        # analogue of true_topk's V * (1 - mask). Subtracting V's own
+        # queried values (not lr-scaled delta) keeps units consistent, so
+        # agg_op sum/mean stay exactly lr-translatable (ModeConfig.agg_op).
+        # Fused into one hash evaluation (csvec.mask_transmitted).
+        V, E = csvec.mask_transmitted(spec, V, E, idx, vals)
         return {"idx": idx, "vals": vals}, {"Vvelocity": V, "Verror": E}
 
     g = agg["dense"]

@@ -271,6 +271,30 @@ def query(spec: CSVecSpec, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return jnp.sort(per_row, axis=0)[(spec.r - 1) // 2]
 
 
+def mask_transmitted(
+    spec: CSVecSpec, V: jnp.ndarray, E: jnp.ndarray,
+    idx: jnp.ndarray, vals: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """FetchSGD's sketch-space masking tail in one call: E -= sketch(vals at
+    idx); V -= sketch(query(V, idx) at idx). Bit-identical to the unfused
+    two-`sketch_sparse`-plus-`query` sequence (same clipped-index hashing as
+    sketch_sparse; invalid idx < 0 / >= d entries contribute exactly 0 to
+    both scatters, as before — the query value at an invalid index was
+    unused garbage in the unfused form too). Pinned in tests/test_csvec.py.
+
+    Note on cost: expressing this as one call changes nothing measured —
+    inside one jitted program XLA already CSE's the three ops' identical
+    (r, k) hash evaluations (the isolated algebra cost is the
+    scatter/gather/sort itself — bench.py server_split's
+    algebra_sketch_ms). So this is a plain composition of the shared
+    primitives, preserving _accumulate's single-scatter-path invariant;
+    the value is the single call site and the documented semantics."""
+    E = E - sketch_sparse(spec, idx, vals)
+    vvals = query(spec, V, idx)
+    V = V - sketch_sparse(spec, idx, vvals)
+    return V, E
+
+
 def query_all(spec: CSVecSpec, table: jnp.ndarray) -> jnp.ndarray:
     """Dense [d] vector of estimates for every coordinate. O(r*d) transient
     memory when num_blocks == 1; scanned per block otherwise."""

@@ -266,3 +266,27 @@ def test_unsketch_single_shot_matches_chunked_scan(monkeypatch):
         np.testing.assert_allclose(
             np.sort(np.asarray(v_single)), np.sort(np.asarray(v_scan)),
             rtol=1e-6)
+
+
+def test_mask_transmitted_matches_unfused():
+    """The fused masking tail (one hash evaluation) must be BIT-IDENTICAL to
+    the unfused sequence E -= sketch_sparse(vals); vvals = query(V);
+    V -= sketch_sparse(vvals) — including idx = -1 padding entries, whose
+    contribution is exactly zero on both paths."""
+    for family in ("rotation", "random"):
+        spec = CSVecSpec(d=4096, c=512, r=5, seed=9, family=family)
+        rng = np.random.RandomState(2)
+        V = jnp.asarray(rng.randn(spec.r, spec.c).astype(np.float32))
+        E = jnp.asarray(rng.randn(spec.r, spec.c).astype(np.float32))
+        idx = jnp.asarray(
+            np.concatenate([rng.choice(spec.d, 30, replace=False),
+                            [-1, -1]]).astype(np.int32))
+        vals = jnp.asarray(rng.randn(32).astype(np.float32))
+
+        E_ref = E - sketch_sparse(spec, idx, vals)
+        vvals = query(spec, V, idx)
+        V_ref = V - sketch_sparse(spec, idx, vvals)
+
+        V_f, E_f = csvec_mod.mask_transmitted(spec, V, E, idx, vals)
+        np.testing.assert_array_equal(np.asarray(V_ref), np.asarray(V_f), err_msg=family)
+        np.testing.assert_array_equal(np.asarray(E_ref), np.asarray(E_f), err_msg=family)
