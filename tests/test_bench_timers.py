@@ -166,3 +166,25 @@ def test_flops_chunked_matches_unchunked(monkeypatch):
         assert abs(f1 - f0) / f0 < 0.10, (f0, f1)
     finally:
         teardown()
+
+
+def test_gpt2_chunk_default_divides_any_cohort(monkeypatch):
+    """The gpt2 client_chunk default must divide W for ANY BENCH_WORKERS a
+    smoke run might set (the engine raises on non-divisors): gcd(8, W)
+    degrades gracefully — 8 for the W=64 default, 2 for a W=6 smoke."""
+    monkeypatch.delenv("BENCH_CLIENT_CHUNK", raising=False)
+    for w, expect in (("64", 8), ("6", 2), ("3", 1), ("16", 8)):
+        bench, teardown = _import_bench(
+            monkeypatch, BENCH_MODEL="gpt2", BENCH_GPT2_SIZE="tiny",
+            BENCH_WORKERS=w, BENCH_COLS="1024", BENCH_TOPK="16",
+            BENCH_BLOCKS="1", BENCH_SEQ="16")
+        try:
+            def dummy_loss(params, net_state, batch, rng):
+                raise AssertionError("never traced at build time")
+            _, _, cfg, _ = bench._make_step(
+                dummy_loss, dict(k=16, num_rows=3, num_cols=1024,
+                                 num_blocks=1), d=4096)
+            assert cfg.client_chunk == expect, (w, cfg.client_chunk)
+            assert int(w) % cfg.client_chunk == 0
+        finally:
+            teardown()
