@@ -509,6 +509,10 @@ def _make_step(loss_fn, sketch_kw, d):
     cfg = engine.EngineConfig(
         mode=mode_cfg, weight_decay=5e-4,
         client_chunk=int(os.environ.get("BENCH_CLIENT_CHUNK", default_chunk)),
+        # match the CLI default ("skip"): the headline number must measure
+        # the guarded round program production actually runs; pin "off" to
+        # A/B the guard's cost
+        on_nonfinite=os.environ.get("BENCH_ON_NONFINITE", "skip"),
     )
     if BENCH_ENGINE_COMPILE == "split":
         client_p, server_p = engine.make_split_round_step(loss_fn, cfg)
@@ -618,7 +622,8 @@ def _server_split(mode_cfg, rt_ms) -> dict:
     from commefficient_tpu.sketch import csvec
 
     spec, k = mode_cfg.sketch_spec, mode_cfg.k
-    out: dict = {"d": spec.d, "k": k, "topk_impl_engine": mode_cfg.topk_impl}
+    out: dict = {"d": spec.d, "k": k, "topk_impl_engine": mode_cfg.topk_impl,
+                 "topk_recall": mode_cfg.topk_recall}
     try:
         v0 = jax.random.normal(jax.random.PRNGKey(7), (spec.d,), jnp.float32)
         t0 = csvec.sketch_vec(spec, v0)
@@ -642,7 +647,7 @@ def _server_split(mode_cfg, rt_ms) -> dict:
         def topk_chain(impl):
             def chain(est, n):
                 def body(x, _):
-                    idx = csvec.topk_abs(x, k, impl=impl)
+                    idx = csvec.topk_abs(x, k, impl=impl, recall=mode_cfg.topk_recall)
                     return x + 1e-12 * x[idx[0]], ()
                 x, _ = jax.lax.scan(body, est, None, length=n)
                 return x[0]

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 from typing import Any, Callable
 
 import numpy as np
@@ -23,6 +24,7 @@ from ..data.fed_dataset import FedDataset
 from ..modes import modes
 from ..modes.config import ModeConfig
 from ..parallel import mesh as meshlib
+from ..resilience import retry as rtry
 from ..utils.comm import round_comm_mb
 from . import engine
 
@@ -46,12 +48,37 @@ class FederatedSession:
         client_dropout: float = 0.0,
         split_compile: bool = False,
         client_chunk: int = 0,
+        on_nonfinite: str = "off",
+        fault_plan=None,
+        retry_policy: rtry.RetryPolicy | None = None,
+        donate_state: bool = True,
     ):
+        if on_nonfinite not in ("off", "skip", "halt"):
+            raise ValueError(
+                f"on_nonfinite must be 'off', 'skip', or 'halt', got "
+                f"{on_nonfinite!r}"
+            )
         self.cfg = engine.EngineConfig(
             mode=mode_cfg, weight_decay=weight_decay, dp_clip=dp_clip,
             dp_noise=dp_noise, client_dropout=client_dropout,
             client_chunk=client_chunk,
+            # CLI "halt" is a host-side policy on top of the compiled "skip"
+            # guard (state stays clean either way; the CLI decides to stop)
+            on_nonfinite="skip" if on_nonfinite == "halt" else on_nonfinite,
         )
+        # resilience hooks (resilience/): a seeded FaultPlan injects failures
+        # at this session's named sites; the retry policy wraps data loading.
+        # Both default to inert so existing callers see zero change.
+        self.fault_plan = fault_plan
+        self.retry_policy = retry_policy or rtry.RetryPolicy()
+        # donate_state=False keeps the server state's device buffers alive
+        # across the in-flight round (one extra copy of params+momentum+error
+        # in HBM). Required for a WORKING mid-round emergency checkpoint on
+        # real accelerators: with donation, self.state points at deleted
+        # buffers for the whole round, so the watchdog's stage-3 save would
+        # always fail with "Array has been deleted" exactly when a round is
+        # wedged. CPU ignores donation, which is why tests can't catch it.
+        self._donate_state = donate_state
         self.train_set = train_set
         self.num_workers = min(num_workers, train_set.num_clients)
         self.local_batch_size = local_batch_size
@@ -99,6 +126,14 @@ class FederatedSession:
             self.cfg = dataclasses.replace(self.cfg, client_chunk=viable)
         self.rng = np.random.RandomState(seed)
         self._rng_key = jax.random.PRNGKey(seed)
+        # round-boundary RNG snapshot (see _snapshot_rng): what checkpoint
+        # writes, so a mid-round emergency save stays replay-consistent
+        self._snapshot_rng()
+        # guards the round-boundary publication of (state, round, snapshot,
+        # comm totals) against a concurrent emergency checkpoint from the
+        # watchdog's timer thread: ckpt.save captures all fields under this
+        # lock, so it can never mix round N's params with round N-1's counter
+        self.mutate_lock = threading.Lock()
 
         self.state = engine.init_server_state(self.cfg, params, net_state)
         self.client_state = modes.init_client_state(mode_cfg, train_set.num_clients)
@@ -115,10 +150,12 @@ class FederatedSession:
             # engine.make_split_round_step for why)
             client_p, server_p = engine.make_split_round_step(train_loss_fn, self.cfg)
             self._step = engine.compose_split(
-                jax.jit(client_p), jax.jit(server_p, donate_argnums=(0,))
+                jax.jit(client_p),
+                jax.jit(server_p, donate_argnums=self._state_donation()),
             )
         else:
-            self._step = jax.jit(engine.make_round_step(train_loss_fn, self.cfg), donate_argnums=(0,))
+            self._step = jax.jit(engine.make_round_step(train_loss_fn, self.cfg),
+                                 donate_argnums=self._state_donation())
         self._eval = jax.jit(engine.make_eval_step(eval_loss_fn))
         if self.client_state is not None:
             gather = lambda st, ids: jax.tree.map(lambda a: a[ids], st)  # noqa: E731
@@ -145,10 +182,16 @@ class FederatedSession:
                 # gathered rows ride the same client-axis sharding the batch
                 # uses, so the vmapped per-client step stays fully sharded
                 self._gather = jax.jit(gather, out_shardings=ns)
-                self._scatter = jax.jit(scatter, donate_argnums=(0,), out_shardings=ns)
+                # scatter donation follows the same gate as the round step:
+                # an emergency save's device_get of client_state must not
+                # race a donation that deletes the captured buffers
+                self._scatter = jax.jit(scatter,
+                                        donate_argnums=self._state_donation(),
+                                        out_shardings=ns)
             else:
                 self._gather = jax.jit(gather)
-                self._scatter = jax.jit(scatter, donate_argnums=(0,))
+                self._scatter = jax.jit(scatter,
+                                        donate_argnums=self._state_donation())
         self.round = 0
         # analytic wire-cost of one round (SURVEY.md §6 row 4 accounting)
         self.comm_per_round = round_comm_mb(mode_cfg, self.num_workers)
@@ -166,24 +209,79 @@ class FederatedSession:
             return jax.set_mesh(self.mesh)
         return contextlib.nullcontext()
 
+    def _state_donation(self) -> tuple:
+        """donate_argnums for the round-step jits: (0,) normally, () when the
+        caller needs the live server state readable mid-round (emergency
+        checkpoints) — see the donate_state comment in __init__."""
+        return (0,) if self._donate_state else ()
+
+    def _snapshot_rng(self):
+        """Capture (host sampling RNG, device PRNG key) as of the last
+        COMPLETED round. The live streams advance at the start of the next
+        round, before `self.round`/`self.state` reflect it — so an emergency
+        checkpoint taken mid-round (the watchdog's timer thread) must write
+        this snapshot, not the live streams, or the resumed run re-samples
+        round N from a stream already advanced past its draws and trains a
+        cohort no deterministic run of this seed would produce."""
+        self.rng_snapshot = (self.rng.get_state(), self._rng_key)
+
+    def _load_client_batch(self, ids) -> dict:
+        """Round-batch assembly behind the retry wrapper. The injection site
+        fires BEFORE any host RNG is consumed, and a failed attempt restores
+        the RNG snapshot, so a retried load replays the identical batch —
+        recovery never perturbs the client sequence a resumed run must
+        replay bit-for-bit."""
+
+        def attempt():
+            rng_state = self.rng.get_state()
+            try:
+                if self.fault_plan is not None:
+                    self.fault_plan.data_load(self.round)
+                return self.train_set.client_batch(
+                    self.rng, ids, self.local_batch_size,
+                    self.cfg.mode.num_local_iters,
+                )
+            except Exception:
+                self.rng.set_state(rng_state)
+                raise
+
+        return rtry.with_retries(
+            attempt, site="data_load", policy=self.retry_policy,
+            seed=self.round,
+        )
+
     # -- one federated round -------------------------------------------------
     def run_round(self, lr: float) -> dict:
         ids = self.train_set.sample_clients(self.rng, self.num_workers)
-        batch = self.train_set.client_batch(
-            self.rng, ids, self.local_batch_size, self.cfg.mode.num_local_iters
-        )
+        batch = self._load_client_batch(ids)
+        if self.fault_plan is not None:
+            # nonfinite burst rides the real gradient path; preempt delivers
+            # a real SIGTERM that the CLI's PreemptionHandler turns into an
+            # emergency checkpoint at this round's end
+            batch = self.fault_plan.poison(self.round, batch)
+            self.fault_plan.preempt(self.round)
         if self.mesh is not None:
             batch = meshlib.shard_client_batch(self.mesh, batch)
         ids_dev = jnp.asarray(ids)
         rows = self._gather(self.client_state, ids_dev) if self.client_state is not None else {}
         self._rng_key, sub = jax.random.split(self._rng_key)
         with self._mesh_ctx():
-            self.state, new_rows, metrics = self._step(
+            new_state, new_rows, metrics = self._step(
                 self.state, batch, rows, jnp.float32(lr), sub
             )
-        if self.client_state is not None:
-            self.client_state = self._scatter(self.client_state, ids_dev, new_rows)
-        return self._finalize_metrics(jax.device_get(metrics), lr)
+        metrics_host = jax.device_get(metrics)  # the round's sync
+        # publish the round atomically w.r.t. a concurrent emergency
+        # checkpoint: by the sync above new_state is concrete, so the lock
+        # is held only for cheap host-side assignments
+        with self.mutate_lock:
+            self.state = new_state
+            if self.client_state is not None:
+                self.client_state = self._scatter(
+                    self.client_state, ids_dev, new_rows
+                )
+            m = self._finalize_metrics(metrics_host, lr)
+            self._snapshot_rng()
+        return m
 
     def _finalize_metrics(self, metrics_host: dict, lr: float) -> dict:
         """Host-side per-round bookkeeping shared by run_round/run_rounds:
@@ -218,8 +316,11 @@ class FederatedSession:
     def supports_block_dispatch(self) -> bool:
         """Whether run_rounds can actually fuse a block into one dispatch:
         per-client-state modes need the host gather/scatter between rounds,
-        and split sessions exist to keep Mosaic OUT of big fused modules."""
-        return self.client_state is None and not self._split
+        and split sessions exist to keep Mosaic OUT of big fused modules.
+        An active fault plan also forces per-round dispatch: injection sites
+        are scheduled by round, which a K-round fused block cannot honor."""
+        return (self.client_state is None and not self._split
+                and self.fault_plan is None)
 
     # -- a block of rounds in one dispatch (SURVEY.md §7 hard part (d)) ------
     def run_rounds(self, lrs) -> list[dict]:
@@ -235,14 +336,14 @@ class FederatedSession:
         if self._multi is None:
             self._multi = jax.jit(
                 engine.make_multi_round_step(self._train_loss_fn, self.cfg),
-                donate_argnums=(0,),
+                donate_argnums=self._state_donation(),
             )
         batches, subs = [], []
         for _ in lrs:
             ids = self.train_set.sample_clients(self.rng, self.num_workers)
-            batches.append(self.train_set.client_batch(
-                self.rng, ids, self.local_batch_size, self.cfg.mode.num_local_iters
-            ))
+            # same retry wrapper as run_round: a transient loader flake must
+            # not kill the block path that long stateless runs actually take
+            batches.append(self._load_client_batch(ids))
             self._rng_key, sub = jax.random.split(self._rng_key)
             subs.append(sub)
         # stack on the HOST: jnp.stack would commit the full [K, W, ...]
@@ -255,14 +356,18 @@ class FederatedSession:
         if self.mesh is not None:
             stacked = meshlib.shard_stacked_client_batch(self.mesh, stacked)
         with self._mesh_ctx():
-            self.state, ms = self._multi(
+            new_state, ms = self._multi(
                 self.state, stacked, jnp.asarray(lrs, jnp.float32), jnp.stack(subs)
             )
         ms = jax.device_get(ms)  # the block's one sync
-        return [
-            self._finalize_metrics({k: v[i] for k, v in ms.items()}, lr)
-            for i, lr in enumerate(lrs)
-        ]
+        with self.mutate_lock:  # see run_round: atomic round publication
+            self.state = new_state
+            out = [
+                self._finalize_metrics({k: v[i] for k, v in ms.items()}, lr)
+                for i, lr in enumerate(lrs)
+            ]
+            self._snapshot_rng()
+        return out
 
     # -- evaluation (SURVEY.md §3.4: forward-only, no compression) -----------
     def evaluate(self, dataset: FedDataset, batch_size: int = 512) -> dict:

@@ -64,6 +64,16 @@ class EngineConfig:
     # applies to linear grad modes without client-local state (elsewhere
     # the per-client wires are needed all at once and the knob is ignored).
     client_chunk: int = 0
+    # Non-finite-update guard (resilience/): "skip" detects NaN/Inf in the
+    # aggregated wire (or the new mutable collections) INSIDE the compiled
+    # step and treats the round like a fully-dropped cohort — zero aggregate
+    # in, so momentum decays but never absorbs the poison, error feedback
+    # stays clean, per-client rows and BN stats keep their pre-round values,
+    # and metrics carry nonfinite_rounds=1 so the skip is loud. "off" keeps
+    # the seed behavior (poison propagates) and the seed's exact compiled
+    # program. When every update is finite, "skip" is bit-identical to "off"
+    # (jnp.where with a true predicate), so enabling it costs nothing.
+    on_nonfinite: str = "off"
 
     def __post_init__(self):
         if not 0.0 <= self.client_dropout < 1.0:
@@ -73,6 +83,10 @@ class EngineConfig:
         if self.client_chunk < 0:
             raise ValueError(
                 f"client_chunk must be >= 0, got {self.client_chunk}"
+            )
+        if self.on_nonfinite not in ("off", "skip"):
+            raise ValueError(
+                f"on_nonfinite must be 'off' or 'skip', got {self.on_nonfinite!r}"
             )
         if self.dp_noise > 0 and self.dp_clip <= 0:
             raise ValueError("dp_noise > 0 requires dp_clip > 0 (unbounded "
@@ -155,6 +169,71 @@ def _dp_noise_agg(cfg: EngineConfig, agg: dict, participants, noise_rng) -> dict
             jax.random.fold_in(noise_rng, i), v.shape, v.dtype)
         for i, (k, v) in enumerate(sorted(agg.items()))
     }
+
+
+def _tree_finite(tree) -> jnp.ndarray:
+    """Scalar bool: every float leaf of `tree` is fully finite (int leaves —
+    sparse wire indices, counters — are finite by construction)."""
+    checks = [
+        jnp.isfinite(leaf).all()
+        for leaf in jax.tree.leaves(tree)
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+    ]
+    if not checks:
+        return jnp.bool_(True)
+    ok = checks[0]
+    for c in checks[1:]:
+        ok = ok & c
+    return ok
+
+
+def _guard_nonfinite(cfg: EngineConfig, agg, new_net_state, net_state,
+                     new_rows, client_rows, out_metrics):
+    """EngineConfig.on_nonfinite="skip": if the aggregated wire or the new
+    mutable collections carry NaN/Inf, zero the aggregate's float leaves
+    (the fully-dropped-round semantics: momentum decays, state stays clean)
+    and keep the previous net_state / per-client rows. The skip is recorded
+    in metrics as nonfinite_rounds. Also returns the `ok` verdict so the
+    caller can gate the DP participant count — a skipped round transmits
+    nothing, so it must release nothing (noising the zeroed wire would feed
+    pure noise into momentum/error feedback, breaking the clean-state
+    promise). On the finite path every jnp.where predicate is true, so the
+    guard is bit-transparent."""
+    if cfg.on_nonfinite != "skip":
+        return agg, new_net_state, new_rows, out_metrics, jnp.bool_(True)
+    ok = (_tree_finite(agg) & _tree_finite(new_net_state)
+          & _tree_finite(new_rows))
+
+    def zero_floats(a):
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return jnp.where(ok, a, jnp.zeros_like(a))
+        return a
+
+    agg = jax.tree.map(zero_floats, agg)
+    new_net_state = jax.tree.map(
+        lambda new, old: jnp.where(ok, new, old), new_net_state, net_state
+    )
+    new_rows = jax.tree.map(
+        lambda new, old: jnp.where(ok, new, old), new_rows, client_rows
+    )
+    out_metrics = _skip_metrics(ok, out_metrics)
+    return agg, new_net_state, new_rows, out_metrics, ok
+
+
+def _skip_metrics(ok, out_metrics) -> dict:
+    """The one source of truth for a skipped round's metric semantics (used
+    by BOTH the fused guard and the split client reduce, so split == fused
+    metric parity can't drift): zero the round's training-stat sums
+    (loss_sum/count/... came from the poisoned forward pass, and one NaN
+    loss_sum would NaN the whole eval window), keep participants (the
+    clients DID transmit; only the server discards), and emit the
+    nonfinite_rounds flag."""
+    out_metrics = {
+        k: v if k == "participants" else jnp.where(ok, v, jnp.zeros_like(v))
+        for k, v in out_metrics.items()
+    }
+    out_metrics["nonfinite_rounds"] = (~ok).astype(jnp.float32)
+    return out_metrics
 
 
 def _merge_net_state(nstates, net_state, part) -> Any:
@@ -373,8 +452,14 @@ def make_round_step(
             new_net_state = _merge_net_state(nstates, net_state, part)
             out_metrics = _survivor_metrics(metrics, part)
 
+        agg, new_net_state, new_rows, out_metrics, fin_ok = _guard_nonfinite(
+            cfg, agg, new_net_state, net_state, new_rows, client_rows,
+            out_metrics,
+        )
         if cfg.dp_noise > 0:
-            agg = _dp_noise_agg(cfg, agg, part.sum(), noise_rng)
+            # fin_ok gates the count: a skipped round is a fully-dropped
+            # cohort, and _dp_noise_agg releases nothing for an empty round
+            agg = _dp_noise_agg(cfg, agg, part.sum() * fin_ok, noise_rng)
 
         # weight-delta modes: local steps already carry the client lr; the
         # server applies the averaged delta at the configured server rate
@@ -450,10 +535,26 @@ def make_split_round_step(
         weighted, new_net_state, out_metrics = _finalize_client_reduce(
             mcfg, wsum, ns_sum, m_sum, net_state, part
         )
+        if cfg.on_nonfinite == "skip":
+            # same verdict the fused step computes from the compressed agg:
+            # compression (sketch sums / dense passthrough) propagates every
+            # NaN/Inf, so finiteness of `weighted` == finiteness of the wire
+            ok = jnp.isfinite(weighted).all() & _tree_finite(new_net_state)
+            out_metrics = _skip_metrics(ok, out_metrics)
         return weighted, new_net_state, out_metrics, noise_rng
 
     def server_step(state, weighted, new_net_state, participants, lr, noise_rng):
         pflat, unravel = ravel_pytree(state["params"])
+        if cfg.on_nonfinite == "skip":
+            ok = jnp.isfinite(weighted).all() & _tree_finite(new_net_state)
+            weighted = jnp.where(ok, weighted, jnp.zeros_like(weighted))
+            new_net_state = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old),
+                new_net_state, state["net_state"],
+            )
+            # a skipped round transmits nothing and must release nothing:
+            # zero the count so _dp_noise_agg's empty-round gate kicks in
+            participants = participants * ok
         agg = _compress_reduced(mcfg, weighted)
         if cfg.dp_noise > 0:
             agg = _dp_noise_agg(cfg, agg, participants, noise_rng)

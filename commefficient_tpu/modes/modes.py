@@ -261,14 +261,17 @@ def server_step_sparse(
 def apply_delta(pflat: jnp.ndarray, delta: dict) -> jnp.ndarray:
     """params - delta for a wire-form delta (see server_step_sparse).
     Honors idx = -1 padding (zero contribution) like every other sparse
-    consumer (to_dense, sketch_sparse): clip + zero, since a raw -1 would
-    wrap to pflat[d-1] — harmless only while padded vals are 0.0."""
+    consumer (to_dense, sketch_sparse): clip + zero. BOTH bounds matter —
+    a raw -1 would wrap to pflat[d-1], and an idx >= d clips to d-1, so
+    either side with a nonzero val would silently corrupt the last
+    parameter."""
     if "dense" in delta:
         return pflat - delta["dense"]
     idx = delta["idx"]
     vals = delta["vals"].astype(pflat.dtype)
-    safe = jnp.clip(idx, 0, pflat.shape[0] - 1)
-    return pflat.at[safe].add(-jnp.where(idx >= 0, vals, 0.0))
+    d = pflat.shape[0]
+    safe = jnp.clip(idx, 0, d - 1)
+    return pflat.at[safe].add(-jnp.where((idx >= 0) & (idx < d), vals, 0.0))
 
 
 def delta_support(d: int, delta: dict) -> jnp.ndarray:

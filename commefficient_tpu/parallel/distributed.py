@@ -45,7 +45,8 @@ def detected() -> bool:
     return len([h for h in hosts.split(",") if h.strip()]) >= 2
 
 
-def initialize(force: bool = False, **kwargs) -> bool:
+def initialize(force: bool = False, fault_plan=None, retry_policy=None,
+               **kwargs) -> bool:
     """Join the multi-host cluster (idempotent). Returns True if the
     distributed runtime is (now) initialized.
 
@@ -57,6 +58,13 @@ def initialize(force: bool = False, **kwargs) -> bool:
       (kwargs pass through to `jax.distributed.initialize`, e.g.
       coordinator_address/num_processes/process_id for non-TPU clusters
       where auto-detection has nothing to read).
+
+    The join itself runs under bounded retries (resilience/retry, site
+    "dist_init"): the common real-world failure is the coordinator not
+    listening YET — pod hosts come up in arbitrary order — which a short
+    backoff rides out where the old single attempt killed the job.
+    `fault_plan` injects scheduled transient failures at the same site so
+    tests exercise exactly this path.
     """
     global _INITIALIZED
     if _INITIALIZED:
@@ -67,6 +75,7 @@ def initialize(force: bool = False, **kwargs) -> bool:
 
     import jax
 
+    from ..resilience import retry as rtry
     from ..utils.hermetic import backends_initialized
 
     if backends_initialized():
@@ -77,8 +86,26 @@ def initialize(force: bool = False, **kwargs) -> bool:
             raise RuntimeError(msg)
         print(f"warning: {msg}", file=sys.stderr, flush=True)
         return False
+
+    def join():
+        if fault_plan is not None:
+            fault_plan.fire_transient("dist_init")
+        try:
+            jax.distributed.initialize(**kwargs)
+        except Exception:
+            # a failed connect leaves jax's global client assigned, and every
+            # later initialize() then raises "should only be called once" —
+            # the retry would mask the real connectivity error and could
+            # never succeed. Tear the half-initialized state down so the
+            # next attempt is a genuine one.
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            raise
+
     try:
-        jax.distributed.initialize(**kwargs)
+        rtry.with_retries(join, site="dist_init", policy=retry_policy)
     except Exception as e:  # noqa: BLE001 — auto mode degrades, forced raises
         if force:
             raise
@@ -89,7 +116,7 @@ def initialize(force: bool = False, **kwargs) -> bool:
     return True
 
 
-def initialize_from_args(args) -> bool:
+def initialize_from_args(args, fault_plan=None, retry_policy=None) -> bool:
     """CLI adapter: explicit cluster flags imply force (a user who typed a
     coordinator address wants a cluster — silently training single-host on
     each node would be the worst failure mode)."""
@@ -98,7 +125,9 @@ def initialize_from_args(args) -> bool:
                           ("num_processes", args.num_processes),
                           ("process_id", args.process_id)) if v is not None
     }
-    return initialize(force=args.multihost or bool(cluster_kw), **cluster_kw)
+    return initialize(force=args.multihost or bool(cluster_kw),
+                      fault_plan=fault_plan, retry_policy=retry_policy,
+                      **cluster_kw)
 
 
 def process_info() -> dict:

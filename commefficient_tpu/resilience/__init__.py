@@ -1,0 +1,37 @@
+"""Deterministic fault injection + failure recovery for the federated engine.
+
+The ROADMAP north star is paper-scale runs that survive preemption, IO
+flakes, and numeric blowups instead of dying silently (the round-5 FEMNIST
+stall the watchdog could only warn about). This package holds the two halves:
+
+- `faults`: a seeded `FaultPlan` that injects failures at named sites and
+  scheduled rounds — simulated preemption (SIGTERM mid-round), checkpoint
+  corruption/partial writes, data-loader stalls, transient
+  `jax.distributed` init failures, NaN/Inf gradient bursts. Everything is
+  off unless a plan is supplied (`--fault_plan`), and a given plan replays
+  identically run-to-run so chaos tests can pin bit-exact recovery.
+- `retry`: bounded retries with exponential backoff + deterministic jitter,
+  wrapped around checkpoint IO, distributed init, and data loading.
+- `preemption`: a SIGTERM handler that finishes the in-flight round, takes
+  an emergency checkpoint, and exits with a resumable status.
+
+The recovery machinery these prove out lives where the failures happen:
+atomic + checksummed checkpoints in `utils.checkpoint`, the non-finite
+round guard in `federated.engine` (EngineConfig.on_nonfinite), and the
+`RoundWatchdog` escalation ladder in `utils.watchdog`.
+"""
+
+from .faults import FaultPlan, FaultSpec, InjectedFault, InjectedTransientError
+from .preemption import EXIT_RESUMABLE, PreemptionHandler
+from .retry import RetryPolicy, with_retries
+
+__all__ = [
+    "EXIT_RESUMABLE",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "InjectedTransientError",
+    "PreemptionHandler",
+    "RetryPolicy",
+    "with_retries",
+]

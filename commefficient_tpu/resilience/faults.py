@@ -1,0 +1,296 @@
+"""Deterministic fault injection — a seeded, scheduled `FaultPlan`.
+
+A plan is parsed from a compact CLI string (`--fault_plan`) of `;`-separated
+entries, each `kind[@round,round,...][:key=val,...]`:
+
+    preempt@3                   SIGTERM this process as round 3 runs (the
+                                preemption handler finishes the round, takes
+                                an emergency checkpoint, exits resumable)
+    stall@2:secs=1.5            sleep 1.5 s in round 2's data-load path
+                                (exercises the watchdog)
+    data_fail@1:times=2         raise a transient error twice in round 1's
+                                data load (recovered by the retry wrapper)
+    nonfinite@4                 poison round 4's client batches with NaN
+                                (value=inf for an Inf burst) so the round's
+                                updates go non-finite through the REAL
+                                gradient path
+    ckpt_fail@2:times=1         transient error on the round-2 checkpoint
+                                write (recovered by retry)
+    ckpt_corrupt@2              flip a byte of the round-2 checkpoint AFTER
+                                it commits (caught by manifest verification
+                                at restore)
+    ckpt_partial@2              truncate a round-2 checkpoint file (simulated
+                                partial write)
+    dist_init:times=2           fail `jax.distributed` bootstrap twice
+                                (recovered by retry)
+    seed=7                      recorded on the plan for reproducibility
+                                reporting (every current site is
+                                deterministic — nothing is drawn from it)
+
+Round numbers are GLOBAL round indices (session.round), so a plan replays
+correctly across checkpoint resume: `preempt@3` does not re-fire in the
+resumed run that starts at round 4. Every injection site is a no-op without
+a matching spec, and `FaultPlan.parse("")` is None — no plan, zero behavior
+change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+# allowed param keys per kind: a typo'd key ("time=5" for "times=5") must
+# fail parse, not silently fall back to the default and under-inject — the
+# vacuous-chaos-test failure mode this module exists to prevent
+KINDS = {
+    "preempt": (),
+    "stall": ("secs",),
+    "data_fail": ("times",),
+    "nonfinite": ("value",),
+    "ckpt_fail": ("times",),
+    "ckpt_corrupt": (),
+    "ckpt_partial": (),
+    "dist_init": ("times",),
+}
+
+
+class InjectedFault(RuntimeError):
+    """Base class for every injected failure."""
+
+
+class InjectedTransientError(InjectedFault):
+    """An injected failure that a retry wrapper is expected to recover."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    kind: str
+    rounds: tuple[int, ...] = ()  # empty = any round (site fires whenever hit)
+    params: dict = dataclasses.field(default_factory=dict)
+
+    def matches(self, rnd: int | None) -> bool:
+        return not self.rounds or (rnd is not None and rnd in self.rounds)
+
+
+def _parse_entry(entry: str) -> FaultSpec:
+    head, _, tail = entry.partition(":")
+    kind, _, rounds_s = head.partition("@")
+    kind = kind.strip()
+    if kind not in KINDS:
+        raise ValueError(
+            f"unknown fault kind {kind!r} in --fault_plan entry {entry!r} "
+            f"(known: {', '.join(KINDS)})"
+        )
+    try:
+        rounds = tuple(
+            int(r) for r in rounds_s.split(",") if r.strip()
+        ) if rounds_s else ()
+    except ValueError:
+        raise ValueError(
+            f"bad @round list {rounds_s!r} in --fault_plan entry {entry!r} "
+            "(expected comma-separated integers)"
+        ) from None
+    if kind == "dist_init" and rounds:
+        # dist_init fires at bootstrap, before any round exists (the site
+        # passes rnd=None): a scheduled spec would parse fine and then
+        # silently never inject — reject it at launch instead
+        raise ValueError(
+            f"fault kind 'dist_init' fires at bootstrap and cannot take an "
+            f"@round schedule (entry {entry!r})"
+        )
+    params: dict = {}
+    if tail:
+        for kv in tail.split(","):
+            k, _, v = kv.partition("=")
+            if not _:
+                raise ValueError(f"bad param {kv!r} in --fault_plan entry {entry!r}")
+            k, v = k.strip(), v.strip()
+            if k not in KINDS[kind]:
+                raise ValueError(
+                    f"unknown param {k!r} for fault kind {kind!r} in "
+                    f"--fault_plan entry {entry!r} "
+                    f"(allowed: {', '.join(KINDS[kind]) or 'none'})"
+                )
+            # coerce at PARSE time: a bad value must reject the plan at
+            # launch, not crash hours later at the scheduled round
+            try:
+                if k == "times":
+                    params[k] = int(v)
+                elif k == "secs":
+                    params[k] = float(v)
+                elif k == "value":
+                    if v not in ("nan", "inf"):
+                        raise ValueError("expected 'nan' or 'inf'")
+                    params[k] = v
+            except ValueError as e:
+                raise ValueError(
+                    f"bad value {v!r} for param {k!r} in --fault_plan entry "
+                    f"{entry!r} ({e})"
+                ) from None
+    return FaultSpec(kind=kind, rounds=rounds, params=params)
+
+
+class FaultPlan:
+    """The parsed plan plus the mutable bookkeeping that makes injection
+    deterministic: per-(kind, round) attempt counters for transient faults
+    and a fired-set for one-shot faults, so a site hit twice (e.g. a retried
+    call) sees exactly the scheduled number of failures."""
+
+    def __init__(self, specs: list[FaultSpec], seed: int = 0, text: str = ""):
+        self.specs = list(specs)
+        # recorded for reproducibility reporting; every current site is
+        # fully deterministic, so no RNG is drawn from it (yet)
+        self.seed = seed
+        self.text = text
+        self._attempts: dict[tuple, int] = {}
+        self._fired: set[tuple] = set()
+
+    def __repr__(self):
+        return f"FaultPlan({self.text!r})"
+
+    @classmethod
+    def parse(cls, text: str | None) -> "FaultPlan | None":
+        """None/empty -> no plan (the off-by-default contract)."""
+        if not text or not text.strip():
+            return None
+        seed, specs = 0, []
+        for entry in text.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if entry.startswith("seed="):
+                try:
+                    seed = int(entry.split("=", 1)[1])
+                except ValueError:
+                    raise ValueError(
+                        f"bad seed in --fault_plan entry {entry!r} "
+                        "(expected an integer)"
+                    ) from None
+                continue
+            specs.append(_parse_entry(entry))
+        return cls(specs, seed=seed, text=text)
+
+    def spec(self, kind: str, rnd: int | None = None) -> FaultSpec | None:
+        for s in self.specs:
+            if s.kind == kind and s.matches(rnd):
+                return s
+        return None
+
+    def _log(self, msg: str):
+        print(f"fault-injection: {msg}", file=sys.stderr, flush=True)
+
+    # ---------------------------------------------------------- named sites
+
+    def fire_transient(self, kind: str, rnd: int | None = None):
+        """Raise InjectedTransientError while the spec's `times` budget
+        (default 1) for this (kind, round) has failures left; succeed after."""
+        s = self.spec(kind, rnd)
+        if s is None:
+            return
+        key = (kind, rnd if s.rounds else None)
+        n = self._attempts.get(key, 0)
+        times = int(s.params.get("times", 1))
+        if n < times:
+            self._attempts[key] = n + 1
+            self._log(f"{kind} transient failure {n + 1}/{times} (round {rnd})")
+            raise InjectedTransientError(
+                f"injected {kind} failure {n + 1}/{times} (round {rnd})"
+            )
+
+    def data_load(self, rnd: int):
+        """Data-loader site: a scheduled stall sleeps once (watchdog fodder);
+        a scheduled data_fail raises transiently (retry fodder). Called
+        BEFORE the loader consumes any host RNG, so a retried attempt
+        replays the identical client batch."""
+        s = self.spec("stall", rnd)
+        if s is not None and ("stall", rnd) not in self._fired:
+            self._fired.add(("stall", rnd))
+            secs = float(s.params.get("secs", 1.0))
+            self._log(f"stalling data load {secs}s (round {rnd})")
+            time.sleep(secs)
+        self.fire_transient("data_fail", rnd)
+
+    def poison(self, rnd: int, batch: dict):
+        """NaN/Inf gradient burst: fill every float leaf of the assembled
+        client batch so the round's updates go non-finite through the real
+        vmapped gradient path (caught by EngineConfig.on_nonfinite)."""
+        s = self.spec("nonfinite", rnd)
+        if s is None:
+            return batch
+        val = np.inf if s.params.get("value", "nan") == "inf" else np.nan
+        poisoned = 0
+
+        def bad(a):
+            nonlocal poisoned
+            a = np.asarray(a)
+            if np.issubdtype(a.dtype, np.floating):
+                poisoned += 1
+                return np.full_like(a, val)
+            return a
+
+        out = {k: bad(v) for k, v in batch.items()}
+        if poisoned:
+            self._log(f"poisoning round {rnd} client batch with {val} "
+                      f"({poisoned} float leaves)")
+        else:
+            # e.g. token-id batches (gpt2/personachat) are all-int: nothing
+            # to poison, and claiming otherwise would make a chaos test
+            # pass vacuously
+            self._log(f"nonfinite@{rnd}: batch has no float leaves; "
+                      "injection is a NO-OP (int-only inputs — poison the "
+                      "gradients via a float task instead)")
+        return out
+
+    def preempt(self, rnd: int):
+        """Simulated preemption: deliver a real SIGTERM to this process as
+        the scheduled round runs (one-shot). The PreemptionHandler turns it
+        into finish-round -> emergency checkpoint -> resumable exit."""
+        s = self.spec("preempt", rnd)
+        if s is None or ("preempt", rnd) in self._fired:
+            return
+        self._fired.add(("preempt", rnd))
+        self._log(f"injecting SIGTERM mid-round (round {rnd})")
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def corrupt_checkpoint(self, rnd: int, path: str):
+        """Post-commit checkpoint damage (one-shot per kind+round):
+        ckpt_corrupt flips one byte of the largest data file; ckpt_partial
+        truncates it to half. Both leave manifest.json intact, which is the
+        point — integrity verification at restore must catch the mismatch."""
+        for kind in ("ckpt_corrupt", "ckpt_partial"):
+            s = self.spec(kind, rnd)
+            if s is None or (kind, rnd) in self._fired:
+                continue
+            self._fired.add((kind, rnd))
+            target = self._largest_data_file(path)
+            if target is None:
+                continue
+            if kind == "ckpt_corrupt":
+                with open(target, "r+b") as f:
+                    f.seek(os.path.getsize(target) // 2)
+                    b = f.read(1)
+                    f.seek(-1, os.SEEK_CUR)
+                    f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+                self._log(f"corrupted checkpoint byte: {target} (round {rnd})")
+            else:
+                with open(target, "r+b") as f:
+                    f.truncate(max(os.path.getsize(target) // 2, 1))
+                self._log(f"truncated checkpoint file: {target} (round {rnd})")
+
+    @staticmethod
+    def _largest_data_file(path: str) -> str | None:
+        best, best_size = None, -1
+        for root, _, files in os.walk(path):
+            for f in files:
+                if f == "manifest.json":
+                    continue
+                full = os.path.join(root, f)
+                size = os.path.getsize(full)
+                if size > best_size:
+                    best, best_size = full, size
+        return best

@@ -1,0 +1,63 @@
+"""Preemption handling: SIGTERM -> finish the in-flight round -> emergency
+checkpoint -> exit with a resumable status.
+
+Cloud TPU/GPU schedulers preempt with SIGTERM and a grace window. The old
+behavior (default handler) killed the process mid-round, losing everything
+since the last scheduled checkpoint. The handler here only sets a flag; the
+training loop checks it at round-block boundaries, where the server state is
+consistent, takes an emergency checkpoint, and exits `EXIT_RESUMABLE` so a
+supervisor (k8s restartPolicy, a bash wrapper, scripts/chaos_smoke.sh) knows
+to relaunch with `--resume`. Because checkpoints capture the full state —
+params, mode state, round counter, host sampling RNG — the resumed run
+replays the uninterrupted client sequence bit-for-bit
+(tests/test_resilience.py chaos test pins this).
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+
+# EX_TEMPFAIL: "temporary failure, retry later" — the exit status contract
+# for "relaunch me with --resume"
+EXIT_RESUMABLE = 75
+
+
+class PreemptionHandler:
+    """Context manager installing a flag-setting handler for `signals`
+    (default SIGTERM). The previous handlers are restored on exit so nested
+    users (tests, notebooks) don't leak signal state.
+
+        with PreemptionHandler() as pre:
+            while ...:
+                run_block()
+                if pre.triggered:
+                    checkpoint(); sys.exit(EXIT_RESUMABLE)
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self.triggered = False
+        self._prev: dict = {}
+
+    def _on_signal(self, signum, frame):
+        if not self.triggered:
+            print(
+                f"preemption: received {signal.Signals(signum).name}; will "
+                "finish the in-flight round, take an emergency checkpoint, "
+                f"and exit {EXIT_RESUMABLE} (resumable)",
+                file=sys.stderr,
+                flush=True,
+            )
+        self.triggered = True
+
+    def __enter__(self) -> "PreemptionHandler":
+        for sig in self.signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        return self
+
+    def __exit__(self, *exc):
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+        return False

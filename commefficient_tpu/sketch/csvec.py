@@ -460,7 +460,9 @@ def unsketch_threshold(
 
 
 def to_dense(d: int, idx: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
-    """Scatter (idx, vals) into a dense [d] vector; idx < 0 entries ignored."""
+    """Scatter (idx, vals) into a dense [d] vector; out-of-range entries
+    (idx < 0 padding, idx >= d) contribute nothing — clip alone would fold
+    an idx >= d contribution onto element d-1."""
     safe = jnp.clip(idx, 0, d - 1)
-    contrib = jnp.where(idx >= 0, vals, 0.0)
+    contrib = jnp.where((idx >= 0) & (idx < d), vals, 0.0)
     return jnp.zeros((d,), dtype=vals.dtype).at[safe].add(contrib)

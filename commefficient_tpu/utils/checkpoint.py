@@ -1,12 +1,32 @@
 """Checkpoint / resume (SURVEY.md §5: the reference has only a minimal model
 save; the rebuild checkpoints the full server state — params, net_state,
 Vvelocity/Verror, per-client state, round counter, host RNG — via orbax, so a
-run can resume mid-schedule at the exact round)."""
+run can resume mid-schedule at the exact round).
+
+Hardened for long paper-scale runs (resilience/):
+
+- **Atomic commit**: everything (orbax tree, host RNG, meta, manifest) is
+  written into a `.tmp_round_*` staging dir, then `os.rename`d to its final
+  `round_*` name. A crash mid-write leaves only a staging dir, which
+  `latest()`/`restore_latest()` never consider and the next save sweeps.
+- **Integrity manifest**: `manifest.json` records a sha256 per file, written
+  last. `verify()` checks it; `restore_latest()` walks newest-to-oldest and
+  falls back LOUDLY past any checkpoint that fails verification or restore,
+  so a corrupted/truncated latest checkpoint costs one checkpoint interval,
+  not the run.
+- **Retries + fault injection**: the write path runs under
+  `resilience.retry` (site "ckpt_save"), and a `FaultPlan` can inject
+  transient write failures or post-commit corruption to prove the above.
+"""
 
 from __future__ import annotations
 
+import contextlib
+import hashlib
 import json
 import os
+import shutil
+import sys
 from typing import Any
 
 import numpy as np
@@ -14,37 +34,151 @@ import numpy as np
 import jax
 import orbax.checkpoint as ocp
 
+from ..resilience import retry as rtry
 
-def _unpadded_client_state(session):
+MANIFEST = "manifest.json"
+_TMP_PREFIX = ".tmp_round_"
+
+
+def _unpadded_client_state(client_state, num_clients: int):
     """Host copy of per-client state with mesh-padding rows stripped, so a
     checkpoint is portable between sharded and unsharded sessions (the mesh
     session pads [num_clients, d] to a multiple of the client-axis size)."""
-    n = session.train_set.num_clients
-    return jax.tree.map(lambda a: np.asarray(a)[:n], jax.device_get(session.client_state))
+    return jax.tree.map(lambda a: np.asarray(a)[:num_clients],
+                        jax.device_get(client_state))
 
 
-def save(ckpt_dir: str, session, keep: int = 3):
-    path = os.path.abspath(os.path.join(ckpt_dir, f"round_{session.round:08d}"))
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_manifest(path: str):
+    sums = {}
+    for root, _, files in os.walk(path):
+        for f in sorted(files):
+            if f == MANIFEST:
+                continue
+            full = os.path.join(root, f)
+            sums[os.path.relpath(full, path)] = _sha256(full)
+    with open(os.path.join(path, MANIFEST), "w") as f:
+        json.dump({"files": sums}, f)
+
+
+def verify(path: str) -> bool | None:
+    """True: manifest present and every file matches. False: mismatch,
+    missing file, or unreadable manifest. None: no manifest (pre-hardening
+    checkpoint — can't verify; restore_latest still tries it)."""
+    mf = os.path.join(path, MANIFEST)
+    if not os.path.exists(mf):
+        return None
+    try:
+        with open(mf) as f:
+            sums = json.load(f)["files"]
+    except Exception:
+        return False
+    for rel, digest in sums.items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full) or _sha256(full) != digest:
+            return False
+    return True
+
+
+def save(ckpt_dir: str, session, keep: int = 3, fault_plan=None,
+         retry_policy: rtry.RetryPolicy | None = None):
+    # capture every session field under the session's mutation lock (when it
+    # has one): an emergency save on the watchdog's timer thread must never
+    # mix round N's params with round N-1's counter/RNG because the stalled
+    # round un-stuck mid-save. jax arrays are immutable, so holding
+    # references is a consistent frozen view — the expensive device_get
+    # happens after the lock is released. (The references stay READABLE
+    # mid-round only because sessions that arm emergency saves disable
+    # state donation — FederatedSession donate_state=False; a donated
+    # state would be deleted buffers for the whole in-flight round.)
+    lock = getattr(session, "mutate_lock", None) or contextlib.nullcontext()
+    with lock:
+        rnd = session.round
+        state_ref = session.state
+        client_state_ref = session.client_state
+        # RNG as of the last COMPLETED round (FederatedSession.rng_snapshot),
+        # not the live streams: mid-round the live streams are already
+        # advanced for the in-flight round, and a resumed run would train a
+        # different cohort. The device key covers participation masks / DP
+        # noise — without it a resumed run replays the client sequence but
+        # draws FRESH dropout masks.
+        rng_state, device_key = getattr(session, "rng_snapshot", None) or (
+            session.rng.get_state(), session._rng_key
+        )
+        comm_mb_total = float(session.comm_mb_total)
+        num_workers = session.num_workers
+    final = os.path.abspath(os.path.join(ckpt_dir, f"round_{rnd:08d}"))
+    staging = os.path.abspath(os.path.join(ckpt_dir, f"{_TMP_PREFIX}{rnd:08d}"))
+
+    # snapshot the full payload ONCE, outside the retry closure: the state is
+    # identical across attempts, and re-pulling hundreds of MB over a
+    # tunnelled TPU link on every filesystem flake would make retries
+    # expensive exactly when the run is already struggling
     payload = {
-        "state": jax.device_get(session.state),
-        "round": session.round,
+        "state": jax.device_get(state_ref),
+        "round": rnd,
     }
-    if session.client_state is not None:
-        payload["client_state"] = _unpadded_client_state(session)
-    ckpt = ocp.PyTreeCheckpointer()
-    ckpt.save(path, payload, force=True)
-    # host-side sampling RNG, so resumed runs replay the same client sequence
-    rng_state = session.rng.get_state()
-    np.save(os.path.join(path, "host_rng.npy"),
-            np.array([rng_state[0], rng_state[1].tolist(), rng_state[2], rng_state[3],
-                      rng_state[4]], dtype=object), allow_pickle=True)
-    # measured cumulative communication: per-round figures vary with dropout
-    # survivors and local_topk's measured down-link, so round * static-estimate
-    # would overstate resumed runs. num_workers makes a cohort-size change
-    # across the checkpoint boundary loud at restore (it breaks exact replay).
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump({"comm_mb_total": float(session.comm_mb_total),
-                   "num_workers": session.num_workers}, f)
+    if client_state_ref is not None:
+        payload["client_state"] = _unpadded_client_state(
+            client_state_ref, session.train_set.num_clients
+        )
+    device_key = np.asarray(jax.device_get(device_key))
+
+    def attempt():
+        if fault_plan is not None:
+            fault_plan.fire_transient("ckpt_fail", rnd)
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        ocp.PyTreeCheckpointer().save(staging, payload, force=True)
+        # host-side sampling RNG, so resumed runs replay the same client
+        # sequence
+        np.save(os.path.join(staging, "host_rng.npy"),
+                np.array([rng_state[0], rng_state[1].tolist(), rng_state[2],
+                          rng_state[3], rng_state[4]], dtype=object),
+                allow_pickle=True)
+        np.save(os.path.join(staging, "device_rng.npy"), device_key)
+        # measured cumulative communication: per-round figures vary with
+        # dropout survivors and local_topk's measured down-link, so
+        # round * static-estimate would overstate resumed runs. num_workers
+        # makes a cohort-size change across the checkpoint boundary loud at
+        # restore (it breaks exact replay).
+        with open(os.path.join(staging, "meta.json"), "w") as f:
+            json.dump({"comm_mb_total": comm_mb_total,
+                       "num_workers": num_workers}, f)
+        _write_manifest(staging)
+        # overwrite (emergency save of a round already checkpointed): rename
+        # the committed copy ASIDE first — a delete-then-rename would leave a
+        # window (the whole rmtree) where round_N's only copy is gone, and
+        # the watchdog's abort stage is designed to fire during this save.
+        # The displaced name still starts with "round_", so if the process
+        # dies between the two renames, restore_latest() finds the displaced
+        # copy (same round, same state — both saves capture the same
+        # round-boundary snapshot) instead of silently losing the round.
+        old = None
+        if os.path.isdir(final):
+            old = final + ".displaced"
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            os.rename(final, old)
+        os.rename(staging, final)  # the atomic commit point
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+        return final
+
+    path = rtry.with_retries(
+        attempt, site="ckpt_save", policy=retry_policy, seed=rnd
+    )
+    if fault_plan is not None:
+        # post-commit damage (ckpt_corrupt/ckpt_partial) — lands AFTER the
+        # manifest so verification, not luck, has to catch it
+        fault_plan.corrupt_checkpoint(rnd, path)
     _prune(ckpt_dir, keep)
     return path
 
@@ -66,7 +200,9 @@ def restore(path: str, session) -> None:
         "round": 0,
     }
     if session.client_state is not None:
-        template["client_state"] = _unpadded_client_state(session)
+        template["client_state"] = _unpadded_client_state(
+            session.client_state, session.train_set.num_clients
+        )
     payload = ckpt.restore(path, item=template)
 
     def _place(a, like):
@@ -95,6 +231,11 @@ def restore(path: str, session) -> None:
         s = np.load(rng_file, allow_pickle=True)
         session.rng.set_state((s[0], np.asarray(s[1], dtype=np.uint32), int(s[2]),
                                int(s[3]), float(s[4])))
+    key_file = os.path.join(path, "device_rng.npy")
+    if os.path.exists(key_file):  # pre-hardening checkpoints lack it
+        session._rng_key = jax.numpy.asarray(np.load(key_file))
+    if hasattr(session, "_snapshot_rng"):
+        session._snapshot_rng()  # restored streams ARE a round boundary
     meta_file = os.path.join(path, "meta.json")
     if os.path.exists(meta_file):
         with open(meta_file) as f:
@@ -115,13 +256,57 @@ def restore(path: str, session) -> None:
         session.comm_mb_total = session.round * session.comm_per_round["comm_total_mb"]
 
 
+def restore_latest(ckpt_dir: str, session) -> str | None:
+    """Restore the newest checkpoint that verifies AND restores, falling
+    back loudly past damaged ones. Returns the restored path, or None when
+    the directory holds no checkpoints (a fresh run). Raises when
+    checkpoints exist but ALL are unrecoverable — silently restarting a
+    long run from round 0 would be the worst outcome."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    rounds = sorted(
+        (d for d in os.listdir(ckpt_dir) if d.startswith("round_")),
+        reverse=True,
+    )
+    if not rounds:
+        return None
+    for i, name in enumerate(rounds):
+        path = os.path.abspath(os.path.join(ckpt_dir, name))
+        if verify(path) is False:
+            print(
+                f"ERROR: checkpoint {path} FAILED integrity verification "
+                "(corrupt or partial write); falling back to the previous "
+                "verified-good checkpoint",
+                file=sys.stderr, flush=True,
+            )
+            continue
+        try:
+            restore(path, session)
+        except Exception as e:  # noqa: BLE001 — fall back past broken trees
+            print(
+                f"ERROR: checkpoint {path} failed to restore "
+                f"({type(e).__name__}: {e}); falling back to the previous "
+                "verified-good checkpoint",
+                file=sys.stderr, flush=True,
+            )
+            continue
+        if i > 0:
+            print(
+                f"recovered: restored {path} after skipping {i} damaged "
+                "checkpoint(s)",
+                file=sys.stderr, flush=True,
+            )
+        return path
+    raise RuntimeError(
+        f"no restorable checkpoint in {ckpt_dir}: all {len(rounds)} "
+        "candidates failed verification or restore"
+    )
+
+
 def _prune(ckpt_dir: str, keep: int):
-    rounds = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("round_"))
-    for stale in rounds[:-keep]:
-        full = os.path.join(ckpt_dir, stale)
-        for root, dirs, files in os.walk(full, topdown=False):
-            for f in files:
-                os.unlink(os.path.join(root, f))
-            for d in dirs:
-                os.rmdir(os.path.join(root, d))
-        os.rmdir(full)
+    names = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("round_"))
+    stale = names[:-keep] if keep > 0 else []
+    # abandoned staging dirs (crash mid-write) are dead weight: sweep them
+    stale += [d for d in os.listdir(ckpt_dir) if d.startswith(_TMP_PREFIX)]
+    for name in stale:
+        shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)

@@ -98,6 +98,39 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "--coordinator_address)")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible")
+    # resilience (resilience/: fault injection + failure recovery)
+    p.add_argument("--fault_plan", default="",
+                   help="deterministic fault-injection plan: ';'-separated "
+                        "kind[@round,...][:key=val,...] entries — kinds: "
+                        "preempt (SIGTERM mid-round), stall:secs=S / "
+                        "data_fail:times=N (data-loader), nonfinite[:value="
+                        "inf] (NaN/Inf gradient burst), ckpt_fail:times=N / "
+                        "ckpt_corrupt / ckpt_partial (checkpoint IO), "
+                        "dist_init:times=N (distributed bootstrap), seed=N. "
+                        "Unset = zero injection, zero behavior change")
+    p.add_argument("--on_nonfinite", default="skip",
+                   choices=["off", "skip", "halt"],
+                   help="NaN/Inf aggregate guard: skip treats the poisoned "
+                        "round as fully-dropped (momentum/error state stay "
+                        "clean; counted in metrics), halt additionally "
+                        "checkpoints and exits, off restores the unguarded "
+                        "seed behavior (poison propagates into the params)")
+    p.add_argument("--max_retries", type=int, default=3,
+                   help="bounded retries (exponential backoff + jitter) for "
+                        "checkpoint IO, distributed init, and data loading")
+    p.add_argument("--no_emergency_checkpoint", action="store_true",
+                   help="disable the watchdog's MID-ROUND emergency "
+                        "checkpoint and keep server-state buffer donation "
+                        "(saves one full state copy in HBM — for runs that "
+                        "barely fit). Scheduled --checkpoint_every saves and "
+                        "the preemption checkpoint still work: both run at "
+                        "round boundaries where donation is safe")
+    p.add_argument("--watchdog_abort", action="store_true",
+                   help="arm the RoundWatchdog's final escalation stage: "
+                        "after warn -> stack dump -> emergency checkpoint, "
+                        "abort the wedged process with the resumable exit "
+                        "status so a supervisor relaunches with --resume "
+                        "(needs --checkpoint_dir)")
     # reference-CLI compatibility no-ops (SURVEY.md §5.6): the reference's
     # process/queue machinery needs them; the TPU engine has no worker
     # processes to pin or ports to bind. Accepted so reference launch
@@ -202,6 +235,13 @@ def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
     if getattr(args, "share_ps_gpu", False) or getattr(args, "port", 0):
         print("note: --share_ps_gpu/--port are reference-CLI compatibility "
               "no-ops (the TPU engine has no worker processes)", flush=True)
+    if getattr(args, "watchdog_abort", False) and not getattr(args, "checkpoint_dir", None):
+        # silently dropping the flag would leave a wedged run hanging for
+        # hours — the exact outcome the operator opted out of
+        raise SystemExit(
+            "--watchdog_abort needs --checkpoint_dir: aborting without an "
+            "emergency checkpoint would lose the run instead of resuming it"
+        )
     return args
 
 

@@ -17,7 +17,9 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import sys
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +32,9 @@ from commefficient_tpu.federated.api import (
 from commefficient_tpu.models.gpt2 import SMALL, TINY, GPT2LMHead
 from commefficient_tpu.models.losses import make_lm_loss
 from commefficient_tpu.parallel import mesh as meshlib, tp
+from commefficient_tpu.resilience import (
+    EXIT_RESUMABLE, FaultPlan, PreemptionHandler, RetryPolicy,
+)
 from commefficient_tpu.utils import checkpoint as ckpt
 from commefficient_tpu.utils.config import make_parser, mode_config_from_args, resolve_defaults
 from commefficient_tpu.utils.logging import TableLogger, Timer
@@ -37,7 +42,14 @@ from commefficient_tpu.utils.watchdog import RoundWatchdog
 from commefficient_tpu.utils.schedules import triangular
 
 
-def build(args):
+def build(args, fault_plan=None, retry_policy=None):
+    # direct callers (tests) pass args only; main() parses once and shares
+    # the SAME plan with distributed init and checkpoint IO so per-site
+    # injection counters stay coherent across the whole run
+    if fault_plan is None:
+        fault_plan = FaultPlan.parse(args.fault_plan)
+    if retry_policy is None:
+        retry_policy = RetryPolicy(max_retries=args.max_retries)
     if args.mc_coef > 0 and args.num_candidates < 2:
         raise SystemExit(
             "--mc_coef > 0 needs --num_candidates >= 2 (the MC head scores "
@@ -149,6 +161,14 @@ def build(args):
         client_dropout=args.client_dropout,
         split_compile=args.split_compile,
         client_chunk=args.client_chunk,
+        on_nonfinite=args.on_nonfinite,
+        fault_plan=fault_plan,
+        retry_policy=retry_policy,
+        # a checkpoint dir arms the watchdog's mid-round emergency save,
+        # which needs the live (non-donated) server state readable; the
+        # opt-out keeps donation for HBM-tight runs
+        donate_state=not (args.checkpoint_dir
+                          and not args.no_emergency_checkpoint),
     )
     if args.attn_impl == "ring" and session.mesh is None:
         raise SystemExit(
@@ -213,10 +233,13 @@ def make_f1_eval(args, model, tok, valid_set):
 
 def main(argv=None):
     args = resolve_defaults(make_parser("gpt2").parse_args(argv))
+    fault_plan = FaultPlan.parse(args.fault_plan)
+    retry_policy = RetryPolicy(max_retries=args.max_retries)
     from commefficient_tpu.parallel import distributed
-    if distributed.initialize_from_args(args):
+    if distributed.initialize_from_args(args, fault_plan=fault_plan,
+                                        retry_policy=retry_policy):
         print(f"multihost: {distributed.process_info()}", flush=True)
-    session, valid_set, extras = build(args)
+    session, valid_set, extras = build(args, fault_plan, retry_policy)
     f1_eval = (
         make_f1_eval(args, extras["model"], extras["tok"], valid_set)
         if args.eval_f1 > 0 else None
@@ -228,10 +251,20 @@ def main(argv=None):
                        rounds_per_epoch)
     model = FedModel(session)
 
+    # serialized: the watchdog's emergency checkpoint runs on its timer
+    # thread and must not race a scheduled save of the same round (both
+    # would target the same staging/final dirs)
+    ckpt_lock = threading.Lock()
+
+    def save_ckpt():
+        with ckpt_lock:
+            return ckpt.save(args.checkpoint_dir, session,
+                             fault_plan=fault_plan, retry_policy=retry_policy)
+
     if args.resume and args.checkpoint_dir:
-        path = ckpt.latest(args.checkpoint_dir)
+        # newest VERIFIED checkpoint; falls back loudly past damaged ones
+        path = ckpt.restore_latest(args.checkpoint_dir, session)
         if path:
-            ckpt.restore(path, session)
             opt.round = session.round
             print(f"resumed from {path} at round {session.round}", flush=True)
 
@@ -242,60 +275,89 @@ def main(argv=None):
     timer = Timer()
     eval_every = args.eval_every or min(rounds_per_epoch, 200)
     acc_loss = acc_count = acc_mc_correct = acc_mc_count = 0.0
-    watchdog = RoundWatchdog()  # hung-round alerts (utils/watchdog.py)
+    nonfinite_total = 0
+    # escalation ladder: warn -> stacks -> emergency ckpt -> (opt-in) abort
+    # with the resumable status so a supervisor relaunches with --resume
+    watchdog = RoundWatchdog(
+        on_emergency=save_ckpt
+        if args.checkpoint_dir and not args.no_emergency_checkpoint else None,
+        on_abort=(lambda: os._exit(EXIT_RESUMABLE))
+        if args.watchdog_abort and args.checkpoint_dir else None,
+    )
     rnd = session.round
-    while rnd < total_rounds:
-        lrs = plan_block(opt, rnd, total_rounds, eval_every,
-                         args.checkpoint_every, args.rounds_per_dispatch)
-        if len(lrs) > 1 and session.supports_block_dispatch:
-            # one dispatch for the block; the watchdog times the block
-            with watchdog.round(rnd):
-                ms = session.run_rounds(lrs)
-        else:
-            # per-round dispatch (stateful/split fallback): keep the
-            # watchdog per-round so a hang is detected at round, not
-            # block, granularity
-            ms = []
-            for j, lr in enumerate(lrs):
-                with watchdog.round(rnd + j):
-                    ms.append(session.run_round(lr))
-        for m in ms:
-            acc_loss += m["loss_sum"]
-            acc_count += m["count"]
-            acc_mc_correct += m.get("mc_correct", 0.0)
-            acc_mc_count += m.get("mc_count", 0.0)
-        rnd += len(lrs)
-        if args.checkpoint_every and args.checkpoint_dir and rnd % args.checkpoint_every == 0:
-            ckpt.save(args.checkpoint_dir, session)
-        if rnd % eval_every == 0 or rnd == total_rounds:
-            ev = model.eval(valid_set, args.eval_batch_size)
-            train_nll = acc_loss / max(acc_count, 1)
-            val_nll = ev["loss_sum"] / max(ev["count"], 1)
-            row = {
-                "round": rnd,
-                "epoch": rnd / rounds_per_epoch,
-                "lr": m["lr"],
-                "train_nll": train_nll,
-                "train_ppl": math.exp(min(train_nll, 20)),
-                "val_nll": val_nll,
-                "val_ppl": math.exp(min(val_nll, 20)),
-                # measured cumulative wire-cost (checkpointed/restored by the
-                # session, so resumed runs stay exact under dropout)
-                "comm_mb": session.comm_mb_total,
-                "time_s": timer(),
-            }
-            if args.mc_coef > 0:
-                row["mc_acc"] = acc_mc_correct / max(acc_mc_count, 1)
-                row["val_mc_acc"] = ev.get("mc_correct", 0.0) / max(ev.get("mc_count", 0.0), 1)
-            if f1_eval is not None:
-                row["val_f1"] = f1_eval(model.params, rnd)
-            logger.append(row)
-            acc_loss = acc_count = acc_mc_correct = acc_mc_count = 0.0
+    with PreemptionHandler() as pre:
+        while rnd < total_rounds:
+            lrs = plan_block(opt, rnd, total_rounds, eval_every,
+                             args.checkpoint_every, args.rounds_per_dispatch)
+            if len(lrs) > 1 and session.supports_block_dispatch:
+                # one dispatch for the block; the watchdog times the block
+                with watchdog.round(rnd):
+                    ms = session.run_rounds(lrs)
+            else:
+                # per-round dispatch (stateful/split fallback): keep the
+                # watchdog per-round so a hang is detected at round, not
+                # block, granularity
+                ms = []
+                for j, lr in enumerate(lrs):
+                    with watchdog.round(rnd + j):
+                        ms.append(session.run_round(lr))
+                    if pre.triggered:
+                        break  # stop inside the block: the grace window is short
+            for m in ms:
+                acc_loss += m["loss_sum"]
+                acc_count += m["count"]
+                acc_mc_correct += m.get("mc_correct", 0.0)
+                acc_mc_count += m.get("mc_count", 0.0)
+                nonfinite_total += int(m.get("nonfinite_rounds", 0))
+            rnd += len(ms)  # == len(lrs) unless preemption cut the block short
+            if pre.triggered:
+                if args.checkpoint_dir:
+                    path = save_ckpt()
+                    print(f"preemption: emergency checkpoint at round {rnd}: "
+                          f"{path}", flush=True)
+                sys.exit(EXIT_RESUMABLE)
+            if nonfinite_total and args.on_nonfinite == "halt":
+                if args.checkpoint_dir:
+                    save_ckpt()
+                sys.exit(f"halting at round {rnd}: non-finite update skipped "
+                         "(--on_nonfinite halt; "
+                         + ("state checkpointed clean)" if args.checkpoint_dir
+                            else "no --checkpoint_dir, nothing saved)"))
+            if args.checkpoint_every and args.checkpoint_dir and rnd % args.checkpoint_every == 0:
+                save_ckpt()
+            if rnd % eval_every == 0 or rnd == total_rounds:
+                ev = model.eval(valid_set, args.eval_batch_size)
+                train_nll = acc_loss / max(acc_count, 1)
+                val_nll = ev["loss_sum"] / max(ev["count"], 1)
+                row = {
+                    "round": rnd,
+                    "epoch": rnd / rounds_per_epoch,
+                    "lr": m["lr"],
+                    "train_nll": train_nll,
+                    "train_ppl": math.exp(min(train_nll, 20)),
+                    "val_nll": val_nll,
+                    "val_ppl": math.exp(min(val_nll, 20)),
+                    # measured cumulative wire-cost (checkpointed/restored by the
+                    # session, so resumed runs stay exact under dropout)
+                    "comm_mb": session.comm_mb_total,
+                    "time_s": timer(),
+                    # always present: TableLogger freezes its columns on the
+                    # first row, so a count first added mid-run would never
+                    # reach the stdout table an operator actually watches
+                    "nonfinite_rounds": nonfinite_total,
+                }
+                if args.mc_coef > 0:
+                    row["mc_acc"] = acc_mc_correct / max(acc_mc_count, 1)
+                    row["val_mc_acc"] = ev.get("mc_correct", 0.0) / max(ev.get("mc_count", 0.0), 1)
+                if f1_eval is not None:
+                    row["val_f1"] = f1_eval(model.params, rnd)
+                logger.append(row)
+                acc_loss = acc_count = acc_mc_correct = acc_mc_count = 0.0
 
     if args.profile_dir:
         jax.profiler.stop_trace()
     if args.checkpoint_dir:
-        ckpt.save(args.checkpoint_dir, session)
+        save_ckpt()
     return session
 
 

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Chaos smoke: run the seeded fault-injection suite end-to-end on CPU.
+#
+# Drives the `chaos`-marked tests (tests/test_resilience.py), which exercise
+# the full recovery surface through the REAL cv_train CLI path on a tiny
+# model: an injected SIGTERM mid-round -> emergency checkpoint -> relaunch
+# with --resume -> final params bit-identical to the uninterrupted run;
+# plus a NaN-burst round skipped with clean momentum/error state, and
+# corrupted/truncated checkpoints falling back to the last verified-good
+# one. Everything is seeded (FaultPlan + data + init), so a failure here is
+# reproducible, not flaky.
+#
+# Usage: scripts/chaos_smoke.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+exec timeout -k 10 "${CHAOS_TIMEOUT_S:-300}" \
+    python -m pytest tests/test_resilience.py -m chaos -q \
+    -p no:cacheprovider "$@"

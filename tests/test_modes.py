@@ -347,3 +347,32 @@ def test_topk_oversample_matches_exact():
     delta, _ = modes.server_step(cfg, agg, sstate, jnp.float32(1.0))
     got = np.nonzero(np.asarray(delta))[0]
     assert {5, 77, 900, 1500} <= set(got.tolist())
+
+
+def test_apply_delta_out_of_range_indices_are_inert():
+    """Regression (advisor finding): idx >= d used to CLIP to d-1 and apply
+    its val there, silently corrupting the last parameter; only idx < 0 was
+    zeroed. Both sides out of range must contribute nothing."""
+    p = jnp.arange(8, dtype=jnp.float32)
+    delta = {
+        "idx": jnp.array([2, -1, 8, 100], dtype=jnp.int32),
+        "vals": jnp.array([1.0, 5.0, 7.0, 9.0], dtype=jnp.float32),
+    }
+    out = np.asarray(modes.apply_delta(p, delta))
+    expected = np.asarray(p).copy()
+    expected[2] -= 1.0  # the one in-range pair
+    np.testing.assert_array_equal(out, expected)
+    assert out[-1] == 7.0  # pflat[d-1] no longer absorbs clipped indices
+
+
+def test_to_dense_out_of_range_indices_are_inert():
+    """Same bound contract as apply_delta for the parallel sparse consumer:
+    idx >= d must contribute nothing, not fold onto vector[d-1]."""
+    from commefficient_tpu.sketch import csvec
+
+    out = np.asarray(csvec.to_dense(
+        4,
+        jnp.array([1, -1, 4, 9], dtype=jnp.int32),
+        jnp.array([2.0, 5.0, 7.0, 9.0], dtype=jnp.float32),
+    ))
+    np.testing.assert_array_equal(out, [0.0, 2.0, 0.0, 0.0])

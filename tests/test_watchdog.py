@@ -32,8 +32,7 @@ def test_stalled_round_alerts_once_with_diagnosis():
             time.sleep(0.02)
     with wd.round(99):
         time.sleep(0.4)  # >> 3 x ~0.02s median, > floor
-    assert wd.stalls_detected == 1
-    assert len(alerts) == 1
+    assert wd.stalls_detected == 1  # ONE stall, however many ladder stages
     assert "round 99" in alerts[0] and "hung" in alerts[0]
     # recovery: the long round joins the history; the next fast round is fine
     with wd.round(100):
@@ -49,3 +48,66 @@ def test_floor_suppresses_early_alerts():
     with wd.round(1):
         time.sleep(0.1)  # 10x the median but far under the 10s floor
     assert alerts == []
+
+
+def _stall_until(wd, round_index, n_stages, deadline_s=15.0):
+    """Hold a round open until the ladder has fired n_stages (or deadline)."""
+    with wd.round(round_index):
+        deadline = time.monotonic() + deadline_s
+        while len(wd.stages_fired) < n_stages and time.monotonic() < deadline:
+            time.sleep(0.02)
+
+
+def test_escalation_ladder_fires_in_order():
+    alerts, fired = [], []
+    wd = RoundWatchdog(
+        factor=2.0, min_history=2, floor_s=0.05, alert=alerts.append,
+        on_emergency=lambda: fired.append("ckpt"),
+        on_abort=lambda: fired.append("abort"),
+    )
+    for i in range(2):
+        with wd.round(i):
+            time.sleep(0.01)
+    _stall_until(wd, 99, n_stages=4)
+    assert wd.stages_fired == ["warn", "stacks", "checkpoint", "abort"]
+    assert fired == ["ckpt", "abort"]
+    assert wd.stalls_detected == 1  # one stall, four stages
+    # stage 2's payload is the "where is it stuck" stack dump
+    assert "thread" in alerts[1] and "_stall_until" in alerts[1]
+    # a later fast round must not fire anything further
+    n = len(wd.stages_fired)
+    with wd.round(100):
+        pass
+    assert len(wd.stages_fired) == n
+
+
+def test_ladder_without_callbacks_ends_with_diagnosis():
+    alerts = []
+    wd = RoundWatchdog(factor=2.0, min_history=2, floor_s=0.05,
+                       alert=alerts.append)
+    for i in range(2):
+        with wd.round(i):
+            time.sleep(0.01)
+    _stall_until(wd, 7, n_stages=4)
+    assert wd.stages_fired == ["warn", "stacks", "checkpoint", "abort"]
+    joined = "\n".join(alerts)
+    assert "no emergency-checkpoint callback" in joined
+    assert "abort disabled" in joined
+
+
+def test_emergency_checkpoint_failure_does_not_stop_ladder():
+    alerts, fired = [], []
+
+    def broken_ckpt():
+        raise OSError("disk full")
+
+    wd = RoundWatchdog(
+        factor=2.0, min_history=2, floor_s=0.05, alert=alerts.append,
+        on_emergency=broken_ckpt, on_abort=lambda: fired.append("abort"),
+    )
+    for i in range(2):
+        with wd.round(i):
+            time.sleep(0.01)
+    _stall_until(wd, 5, n_stages=4)
+    assert wd.stages_fired[-1] == "abort" and fired == ["abort"]
+    assert any("emergency checkpoint failed" in a for a in alerts)
