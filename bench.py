@@ -17,6 +17,14 @@ analytic + XLA-cost-analysis FLOPs/round, achieved TFLOP/s, MFU against the
 chip's bf16 peak, per-chain round-time percentiles, and a workers scale
 check (2x clients ≈ 2x round time, else flagged) so the number is auditable.
 
+The JSON also carries a `run_loop` section (a REAL FederatedSession driven
+through the shared runner/ harness, --sync_loop-style and async:
+`wall_clock_updates_per_sec` + `host_overhead_ms` per arm — the end-to-end
+counterpart of the chained compiled-round headline) and a `resilience`
+section (nonfinite_rounds, per-site retry counts, checkpoint save-verify
+failures; inject faults into the run-loop arms with BENCH_FAULT_PLAN to
+benchmark chaos runs).
+
 Robustness contract: a JSON line is ALWAYS emitted. Backend init is probed in
 a subprocess with a timeout first, so a broken/hanging TPU plugin (e.g. the
 axon tunnel being down) degrades to a CPU run flagged "platform": "cpu"
@@ -309,6 +317,21 @@ SERVER_SPLIT = os.environ.get("BENCH_SERVER_SPLIT", "1") == "1"
 # this bench's seqs-per-client), so the JSON carries the arithmetic behind
 # the baseline multiple instead of only a remembered constant.
 BASELINE_BASIS = os.environ.get("BENCH_BASELINE_BASIS", "1") == "1"
+# End-to-end run-loop harness measurement (runner/): drive a REAL
+# FederatedSession (host sampling + native batch assembly + dispatch +
+# metrics + bookkeeping) through the shared run loop, --sync_loop-style and
+# async, on the flagship workload. Reports wall_clock_updates_per_sec and
+# host_overhead_ms (wall-clock round minus the compiled round measured by
+# the timed chains) for BOTH loops, so the overlap win is a measured
+# headline, not a claim. resnet9 only (the flagship the driver measures).
+RUN_LOOP = os.environ.get("BENCH_RUN_LOOP", "1") == "1"
+RUN_LOOP_ROUNDS = int(os.environ.get("BENCH_RUN_LOOP_ROUNDS", 30))
+# Optional fault plan injected into the run-loop section's session, making
+# chaos runs benchmarkable: the JSON's `resilience` block then carries the
+# nonfinite_rounds and per-site retry counts the plan provoked. preempt
+# specs are stripped (a SIGTERM would turn the bench itself into a
+# resumable exit instead of a JSON line).
+BENCH_FAULT_PLAN = os.environ.get("BENCH_FAULT_PLAN", "")
 
 
 def _kernel_microbench(platform: str, rt_ms: float) -> dict:
@@ -882,6 +905,110 @@ def _baseline_basis(rt_ms) -> dict:
     return out
 
 
+def _run_loop_bench(round_ms: float) -> dict:
+    """Sync-vs-async run-loop comparison on a real FederatedSession at the
+    flagship dims: synthetic CIFAR-shaped shards feed the session's actual
+    host path (sample_clients -> native batch assembly -> dispatch ->
+    metrics -> comm bookkeeping) through runner.run_loop. One session serves
+    both arms back-to-back (same compiled step, warm), so the ONLY
+    difference is the loop discipline. `host_overhead_ms` = wall-clock round
+    minus `round_ms` (the compiled+queued round from the timed chains); the
+    async loop's should sit measurably below the sync loop's. Never
+    raises."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+    from commefficient_tpu.federated.api import FederatedSession, FedOptimizer
+    from commefficient_tpu.modes.config import ModeConfig
+    from commefficient_tpu.resilience import FaultPlan
+    from commefficient_tpu.runner import RunnerConfig, run_loop
+
+    out: dict = {"rounds_per_arm": RUN_LOOP_ROUNDS}
+    try:
+        params, net_state, _, loss_fn, _, sketch_kw, workers = _resnet9_workload()
+        from jax.flatten_util import ravel_pytree
+
+        d = ravel_pytree(params)[0].size
+        rng = np.random.RandomState(0)
+        n_examples = max(512, workers * LOCAL_BATCH * 4)
+        x = rng.randn(n_examples, 32, 32, 3).astype(np.float32)
+        y = rng.randint(0, 10, size=n_examples).astype(np.int32)
+        train_set = FedDataset(
+            x, y, shard_iid(n_examples, max(2 * workers, 8),
+                            np.random.RandomState(1))
+        )
+        fault_plan = FaultPlan.parse(BENCH_FAULT_PLAN)
+        if fault_plan is not None:
+            stripped = [s.kind for s in fault_plan.specs if s.kind == "preempt"]
+            if stripped:
+                fault_plan.specs = [
+                    s for s in fault_plan.specs if s.kind != "preempt"
+                ]
+                out["fault_plan_note"] = (
+                    "preempt specs stripped: a SIGTERM would exit the bench "
+                    "resumably instead of emitting its JSON line"
+                )
+        mode_cfg = ModeConfig(
+            mode="sketch", d=d, momentum_type="virtual", error_type="virtual",
+            topk_impl=os.environ.get("BENCH_TOPK_IMPL", "approx"),
+            topk_recall=float(os.environ.get("BENCH_TOPK_RECALL", 0.99)),
+            **sketch_kw,
+        )
+        session = FederatedSession(
+            train_loss_fn=loss_fn,
+            eval_loss_fn=loss_fn,
+            params=jax.tree.map(jnp.copy, params),
+            net_state=jax.tree.map(jnp.copy, net_state),
+            mode_cfg=mode_cfg,
+            train_set=train_set,
+            num_workers=workers,
+            local_batch_size=LOCAL_BATCH,
+            weight_decay=5e-4,
+            seed=0,
+            split_compile=BENCH_ENGINE_COMPILE == "split",
+            on_nonfinite=os.environ.get("BENCH_ON_NONFINITE", "skip"),
+            fault_plan=fault_plan,
+        )
+        opt = FedOptimizer(lambda _: 0.01, 1)
+
+        def arm(sync: bool, rounds: int):
+            cfg = RunnerConfig(
+                total_rounds=session.round + rounds,
+                eval_every=session.round + rounds,  # boundaries only at end
+                sync_loop=sync,
+            )
+            return run_loop(session, opt, cfg)
+
+        arm(sync=True, rounds=min(2, RUN_LOOP_ROUNDS))  # compile + warm
+        nonfinite = 0
+        for label, sync in (("sync", True), ("async", False)):
+            stats = arm(sync, RUN_LOOP_ROUNDS)
+            wall_round_ms = stats.wall_s * 1e3 / max(stats.rounds, 1)
+            nonfinite += stats.nonfinite_rounds
+            out[label] = {
+                "wall_clock_updates_per_sec": round(
+                    workers * stats.rounds / max(stats.wall_s, 1e-9), 2),
+                "wall_round_ms": round(wall_round_ms, 2),
+                "host_overhead_ms": round(wall_round_ms - round_ms, 2),
+                "drains": stats.drains,
+            }
+        out["nonfinite_rounds"] = nonfinite
+        out["async_speedup_vs_sync"] = round(
+            out["sync"]["wall_round_ms"] / max(out["async"]["wall_round_ms"],
+                                               1e-9), 3)
+        out["note"] = (
+            "one session, arms run back-to-back on the warm compiled step; "
+            "host_overhead_ms = wall-clock round - round_ms (the chained "
+            "compiled round), i.e. what the host costs on top of the device"
+        )
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def run_bench(platform: str) -> dict:
     import jax
     import jax.numpy as jnp
@@ -1052,6 +1179,37 @@ def run_bench(platform: str) -> dict:
                 "server-dominated round (the sketch server step's cost is "
                 "independent of W); phase_timing's client_ms vs server_ms "
                 "distinguishes the two")
+
+    rl_nonfinite = 0
+    if RUN_LOOP:
+        if BENCH_MODEL == "resnet9":
+            _stage("run-loop harness (sync vs async overlap) ...")
+            rl = _run_loop_bench(round_ms)
+            result["run_loop"] = rl
+            _stage(f"run_loop: {rl}")
+            if "async" in rl:
+                # the end-to-end headline pair: what a real training loop
+                # delivers (vs `value`, the chained compiled-round ceiling)
+                result["wall_clock_updates_per_sec"] = (
+                    rl["async"]["wall_clock_updates_per_sec"])
+                result["host_overhead_ms"] = rl["async"]["host_overhead_ms"]
+                rl_nonfinite = rl.get("nonfinite_rounds", 0)
+        else:
+            result["run_loop"] = {
+                "skipped": "run-loop section measures the flagship resnet9 "
+                           "workload (BENCH_MODEL=resnet9)"}
+    # chaos runs are benchmarkable: what the resilience layer absorbed while
+    # this process produced the numbers above (nonzero only under
+    # BENCH_FAULT_PLAN or real flakes)
+    from commefficient_tpu.resilience import retry_counts
+    from commefficient_tpu.utils import checkpoint as _ckpt
+
+    result["resilience"] = {
+        "nonfinite_rounds": rl_nonfinite,
+        "retries": retry_counts(),
+        "ckpt_save_verify_failures": _ckpt.save_verify_failures(),
+        **({"fault_plan": BENCH_FAULT_PLAN} if BENCH_FAULT_PLAN else {}),
+    }
     return result
 
 
@@ -1062,13 +1220,15 @@ def _shrink_for_cpu():
     for name, small in [("NUM_WORKERS", 8), ("CHAIN_LEN", 3), ("NUM_CHAINS", 2),
                         ("WARMUP_ROUNDS", 1), ("MICROBENCH_D", 2_000_000),
                         ("MICRO_CHAIN", 3), ("SKETCH_COLS", 65_536),
-                        ("TOPK", 8_192), ("PHASE_CHAIN", 2)]:
+                        ("TOPK", 8_192), ("PHASE_CHAIN", 2),
+                        ("RUN_LOOP_ROUNDS", 6)]:
         env_name = {"NUM_WORKERS": "BENCH_WORKERS", "CHAIN_LEN": "BENCH_CHAIN_LEN",
                     "NUM_CHAINS": "BENCH_CHAINS", "WARMUP_ROUNDS": "BENCH_WARMUP",
                     "MICROBENCH_D": "BENCH_MICRO_D",
                     "MICRO_CHAIN": "BENCH_MICRO_CHAIN",
                     "SKETCH_COLS": "BENCH_COLS", "TOPK": "BENCH_TOPK",
-                    "PHASE_CHAIN": "BENCH_PHASE_CHAIN"}[name]
+                    "PHASE_CHAIN": "BENCH_PHASE_CHAIN",
+                    "RUN_LOOP_ROUNDS": "BENCH_RUN_LOOP_ROUNDS"}[name]
         if env_name not in os.environ:
             g[name] = small
     if "BENCH_SCALE_CHECK" not in os.environ:

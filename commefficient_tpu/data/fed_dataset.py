@@ -10,9 +10,93 @@ so the round step compiles once.
 
 from __future__ import annotations
 
+import queue
+import threading
+
 import numpy as np
 
 from .. import native
+
+
+class ThreadedPrefetcher:
+    """Bounded background producer over any iterator: one daemon thread
+    pulls items in order into a depth-bounded queue, so host-side work
+    (batch padding/assembly) overlaps whatever the consumer blocks on
+    (device compute). The ONE copy of the subtle thread machinery —
+    stop-responsive bounded puts, sentinel termination, parked-exception
+    re-raise, join-on-stop — shared by `prefetch_iter` (eval batches) and
+    `runner.prefetch.RoundPrefetcher` (training rounds)."""
+
+    _DONE = object()
+
+    def __init__(self, it, depth: int = 2, name: str = "prefetch"):
+        self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._stop = threading.Event()
+        self._exc: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._produce, args=(it,), name=name, daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Stop-responsive bounded put; False when stopped while full."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self, it):
+        try:
+            for item in it:
+                if not self._put(item):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised at next()
+            self._exc = e
+        self._put(self._DONE)
+
+    def next(self):
+        """Next item in order; re-raises a parked producer exception;
+        StopIteration when the source is exhausted."""
+        item = self._q.get()
+        if item is self._DONE:
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+    def stop(self):
+        """Halt and join the producer (unblocking it if the queue is
+        full). Safe to call twice."""
+        self._stop.set()
+        try:  # unblock a producer stuck on a full queue
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10.0)
+
+
+def prefetch_iter(it, depth: int = 2):
+    """Generator view of ThreadedPrefetcher: items in order up to `depth`
+    ahead of the consumer; a producer exception re-raises at the consuming
+    point; abandoning the generator (break / close / GC) stops the
+    producer. depth <= 0 degrades to plain iteration."""
+    if depth <= 0:
+        yield from it
+        return
+    pf = ThreadedPrefetcher(it, depth, name="eval-prefetch")
+    try:
+        while True:
+            try:
+                item = pf.next()
+            except StopIteration:
+                return
+            yield item
+    finally:
+        pf.stop()
 
 
 class FedDataset:

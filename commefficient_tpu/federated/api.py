@@ -20,13 +20,60 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from ..data.fed_dataset import FedDataset
+from ..data.fed_dataset import FedDataset, prefetch_iter
 from ..modes import modes
 from ..modes.config import ModeConfig
 from ..parallel import mesh as meshlib
 from ..resilience import retry as rtry
 from ..utils.comm import round_comm_mb
 from . import engine
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedRound:
+    """Host-side product of one round's preparation — client sampling, batch
+    assembly, the device PRNG split — decoupled from the device dispatch so a
+    prefetch thread can assemble round N+1's batch while the device computes
+    round N (runner/). `snapshot` is the (host RNG, device key) state right
+    AFTER this round's draws: committing the round publishes it as the
+    session's round-boundary snapshot, so checkpoints stay replay-consistent
+    even when the live streams have already been advanced by prefetch."""
+
+    rnd: int
+    ids: Any
+    batch: dict
+    sub: Any
+    snapshot: tuple
+
+
+@dataclasses.dataclass
+class InFlightRound:
+    """A dispatched-but-uncommitted round (or fused block of rounds): the
+    device-side result futures plus everything commit_round needs to publish
+    it. `metrics` stays a DEVICE tree until commit, so the runner can defer
+    the host sync to an eval/log boundary instead of blocking every
+    dispatch."""
+
+    new_state: Any
+    new_client_state: Any
+    metrics: Any
+    lrs: list
+    snapshot: tuple
+    stacked: bool  # block dispatch: metrics leaves carry a leading [K] axis
+
+    @property
+    def num_rounds(self) -> int:
+        return len(self.lrs)
+
+    def release_state(self):
+        """Drop the server-state references. The runner calls this when a
+        NEWER dispatch supersedes this one as the pipeline head: only the
+        newest pending state is ever published at a batch commit, so
+        holding every intermediate tree would pin up to max_inflight full
+        copies of params+momentum+error in HBM with no reader (the metrics
+        stay — they are the per-round scalars commit needs)."""
+        self.new_state = None
+        self.new_client_state = None
 
 
 class FederatedSession:
@@ -134,6 +181,17 @@ class FederatedSession:
         # watchdog's timer thread: ckpt.save captures all fields under this
         # lock, so it can never mix round N's params with round N-1's counter
         self.mutate_lock = threading.Lock()
+        # pipelining head (runner/): the newest DISPATCHED state futures,
+        # distinct from self.state (the newest COMMITTED state) so a chain of
+        # uncommitted dispatches threads device-side while emergency
+        # checkpoints keep reading a consistent committed view. Main-thread
+        # only — dispatch and commit both run on the caller's thread.
+        # _inflight counts dispatch UNITS (a fused block is one);
+        # _inflight_rounds counts ROUNDS (a block is len(lrs)).
+        self._inflight = 0
+        self._inflight_rounds = 0
+        self._head_state = None
+        self._head_client_state = None
 
         self.state = engine.init_server_state(self.cfg, params, net_state)
         self.client_state = modes.init_client_state(mode_cfg, train_set.num_clients)
@@ -225,18 +283,22 @@ class FederatedSession:
         cohort no deterministic run of this seed would produce."""
         self.rng_snapshot = (self.rng.get_state(), self._rng_key)
 
-    def _load_client_batch(self, ids) -> dict:
+    def _load_client_batch(self, ids, rnd: int | None = None) -> dict:
         """Round-batch assembly behind the retry wrapper. The injection site
         fires BEFORE any host RNG is consumed, and a failed attempt restores
         the RNG snapshot, so a retried load replays the identical batch —
         recovery never perturbs the client sequence a resumed run must
-        replay bit-for-bit."""
+        replay bit-for-bit. `rnd` is the GLOBAL round this batch feeds
+        (defaults to the session counter; a prefetcher preparing ahead
+        passes the future index so scheduled faults land on their round)."""
+        if rnd is None:
+            rnd = self.round
 
         def attempt():
             rng_state = self.rng.get_state()
             try:
                 if self.fault_plan is not None:
-                    self.fault_plan.data_load(self.round)
+                    self.fault_plan.data_load(rnd)
                 return self.train_set.client_batch(
                     self.rng, ids, self.local_batch_size,
                     self.cfg.mode.num_local_iters,
@@ -247,41 +309,150 @@ class FederatedSession:
 
         return rtry.with_retries(
             attempt, site="data_load", policy=self.retry_policy,
-            seed=self.round,
+            seed=rnd,
         )
+
+    # -- prepare / dispatch / commit (the runner/ pipeline surface) ----------
+    def prepare_round(self, rnd: int | None = None) -> PreparedRound:
+        """Host-side half of a round: sample the cohort, assemble the batch
+        (retry-wrapped, fault sites at `rnd`), split the device PRNG. Draws
+        from the LIVE host streams in round order — the single producer
+        (inline loop or the runner's prefetch thread) must call this
+        sequentially. The returned snapshot captures the streams right after
+        this round's draws; it becomes the session's round-boundary snapshot
+        only when the round COMMITS, so an emergency checkpoint taken while
+        later rounds are already prepared still resumes bit-identically."""
+        if rnd is None:
+            rnd = self.round + self._inflight_rounds
+        ids = self.train_set.sample_clients(self.rng, self.num_workers)
+        batch = self._load_client_batch(ids, rnd)
+        if self.fault_plan is not None:
+            # nonfinite burst rides the real gradient path (poison the
+            # assembled batch); preempt stays a DISPATCH-time site so the
+            # SIGTERM lands when the round runs, not when it is prefetched
+            batch = self.fault_plan.poison(rnd, batch)
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return PreparedRound(
+            rnd, ids, batch, sub, (self.rng.get_state(), self._rng_key)
+        )
+
+    def dispatch_round(self, prep: PreparedRound, lr: float) -> InFlightRound:
+        """Enqueue one round on the device WITHOUT a host sync. Chains on the
+        newest dispatched state (not the committed one), so back-to-back
+        dispatches queue on the device while metrics stay device arrays until
+        commit_round. Caller must commit in dispatch order."""
+        if self.fault_plan is not None:
+            # delivers a real SIGTERM that the runner's PreemptionHandler
+            # turns into drain -> emergency checkpoint -> resumable exit
+            self.fault_plan.preempt(prep.rnd)
+        batch = prep.batch
+        if self.mesh is not None:
+            batch = meshlib.shard_client_batch(self.mesh, batch)
+        state = self._head_state if self._head_state is not None else self.state
+        cstate = (self._head_client_state
+                  if self._head_client_state is not None else self.client_state)
+        ids_dev = jnp.asarray(prep.ids)
+        rows = self._gather(cstate, ids_dev) if cstate is not None else {}
+        with self._mesh_ctx():
+            new_state, new_rows, metrics = self._step(
+                state, batch, rows, jnp.float32(lr), prep.sub
+            )
+        new_cstate = None
+        if cstate is not None:
+            new_cstate = self._scatter(cstate, ids_dev, new_rows)
+            self._head_client_state = new_cstate
+        self._head_state = new_state
+        self._inflight += 1
+        self._inflight_rounds += 1
+        return InFlightRound(new_state, new_cstate, metrics, [lr],
+                             prep.snapshot, stacked=False)
+
+    def dispatch_block(self, preps: list[PreparedRound], lrs) -> InFlightRound:
+        """Enqueue a K-round fused block (ONE device dispatch, lax.scan over
+        the round step) without a host sync. Stateless modes only — see
+        supports_block_dispatch."""
+        lrs = list(lrs)
+        if self._multi is None:
+            self._multi = jax.jit(
+                engine.make_multi_round_step(self._train_loss_fn, self.cfg),
+                donate_argnums=self._state_donation(),
+            )
+        # stack on the HOST: jnp.stack would commit the full [K, W, ...]
+        # block to the default device before resharding — a K-round HBM
+        # spike on one chip, defeating the memory story this feature and
+        # client_chunk exist for. device transfer happens once, sharded.
+        stacked = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]),
+            *[p.batch for p in preps],
+        )
+        if self.mesh is not None:
+            stacked = meshlib.shard_stacked_client_batch(self.mesh, stacked)
+        state = self._head_state if self._head_state is not None else self.state
+        with self._mesh_ctx():
+            new_state, ms = self._multi(
+                state, stacked, jnp.asarray(lrs, jnp.float32),
+                jnp.stack([p.sub for p in preps]),
+            )
+        self._head_state = new_state
+        self._inflight += 1
+        self._inflight_rounds += len(lrs)
+        return InFlightRound(new_state, None, ms, lrs,
+                             preps[-1].snapshot, stacked=True)
+
+    def commit_round(self, infl: InFlightRound, metrics_host=None) -> list[dict]:
+        """Publish one dispatched round/block: sync its metrics (unless the
+        caller already fetched them), assign the state futures, run the
+        host-side bookkeeping, and install the round-boundary RNG snapshot —
+        all atomically w.r.t. a concurrent emergency checkpoint."""
+        if metrics_host is None:
+            metrics_host = jax.device_get(infl.metrics)  # the round's sync
+        return self.commit_rounds([infl], [metrics_host])
+
+    def commit_rounds(self, infls: list[InFlightRound],
+                      metrics_hosts: list) -> list[dict]:
+        """Batch commit for a drained pipeline, in dispatch order, under ONE
+        mutate_lock hold: every round's metrics/comm/round-counter
+        bookkeeping runs, but the server state is published ONCE — the
+        newest dispatch's (intermediate trees may already be released, see
+        InFlightRound.release_state). The single lock hold keeps the
+        (state, round, snapshot) triple consistent for an emergency
+        checkpoint: it observes either the pre-drain committed view or the
+        fully-drained one, never a mix."""
+        out = []
+        with self.mutate_lock:
+            for infl, mh in zip(infls, metrics_hosts):
+                if infl.stacked:
+                    out.extend(
+                        self._finalize_metrics({k: v[i] for k, v in mh.items()}, lr)
+                        for i, lr in enumerate(infl.lrs)
+                    )
+                else:
+                    out.append(self._finalize_metrics(mh, infl.lrs[0]))
+                self._inflight -= 1
+                self._inflight_rounds -= infl.num_rounds
+            last = infls[-1]
+            if last.new_state is None:
+                raise RuntimeError(
+                    "commit_rounds: the newest in-flight dispatch has no "
+                    "state reference (release_state must only be called on "
+                    "superseded entries)"
+                )
+            self.state = last.new_state
+            if last.new_client_state is not None:
+                self.client_state = last.new_client_state
+            self.rng_snapshot = last.snapshot
+            if self._inflight == 0:
+                self._head_state = None
+                self._head_client_state = None
+        return out
 
     # -- one federated round -------------------------------------------------
     def run_round(self, lr: float) -> dict:
-        ids = self.train_set.sample_clients(self.rng, self.num_workers)
-        batch = self._load_client_batch(ids)
-        if self.fault_plan is not None:
-            # nonfinite burst rides the real gradient path; preempt delivers
-            # a real SIGTERM that the CLI's PreemptionHandler turns into an
-            # emergency checkpoint at this round's end
-            batch = self.fault_plan.poison(self.round, batch)
-            self.fault_plan.preempt(self.round)
-        if self.mesh is not None:
-            batch = meshlib.shard_client_batch(self.mesh, batch)
-        ids_dev = jnp.asarray(ids)
-        rows = self._gather(self.client_state, ids_dev) if self.client_state is not None else {}
-        self._rng_key, sub = jax.random.split(self._rng_key)
-        with self._mesh_ctx():
-            new_state, new_rows, metrics = self._step(
-                self.state, batch, rows, jnp.float32(lr), sub
-            )
-        metrics_host = jax.device_get(metrics)  # the round's sync
-        # publish the round atomically w.r.t. a concurrent emergency
-        # checkpoint: by the sync above new_state is concrete, so the lock
-        # is held only for cheap host-side assignments
-        with self.mutate_lock:
-            self.state = new_state
-            if self.client_state is not None:
-                self.client_state = self._scatter(
-                    self.client_state, ids_dev, new_rows
-                )
-            m = self._finalize_metrics(metrics_host, lr)
-            self._snapshot_rng()
-        return m
+        """Prepare + dispatch + commit, synchronously — bit-identical to the
+        pre-pipeline implementation (the three phases are a pure refactor of
+        the old inline body)."""
+        prep = self.prepare_round(self.round)
+        return self.commit_round(self.dispatch_round(prep, lr))[0]
 
     def _finalize_metrics(self, metrics_host: dict, lr: float) -> dict:
         """Host-side per-round bookkeeping shared by run_round/run_rounds:
@@ -333,41 +504,11 @@ class FederatedSession:
         lrs = list(lrs)
         if not self.supports_block_dispatch or len(lrs) <= 1:
             return [self.run_round(lr) for lr in lrs]
-        if self._multi is None:
-            self._multi = jax.jit(
-                engine.make_multi_round_step(self._train_loss_fn, self.cfg),
-                donate_argnums=self._state_donation(),
-            )
-        batches, subs = [], []
-        for _ in lrs:
-            ids = self.train_set.sample_clients(self.rng, self.num_workers)
-            # same retry wrapper as run_round: a transient loader flake must
-            # not kill the block path that long stateless runs actually take
-            batches.append(self._load_client_batch(ids))
-            self._rng_key, sub = jax.random.split(self._rng_key)
-            subs.append(sub)
-        # stack on the HOST: jnp.stack would commit the full [K, W, ...]
-        # block to the default device before resharding — a K-round HBM
-        # spike on one chip, defeating the memory story this feature and
-        # client_chunk exist for. device transfer happens once, sharded.
-        stacked = jax.tree.map(
-            lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches
-        )
-        if self.mesh is not None:
-            stacked = meshlib.shard_stacked_client_batch(self.mesh, stacked)
-        with self._mesh_ctx():
-            new_state, ms = self._multi(
-                self.state, stacked, jnp.asarray(lrs, jnp.float32), jnp.stack(subs)
-            )
-        ms = jax.device_get(ms)  # the block's one sync
-        with self.mutate_lock:  # see run_round: atomic round publication
-            self.state = new_state
-            out = [
-                self._finalize_metrics({k: v[i] for k, v in ms.items()}, lr)
-                for i, lr in enumerate(lrs)
-            ]
-            self._snapshot_rng()
-        return out
+        # same prepare path as run_round (identical host RNG order, same
+        # retry wrapper — a transient loader flake must not kill the block
+        # path long stateless runs actually take), then one fused dispatch
+        preps = [self.prepare_round(self.round + i) for i in range(len(lrs))]
+        return self.commit_round(self.dispatch_block(preps, lrs))
 
     # -- evaluation (SURVEY.md §3.4: forward-only, no compression) -----------
     def evaluate(self, dataset: FedDataset, batch_size: int = 512) -> dict:
@@ -378,11 +519,22 @@ class FederatedSession:
         runs n-way. eval_batches pads every batch to full shape with a
         0-mask tail, so metric sums are shard-count invariant
         (tests/test_engine.py::test_sharded_eval_matches_unsharded)."""
+        if self._inflight:
+            raise RuntimeError(
+                f"evaluate() with {self._inflight} uncommitted in-flight "
+                "dispatch(es): the runner must drain the pipeline before an "
+                "eval boundary (self.state would be stale or donated)"
+            )
+        if self.fault_plan is not None:
+            # eval-loader site: a scheduled eval_stall sleeps here once
+            self.fault_plan.eval_load(self.round)
         totals: dict[str, float] = {}
         if self.mesh is not None:
             shards = meshlib.client_shards(self.mesh)
             batch_size = -(-batch_size // shards) * shards  # round up
-        for batch in dataset.eval_batches(batch_size):
+        # double-buffer the host-side batch padding/assembly behind the
+        # device's eval compute (values are identical; order is preserved)
+        for batch in prefetch_iter(dataset.eval_batches(batch_size), depth=2):
             if self.mesh is not None:
                 batch = meshlib.shard_client_batch(self.mesh, batch)
             with self._mesh_ctx():

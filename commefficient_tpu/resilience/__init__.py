@@ -23,7 +23,7 @@ round guard in `federated.engine` (EngineConfig.on_nonfinite), and the
 
 from .faults import FaultPlan, FaultSpec, InjectedFault, InjectedTransientError
 from .preemption import EXIT_RESUMABLE, PreemptionHandler
-from .retry import RetryPolicy, with_retries
+from .retry import RetryPolicy, reset_retry_counts, retry_counts, with_retries
 
 __all__ = [
     "EXIT_RESUMABLE",
@@ -33,5 +33,7 @@ __all__ = [
     "InjectedTransientError",
     "PreemptionHandler",
     "RetryPolicy",
+    "reset_retry_counts",
+    "retry_counts",
     "with_retries",
 ]

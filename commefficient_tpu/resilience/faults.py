@@ -8,6 +8,9 @@ entries, each `kind[@round,round,...][:key=val,...]`:
                                 an emergency checkpoint, exits resumable)
     stall@2:secs=1.5            sleep 1.5 s in round 2's data-load path
                                 (exercises the watchdog)
+    eval_stall@4:secs=1.5       sleep 1.5 s in the EVAL loader as the round-4
+                                eval boundary starts (the round-5 FEMNIST
+                                stall class lived in eval, not training)
     data_fail@1:times=2         raise a transient error twice in round 1's
                                 data load (recovered by the retry wrapper)
     nonfinite@4                 poison round 4's client batches with NaN
@@ -50,6 +53,7 @@ import numpy as np
 KINDS = {
     "preempt": (),
     "stall": ("secs",),
+    "eval_stall": ("secs",),
     "data_fail": ("times",),
     "nonfinite": ("value",),
     "ckpt_fail": ("times",),
@@ -214,6 +218,18 @@ class FaultPlan:
             self._log(f"stalling data load {secs}s (round {rnd})")
             time.sleep(secs)
         self.fire_transient("data_fail", rnd)
+
+    def eval_load(self, rnd: int):
+        """Eval-loader site (FederatedSession.evaluate): a scheduled
+        eval_stall sleeps once per scheduled round as the eval pass starts —
+        the eval half of the round-5 FEMNIST stall the training-side `stall`
+        site cannot reproduce."""
+        s = self.spec("eval_stall", rnd)
+        if s is not None and ("eval_stall", rnd) not in self._fired:
+            self._fired.add(("eval_stall", rnd))
+            secs = float(s.params.get("secs", 1.0))
+            self._log(f"stalling eval load {secs}s (round {rnd})")
+            time.sleep(secs)
 
     def poison(self, rnd: int, batch: dict):
         """NaN/Inf gradient burst: fill every float leaf of the assembled

@@ -23,10 +23,35 @@ from __future__ import annotations
 
 import dataclasses
 import sys
+import threading
 import time
 from typing import Callable
 
 import numpy as np
+
+# process-wide per-site count of FAILED attempts (each one either backed off
+# and retried, or exhausted the budget) — the benchmarkable footprint of a
+# chaos run: bench.py surfaces this dict in its JSON so "the run recovered
+# from N flakes" is a number, not a log-grep
+_COUNTS_LOCK = threading.Lock()
+_RETRY_COUNTS: dict[str, int] = {}
+
+
+def _count_failure(site: str) -> None:
+    with _COUNTS_LOCK:
+        _RETRY_COUNTS[site] = _RETRY_COUNTS.get(site, 0) + 1
+
+
+def retry_counts() -> dict[str, int]:
+    """Snapshot of {site: failed-attempt count} since process start (or the
+    last reset). A site absent from the dict never failed."""
+    with _COUNTS_LOCK:
+        return dict(_RETRY_COUNTS)
+
+
+def reset_retry_counts() -> None:
+    with _COUNTS_LOCK:
+        _RETRY_COUNTS.clear()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +98,7 @@ def with_retries(
         try:
             return fn()
         except policy.retry_on as e:  # noqa: PERF203 — retry loop
+            _count_failure(site)
             if attempt >= policy.max_retries:
                 log(
                     f"retry[{site}]: attempt {attempt + 1}/"

@@ -1,0 +1,344 @@
+"""The shared run loop: block planning, overlap, and the operational wiring
+(watchdog, preemption, non-finite halt, eval/checkpoint cadence) both CLIs
+previously hand-rolled and copy-pasted.
+
+Overlap model (async, the default):
+
+    prefetch thread:  prepare N+1, N+2   (client sampling + batch assembly)
+    main thread:      dispatch N, N+1, ...      (no per-dispatch host sync)
+    device:           compute N, N+1, ...       (queued back-to-back)
+    writer thread:    periodic checkpoint save  (staging + rename commit)
+    main thread @ boundary: ONE batched device_get of every pending round's
+        metrics -> commit in dispatch order -> eval / log / checkpoint
+
+What stays synchronous, deliberately:
+
+- **Commit order**: rounds publish (state, round counter, comm totals, RNG
+  snapshot) in dispatch order under the session's mutate_lock — an
+  emergency checkpoint from the watchdog's timer thread always captures a
+  consistent committed view.
+- **Eval**: runs only at a drained boundary (the pipeline is empty, so
+  `session.state` is the exact committed params — and, with buffer
+  donation on, the only state guaranteed live).
+- **Emergency + preemption + final saves**: the moments where "the save
+  completed" must hold before the next action (abort, exit 75, process
+  end). The async writer is DRAINED before the preemption save and before
+  exit.
+- **Non-finite halt**: evaluated from committed metrics at drain
+  boundaries — the same block granularity the old loop had with
+  `--rounds_per_dispatch > 1` (the compiled `skip` guard keeps state clean
+  for any rounds dispatched past the poisoned one).
+
+`--sync_loop` collapses all of it: inline preparation, one watchdog-wrapped
+prepare->dispatch->sync per round (or per fused block), blocking saves —
+the old loop, kept as the A/B baseline and escape hatch. Both paths drive
+the identical compiled programs in the identical order with the identical
+host RNG stream, which is why tests/test_runner.py can pin them
+bit-identical.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import os
+import sys
+import threading
+import time
+
+import jax
+
+from ..federated.api import FederatedSession, FedOptimizer, plan_block
+from ..resilience import EXIT_RESUMABLE, PreemptionHandler
+from ..utils import checkpoint as ckpt
+from ..utils.logging import Timer
+from ..utils.watchdog import RoundWatchdog
+from .prefetch import PreparedSource, RoundPrefetcher
+from .writer import AsyncCheckpointWriter
+
+
+@dataclasses.dataclass
+class RunnerConfig:
+    """Loop shape + operational policy (mirrors the CLI flag surface; build
+    one with from_args in the CLIs, or directly in tests/bench)."""
+
+    total_rounds: int
+    eval_every: int
+    checkpoint_every: int = 0
+    checkpoint_dir: str = ""
+    rounds_per_dispatch: int = 1
+    sync_loop: bool = False
+    # async only: drain when this many rounds are dispatched-uncommitted,
+    # even between boundaries — bounds how much work a preemption's grace
+    # window has to wait out, and how stale the halt check can run
+    max_inflight: int = 4
+    prefetch_depth: int = 2  # 2 = double buffering
+    on_nonfinite: str = "skip"  # the CLI-level halt policy ("halt" stops)
+    watchdog_abort: bool = False
+    no_emergency_checkpoint: bool = False
+
+    @classmethod
+    def from_args(cls, args, total_rounds: int, eval_every: int):
+        return cls(
+            total_rounds=total_rounds,
+            eval_every=eval_every,
+            checkpoint_every=args.checkpoint_every,
+            checkpoint_dir=args.checkpoint_dir,
+            rounds_per_dispatch=args.rounds_per_dispatch,
+            sync_loop=args.sync_loop,
+            on_nonfinite=args.on_nonfinite,
+            watchdog_abort=args.watchdog_abort,
+            no_emergency_checkpoint=args.no_emergency_checkpoint,
+        )
+
+
+@dataclasses.dataclass
+class RunStats:
+    """What the loop did — bench.py's run_loop section reads these."""
+
+    rounds: int = 0
+    wall_s: float = 0.0
+    nonfinite_rounds: int = 0
+    drains: int = 0
+    evals: int = 0
+    sync_checkpoints: int = 0
+    async_checkpoints: int = 0
+
+
+def make_save_ckpt(session: FederatedSession, checkpoint_dir: str):
+    """The one shared save closure: serialized by its own lock (the
+    watchdog's emergency save runs on a timer thread and must not race a
+    scheduled/periodic save of the same round — both would target the same
+    staging/final dirs), sharing the session's fault plan + retry policy so
+    per-site injection counters stay coherent across the whole run."""
+    lock = threading.Lock()
+
+    def save_ckpt():
+        with lock:
+            return ckpt.save(
+                checkpoint_dir, session,
+                fault_plan=session.fault_plan,
+                retry_policy=session.retry_policy,
+            )
+
+    return save_ckpt
+
+
+def run_loop(
+    session: FederatedSession,
+    opt: FedOptimizer,
+    cfg: RunnerConfig,
+    *,
+    eval_fn=None,
+    build_row=None,
+    logger=None,
+    save_ckpt=None,
+) -> RunStats:
+    """Run the training loop from session.round to cfg.total_rounds.
+
+    eval_fn() -> metrics dict, called at every eval boundary (drained).
+    build_row(rnd, m, totals, ev, time_s, nonfinite_total) -> row dict for
+    the logger; `m` is the last round's metrics, `totals` the sum of every
+    numeric metric key since the previous eval row. Either may be None (no
+    eval / no logging — bench runs). save_ckpt defaults to make_save_ckpt
+    when cfg.checkpoint_dir is set.
+
+    Exits the process (not returns) on preemption (EXIT_RESUMABLE) and on
+    --on_nonfinite halt, after the same drain/save sequence the CLIs used
+    to inline.
+    """
+    stats = RunStats()
+    t0 = time.perf_counter()
+    eval_every = max(cfg.eval_every, 1)
+    start_round = session.round
+
+    if save_ckpt is None and cfg.checkpoint_dir:
+        save_ckpt = make_save_ckpt(session, cfg.checkpoint_dir)
+
+    # escalation ladder: warn -> stacks -> emergency ckpt -> (opt-in) abort
+    # with the resumable status so a supervisor relaunches with --resume
+    watchdog = RoundWatchdog(
+        on_emergency=save_ckpt
+        if save_ckpt and not cfg.no_emergency_checkpoint else None,
+        on_abort=(lambda: os._exit(EXIT_RESUMABLE))
+        if cfg.watchdog_abort and save_ckpt else None,
+    )
+
+    async_mode = not cfg.sync_loop
+    writer = None
+    if async_mode and save_ckpt and cfg.checkpoint_every:
+        if session._donate_state:
+            # an overlapped save reads session.state while later rounds
+            # dispatch — with donation the committed buffers are already
+            # dead. Keep the periodic saves, just blocking (the HBM-tight
+            # --no_emergency_checkpoint trade-off extends to overlap).
+            print(
+                "runner: state-buffer donation is on "
+                "(--no_emergency_checkpoint); periodic checkpoint writes "
+                "stay synchronous — an overlapped save would read donated "
+                "buffers",
+                flush=True,
+            )
+        else:
+            writer = AsyncCheckpointWriter(save_ckpt)
+    src = (
+        RoundPrefetcher(session, start_round, depth=cfg.prefetch_depth)
+        if async_mode else PreparedSource(session, start_round)
+    )
+
+    pending: collections.deque = collections.deque()  # in-flight dispatches
+    pending_rounds = 0
+    totals: collections.defaultdict = collections.defaultdict(float)
+    last_m: dict | None = None
+    nonfinite_total = 0
+    timer = Timer()
+
+    def drain(watch: bool = True):
+        """Commit every pending dispatch: ONE batched device_get for all
+        their metrics, then in-order publication + metric folding."""
+        nonlocal pending_rounds, last_m, nonfinite_total
+        if not pending:
+            return
+        first = session.round  # oldest uncommitted round index
+        # the drain legitimately waits out every queued dispatch, so the
+        # watchdog threshold scales by the round count and the recorded
+        # time is normalized back to a per-round figure (true median)
+        with (watchdog.round(first, rounds=pending_rounds)
+              if watch else contextlib.nullcontext()):
+            hosts = jax.device_get([fl.metrics for fl in pending])
+        for m in session.commit_rounds(list(pending), hosts):
+            last_m = m
+            nonfinite_total += int(m.get("nonfinite_rounds", 0))
+            for k, v in m.items():
+                if isinstance(v, (int, float)):
+                    totals[k] += v
+        pending.clear()
+        pending_rounds = 0
+        stats.drains += 1
+
+    def shutdown():
+        """Exit-path teardown (preemption/halt): stop the prefetcher and
+        drain the writer. A failed async save is reported but must NOT
+        block the synchronous exit save that follows — that save is the
+        corrective action (and carries its own retries)."""
+        src.stop()
+        if writer is not None:
+            try:
+                writer.drain()
+            except Exception as e:  # noqa: BLE001 — exit save still runs
+                print(
+                    f"runner: async checkpoint failure at shutdown "
+                    f"({type(e).__name__}: {e}); continuing to the "
+                    "synchronous exit save", file=sys.stderr, flush=True,
+                )
+            writer.close()
+
+    rnd = start_round
+    try:
+        with PreemptionHandler() as pre:
+            while rnd < cfg.total_rounds:
+                lrs = plan_block(opt, rnd, cfg.total_rounds, eval_every,
+                                 cfg.checkpoint_every, cfg.rounds_per_dispatch)
+                if len(lrs) > 1 and session.supports_block_dispatch:
+                    # one dispatch for the block; the watchdog times the
+                    # block (prefetch pull included — a stalled loader is a
+                    # stall the ladder should see). In async mode a dispatch
+                    # returns without a host sync in ~ms, so it must not
+                    # feed the learned round-time median (record=False) —
+                    # the boundary drain records the true per-round time.
+                    with watchdog.round(rnd, record=cfg.sync_loop):
+                        preps = [src.next() for _ in lrs]
+                        pending.append(session.dispatch_block(preps, lrs))
+                        if len(pending) > 1:
+                            pending[-2].release_state()  # superseded head
+                        pending_rounds += len(lrs)
+                        if cfg.sync_loop:
+                            drain(watch=False)
+                    rnd += len(lrs)
+                else:
+                    # per-round dispatch (stateful/split/fault-plan
+                    # fallback): keep the watchdog per-round so a hang is
+                    # detected at round, not block, granularity
+                    for j, lr in enumerate(lrs):
+                        with watchdog.round(rnd + j, record=cfg.sync_loop):
+                            pending.append(
+                                session.dispatch_round(src.next(), lr)
+                            )
+                            if len(pending) > 1:
+                                pending[-2].release_state()  # superseded
+                            pending_rounds += 1
+                            if cfg.sync_loop:
+                                drain(watch=False)
+                        rnd += 1
+                        if pre.triggered:
+                            break  # stop inside the block: the grace window
+                            # is short
+                if (pending_rounds
+                        and (pre.triggered
+                             or pending_rounds >= cfg.max_inflight
+                             or rnd >= cfg.total_rounds
+                             or rnd % eval_every == 0
+                             or (cfg.checkpoint_every
+                                 and rnd % cfg.checkpoint_every == 0))):
+                    drain()
+                if pre.triggered:
+                    shutdown()
+                    if save_ckpt:
+                        path = save_ckpt()
+                        print(
+                            f"preemption: emergency checkpoint at round "
+                            f"{session.round}: {path}", flush=True,
+                        )
+                    sys.exit(EXIT_RESUMABLE)
+                if nonfinite_total and cfg.on_nonfinite == "halt":
+                    shutdown()
+                    if save_ckpt:
+                        save_ckpt()
+                    sys.exit(
+                        f"halting at round {rnd}: non-finite update skipped "
+                        "(--on_nonfinite halt; "
+                        + ("state checkpointed clean)" if save_ckpt
+                           else "no --checkpoint_dir, nothing saved)")
+                    )
+                if (cfg.checkpoint_every and save_ckpt
+                        and rnd % cfg.checkpoint_every == 0):
+                    if writer is not None:
+                        writer.request()  # off the round path
+                        stats.async_checkpoints += 1
+                    else:
+                        save_ckpt()
+                        stats.sync_checkpoints += 1
+                if rnd % eval_every == 0 or rnd >= cfg.total_rounds:
+                    ev = eval_fn() if eval_fn is not None else {}
+                    stats.evals += 1
+                    if build_row is not None and logger is not None:
+                        logger.append(build_row(
+                            rnd=rnd, m=last_m, totals=dict(totals), ev=ev,
+                            time_s=timer(), nonfinite_total=nonfinite_total,
+                        ))
+                    totals.clear()
+    finally:
+        src.stop()
+        # the prefetcher may have prepared (drawn host RNG / split the
+        # device key for) rounds that were never dispatched; rewind the
+        # LIVE streams to the committed round boundary so a caller reusing
+        # the session (a second run_loop, run_round in a notebook) stays on
+        # the bit-identical sequence the sync loop would produce. No-op
+        # when the streams already sit at the boundary (sync mode, clean
+        # exit).
+        with session.mutate_lock:
+            rng_state, rng_key = session.rng_snapshot
+            session.rng.set_state(rng_state)
+            session._rng_key = rng_key
+    # shutdown() tolerates a stored async-save failure: the final
+    # synchronous save below is the corrective action (it carries its own
+    # retries), and an hours-old transient write error must not block it
+    shutdown()
+    if save_ckpt:
+        save_ckpt()  # final checkpoint, synchronous (durable before return)
+        stats.sync_checkpoints += 1
+    stats.rounds = session.round - start_round
+    stats.nonfinite_rounds = nonfinite_total
+    stats.wall_s = time.perf_counter() - t0
+    return stats

@@ -1,0 +1,80 @@
+"""Round preparation sources: inline (sync) and background-thread (async).
+
+Both serve `FederatedSession.prepare_round(rnd)` results strictly in round
+order from ONE producer, which is what keeps the host RNG streams — client
+sampling, batch-assembly draws, the device key chain — identical to the
+synchronous loop: prepare_round is the only mutator of those streams, and
+here it is only ever called sequentially from a single thread.
+
+The async variant (`RoundPrefetcher`) is the tentpole's data-prefetch half:
+a daemon thread assembles round N+1's (and N+2's, bounded by `depth` —
+double buffering by default) client batch while the device computes round
+N. Determinism properties preserved:
+
+- **Retry replay**: `_load_client_batch` restores the host RNG snapshot on
+  a failed attempt before retrying, so an injected `data_fail` recovered on
+  the prefetch thread yields the bit-identical batch the clean run sees.
+- **Resume replay**: prepared-but-uncommitted rounds advance only the LIVE
+  streams; the session's checkpointable `rng_snapshot` moves at COMMIT time
+  (to the snapshot captured inside the PreparedRound), so a checkpoint
+  taken while the prefetcher is ahead resumes bit-identically — the
+  discarded prepared rounds are simply re-prepared from the same stream
+  state after restore.
+- **Fault scheduling**: data-load faults fire inside prepare_round with the
+  EXPLICIT round index being prepared (not the session's lagging counter),
+  so `stall@7`/`data_fail@7` land on round 7 no matter how far ahead the
+  prefetcher runs. `preempt` stays a dispatch-time site on the main thread.
+
+Errors that survive the retry budget propagate: the thread parks the
+exception and `next()` re-raises it at the consuming point, so the run dies
+as loudly as the synchronous loop would.
+"""
+
+from __future__ import annotations
+
+from ..data.fed_dataset import ThreadedPrefetcher
+
+
+class PreparedSource:
+    """Inline producer (the --sync_loop path): prepare_round at the call
+    point, no thread, no lookahead — byte-for-byte the old loop's timing."""
+
+    def __init__(self, session, start_round: int):
+        self.session = session
+        self._next = start_round
+
+    def next(self):
+        prep = self.session.prepare_round(self._next)
+        self._next += 1
+        return prep
+
+    def stop(self):
+        pass
+
+
+class RoundPrefetcher(PreparedSource):
+    """Background producer with a bounded queue (depth=2: double buffering),
+    built on the same ThreadedPrefetcher machinery the eval loader uses.
+
+    `next()` blocks until the next round's preparation is done (or its
+    parked error re-raises); `stop()` halts the producer and joins it —
+    called by the runner before any exit path so a preemption can't leak a
+    thread mid-assembly."""
+
+    def __init__(self, session, start_round: int, depth: int = 2):
+        super().__init__(session, start_round)
+
+        def rounds():
+            rnd = start_round
+            while True:  # unbounded: the consumer (run_loop) decides the end
+                yield session.prepare_round(rnd)
+                rnd += 1
+
+        self._pf = ThreadedPrefetcher(rounds(), depth=depth,
+                                      name="round-prefetch")
+
+    def next(self):
+        return self._pf.next()
+
+    def stop(self):
+        self._pf.stop()

@@ -1,0 +1,117 @@
+"""Asynchronous checkpoint writer: periodic saves off the round path.
+
+A scheduled `--checkpoint_every` save costs a full `device_get` of the
+server state plus orbax serialization plus fsync-ish filesystem traffic —
+all host work the old loop paid INSIDE the round loop, stalling dispatch.
+The writer moves it to a dedicated thread. This is safe to overlap because
+of utils.checkpoint's commit protocol: writes stage into `.tmp_round_*` and
+`os.rename` to their final name, so training can keep dispatching while a
+save is in flight and a torn write can never be mistaken for a checkpoint;
+`ckpt.save` itself captures a consistent (state, round, RNG-snapshot) view
+under the session's mutate_lock, exactly like the watchdog's emergency save
+has always done from ITS timer thread.
+
+Contract:
+
+- `request()` coalesces: a request arriving while a save runs marks ONE
+  follow-up save (which captures the then-newest committed state) — a slow
+  filesystem degrades checkpoint cadence, never queues unbounded work.
+- `drain()` blocks until idle and re-raises the first stored error, so a
+  failing writer surfaces at the next boundary instead of silently eating
+  checkpoints; the runner drains before exit 75 (a preemption must not race
+  its own emergency save against an in-flight periodic one — ckpt.save's
+  caller-side lock serializes the writes themselves).
+- Emergency (watchdog) and preemption saves do NOT go through the writer:
+  they stay synchronous on their triggering thread, because both run at
+  moments where "the save completed" must hold before the next action
+  (abort / exit 75).
+
+NOT safe with server-state buffer donation: an overlapped save reads
+`session.state` while later rounds dispatch, which requires the live
+buffers to survive the in-flight round (`donate_state=False` — the same
+condition the watchdog's mid-round emergency save already imposes). The
+runner checks and falls back to synchronous saves when donation is on.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+
+class AsyncCheckpointWriter:
+    def __init__(self, save_fn, alert=None):
+        """save_fn: zero-arg callable performing one checkpoint save (the
+        CLI/runner closure over ckpt.save, including its serializing lock).
+        alert: callable(str) for failure messages (default: stderr)."""
+        self._save_fn = save_fn
+        self._alert = alert or (
+            lambda msg: print(msg, file=sys.stderr, flush=True)
+        )
+        self._cv = threading.Condition()
+        self._pending = False
+        self._busy = False
+        self._closed = False
+        self._error: BaseException | None = None
+        self.saves_completed = 0
+        self.saves_coalesced = 0
+        self.last_path = None
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def request(self) -> None:
+        """Ask for one save of the (future) newest committed state."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            if self._pending or self._busy:
+                self.saves_coalesced += 1
+            self._pending = True
+            self._cv.notify_all()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if not self._pending:  # closed, nothing queued
+                    return
+                self._pending = False
+                self._busy = True
+            try:
+                path = self._save_fn()
+                with self._cv:
+                    self.saves_completed += 1
+                    self.last_path = path
+            except BaseException as e:  # noqa: BLE001 — surfaced at drain()
+                with self._cv:
+                    if self._error is None:
+                        self._error = e
+                self._alert(
+                    f"async-checkpoint: save FAILED ({type(e).__name__}: "
+                    f"{e}); the failure re-raises at the next drain"
+                )
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def drain(self) -> None:
+        """Block until no save is queued or running; re-raise a stored
+        failure (once)."""
+        with self._cv:
+            while self._pending or self._busy:
+                self._cv.wait()
+            if self._error is not None:
+                e, self._error = self._error, None
+                raise e
+
+    def close(self) -> None:
+        """Finish outstanding work and stop the thread (drain first if the
+        caller wants errors re-raised; close itself never raises)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=60.0)

@@ -13,7 +13,9 @@ Hardened for long paper-scale runs (resilience/):
   last. `verify()` checks it; `restore_latest()` walks newest-to-oldest and
   falls back LOUDLY past any checkpoint that fails verification or restore,
   so a corrupted/truncated latest checkpoint costs one checkpoint interval,
-  not the run.
+  not the run. `save()` additionally READS BACK the committed files against
+  the manifest (silent-bitrot-on-write media fails the save, counted in
+  `save_verify_failures()`, retried by the wrapper below).
 - **Retries + fault injection**: the write path runs under
   `resilience.retry` (site "ckpt_save"), and a `FaultPlan` can inject
   transient write failures or post-commit corruption to prove the above.
@@ -38,6 +40,23 @@ from ..resilience import retry as rtry
 
 MANIFEST = "manifest.json"
 _TMP_PREFIX = ".tmp_round_"
+
+# process-wide count of committed checkpoints that FAILED the post-commit
+# read-back (save-time manifest verification): silent-bitrot-on-write media
+# caught in the act. Each failure also raises inside the retry wrapper, so a
+# transient flake gets re-written; bench.py surfaces the count in its JSON.
+_VERIFY_FAILURES = 0
+
+
+def save_verify_failures() -> int:
+    return _VERIFY_FAILURES
+
+
+class CheckpointVerifyError(RuntimeError):
+    """A just-committed checkpoint failed its read-back against the sha256
+    manifest — the write path (or the media under it) silently corrupted
+    data. Raised from inside the retry wrapper so bounded retries re-write;
+    exhaustion propagates it to the caller LOUDLY."""
 
 
 def _unpadded_client_state(client_state, num_clients: int):
@@ -88,7 +107,8 @@ def verify(path: str) -> bool | None:
 
 
 def save(ckpt_dir: str, session, keep: int = 3, fault_plan=None,
-         retry_policy: rtry.RetryPolicy | None = None):
+         retry_policy: rtry.RetryPolicy | None = None,
+         verify_on_save: bool = True):
     # capture every session field under the session's mutation lock (when it
     # has one): an emergency save on the watchdog's timer thread must never
     # mix round N's params with round N-1's counter/RNG because the stalled
@@ -168,6 +188,25 @@ def save(ckpt_dir: str, session, keep: int = 3, fault_plan=None,
                 shutil.rmtree(old)
             os.rename(final, old)
         os.rename(staging, final)  # the atomic commit point
+        if verify_on_save and verify(final) is not True:
+            # read-back of the COMMITTED files against the manifest: media
+            # that acknowledges writes and returns different bytes (silent
+            # bitrot-on-write) must fail the SAVE loudly, not the restore
+            # hours later when this checkpoint is the only copy. Counted,
+            # then raised inside the retry wrapper so the write is retried.
+            # Runs BEFORE the displaced copy is deleted: a corrupt re-save
+            # of an already-checkpointed round must never destroy the
+            # verified-good copy it displaced — put it back instead.
+            global _VERIFY_FAILURES
+            _VERIFY_FAILURES += 1
+            if old is not None:
+                shutil.rmtree(final, ignore_errors=True)
+                os.rename(old, final)
+            raise CheckpointVerifyError(
+                f"checkpoint {final} failed post-commit read-back "
+                "verification (write-path corruption); "
+                f"save-verify failures this process: {_VERIFY_FAILURES}"
+            )
         if old is not None:
             shutil.rmtree(old, ignore_errors=True)
         return final

@@ -74,6 +74,13 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "(lax.scan) with a single host sync per block — "
                         "amortizes the host round-trip; stateless modes only "
                         "(others silently run per-round)")
+    p.add_argument("--sync_loop", action="store_true",
+                   help="run the fully synchronous round loop: inline batch "
+                        "assembly, a blocking metrics sync per dispatch, and "
+                        "blocking checkpoint writes. The default ASYNC "
+                        "harness (runner/) overlaps all three with device "
+                        "compute and is pinned bit-identical to this loop; "
+                        "--sync_loop is the escape hatch / A-B baseline")
     p.add_argument("--client_chunk", type=int, default=0,
                    help="> 0 scans the per-client grads in chunks of this "
                         "many clients (must divide --num_workers), so at "
@@ -103,7 +110,8 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                    help="deterministic fault-injection plan: ';'-separated "
                         "kind[@round,...][:key=val,...] entries — kinds: "
                         "preempt (SIGTERM mid-round), stall:secs=S / "
-                        "data_fail:times=N (data-loader), nonfinite[:value="
+                        "data_fail:times=N (data-loader), eval_stall:secs=S "
+                        "(eval loader), nonfinite[:value="
                         "inf] (NaN/Inf gradient burst), ckpt_fail:times=N / "
                         "ckpt_corrupt / ckpt_partial (checkpoint IO), "
                         "dist_init:times=N (distributed bootstrap), seed=N. "

@@ -183,14 +183,24 @@ class RoundWatchdog:
                 self.on_abort()
 
     @contextlib.contextmanager
-    def round(self, round_index: int):
+    def round(self, round_index: int, rounds: int = 1, record: bool = True):
+        """Time one guarded segment. `rounds` > 1 marks a segment that
+        legitimately spans that many rounds (the async runner's boundary
+        drain waits out every queued dispatch): the stall threshold scales
+        by `rounds` and the completion time is recorded PER ROUND, so the
+        learned median stays a true round time. `record=False` guards a
+        segment without feeding the median at all — the async runner's
+        dispatch segments return in ~ms (no host sync) and would otherwise
+        drag the median to ~0, collapsing every threshold to the floor and
+        false-firing the ladder on healthy boundary drains."""
+        rounds = max(rounds, 1)
         thr = self.threshold_s()
         start = time.monotonic()
         if thr is not None:
             with self._lock:
                 self._armed = True
                 self._gen += 1
-                self._arm_stage(round_index, thr, start, 0, self._gen)
+                self._arm_stage(round_index, thr * rounds, start, 0, self._gen)
         try:
             yield
         finally:
@@ -199,4 +209,5 @@ class RoundWatchdog:
                 if self._timer is not None:
                     self._timer.cancel()
                     self._timer = None
-            self._times.append(time.monotonic() - start)
+            if record:
+                self._times.append((time.monotonic() - start) / rounds)

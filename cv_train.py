@@ -15,9 +15,7 @@ Smoke test (BASELINE config #1):
 from __future__ import annotations
 
 import math
-import os
 import sys
-import threading
 
 import jax
 import jax.numpy as jnp
@@ -25,20 +23,16 @@ from jax.flatten_util import ravel_pytree
 
 from commefficient_tpu.data.cifar import load_cifar_fed
 from commefficient_tpu.data.femnist import load_femnist_fed
-from commefficient_tpu.federated.api import (
-    FederatedSession, FedModel, FedOptimizer, plan_block,
-)
+from commefficient_tpu.federated.api import FederatedSession, FedModel, FedOptimizer
 from commefficient_tpu.models.femnist_cnn import FEMNISTCNN
 from commefficient_tpu.models.losses import make_classification_loss
 from commefficient_tpu.models.resnet9 import ResNet9
 from commefficient_tpu.parallel import mesh as meshlib
-from commefficient_tpu.resilience import (
-    EXIT_RESUMABLE, FaultPlan, PreemptionHandler, RetryPolicy,
-)
+from commefficient_tpu.resilience import FaultPlan, RetryPolicy
+from commefficient_tpu.runner import RunnerConfig, run_loop
 from commefficient_tpu.utils import checkpoint as ckpt
 from commefficient_tpu.utils.config import make_parser, mode_config_from_args, resolve_defaults
-from commefficient_tpu.utils.logging import TableLogger, Timer
-from commefficient_tpu.utils.watchdog import RoundWatchdog
+from commefficient_tpu.utils.logging import TableLogger
 from commefficient_tpu.utils.schedules import triangular
 
 
@@ -120,16 +114,6 @@ def main(argv=None):
     opt = FedOptimizer(schedule, rounds_per_epoch)
     model = FedModel(session)
 
-    # serialized: the watchdog's emergency checkpoint runs on its timer
-    # thread and must not race a scheduled save of the same round (both
-    # would target the same staging/final dirs)
-    ckpt_lock = threading.Lock()
-
-    def save_ckpt():
-        with ckpt_lock:
-            return ckpt.save(args.checkpoint_dir, session,
-                             fault_plan=fault_plan, retry_policy=retry_policy)
-
     if args.resume and args.checkpoint_dir:
         # newest VERIFIED checkpoint; falls back loudly past damaged ones
         path = ckpt.restore_latest(args.checkpoint_dir, session)
@@ -141,84 +125,39 @@ def main(argv=None):
         jax.profiler.start_trace(args.profile_dir)
 
     logger = TableLogger(args.log_jsonl or None)
-    timer = Timer()
-    eval_every = args.eval_every or rounds_per_epoch
-    acc_loss = acc_count = acc_correct = 0.0
-    nonfinite_total = 0
-    # escalation ladder: warn -> stacks -> emergency ckpt -> (opt-in) abort
-    # with the resumable status so a supervisor relaunches with --resume
-    watchdog = RoundWatchdog(
-        on_emergency=save_ckpt
-        if args.checkpoint_dir and not args.no_emergency_checkpoint else None,
-        on_abort=(lambda: os._exit(EXIT_RESUMABLE))
-        if args.watchdog_abort and args.checkpoint_dir else None,
+
+    def build_row(rnd, m, totals, ev, time_s, nonfinite_total):
+        return {
+            "round": rnd,
+            "epoch": rnd / rounds_per_epoch,
+            "lr": m["lr"],
+            "train_loss": totals.get("loss_sum", 0.0) / max(totals.get("count", 0.0), 1),
+            "train_acc": totals.get("correct", 0.0) / max(totals.get("count", 0.0), 1),
+            "test_loss": ev["loss_sum"] / max(ev["count"], 1),
+            "test_acc": ev["correct"] / max(ev["count"], 1),
+            # measured cumulative wire-cost (checkpointed/restored by
+            # the session, so resumed runs stay exact under dropout)
+            "comm_mb": session.comm_mb_total,
+            "time_s": time_s,
+            # always present: TableLogger freezes its columns on the
+            # first row, so a count first added mid-run would never
+            # reach the stdout table an operator actually watches
+            "nonfinite_rounds": nonfinite_total,
+        }
+
+    # the shared harness owns the loop: block planning, async prefetch /
+    # deferred metrics / overlapped checkpoint writes (or the --sync_loop
+    # serial path), watchdog escalation, preemption, non-finite halt
+    run_loop(
+        session, opt,
+        RunnerConfig.from_args(args, total_rounds, args.eval_every or rounds_per_epoch),
+        eval_fn=lambda: model.eval(test_set, args.eval_batch_size),
+        build_row=build_row,
+        logger=logger,
     )
-    rnd = session.round
-    with PreemptionHandler() as pre:
-        while rnd < total_rounds:
-            lrs = plan_block(opt, rnd, total_rounds, eval_every,
-                             args.checkpoint_every, args.rounds_per_dispatch)
-            if len(lrs) > 1 and session.supports_block_dispatch:
-                # one dispatch for the block; the watchdog times the block
-                with watchdog.round(rnd):
-                    ms = session.run_rounds(lrs)
-            else:
-                # per-round dispatch (stateful/split fallback): keep the
-                # watchdog per-round so a hang is detected at round, not
-                # block, granularity
-                ms = []
-                for j, lr in enumerate(lrs):
-                    with watchdog.round(rnd + j):
-                        ms.append(session.run_round(lr))
-                    if pre.triggered:
-                        break  # stop inside the block: the grace window is short
-            for m in ms:
-                acc_loss += m["loss_sum"]
-                acc_count += m["count"]
-                acc_correct += m["correct"]
-                nonfinite_total += int(m.get("nonfinite_rounds", 0))
-            rnd += len(ms)  # == len(lrs) unless preemption cut the block short
-            if pre.triggered:
-                if args.checkpoint_dir:
-                    path = save_ckpt()
-                    print(f"preemption: emergency checkpoint at round {rnd}: "
-                          f"{path}", flush=True)
-                sys.exit(EXIT_RESUMABLE)
-            if nonfinite_total and args.on_nonfinite == "halt":
-                if args.checkpoint_dir:
-                    save_ckpt()
-                sys.exit(f"halting at round {rnd}: non-finite update skipped "
-                         "(--on_nonfinite halt; "
-                         + ("state checkpointed clean)" if args.checkpoint_dir
-                            else "no --checkpoint_dir, nothing saved)"))
-            if args.checkpoint_every and args.checkpoint_dir and rnd % args.checkpoint_every == 0:
-                save_ckpt()
-            if rnd % eval_every == 0 or rnd == total_rounds:
-                ev = model.eval(test_set, args.eval_batch_size)
-                row = {
-                    "round": rnd,
-                    "epoch": rnd / rounds_per_epoch,
-                    "lr": m["lr"],
-                    "train_loss": acc_loss / max(acc_count, 1),
-                    "train_acc": acc_correct / max(acc_count, 1),
-                    "test_loss": ev["loss_sum"] / max(ev["count"], 1),
-                    "test_acc": ev["correct"] / max(ev["count"], 1),
-                    # measured cumulative wire-cost (checkpointed/restored by
-                    # the session, so resumed runs stay exact under dropout)
-                    "comm_mb": session.comm_mb_total,
-                    "time_s": timer(),
-                    # always present: TableLogger freezes its columns on the
-                    # first row, so a count first added mid-run would never
-                    # reach the stdout table an operator actually watches
-                    "nonfinite_rounds": nonfinite_total,
-                }
-                logger.append(row)
-                acc_loss = acc_count = acc_correct = 0.0
 
     if args.profile_dir:
         jax.profiler.stop_trace()
-    if args.checkpoint_dir:
-        save_ckpt()
     return session
 
 

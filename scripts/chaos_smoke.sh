@@ -1,22 +1,112 @@
 #!/usr/bin/env bash
 # Chaos smoke: run the seeded fault-injection suite end-to-end on CPU.
 #
-# Drives the `chaos`-marked tests (tests/test_resilience.py), which exercise
-# the full recovery surface through the REAL cv_train CLI path on a tiny
-# model: an injected SIGTERM mid-round -> emergency checkpoint -> relaunch
-# with --resume -> final params bit-identical to the uninterrupted run;
-# plus a NaN-burst round skipped with clean momentum/error state, and
-# corrupted/truncated checkpoints falling back to the last verified-good
-# one. Everything is seeded (FaultPlan + data + init), so a failure here is
-# reproducible, not flaky.
+# Drives the `chaos`-marked tests (tests/test_resilience.py +
+# tests/test_runner.py), which exercise the full recovery surface through
+# the REAL cv_train CLI path on a tiny model: an injected SIGTERM mid-round
+# -> emergency checkpoint -> relaunch with --resume -> final params
+# bit-identical to the uninterrupted run; the async run loop pinned
+# bit-identical to --sync_loop; a NaN-burst round skipped with clean
+# momentum/error state; and corrupted/truncated checkpoints falling back to
+# the last verified-good one. Everything is seeded (FaultPlan + data +
+# init), so a failure here is reproducible, not flaky.
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
+#        scripts/chaos_smoke.sh supervisor
+#
+# `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
+# restartPolicy would: it launches the tiny cv_train run with a fault plan
+# that SIGTERMs it twice (rounds 1 and 3) and relaunches with --resume in a
+# loop while the child exits 75 (EX_TEMPFAIL, the resumable contract),
+# asserting the run eventually finishes cleanly after >= 1 relaunch.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 
-exec timeout -k 10 "${CHAOS_TIMEOUT_S:-300}" \
-    python -m pytest tests/test_resilience.py -m chaos -q \
+if [[ "${1:-}" == "supervisor" ]]; then
+    shift
+    ckdir="$(mktemp -d)"
+    trap 'rm -rf "$ckdir"' EXIT
+    relaunches=0
+    rc=75
+    extra=()
+    while [[ $rc -eq 75 ]]; do
+        if [[ $relaunches -gt 6 ]]; then
+            echo "supervisor: FAILED — still exiting 75 after $relaunches relaunches" >&2
+            exit 1
+        fi
+        set +e
+        # ${arr[@]+...}: empty-array expansion is an unbound-variable error
+        # under set -u on bash <= 4.3 (macOS system bash)
+        timeout -k 10 "${CHAOS_TIMEOUT_S:-300}" \
+            python - "$ckdir" ${extra[@]+"${extra[@]}"} "$@" <<'EOF'
+# tiny supervisor child: the real cv_train.main CLI path with the same
+# 2-layer-MLP + 64-image synthetic-CIFAR substitution the chaos tests use
+# (recovery logic is model-agnostic; ResNet-9 compiles for minutes on CPU)
+import sys
+
+import flax.linen as nn
+
+import commefficient_tpu.data.cifar as cifar
+import cv_train
+
+
+class _TinyNet(nn.Module):
+    num_classes: int = 10
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+_orig = cifar.load_cifar_fed
+
+
+def _tiny(*a, **kw):
+    kw.update(synthetic_train=64, synthetic_test=32)
+    return _orig(*a, **kw)
+
+
+cv_train.ResNet9 = _TinyNet
+cv_train.load_cifar_fed = _tiny
+
+ckdir, extra = sys.argv[1], sys.argv[2:]
+argv = [
+    "--dataset", "cifar10", "--mode", "uncompressed", "--num_clients", "8",
+    "--num_workers", "2", "--local_batch_size", "4", "--lr_scale", "0.05",
+    "--weight_decay", "0", "--data_root", "/nonexistent",
+    "--num_rounds", "6", "--checkpoint_dir", ckdir,
+    "--fault_plan", "preempt@1,3", *extra,
+]
+session = cv_train.main(argv)
+print(f"supervisor-child: finished cleanly at round {session.round}")
+assert session.round == 6, session.round
+EOF
+        rc=$?
+        set -e
+        echo "supervisor: child exited rc=$rc (relaunches so far: $relaunches)"
+        if [[ $rc -eq 75 ]]; then
+            relaunches=$((relaunches + 1))
+            extra=(--resume)
+        fi
+    done
+    if [[ $rc -ne 0 ]]; then
+        echo "supervisor: FAILED — child exited rc=$rc" >&2
+        exit "$rc"
+    fi
+    if [[ $relaunches -lt 1 ]]; then
+        echo "supervisor: FAILED — expected >= 1 preemption relaunch (the fault plan never fired?)" >&2
+        exit 1
+    fi
+    echo "supervisor: PASS (preempt -> exit 75 -> --resume x$relaunches, clean finish)"
+    exit 0
+fi
+
+exec timeout -k 10 "${CHAOS_TIMEOUT_S:-600}" \
+    python -m pytest tests/test_resilience.py tests/test_runner.py -m chaos -q \
     -p no:cacheprovider "$@"

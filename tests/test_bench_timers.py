@@ -128,6 +128,83 @@ def test_server_split_reports_all_ops(bench_mod, monkeypatch):
     assert out["d"] == 4096 and out["k"] == 64
 
 
+def test_server_split_topk_runs_at_engine_recall(bench_mod, monkeypatch):
+    """ADVICE r5: the isolated topk_approx/oversample chains must run at the
+    recall the ENGINE actually runs (mode_cfg.topk_recall), not topk_abs's
+    default 0.95 — approx_max_k's cost depends on recall_target, so the
+    attribution would otherwise measure a different op."""
+    from commefficient_tpu.modes.config import ModeConfig
+    from commefficient_tpu.sketch import csvec
+
+    calls = []
+    real = csvec.topk_abs
+
+    def spy(x, k, approx=False, recall=0.95, impl=None):
+        calls.append((impl, recall))
+        return real(x, k, approx=approx, recall=recall, impl=impl)
+
+    monkeypatch.setattr(csvec, "topk_abs", spy)
+    monkeypatch.setattr(bench_mod, "PHASE_CHAIN", 2)
+    cfg = ModeConfig(mode="sketch", d=4096, k=64, num_rows=3, num_cols=1024,
+                     momentum_type="virtual", error_type="virtual",
+                     topk_recall=0.7)
+    out = bench_mod._server_split(cfg, rt_ms=0.0)
+    assert "error" not in out, out
+    assert out["topk_recall"] == 0.7
+    recalls = {r for impl, r in calls if impl in ("approx", "oversample")}
+    assert recalls == {0.7}, calls
+
+
+def test_run_loop_bench_measures_both_arms(monkeypatch):
+    """bench's run_loop section must drive a real FederatedSession through
+    the shared harness in BOTH loop modes and report the acceptance pair
+    (wall_clock_updates_per_sec, host_overhead_ms) per arm, plus fold an
+    injected fault's footprint into nonfinite_rounds."""
+    bench, teardown = _import_bench(
+        monkeypatch, BENCH_MODEL="resnet9", BENCH_WORKERS="2",
+        BENCH_LOCAL_BATCH="2", BENCH_COLS="512", BENCH_TOPK="32",
+        BENCH_BLOCKS="1", BENCH_DTYPE="float32",
+        BENCH_RUN_LOOP_ROUNDS="3",
+        # nonfinite@3 lands inside the timed sync arm (rounds 2-4 after the
+        # 2-round warmup); preempt@4 must be STRIPPED, not SIGTERM the bench
+        BENCH_FAULT_PLAN="nonfinite@3;preempt@4",
+    )
+    try:
+        import flax.linen as nn
+
+        from commefficient_tpu.models.losses import make_classification_loss
+
+        class _TinyNet(nn.Module):
+            num_classes: int = 10
+            dtype: str = "float32"
+
+            @nn.compact
+            def __call__(self, x, train=False):
+                x = x.reshape((x.shape[0], -1))
+                return nn.Dense(self.num_classes)(x)
+
+        def tiny_workload():
+            model = _TinyNet()
+            x0 = jnp.zeros((1, 32, 32, 3), jnp.float32)
+            params = model.init(jax.random.PRNGKey(0), x0, train=False)["params"]
+            loss_fn = make_classification_loss(model, train=True)
+            sketch_kw = dict(k=32, num_rows=3, num_cols=512, num_blocks=1)
+            return params, {}, None, loss_fn, "tiny", sketch_kw, 2
+
+        monkeypatch.setattr(bench, "_resnet9_workload", tiny_workload)
+        out = bench._run_loop_bench(round_ms=0.0)
+        assert "error" not in out, out
+        for arm in ("sync", "async"):
+            assert out[arm]["wall_clock_updates_per_sec"] > 0
+            assert "host_overhead_ms" in out[arm]
+            assert out[arm]["drains"] >= 1
+        assert out["async_speedup_vs_sync"] > 0
+        assert out["nonfinite_rounds"] == 1  # the injected burst, counted
+        assert "stripped" in out["fault_plan_note"]
+    finally:
+        teardown()
+
+
 def test_flops_chunked_matches_unchunked(monkeypatch):
     """XLA cost analysis counts a lax.scan body ONCE, so the chunked client
     step (BENCH_CLIENT_CHUNK > 0) undercounts flops by the trip count —

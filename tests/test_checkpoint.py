@@ -162,6 +162,61 @@ def test_restore_via_relative_checkpoint_dir(small_session, tmp_path, monkeypatc
     )
 
 
+def test_save_readback_catches_silent_bitrot(small_session, tmp_path, monkeypatch):
+    """Manifest verification on SAVE: media that acknowledges a write and
+    stores different bytes must fail the save LOUDLY (counted, raised inside
+    the retry wrapper) — not surface hours later at restore when the damaged
+    checkpoint is the only copy. A transient bitrot recovers via the
+    re-write; persistent bitrot exhausts retries and raises."""
+    from commefficient_tpu.resilience import FaultPlan, RetryPolicy
+
+    s, _ = cv_train.build(_args(tmp_path))
+    s.run_round(0.05)
+
+    real_manifest = ckpt._write_manifest
+    lies = {"left": 1}
+
+    def lying_media(path):
+        # manifest records the TRUE hashes; then the 'media' flips a byte of
+        # the largest staged file — exactly what the post-commit read-back
+        # exists to catch (write-path corruption under an intact manifest)
+        real_manifest(path)
+        if lies["left"] > 0:
+            lies["left"] -= 1
+            target = FaultPlan._largest_data_file(path)
+            with open(target, "r+b") as f:
+                b = f.read(1)
+                f.seek(0)
+                f.write(bytes([b[0] ^ 0xFF]))
+
+    monkeypatch.setattr(ckpt, "_write_manifest", lying_media)
+    before = ckpt.save_verify_failures()
+    path = ckpt.save(str(tmp_path / "ck"), s,
+                     retry_policy=RetryPolicy(max_retries=2, base_delay_s=0.001))
+    assert ckpt.save_verify_failures() == before + 1  # counted in metrics
+    assert ckpt.verify(path) is True  # the retry re-wrote a clean copy
+
+    lies["left"] = 99  # persistent bitrot: every attempt fails, loudly
+    with pytest.raises(ckpt.CheckpointVerifyError):
+        ckpt.save(str(tmp_path / "ck2"), s,
+                  retry_policy=RetryPolicy(max_retries=1, base_delay_s=0.001))
+    assert ckpt.save_verify_failures() == before + 3
+
+    # a corrupt RE-SAVE of an already-checkpointed round must put the
+    # displaced verified-good copy back, never destroy it
+    with pytest.raises(ckpt.CheckpointVerifyError):
+        ckpt.save(str(tmp_path / "ck"), s,
+                  retry_policy=RetryPolicy(max_retries=0))
+    assert ckpt.verify(path) is True  # the good round survived the attempt
+
+    # the opt-out keeps the old (unverified) save behavior
+    lies["left"] = 1
+    p3 = ckpt.save(str(tmp_path / "ck3"), s,
+                   retry_policy=RetryPolicy(max_retries=0),
+                   verify_on_save=False)
+    assert ckpt.verify(p3) is False  # damage committed silently, as opted
+
+
 def test_cifar100_build_path_round(small_session, tmp_path):
     """--dataset cifar100 through the full cv_train build path (the parser
     offered the choice with nothing behind it until round 4); loader-level

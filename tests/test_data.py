@@ -58,6 +58,48 @@ def test_eval_batches_cover_everything_once():
     assert seen == 10
 
 
+def test_prefetch_iter_preserves_values_order_and_errors():
+    """The eval-loader overlap helper (runner tentpole): identical items in
+    identical order, producer exceptions re-raised at the consuming point,
+    and the producer thread stopped when the consumer abandons early."""
+    import threading
+    import time
+
+    import pytest
+
+    from commefficient_tpu.data.fed_dataset import prefetch_iter
+
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    ds = FedDataset(x, np.zeros(10, np.int32), [np.arange(10)])
+    plain = list(ds.eval_batches(4))
+    fetched = list(prefetch_iter(ds.eval_batches(4), depth=2))
+    assert len(plain) == len(fetched)
+    for a, b in zip(plain, fetched):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+    # depth <= 0 degrades to plain iteration
+    assert len(list(prefetch_iter(ds.eval_batches(4), depth=0))) == len(plain)
+
+    def boom():
+        yield 1
+        raise ValueError("loader died")
+
+    it = prefetch_iter(boom(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="loader died"):
+        next(it)
+
+    # abandoning the generator stops the producer (no thread leak)
+    before = threading.active_count()
+    g = prefetch_iter(iter(range(1000)), depth=1)
+    assert next(g) == 0
+    g.close()
+    deadline = time.monotonic() + 5
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before
+
+
 def test_cifar_synthetic_fallback():
     train, test, nc = load_cifar_fed("cifar10", num_clients=50, iid=False,
                                      data_root="/nonexistent", synthetic_train=500,

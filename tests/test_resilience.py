@@ -128,6 +128,44 @@ def test_stall_site_sleeps_once():
     assert first >= 0.05 and again < 0.05
 
 
+def test_eval_stall_site_sleeps_once_on_scheduled_round():
+    plan = FaultPlan.parse("eval_stall@2:secs=0.05")
+    t0 = time.monotonic()
+    plan.eval_load(0)  # not scheduled for round 0
+    assert time.monotonic() - t0 < 0.05
+    t0 = time.monotonic()
+    plan.eval_load(2)
+    assert time.monotonic() - t0 >= 0.05
+    t0 = time.monotonic()
+    plan.eval_load(2)  # one-shot per round
+    assert time.monotonic() - t0 < 0.05
+    # the training-loader `stall` spec must NOT leak into the eval site
+    assert FaultPlan.parse("stall@2:secs=9").spec("eval_stall", 2) is None
+
+
+def test_retry_counts_surface_failed_attempts():
+    """Chaos runs are benchmarkable: every failed attempt bumps the per-site
+    process counter bench.py publishes in its JSON."""
+    from commefficient_tpu.resilience import reset_retry_counts, retry_counts
+
+    reset_retry_counts()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("flake")
+        return "ok"
+
+    with_retries(flaky, site="countme",
+                 policy=RetryPolicy(max_retries=3, base_delay_s=0.0),
+                 sleep=lambda d: None, log=lambda m: None)
+    assert retry_counts()["countme"] == 2
+    assert "neverfailed" not in retry_counts()
+    reset_retry_counts()
+    assert retry_counts() == {}
+
+
 # -------------------------------------------------------------- retry.py unit
 
 
@@ -245,6 +283,26 @@ def test_data_load_retry_replays_identical_round(tiny_cv):
         jax.tree.leaves(jax.device_get(b.state["params"])),
     ):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.chaos
+def test_eval_stall_fires_in_real_eval_path(tiny_cv):
+    """The eval_stall site is wired into FederatedSession.evaluate (the eval
+    loader the round-5 FEMNIST stall actually lived in): scheduled round
+    stalls once, and eval results are unaffected."""
+    s, test_set = cv_train.build(
+        _args(("--fault_plan", "eval_stall@1:secs=0.3"))
+    )
+    ev0 = s.evaluate(test_set, 32)  # round 0: no stall; compiles eval
+    s.run_round(LR)  # -> round 1
+    t0 = time.monotonic()
+    ev1 = s.evaluate(test_set, 32)
+    stalled = time.monotonic() - t0
+    t0 = time.monotonic()
+    ev2 = s.evaluate(test_set, 32)  # one-shot: same round, no re-stall
+    clean = time.monotonic() - t0
+    assert stalled >= 0.3 and stalled - clean >= 0.25
+    assert ev1 == ev2 and ev0.keys() == ev1.keys()
 
 
 def _snap(session):

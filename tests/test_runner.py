@@ -1,0 +1,360 @@
+"""Async run-loop harness (runner/): the acceptance pin is that the
+overlapped loop — background batch prefetch, deferred device_get of
+metrics, checkpoint writes on a writer thread — produces BIT-IDENTICAL
+final params and logged metrics to `--sync_loop` (the old serial loop),
+including across an emergency-checkpoint resume, because both drive the
+identical compiled programs in the identical order with the identical host
+RNG stream.
+
+Same tiny-MLP + synthetic-CIFAR substitution as tests/test_resilience.py
+(the loop logic is model-agnostic; ResNet-9 compiles for minutes on this
+1-core box)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import cv_train
+from commefficient_tpu.resilience import (
+    EXIT_RESUMABLE, InjectedTransientError,
+)
+from commefficient_tpu.runner import AsyncCheckpointWriter, RoundPrefetcher
+from commefficient_tpu.utils import checkpoint as ckpt
+from commefficient_tpu.utils.config import make_parser, resolve_defaults
+
+LR = 0.05
+
+
+def _argv(extra=()):
+    return [
+        "--dataset", "cifar10", "--mode", "uncompressed", "--num_clients", "8",
+        "--num_workers", "2", "--local_batch_size", "4", "--lr_scale", "0.05",
+        "--weight_decay", "0", "--data_root", "/nonexistent", *extra,
+    ]
+
+
+def _args(extra=()):
+    return resolve_defaults(make_parser("cv").parse_args(_argv(extra)))
+
+
+@pytest.fixture()
+def tiny_cv(tmp_path, monkeypatch):
+    import flax.linen as nn
+
+    import commefficient_tpu.data.cifar as cifar_mod
+
+    orig = cifar_mod.load_cifar_fed
+
+    def tiny(*a, **kw):
+        kw.update(synthetic_train=64, synthetic_test=32)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(cv_train, "load_cifar_fed", tiny)
+
+    class _TinyNet(nn.Module):
+        num_classes: int = 10
+        dtype: str = "float32"
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(self.num_classes)(x)
+
+    monkeypatch.setattr(cv_train, "ResNet9", _TinyNet)
+    return tmp_path
+
+
+def _rows(path):
+    """Logged JSONL rows minus wall-clock (the one legitimately
+    loop-dependent field)."""
+    rows = [json.loads(line) for line in open(path)]
+    for r in rows:
+        r.pop("time_s")
+    return rows
+
+
+def _assert_params_equal(sa, sb):
+    for x, y in zip(
+        jax.tree.leaves(jax.device_get(sa.state["params"])),
+        jax.tree.leaves(jax.device_get(sb.state["params"])),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ------------------------------------------------- the acceptance headline
+
+
+@pytest.mark.chaos
+def test_async_loop_bit_identical_to_sync(tiny_cv, tmp_path):
+    """Multi-round run through the REAL CLI, eval cadence mid-run, mixed
+    block sizes (--rounds_per_dispatch 2 against --eval_every 3 exercises
+    BOTH the fused-block and per-round dispatch paths): the async loop's
+    final params and every logged metric row must be bit-identical to
+    --sync_loop's."""
+    base = _argv(("--num_rounds", "6", "--eval_every", "3",
+                  "--rounds_per_dispatch", "2"))
+    la, lb = str(tmp_path / "sync.jsonl"), str(tmp_path / "async.jsonl")
+    sa = cv_train.main(base + ["--sync_loop", "--log_jsonl", la])
+    sb = cv_train.main(base + ["--log_jsonl", lb])
+    assert sa.round == sb.round == 6
+    _assert_params_equal(sa, sb)
+    rows_a, rows_b = _rows(la), _rows(lb)
+    assert rows_a and rows_a == rows_b
+
+
+@pytest.mark.chaos
+def test_async_loop_preempt_resume_bit_identical(tiny_cv, tmp_path):
+    """SIGTERM mid-block under the async loop (prefetcher ahead, rounds in
+    flight, periodic saves on the writer thread): drain -> emergency
+    checkpoint -> exit 75; the relaunched --resume run must finish with
+    params bit-identical to an uninterrupted --sync_loop run. This is the
+    'checkpoint+resume mid-run + SIGTERM mid-block' acceptance case."""
+    base = _argv(("--num_rounds", "6"))
+    sa = cv_train.main(base + ["--sync_loop"])  # uninterrupted reference
+
+    ckdir = str(tmp_path / "ck")
+    chaos = ["--checkpoint_dir", ckdir, "--checkpoint_every", "2",
+             "--fault_plan", "preempt@2"]
+    with pytest.raises(SystemExit) as ei:
+        cv_train.main(base + chaos)
+    assert ei.value.code == EXIT_RESUMABLE
+    # the SIGTERM fired as round 2 dispatched; the drain let it commit, so
+    # the emergency checkpoint is a verified round-3 boundary
+    names = sorted(d for d in os.listdir(ckdir) if d.startswith("round_"))
+    assert names[-1] == "round_00000003"
+    assert ckpt.verify(os.path.join(ckdir, names[-1])) is True
+
+    sc = cv_train.main(base + chaos + ["--resume"])
+    assert sc.round == 6
+    _assert_params_equal(sa, sc)
+
+
+@pytest.mark.chaos
+def test_prefetcher_deterministic_under_injected_data_fault(tiny_cv):
+    """A data load failing transiently ON THE PREFETCH THREAD must recover
+    via the retry wrapper's RNG-snapshot restore and still serve the
+    bit-identical round sequence — prefetch never perturbs the client
+    stream."""
+    a, _ = cv_train.build(_args())
+    ms_a = [a.run_round(LR) for _ in range(4)]
+
+    b, _ = cv_train.build(_args(("--fault_plan", "data_fail@1:times=2")))
+    src = RoundPrefetcher(b, b.round, depth=2)
+    try:
+        ms_b = [b.commit_round(b.dispatch_round(src.next(), LR))[0]
+                for _ in range(4)]
+    finally:
+        src.stop()
+    assert [m["loss_sum"] for m in ms_a] == [m["loss_sum"] for m in ms_b]
+    _assert_params_equal(a, b)
+
+
+@pytest.mark.chaos
+def test_async_periodic_checkpoints_land_verified(tiny_cv, tmp_path):
+    """Periodic saves ride the writer thread in the async loop; by process
+    end every committed checkpoint must verify and include the final
+    round's synchronous save."""
+    ckdir = str(tmp_path / "ck")
+    s = cv_train.main(_argv(("--num_rounds", "6", "--checkpoint_dir", ckdir,
+                             "--checkpoint_every", "2")))
+    assert s.round == 6
+    names = sorted(d for d in os.listdir(ckdir) if d.startswith("round_"))
+    assert names and names[-1] == "round_00000006"
+    for name in names:
+        assert ckpt.verify(os.path.join(ckdir, name)) is True
+    # no staging dirs leaked by the overlapped writes
+    assert not [d for d in os.listdir(ckdir) if d.startswith(".tmp_round_")]
+
+
+# ----------------------------------------------------- prefetcher contract
+
+
+def test_prefetcher_serves_rounds_in_order(tiny_cv):
+    """The prefetched sequence must equal inline prepare_round calls on an
+    identically-seeded session: same cohorts, same batches, same snapshot
+    chain (the double buffer only changes WHEN host work runs)."""
+    a, _ = cv_train.build(_args())
+    b, _ = cv_train.build(_args())
+    inline = [a.prepare_round(i) for i in range(3)]
+    src = RoundPrefetcher(b, 0, depth=2)
+    try:
+        fetched = [src.next() for _ in range(3)]
+    finally:
+        src.stop()
+    for pa, pb in zip(inline, fetched):
+        assert pa.rnd == pb.rnd
+        np.testing.assert_array_equal(pa.ids, pb.ids)
+        for k in pa.batch:
+            np.testing.assert_array_equal(pa.batch[k], pb.batch[k])
+        np.testing.assert_array_equal(np.asarray(pa.sub), np.asarray(pb.sub))
+
+
+def test_prefetcher_propagates_loader_error(tiny_cv):
+    """Retry exhaustion on the prefetch thread re-raises at next(), as
+    loudly as the inline loop would."""
+    b, _ = cv_train.build(
+        _args(("--fault_plan", "data_fail@0:times=99", "--max_retries", "1"))
+    )
+    src = RoundPrefetcher(b, 0, depth=2)
+    try:
+        with pytest.raises(InjectedTransientError):
+            src.next()
+    finally:
+        src.stop()
+
+
+def test_prefetcher_stop_unblocks_producer(tiny_cv):
+    """stop() must join a producer blocked on a full queue (the preemption
+    exit path cannot afford to leak a thread mid-assembly)."""
+    b, _ = cv_train.build(_args())
+    src = RoundPrefetcher(b, 0, depth=1)
+    src.next()  # ensure the thread is live and refilling
+    time.sleep(0.05)  # let it block on the full queue
+    src.stop()
+    assert not src._pf._thread.is_alive()
+
+
+# --------------------------------------------------------- writer contract
+
+
+def test_writer_coalesces_requests():
+    gate = threading.Event()
+    calls = []
+
+    def save():
+        gate.wait(5)
+        calls.append(1)
+        return f"p{len(calls)}"
+
+    w = AsyncCheckpointWriter(save)
+    w.request()
+    deadline = time.monotonic() + 5
+    while not w._busy and time.monotonic() < deadline:
+        time.sleep(0.005)  # wait until the first save is IN flight
+    for _ in range(4):
+        w.request()  # all four coalesce into ONE follow-up save
+    gate.set()
+    w.drain()
+    w.close()
+    # four requests landed while a save was in flight: ONE follow-up save
+    # ran (capturing the newest state), all four counted as coalesced
+    assert len(calls) == 2
+    assert w.saves_completed == 2 and w.saves_coalesced == 4
+    assert w.last_path == "p2"
+
+
+def test_writer_reraises_failure_at_drain():
+    def bad():
+        raise OSError("disk gone")
+
+    w = AsyncCheckpointWriter(bad, alert=lambda m: None)
+    w.request()
+    with pytest.raises(OSError, match="disk gone"):
+        w.drain()
+    w.drain()  # error surfaced once; the writer stays usable
+    w.close()
+
+
+def test_writer_close_finishes_outstanding_work():
+    calls = []
+    w = AsyncCheckpointWriter(lambda: calls.append(1) or "p")
+    w.request()
+    w.close()
+    assert calls == [1]
+    with pytest.raises(RuntimeError, match="closed"):
+        w.request()
+
+
+def test_superseded_inflight_releases_state_batch_commit_exact(tiny_cv):
+    """The HBM contract of the async pipeline: once a newer dispatch
+    supersedes an in-flight round, its server-state tree is released (only
+    the newest is ever published at a batch commit) — and the batch commit
+    still produces the exact per-round metrics and final params of the
+    synchronous loop."""
+    s, _ = cv_train.build(_args())
+    i1 = s.dispatch_round(s.prepare_round(0), LR)
+    i2 = s.dispatch_round(s.prepare_round(1), LR)
+    i1.release_state()
+    assert i1.new_state is None  # nothing pins the intermediate tree
+    out = s.commit_rounds([i1, i2], jax.device_get([i1.metrics, i2.metrics]))
+    assert len(out) == 2 and s.round == 2
+
+    b, _ = cv_train.build(_args())
+    mb = [b.run_round(LR) for _ in range(2)]
+    assert [m["loss_sum"] for m in out] == [m["loss_sum"] for m in mb]
+    _assert_params_equal(s, b)
+    # releasing the NEWEST entry is a contract violation, loudly
+    i3 = s.dispatch_round(s.prepare_round(2), LR)
+    i3.release_state()
+    with pytest.raises(RuntimeError, match="release_state"):
+        s.commit_rounds([i3], [jax.device_get(i3.metrics)])
+
+
+def test_async_writer_failure_does_not_block_final_save(tiny_cv, tmp_path):
+    """A periodic save failing on the writer thread hours into a run must
+    not block the FINAL synchronous save at normal completion — that save
+    is the corrective action."""
+    from commefficient_tpu.federated.api import FedOptimizer
+    from commefficient_tpu.runner import RunnerConfig, run_loop
+
+    # checkpoint_dir arms emergency saves -> donation off -> writer eligible
+    s, _ = cv_train.build(_args(("--checkpoint_dir", str(tmp_path / "ck"))))
+    calls = []
+
+    def flaky_save():
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError("transient ENOSPC")
+        return "saved"
+
+    stats = run_loop(
+        s, FedOptimizer(lambda _: LR, 1),
+        RunnerConfig(total_rounds=4, eval_every=4, checkpoint_every=2,
+                     checkpoint_dir=str(tmp_path / "ck")),
+        save_ckpt=flaky_save,
+    )
+    assert s.round == 4
+    assert stats.async_checkpoints >= 1  # the periodic save rode the writer
+    assert len(calls) >= 2  # failed periodic + successful final
+
+
+def test_session_reusable_after_async_loop(tiny_cv):
+    """run_loop's exit path rewinds the live host RNG / device key to the
+    committed boundary (the prefetcher prepared — and drew RNG for — rounds
+    that were never dispatched), so continuing to drive the session stays on
+    the bit-identical sequence the sync loop would produce."""
+    from commefficient_tpu.federated.api import FedOptimizer
+    from commefficient_tpu.runner import RunnerConfig, run_loop
+
+    a, _ = cv_train.build(_args())
+    b, _ = cv_train.build(_args())
+    run_loop(a, FedOptimizer(lambda _: LR, 1),
+             RunnerConfig(total_rounds=3, eval_every=3))  # async
+    run_loop(b, FedOptimizer(lambda _: LR, 1),
+             RunnerConfig(total_rounds=3, eval_every=3, sync_loop=True))
+    _assert_params_equal(a, b)
+    ma, mb = a.run_round(LR), b.run_round(LR)  # continue past the loop
+    assert ma["loss_sum"] == mb["loss_sum"]
+    _assert_params_equal(a, b)
+
+
+# ------------------------------------------------------- session invariant
+
+
+def test_evaluate_refuses_inflight_pipeline(tiny_cv):
+    """Eval must only run at a drained boundary (the committed state is the
+    only consistent — and, under donation, the only live — view)."""
+    s, test_set = cv_train.build(_args())
+    prep = s.prepare_round(0)
+    infl = s.dispatch_round(prep, LR)
+    with pytest.raises(RuntimeError, match="in-flight"):
+        s.evaluate(test_set, 32)
+    s.commit_round(infl)
+    s.evaluate(test_set, 32)  # drained: fine

@@ -24,6 +24,42 @@ def test_fast_rounds_never_alert():
     assert alerts == [] and wd.stalls_detected == 0
 
 
+def test_unrecorded_segments_do_not_feed_the_median():
+    """The async runner's dispatch segments return in ~ms (no host sync);
+    record=False must guard them WITHOUT dragging the learned median to ~0
+    (which would collapse every threshold to the floor and false-fire the
+    ladder on healthy boundary drains)."""
+    wd = RoundWatchdog(min_history=2, floor_s=0.01)
+    for i in range(2):
+        with wd.round(i):
+            time.sleep(0.05)
+    before = wd.threshold_s()
+    for i in range(2, 12):
+        with wd.round(i, record=False):
+            pass  # ~0 s dispatch; must not enter _times
+    assert len(wd._times) == 2
+    assert wd.threshold_s() == before
+
+
+def test_multi_round_segment_scales_threshold_and_normalizes_median():
+    """A drain that waits out K queued rounds is not a stall: the stage-1
+    delay scales by K and the recorded time is per-round, so the median
+    stays a true round time."""
+    alerts = []
+    wd = RoundWatchdog(factor=3.0, min_history=2, floor_s=0.01,
+                       alert=alerts.append)
+    for i in range(2):
+        with wd.round(i):
+            time.sleep(0.03)
+    thr = wd.threshold_s()
+    # a 4-round drain taking ~4x a round: within 4*thr, no alert
+    with wd.round(2, rounds=4):
+        time.sleep(min(0.12, 4 * thr * 0.8))
+    assert alerts == [] and wd.stalls_detected == 0
+    # and the median absorbed ~a round time, not the whole drain
+    assert wd._times[-1] < 2 * wd._times[0] + 0.05
+
+
 def test_stalled_round_alerts_once_with_diagnosis():
     alerts = []
     wd = RoundWatchdog(factor=3.0, min_history=2, floor_s=0.05, alert=alerts.append)
