@@ -326,6 +326,17 @@ BASELINE_BASIS = os.environ.get("BENCH_BASELINE_BASIS", "1") == "1"
 # headline, not a claim. resnet9 only (the flagship the driver measures).
 RUN_LOOP = os.environ.get("BENCH_RUN_LOOP", "1") == "1"
 RUN_LOOP_ROUNDS = int(os.environ.get("BENCH_RUN_LOOP_ROUNDS", 30))
+# Mesh scaling section: time the SPMD sharded round (engine.
+# make_sharded_round_step — per-device partial sketch + one table merge)
+# at the same global cohort across 1, 2, 4, ... visible devices, and record
+# the comm-efficiency headline: sketch-table merge bytes vs the dense [d]
+# all-reduce a gradient-synchronous round would ship. Degrades to
+# {"skipped": ...} on a single device — the flagship single-chip headline
+# is unaffected. BENCH_MESH=0 disables; =1 also opts in when the Pallas
+# engine path is routed (a Mosaic-bearing shard_map module is an unproven
+# compile shape on the wedge-prone chip, same caveat as phase_timing).
+MESH_BENCH = os.environ.get("BENCH_MESH", "1") == "1"
+MESH_CHAINS = int(os.environ.get("BENCH_MESH_CHAINS", 2))
 # Optional fault plan injected into the run-loop section's session, making
 # chaos runs benchmarkable: the JSON's `resilience` block then carries the
 # nonfinite_rounds and per-site retry counts the plan provoked. preempt
@@ -1009,6 +1020,115 @@ def _run_loop_bench(round_ms: float) -> dict:
     return out
 
 
+def _mesh_bench(rt_ms: float) -> dict:
+    """Strong-scaling curve of the SPMD sharded round: the SAME global
+    cohort (NUM_WORKERS clients) on 1, 2, 4, ... devices, per-device and
+    aggregate updates/s per count, plus the analytic per-round cross-device
+    traffic (sketch-table merge vs dense all-reduce — the reason the round
+    scales: the merge ships r*c floats, not d). Uses the flagship workload
+    dims; never raises."""
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    n = jax.device_count()
+    if n < 2:
+        return {"skipped": f"{n} device visible; the mesh section needs >= 2 "
+                           "(run under a multi-chip mesh or "
+                           "XLA_FLAGS=--xla_force_host_platform_device_count=8)"}
+    out: dict = {"n_devices": n}
+    try:
+        from commefficient_tpu.federated import engine
+        from commefficient_tpu.modes.config import ModeConfig
+        from commefficient_tpu.parallel import mesh as meshlib
+        from commefficient_tpu.sketch import csvec
+
+        workload = _gpt2_workload if BENCH_MODEL == "gpt2" else _resnet9_workload
+        params, net_state, batch, loss_fn, name, sketch_kw, workers = workload()
+        d = ravel_pytree(params)[0].size
+        mode_cfg = ModeConfig(
+            mode="sketch", d=d, momentum_type="virtual", error_type="virtual",
+            topk_impl=os.environ.get("BENCH_TOPK_IMPL", "approx"),
+            topk_recall=float(os.environ.get("BENCH_TOPK_RECALL", 0.99)),
+            **sketch_kw,
+        )
+        if (csvec._use_pallas(mode_cfg.sketch_spec)
+                and os.environ.get("BENCH_MESH") != "1"):
+            return {"skipped": "pallas engine routed; set BENCH_MESH=1 to "
+                               "compile the Mosaic-bearing shard_map round"}
+        counts = [c for c in (1, 2, 4, 8, 16, 32, 64, 128)
+                  if c <= n and workers % c == 0]
+        if len(counts) < 2:
+            # no multi-device count divides the cohort: a "scaling" section
+            # that measured no mesh must say so, not quietly bench 1 device
+            return {"skipped": f"no device count in 2..{n} divides the "
+                               f"cohort (BENCH_WORKERS={workers})"}
+        out["workers"] = workers
+        out["device_counts"] = counts
+        scaling: dict = {}
+        for c in counts:
+            # same HBM bound as _make_step: gpt2 caps concurrent [d] grads
+            # per shard (the chunk must divide the PER-SHARD cohort)
+            if BENCH_MODEL == "gpt2":
+                import math
+                chunk = math.gcd(
+                    int(os.environ.get("BENCH_CLIENT_CHUNK", 8)) or 8,
+                    workers // c)
+            else:
+                chunk = 0
+            cfg = engine.EngineConfig(
+                mode=mode_cfg, weight_decay=5e-4, client_shards=c,
+                client_chunk=chunk,
+                on_nonfinite=os.environ.get("BENCH_ON_NONFINITE", "skip"),
+            )
+            if c == 1:
+                step = jax.jit(engine.make_round_step(loss_fn, cfg),
+                               donate_argnums=(0,))
+                batch_c = batch
+            else:
+                mesh = meshlib.make_mesh(c)
+                step = jax.jit(
+                    engine.make_sharded_round_step(loss_fn, cfg, mesh),
+                    donate_argnums=(0,))
+                batch_c = meshlib.shard_client_batch(mesh, batch)
+            state = engine.init_server_state(
+                cfg, jax.tree.map(jnp.copy, params),
+                jax.tree.map(jnp.copy, net_state))
+            state, _, _ = step(state, batch_c, {}, jnp.float32(0.01),
+                               jax.random.PRNGKey(0))
+            _ = jax.device_get(state["round"] + jnp.int32(0))
+            ms, state = _timed_chains(
+                step, state, batch_c, MESH_CHAINS, CHAIN_LEN, rt_ms)
+            round_ms = sorted(ms)[len(ms) // 2]
+            scaling[str(c)] = {
+                "round_ms": round(round_ms, 2),
+                "updates_per_sec_aggregate": round(
+                    workers / max(round_ms / 1e3, 1e-9), 2),
+                "updates_per_sec_per_device": round(
+                    workers / max(round_ms / 1e3, 1e-9) / c, 2),
+            }
+        out["scaling"] = scaling
+        if "1" in scaling:
+            base = scaling["1"]["round_ms"]
+            out["speedup_vs_1_device"] = {
+                c: round(base / max(s["round_ms"], 1e-9), 2)
+                for c, s in scaling.items()
+            }
+        out["comm_per_round"] = meshlib.merge_comm_bytes(
+            counts[-1], mode_cfg.num_rows, mode_cfg.num_cols, d)
+        out["note"] = (
+            "strong scaling at the fixed flagship cohort: each device "
+            "reduces+sketches its client shard locally and the cross-device "
+            "merge ships one r x c table (comm_per_round vs the dense [d] "
+            "all-reduce a gradient-synchronous round would pay); "
+            "updates_per_sec_per_device falling while aggregate rises means "
+            "the fixed sketch-server step is amortizing, not the clients"
+        )
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def run_bench(platform: str) -> dict:
     import jax
     import jax.numpy as jnp
@@ -1179,6 +1299,11 @@ def run_bench(platform: str) -> dict:
                 "server-dominated round (the sketch server step's cost is "
                 "independent of W); phase_timing's client_ms vs server_ms "
                 "distinguishes the two")
+
+    if MESH_BENCH:
+        _stage("mesh scaling (sharded round across devices) ...")
+        result["mesh"] = _mesh_bench(rt_ms)
+        _stage(f"mesh: {result['mesh']}")
 
     rl_nonfinite = 0
     if RUN_LOOP:

@@ -99,7 +99,14 @@ class FederatedSession:
         fault_plan=None,
         retry_policy: rtry.RetryPolicy | None = None,
         donate_state: bool = True,
+        client_shards: int = 0,
     ):
+        # client_shards: 0 = derive from the mesh (the default — on a >1-
+        # device mesh with a mode in engine.supports_sharded_round's scope
+        # the session compiles the SPMD sharded round, the sharded path
+        # being the default whenever more than one device is visible);
+        # > 1 without a mesh runs the SAME shard-structured program on one
+        # device (the bit-parity reference the CPU-mesh tests pin against).
         if on_nonfinite not in ("off", "skip", "halt"):
             raise ValueError(
                 f"on_nonfinite must be 'off', 'skip', or 'halt', got "
@@ -129,14 +136,25 @@ class FederatedSession:
         self.train_set = train_set
         self.num_workers = min(num_workers, train_set.num_clients)
         self.local_batch_size = local_batch_size
-        if mesh is not None and self.num_workers % meshlib.client_shards(mesh) != 0:
-            # The sampled-client axis must split evenly over the mesh. The old
-            # behavior (silently dropping to a single device) is a silent
+        if (client_shards >= 1 and mesh is not None
+                and client_shards != meshlib.client_shards(mesh)):
+            # any EXPLICIT shard count that disagrees with the mesh raises —
+            # including client_shards=1 ("force unsharded"), which silently
+            # compiling the mesh's S-way program would drop without notice
+            raise ValueError(
+                f"client_shards={client_shards} disagrees with the "
+                f"{meshlib.client_shards(mesh)}-way client mesh; pass one or "
+                "the other"
+            )
+        shards = (meshlib.client_shards(mesh) if mesh is not None
+                  else max(client_shards, 1))
+        if shards > 1 and self.num_workers % shards != 0:
+            # The sampled-client axis must split evenly over the shards. The
+            # old behavior (silently dropping to a single device) is a silent
             # n_devices-x slowdown on a pod — the exact failure class the
             # watchdog exists to catch. Instead, round the cohort to the
             # nearest viable multiple (documented, loud), and raise when no
             # multiple exists at all.
-            shards = meshlib.client_shards(mesh)
             up = -(-self.num_workers // shards) * shards
             adjusted = up if up <= train_set.num_clients else (
                 train_set.num_clients // shards) * shards
@@ -156,18 +174,45 @@ class FederatedSession:
             )
             self.num_workers = adjusted
         self.mesh = mesh
-        if client_chunk and self.num_workers % client_chunk:
-            # the cohort may have been clamped to num_clients or rounded for
-            # the mesh above — a chunk that divided the REQUESTED cohort may
-            # no longer divide; failing at the first jit trace would be a
-            # far worse place to find out. Use the largest viable chunk.
+        # The SPMD sharded round (the default whenever the mesh splits the
+        # client axis more than one way and the mode is in scope): each
+        # device reduces + compresses its cohort shard locally and the
+        # cross-device merge ships the compressed wire (the r x c sketch
+        # table), never the dense [d] gradient. Out-of-scope modes keep the
+        # GSPMD-annotation path unchanged.
+        self._spmd = shards > 1 and engine.supports_sharded_round(mode_cfg)
+        if client_shards > 1 and not self._spmd:
+            # an EXPLICIT shard request for an out-of-scope mode must fail
+            # loudly (the engine's _sharded_scope_check does): silently
+            # running the plain round would hand a parity test a different
+            # program. A mesh with an out-of-scope mode is fine — that's
+            # the documented GSPMD fallback.
+            raise ValueError(
+                f"client_shards={client_shards} requires a mode in the "
+                f"sharded-round scope (linear grad modes without client-"
+                f"local state); mode={mode_cfg.mode!r} error_type="
+                f"{mode_cfg.error_type!r} runs the GSPMD path — pass a mesh "
+                "instead of client_shards"
+            )
+        if self._spmd:
+            self.cfg = dataclasses.replace(self.cfg, client_shards=shards)
+        # On the SPMD path client_chunk scans WITHIN each shard, so it must
+        # divide the per-shard cohort, not the global one.
+        chunk_cohort = (self.num_workers // shards if self._spmd
+                        else self.num_workers)
+        if client_chunk and chunk_cohort % client_chunk:
+            # the cohort may have been clamped to num_clients or rounded/
+            # sharded for the mesh above — a chunk that divided the REQUESTED
+            # cohort may no longer divide; failing at the first jit trace
+            # would be a far worse place to find out. Largest viable chunk.
             viable = next(
-                c for c in range(min(client_chunk, self.num_workers), 0, -1)
-                if self.num_workers % c == 0
+                c for c in range(min(client_chunk, chunk_cohort), 0, -1)
+                if chunk_cohort % c == 0
             )
             print(
                 f"note: client_chunk={client_chunk} does not divide the "
-                f"cohort ({self.num_workers}); using client_chunk={viable}",
+                f"{'per-shard ' if self._spmd else ''}cohort ({chunk_cohort})"
+                f"; using client_chunk={viable}",
                 flush=True,
             )
             self.cfg = dataclasses.replace(self.cfg, client_chunk=viable)
@@ -205,12 +250,29 @@ class FederatedSession:
         if split_compile:
             # two XLA programs per round: the Pallas/Mosaic sketch server step
             # compiles separately from the big vmapped grad module (see
-            # engine.make_split_round_step for why)
-            client_p, server_p = engine.make_split_round_step(train_loss_fn, self.cfg)
+            # engine.make_split_round_step for why). On the SPMD path the
+            # program boundary carries per-device-resident partials instead
+            # of one dense [d] update (engine.make_sharded_split_round_step).
+            if self._spmd:
+                if mesh is None:
+                    raise ValueError(
+                        "split_compile with client_shards > 1 needs a mesh; "
+                        "the single-device sharded reference is fused-only"
+                    )
+                client_p, server_p = engine.make_sharded_split_round_step(
+                    train_loss_fn, self.cfg, mesh)
+            else:
+                client_p, server_p = engine.make_split_round_step(
+                    train_loss_fn, self.cfg)
             self._step = engine.compose_split(
                 jax.jit(client_p),
                 jax.jit(server_p, donate_argnums=self._state_donation()),
             )
+        elif self._spmd:
+            self._step = jax.jit(
+                engine.make_sharded_round_step(train_loss_fn, self.cfg,
+                                               self.mesh),
+                donate_argnums=self._state_donation())
         else:
             self._step = jax.jit(engine.make_round_step(train_loss_fn, self.cfg),
                                  donate_argnums=self._state_donation())
@@ -373,8 +435,11 @@ class FederatedSession:
         supports_block_dispatch."""
         lrs = list(lrs)
         if self._multi is None:
+            # make_multi_round_step routes to the SPMD sharded body itself
+            # when the cfg/mesh say so — blocks stay data-parallel
             self._multi = jax.jit(
-                engine.make_multi_round_step(self._train_loss_fn, self.cfg),
+                engine.make_multi_round_step(self._train_loss_fn, self.cfg,
+                                             self.mesh),
                 donate_argnums=self._state_donation(),
             )
         # stack on the HOST: jnp.stack would commit the full [K, W, ...]

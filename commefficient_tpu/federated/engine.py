@@ -74,8 +74,23 @@ class EngineConfig:
     # program. When every update is finite, "skip" is bit-identical to "off"
     # (jnp.where with a true predicate), so enabling it costs nothing.
     on_nonfinite: str = "off"
+    # Data-parallel shard count of the sampled cohort (the device-mesh round,
+    # make_sharded_round_step): > 1 splits the W clients into this many
+    # equal shards, each shard's clients reduce locally and COMPRESS locally
+    # (the partial Count Sketch), and the partial wires merge with one
+    # ordered cross-shard sum — so on a mesh the cross-device traffic is the
+    # r x c table, never the dense [d] gradient. Like client_chunk, the
+    # shard count is part of the round's numerical contract (it fixes the fp
+    # summation order): a given client_shards produces identical bits on one
+    # device and on a client_shards-way mesh (pinned by the CPU-mesh parity
+    # tests), while different shard counts differ at fp-reassociation level.
+    client_shards: int = 1
 
     def __post_init__(self):
+        if self.client_shards < 1:
+            raise ValueError(
+                f"client_shards must be >= 1, got {self.client_shards}"
+            )
         if not 0.0 <= self.client_dropout < 1.0:
             raise ValueError(
                 f"client_dropout must be in [0, 1), got {self.client_dropout}"
@@ -486,6 +501,410 @@ def make_round_step(
     return step
 
 
+def supports_sharded_round(mcfg: ModeConfig) -> bool:
+    """Scope of the SPMD data-parallel round (make_sharded_round_step):
+    linear grad modes without client-local state and without the local-SGD
+    weight-delta loop — compression must commute with the cross-shard sum,
+    which is exactly FetchSGD's sketch linearity (and trivially holds for
+    dense wires). Same scope as the split step: the flagship configuration.
+    Everything else keeps the GSPMD-annotation path (XLA partitions the
+    unchanged round program; cross-device reduction is the dense wire)."""
+    return (modes.is_linear(mcfg) and not mcfg.needs_local_state
+            and not mcfg.uses_weight_delta)
+
+
+def _sharded_scope_check(mcfg: ModeConfig):
+    if not supports_sharded_round(mcfg):
+        raise ValueError(
+            "sharded round supports linear grad modes without client-local "
+            f"state (the flagship sketch config); mode={mcfg.mode!r} "
+            f"error_type={mcfg.error_type!r} momentum_type="
+            f"{mcfg.momentum_type!r} needs the GSPMD path (make_round_step "
+            "over a sharded batch)"
+        )
+
+
+def _cohort_streams(cfg: EngineConfig, rng, num_sampled: int):
+    """The full cohort's device-side streams, derived EXACTLY as the fused
+    step derives them (split-first; see make_round_step's collision comment):
+    per-client rng rows, participation mask, DP noise key. The sharded round
+    computes these replicated and hands each shard its contiguous row slice,
+    so client i sees the same rng stream at every shard count and on every
+    mesh shape — the cohort-to-device assignment preserves per-client RNG
+    streams."""
+    crng, noise_rng, drop_rng = jax.random.split(rng, 3)
+    client_rngs = jax.random.split(crng, num_sampled)
+    part = participation_mask(drop_rng, num_sampled, cfg.client_dropout)
+    return client_rngs, part, noise_rng
+
+
+def _merged_survivor_finalize(ns_sum, m_sum, part, net_state):
+    """Survivor-mean mutable collections + metrics/participants from MERGED
+    cross-shard sums — the sharded round's counterpart of
+    _finalize_client_reduce, the ONE place for these semantics so the fused
+    tail and the split client program cannot drift apart."""
+    n_live = jnp.maximum(part.sum(), 1.0)
+    new_net_state = jax.tree.map(
+        lambda s, prev: jnp.where(part.sum() > 0, s / n_live, prev),
+        ns_sum, net_state,
+    )
+    out_metrics = dict(m_sum)
+    out_metrics["participants"] = part.sum()
+    return new_net_state, out_metrics
+
+
+def _normalize_merged_wire(mcfg: ModeConfig, wire_sum: dict, n_live) -> dict:
+    """Survivor normalization IN WIRE SPACE (compression is homogeneous only
+    up to fp order, so every sharded path normalizes after the merge — one
+    place, shared by the fused tail and the split server program)."""
+    if mcfg.agg_op == "sum":
+        return dict(wire_sum)
+    return {k: v / n_live for k, v in wire_sum.items()}
+
+
+def _merged_sharded_tail(
+    cfg: EngineConfig, state, stacked_wire, stacked_ns, stacked_m, part,
+    lr, noise_rng,
+):
+    """Everything after the per-shard client phase, shared verbatim by the
+    mesh execution and the single-device reference so they cannot drift:
+    ordered merge of the stacked [S, ...] partials (modes.merge_partial_wires
+    — an ordered sum, NOT a psum, which is what makes mesh == single-device
+    bit-identical), survivor normalization, non-finite guard, DP noise, and
+    the replicated server step."""
+    mcfg = cfg.mode
+    wire_sum = modes.merge_partial_wires(mcfg, stacked_wire)
+    ns_sum = jax.tree.map(lambda x: x.sum(axis=0), stacked_ns)
+    m_sum = jax.tree.map(lambda x: x.sum(axis=0), stacked_m)
+    pflat, unravel = ravel_pytree(state["params"])
+    agg = _normalize_merged_wire(mcfg, wire_sum, jnp.maximum(part.sum(), 1.0))
+    new_net_state, out_metrics = _merged_survivor_finalize(
+        ns_sum, m_sum, part, state["net_state"])
+    agg, new_net_state, _, out_metrics, fin_ok = _guard_nonfinite(
+        cfg, agg, new_net_state, state["net_state"], {}, {}, out_metrics,
+    )
+    if cfg.dp_noise > 0:
+        agg = _dp_noise_agg(cfg, agg, part.sum() * fin_ok, noise_rng)
+    delta, mode_state = modes.server_step_sparse(
+        mcfg, agg, state["mode_state"], lr)
+    new_state = {
+        "params": unravel(modes.apply_delta(pflat, delta)),
+        "net_state": new_net_state,
+        "mode_state": mode_state,
+        "round": state["round"] + 1,
+    }
+    return new_state, out_metrics
+
+
+def _mesh_shard_info(mesh):
+    from ..parallel import mesh as meshlib
+
+    return meshlib.client_shards(mesh), meshlib.client_axis_names(mesh)
+
+
+def _shard_index(mesh, axis_names) -> jnp.ndarray:
+    """This device's shard position along the (possibly hybrid) client axes,
+    row-major over (slices, clients) — the same order shard_client_batch
+    lays the cohort out in and all_gather stacks partials in, so slice i of
+    the replicated per-client streams is exactly shard i's cohort."""
+    idx = jnp.int32(0)
+    for name in axis_names:
+        idx = idx * mesh.shape[name] + jax.lax.axis_index(name)
+    return idx
+
+
+def make_sharded_round_step(
+    loss_fn: Callable, cfg: EngineConfig, mesh=None
+) -> Callable[[dict, Any, dict, jnp.ndarray, jnp.ndarray], tuple[dict, dict, dict]]:
+    """The data-parallel round as an explicit SPMD program — the device mesh
+    realized in the ENGINE rather than left to GSPMD's partitioner.
+
+    Per shard (= per device on a mesh): the shard's W/S clients run the
+    vmapped (or client_chunk-scanned) fwd/bwd, reduce to ONE local weighted
+    update, and compress it locally — for mode=sketch that is the shard's
+    partial Count Sketch, via the same csvec path (Pallas when routed) the
+    single-device round uses. The cross-device merge is then a single
+    ordered sum of the r x c partial tables (modes.merge_partial_wires /
+    csvec.merge_tables): FetchSGD's linearity means sketches of partial
+    client sums ADD to the sketch of the cohort sum, so per-device uplink
+    stays the paper's sketch size while client compute scales linearly with
+    devices — a dense [d] gradient never crosses the mesh. The merge is
+    implemented as all_gather + ordered sum rather than a psum: measured on
+    an 8-way CPU mesh, a ring psum reassociates the reduce and breaks the
+    bit-parity this program pins (at table scale the extra gather bytes are
+    noise next to the d/(r*c) savings vs a dense all-reduce). Overlap with
+    compute comes from the runner's in-flight chain: round N+1's dispatch
+    queues behind round N's collectives, so XLA's scheduler hides the
+    (ICI/DCN) merge behind the next round's client phase.
+
+    mesh=None runs the SAME shard-structured program on one device (a
+    lax.map over the cfg.client_shards shards, merged by the same ordered
+    sum) — the bit-parity reference the CPU-mesh tests compare against, and
+    the numerical contract: client_shards=S produces identical bits on one
+    device and on an S-way mesh. Signature matches make_round_step
+    (client_rows pass through untouched — the scope has no local state)."""
+    mcfg = cfg.mode
+    _sharded_scope_check(mcfg)
+    if mesh is not None:
+        S, axis_names = _mesh_shard_info(mesh)
+        if cfg.client_shards > 1 and cfg.client_shards != S:
+            raise ValueError(
+                f"cfg.client_shards={cfg.client_shards} disagrees with the "
+                f"{S}-way client mesh"
+            )
+    else:
+        S = cfg.client_shards
+    if S <= 1:
+        raise ValueError(
+            "sharded round needs client_shards > 1 (or a mesh with > 1 "
+            "client shard); use make_round_step for the unsharded round"
+        )
+    grad_client = _make_grad_client(loss_fn, cfg)
+
+    def local_phase(params, pflat, net_state, batch_l, rngs_l, part_l):
+        wsum, ns_sum, m_sum = _weighted_client_reduce(
+            cfg, grad_client, params, pflat, net_state, batch_l, rngs_l,
+            part_l,
+        )
+        wire, _ = modes.client_compress(mcfg, wsum, {})
+        return wire, ns_sum, m_sum
+
+    if mesh is None:
+        def step(state, batch, client_rows, lr, rng):
+            params, net_state = state["params"], state["net_state"]
+            pflat, _ = ravel_pytree(params)
+            W = jax.tree.leaves(batch)[0].shape[0]
+            if W % S:
+                raise ValueError(
+                    f"sampled cohort ({W}) not divisible by "
+                    f"client_shards={S}"
+                )
+            wl = W // S
+            all_rngs, part, noise_rng = _cohort_streams(cfg, rng, W)
+            shards = (
+                jax.tree.map(
+                    lambda a: a.reshape((S, wl) + a.shape[1:]), batch),
+                all_rngs.reshape((S, wl) + all_rngs.shape[1:]),
+                part.reshape(S, wl),
+            )
+            # lax.map (sequential scan) over shards: the body executes the
+            # per-shard phase exactly as each mesh device executes it, and
+            # the stacked result feeds the same merged tail. Parity with
+            # the shard_map program is bit-exact for params and every
+            # metric (pinned in tests/test_sharded_round.py); the sketch
+            # server-state tables can carry last-bit (~1e-9) differences
+            # because XLA:CPU's value-dependent vectorization of an
+            # identical subgraph differs between a while-loop body and the
+            # inlined shard_map body — no structuring of the reference
+            # (unrolled, length-1 map, top-level tail) removes it for
+            # every mode at once, it only moves which ops carry the ulp.
+            stacked = jax.lax.map(
+                lambda xs: local_phase(params, pflat, net_state, *xs), shards
+            )
+            new_state, out_metrics = _merged_sharded_tail(
+                cfg, state, *stacked, part, lr, noise_rng)
+            return new_state, client_rows, out_metrics
+
+        return step
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import mesh as meshlib
+
+    batch_spec = P(meshlib.client_axes(mesh))
+
+    # Only the CLIENT PHASE + gather runs inside shard_map; the merged tail
+    # (ordered reduce + server algebra) runs at jit top level on the
+    # replicated gathered stacks — the same compile context the reference's
+    # tail has after its lax.map. Running the tail inside the shard_map body
+    # instead compiles it in a per-shard module where XLA's value-dependent
+    # fusion (fma contraction) can differ from the reference's at the last
+    # bit (observed: ~6 table entries at 1e-9 after one momentum round),
+    # which would break the bit-identity pin on the server state.
+    def body(state, batch_l, lr, rng):
+        params, net_state = state["params"], state["net_state"]
+        pflat, _ = ravel_pytree(params)
+        wl = jax.tree.leaves(batch_l)[0].shape[0]
+        # replicated derivation of the FULL cohort's streams on every
+        # device, then this shard's contiguous slice — per-client rng
+        # streams are mesh-shape-invariant (see _cohort_streams)
+        all_rngs, part, noise_rng = _cohort_streams(cfg, rng, wl * S)
+        lo = _shard_index(mesh, axis_names) * wl
+        rngs_l = jax.lax.dynamic_slice_in_dim(all_rngs, lo, wl)
+        part_l = jax.lax.dynamic_slice_in_dim(part, lo, wl)
+        wire_l, ns_l, m_l = local_phase(
+            params, pflat, net_state, batch_l, rngs_l, part_l)
+        # THE cross-device move: gather the [S] partial wires in shard
+        # order; the ordered reduce happens outside, shared with the
+        # reference (merged tail)
+        stacked = jax.tree.map(
+            lambda x: jax.lax.all_gather(x, axis_names, axis=0),
+            (wire_l, ns_l, m_l),
+        )
+        return stacked + (part, noise_rng)
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), batch_spec, P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
+        # outputs ARE replicated (all_gather results and the replicated
+        # stream derivations are identical on every device); the static
+        # checker just can't see through all_gather
+        check_rep=False,
+    )
+
+    def step(state, batch, client_rows, lr, rng):
+        stacked_wire, stacked_ns, stacked_m, part, noise_rng = mapped(
+            state, batch, lr, rng)
+        new_state, out_metrics = _merged_sharded_tail(
+            cfg, state, stacked_wire, stacked_ns, stacked_m, part, lr,
+            noise_rng)
+        return new_state, client_rows, out_metrics
+
+    return step
+
+
+def make_sharded_split_round_step(
+    loss_fn: Callable, cfg: EngineConfig, mesh
+) -> tuple[Callable, Callable]:
+    """The sharded round split into the same TWO jittable programs as
+    make_split_round_step — and for the same reason (keep Mosaic out of the
+    big vmapped module; ROUND3_NOTES.md) — but with the program boundary
+    moved so the dense [d] update still never crosses the mesh:
+
+        client_step(state, batch, lr, rng) -> (wpart[S, d] SHARDED,
+                                               net_state', metrics, noise_rng)
+        server_step(state, wpart, net_state', participants, lr, noise_rng)
+            -> state'
+
+    The client program (Mosaic-free) reduces each shard to its local dense
+    partial and leaves it RESIDENT on its device ([S, d] sharded over the
+    client axes — no transfer). The server program (small, Mosaic-bearing)
+    sketches each partial where it lives, merges the r x c tables with the
+    ordered all_gather sum, and runs the FetchSGD algebra replicated. Same
+    signature arity as make_split_round_step, so compose_split and the
+    session's split wiring work unchanged. Bit-identical to
+    make_sharded_round_step on the same mesh (pinned in tests).
+    """
+    mcfg = cfg.mode
+    _sharded_scope_check(mcfg)
+    if mesh is None:
+        raise ValueError(
+            "sharded split round needs a mesh; the single-device reference "
+            "is the fused make_sharded_round_step(mesh=None)"
+        )
+    S, axis_names = _mesh_shard_info(mesh)
+    if S <= 1:
+        raise ValueError("sharded split round needs a mesh with > 1 client "
+                         "shard; use make_split_round_step")
+    if cfg.client_shards > 1 and cfg.client_shards != S:
+        raise ValueError(
+            f"cfg.client_shards={cfg.client_shards} disagrees with the "
+            f"{S}-way client mesh"
+        )
+    grad_client = _make_grad_client(loss_fn, cfg)
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import mesh as meshlib
+
+    axes = meshlib.client_axes(mesh)
+
+    # As in the fused sharded step, ONLY the per-shard work + gathers live
+    # inside shard_map; merges and the server algebra run at jit top level
+    # on the replicated stacks so both programs (and the single-device
+    # reference) share one compile context for the value-sensitive fp tail.
+    def client_body(state, batch_l, lr, rng):
+        params, net_state = state["params"], state["net_state"]
+        pflat, _ = ravel_pytree(params)
+        wl = jax.tree.leaves(batch_l)[0].shape[0]
+        all_rngs, part, noise_rng = _cohort_streams(cfg, rng, wl * S)
+        lo = _shard_index(mesh, axis_names) * wl
+        rngs_l = jax.lax.dynamic_slice_in_dim(all_rngs, lo, wl)
+        part_l = jax.lax.dynamic_slice_in_dim(part, lo, wl)
+        wsum_l, ns_l, m_l = _weighted_client_reduce(
+            cfg, grad_client, params, pflat, net_state, batch_l, rngs_l,
+            part_l,
+        )
+        stacked_ns, stacked_m = jax.tree.map(
+            lambda x: jax.lax.all_gather(x, axis_names, axis=0),
+            (ns_l, m_l),
+        )
+        # finiteness of the partials == finiteness of the merged wire
+        # (compression propagates every NaN/Inf — the same equivalence
+        # make_split_round_step already relies on); gathered here so both
+        # programs share the identical verdict
+        parts_ok = jax.lax.all_gather(
+            jnp.isfinite(wsum_l).all()[None], axis_names, axis=0).all()
+        return wsum_l[None], stacked_ns, stacked_m, part, noise_rng, parts_ok
+
+    client_mapped = shard_map(
+        client_body, mesh=mesh,
+        in_specs=(P(), P(axes), P(), P()),
+        out_specs=(P(axes), P(), P(), P(), P(), P()),
+        check_rep=False,
+    )
+
+    def client_step(state, batch, lr, rng):
+        wpart, stacked_ns, stacked_m, part, noise_rng, parts_ok = (
+            client_mapped(state, batch, lr, rng))
+        ns_sum = jax.tree.map(lambda x: x.sum(axis=0), stacked_ns)
+        m_sum = jax.tree.map(lambda x: x.sum(axis=0), stacked_m)
+        new_net_state, out_metrics = _merged_survivor_finalize(
+            ns_sum, m_sum, part, state["net_state"])
+        if cfg.on_nonfinite == "skip":
+            ok = parts_ok & _tree_finite(new_net_state)
+            out_metrics = _skip_metrics(ok, out_metrics)
+        return wpart, new_net_state, out_metrics, noise_rng
+
+    def server_body(wpart_l):
+        wire_l, _ = modes.client_compress(mcfg, wpart_l[0], {})
+        stacked_wire = jax.tree.map(
+            lambda x: jax.lax.all_gather(x, axis_names, axis=0), wire_l)
+        parts_ok = jax.lax.all_gather(
+            jnp.isfinite(wpart_l).all()[None], axis_names, axis=0).all()
+        return stacked_wire, parts_ok
+
+    server_mapped = shard_map(
+        server_body, mesh=mesh,
+        in_specs=P(axes),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )
+
+    def server_step(state, wpart, new_net_state, participants, lr, noise_rng):
+        stacked_wire, parts_ok = server_mapped(wpart)
+        pflat, unravel = ravel_pytree(state["params"])
+        wire_sum = modes.merge_partial_wires(mcfg, stacked_wire)
+        agg = _normalize_merged_wire(
+            mcfg, wire_sum, jnp.maximum(participants, 1.0))
+        if cfg.on_nonfinite == "skip":
+            # derived from the PARTIALS (available here), matching the
+            # client program's verdict exactly
+            ok = parts_ok & _tree_finite(new_net_state)
+            agg = jax.tree.map(
+                lambda a: jnp.where(ok, a, jnp.zeros_like(a)), agg)
+            new_net_state = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old),
+                new_net_state, state["net_state"],
+            )
+            participants = participants * ok
+        if cfg.dp_noise > 0:
+            agg = _dp_noise_agg(cfg, agg, participants, noise_rng)
+        delta, mode_state = modes.server_step_sparse(
+            mcfg, agg, state["mode_state"], lr)
+        return {
+            "params": unravel(modes.apply_delta(pflat, delta)),
+            "net_state": new_net_state,
+            "mode_state": mode_state,
+            "round": state["round"] + 1,
+        }
+
+    return client_step, server_step
+
+
 def make_split_round_step(
     loss_fn: Callable, cfg: EngineConfig
 ) -> tuple[Callable, Callable]:
@@ -570,7 +989,9 @@ def make_split_round_step(
     return client_step, server_step
 
 
-def make_multi_round_step(loss_fn: Callable, cfg: EngineConfig) -> Callable:
+def make_multi_round_step(
+    loss_fn: Callable, cfg: EngineConfig, mesh=None
+) -> Callable:
     """K federated rounds as ONE compiled program — a lax.scan over the
     single-round step:
 
@@ -584,7 +1005,13 @@ def make_multi_round_step(loss_fn: Callable, cfg: EngineConfig) -> Callable:
     sampling stays on the host: the caller pre-samples K cohorts and stacks
     their batches. Modes with per-client persistent state need the host
     gather/scatter between rounds and fall back to per-round dispatch
-    (FederatedSession.run_rounds does this automatically)."""
+    (FederatedSession.run_rounds does this automatically).
+
+    With a mesh (or cfg.client_shards > 1) and a mode in the sharded scope,
+    the scanned body is the SPMD sharded round — the K-round block stays
+    data-parallel, each round's cross-device merge is still one table
+    merge, and the queued rounds let the collectives overlap the next
+    round's client compute inside the block."""
     if cfg.mode.needs_local_state:
         raise ValueError(
             "multi-round dispatch requires a mode without per-client "
@@ -592,7 +1019,12 @@ def make_multi_round_step(loss_fn: Callable, cfg: EngineConfig) -> Callable:
             "rounds); use per-round run_round for "
             f"mode={cfg.mode.mode!r} error_type={cfg.mode.error_type!r}"
         )
-    step = make_round_step(loss_fn, cfg)
+    sharded = supports_sharded_round(cfg.mode) and (
+        cfg.client_shards > 1
+        or (mesh is not None and _mesh_shard_info(mesh)[0] > 1)
+    )
+    step = (make_sharded_round_step(loss_fn, cfg, mesh) if sharded
+            else make_round_step(loss_fn, cfg))
 
     def multi(state, batches, lrs, rngs):
         def body(st, xs):

@@ -181,6 +181,28 @@ def aggregate(cfg: ModeConfig, wires: dict, weights=None) -> dict:
     return {"dense": op(wires["dense"])}
 
 
+def merge_partial_wires(cfg: ModeConfig, stacked: dict) -> dict:
+    """Merge S per-shard partial wires (leaves stacked on a leading [S] axis,
+    in shard-index order) into one wire — the cross-device reduction of the
+    data-parallel round. Linear modes only: the partial wires are compressions
+    of PARTIAL client sums, and linearity is exactly what makes their ordered
+    sum equal the compression of the full sum.
+
+    Sketch tables route through `csvec.merge_tables` (the documented merge
+    entry point); dense wires are the same ordered sum. The ordered reduce —
+    not a psum — is what lets the mesh execution and the single-device
+    reference of the sharded round stay bit-identical (see merge_tables)."""
+    if not is_linear(cfg):
+        raise ValueError(
+            f"mode={cfg.mode!r} is nonlinear: partial per-shard wires cannot "
+            "be merged by addition (per-client top-k does not commute with "
+            "the cross-shard sum)"
+        )
+    if cfg.mode == "sketch":
+        return {"table": csvec.merge_tables(cfg.sketch_spec, stacked["table"])}
+    return {"dense": stacked["dense"].sum(axis=0)}
+
+
 # ------------------------------------------------------------- server side
 
 

@@ -130,6 +130,22 @@ def initialize_from_args(args, fault_plan=None, retry_policy=None) -> bool:
                       **cluster_kw)
 
 
+def mesh_info(mesh) -> dict:
+    """Mesh-level topology summary for startup logs: which axes the round
+    shards over, how many ways the client cohort splits (= the devices the
+    federated round scales across), and whether the once-per-round partial-
+    wire merge crosses DCN (multi-slice) or stays on ICI. The CLIs print
+    this next to the model line so a pod job that silently fell back to one
+    device is visible in the first screen of output."""
+    from . import mesh as meshlib
+
+    return {
+        "axes": dict(mesh.shape),
+        "client_shards": meshlib.client_shards(mesh),
+        "merge_crosses_dcn": meshlib.DCN_AXIS in mesh.axis_names,
+    }
+
+
 def process_info() -> dict:
     """Host-level topology summary for logs: which process this is, how many
     there are, and the local/global device split."""

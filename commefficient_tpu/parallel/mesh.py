@@ -104,6 +104,99 @@ def client_axes(mesh: Mesh) -> tuple[str, ...] | str:
     return CLIENT_AXIS
 
 
+def client_axis_names(mesh: Mesh) -> tuple[str, ...]:
+    """`client_axes` normalized to a tuple — the form collectives
+    (all_gather / axis_index) take inside the engine's sharded round."""
+    axes = client_axes(mesh)
+    return (axes,) if isinstance(axes, str) else tuple(axes)
+
+
+def parse_mesh_spec(spec: str) -> dict:
+    """Parse the CLI `--mesh clients=N[,slices=M]` spec into make_mesh-style
+    sizes. Returns {"clients": N, "slices": M} (slices defaults to 1).
+    Validation is loud: a typo'd axis silently training single-device is the
+    failure mode the flag exists to prevent."""
+    out = {"clients": 0, "slices": 1}
+    seen: set[str] = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad --mesh entry {part!r}: expected axis=size "
+                "(e.g. clients=8 or clients=4,slices=2)"
+            )
+        axis, _, size = part.partition("=")
+        axis = axis.strip()
+        if axis not in ("clients", "slices"):
+            raise ValueError(
+                f"unknown --mesh axis {axis!r}: the round shards over "
+                "'clients' (ICI) and 'slices' (DCN); model/seq parallelism "
+                "keep their dedicated flags"
+            )
+        if axis in seen:
+            # a duplicate is almost always a typo for the OTHER axis;
+            # last-one-wins would train a silently different topology
+            raise ValueError(f"--mesh sets axis {axis!r} twice: {spec!r}")
+        seen.add(axis)
+        try:
+            out[axis] = int(size)
+        except ValueError:
+            raise ValueError(f"bad --mesh size {size!r} for axis {axis!r}")
+        if out[axis] <= 0:
+            raise ValueError(f"--mesh {axis} must be positive, got {out[axis]}")
+    if out["clients"] <= 0:
+        raise ValueError("--mesh must set clients=N (e.g. clients=8)")
+    return out
+
+
+def make_mesh_from_spec(
+    spec: str, model_parallel: int = 1, seq_parallel: int = 1
+) -> Mesh:
+    """Build the mesh a `--mesh clients=N[,slices=M]` spec asks for, erroring
+    (not degrading) when the host doesn't expose enough devices — an operator
+    who typed a topology wants that topology or a loud failure."""
+    import jax
+
+    sizes = parse_mesh_spec(spec)
+    need = sizes["clients"] * sizes["slices"] * model_parallel * seq_parallel
+    have = len(jax.devices())
+    if need > have:
+        raise ValueError(
+            f"--mesh {spec!r} (x model_parallel={model_parallel} x "
+            f"seq_parallel={seq_parallel}) needs {need} devices; only {have} "
+            "visible"
+        )
+    return make_mesh(
+        need, model_parallel=model_parallel, num_slices=sizes["slices"],
+        seq_parallel=seq_parallel,
+    )
+
+
+def merge_comm_bytes(n_shards: int, r: int, c: int, d: int) -> dict:
+    """Analytic per-round cross-device traffic of the sharded round's merge,
+    per device: the sketch-table merge (what the engine ships) vs the dense
+    [d] all-reduce a gradient-synchronous data-parallel round would ship —
+    the comm-efficiency headline bench.py's mesh section records.
+
+    allgather = (S-1) tables received per device (the deterministic ordered
+    merge the engine uses); psum = 2(S-1)/S tables (the classic ring
+    all-reduce lower bound, for comparison); dense_allreduce = the same ring
+    bound on [d] floats."""
+    table = r * c * 4
+    dense = d * 4
+    s = max(n_shards, 1)
+    ring = 2 * (s - 1) / s
+    return {
+        "sketch_table_mb": table / 1e6,
+        "sketch_allgather_mb_per_device": (s - 1) * table / 1e6,
+        "sketch_psum_mb_per_device": ring * table / 1e6,
+        "dense_allreduce_mb_per_device": ring * dense / 1e6,
+        "dense_over_sketch_ratio": d / (r * c),
+    }
+
+
 def client_shards(mesh: Mesh) -> int:
     """Total ways the client batch axis splits (must divide num_workers)."""
     n = mesh.shape[CLIENT_AXIS]

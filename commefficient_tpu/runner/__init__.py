@@ -28,7 +28,13 @@ programs, same commit order — by tests/test_runner.py, including across a
 checkpoint resume.
 """
 
-from .loop import RunnerConfig, RunStats, run_loop
+from .loop import (
+    RunnerConfig,
+    RunStats,
+    auto_inflight,
+    measure_rtt_ms,
+    run_loop,
+)
 from .prefetch import PreparedSource, RoundPrefetcher
 from .writer import AsyncCheckpointWriter
 
@@ -38,5 +44,7 @@ __all__ = [
     "RoundPrefetcher",
     "RunStats",
     "RunnerConfig",
+    "auto_inflight",
+    "measure_rtt_ms",
     "run_loop",
 ]

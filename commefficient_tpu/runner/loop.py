@@ -58,6 +58,45 @@ from .prefetch import PreparedSource, RoundPrefetcher
 from .writer import AsyncCheckpointWriter
 
 
+DEFAULT_MAX_INFLIGHT = 4  # auto-tune's starting point until a round is timed
+AUTO_INFLIGHT_LO, AUTO_INFLIGHT_HI = 2, 16
+
+
+def measure_rtt_ms(samples: int = 5) -> float:
+    """Median host<->device round-trip of a trivial jitted op + device_get —
+    the per-drain sync cost the in-flight chain exists to amortize (tens of
+    ms on the tunnelled TPU, ~0.1 ms locally). Same discipline as bench.py's
+    tunnel measurement; cheap enough to run once at loop start."""
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0.0)
+    jax.device_get(f(x))  # compile + warm
+    ts = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        jax.device_get(f(x))
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return sorted(ts)[len(ts) // 2]
+
+
+def auto_inflight(rtt_ms: float, round_ms: float,
+                  target_overhead: float = 0.1) -> int:
+    """In-flight depth that keeps the per-drain host sync under
+    ~target_overhead of the work it amortizes: each drain costs one RTT (the
+    batched device_get), spread over the rounds committed in it, so depth
+    >= rtt / (target * round) bounds the sync tax at ~target. Clamped to
+    [2, 16]: 2 keeps dispatch/commit overlapped even on zero-RTT local
+    backends; 16 bounds how much work a preemption's grace window must wait
+    out (the same concern the fixed default had)."""
+    if round_ms <= 0:
+        return DEFAULT_MAX_INFLIGHT
+    import math
+
+    want = math.ceil(rtt_ms / (target_overhead * round_ms))
+    return max(AUTO_INFLIGHT_LO, min(AUTO_INFLIGHT_HI, want))
+
+
 @dataclasses.dataclass
 class RunnerConfig:
     """Loop shape + operational policy (mirrors the CLI flag surface; build
@@ -71,9 +110,16 @@ class RunnerConfig:
     sync_loop: bool = False
     # async only: drain when this many rounds are dispatched-uncommitted,
     # even between boundaries — bounds how much work a preemption's grace
-    # window has to wait out, and how stale the halt check can run
-    max_inflight: int = 4
-    prefetch_depth: int = 2  # 2 = double buffering
+    # window has to wait out, and how stale the halt check can run.
+    # 0 (default) = auto-tune: measure the host<->device RTT once at loop
+    # start, then re-derive the depth from the observed per-round time at
+    # every drain (auto_inflight) — a tunnelled TPU gets a deep chain, a
+    # local CPU stays shallow. > 0 is the manual override (--max_inflight).
+    max_inflight: int = 0
+    # round-prep lookahead; 0 = auto (double buffering, deepened to 4 when
+    # the measured RTT says the host link is slow enough that batch assembly
+    # may lag a drained burst of dispatches)
+    prefetch_depth: int = 0
     on_nonfinite: str = "skip"  # the CLI-level halt policy ("halt" stops)
     watchdog_abort: bool = False
     no_emergency_checkpoint: bool = False
@@ -87,6 +133,8 @@ class RunnerConfig:
             checkpoint_dir=args.checkpoint_dir,
             rounds_per_dispatch=args.rounds_per_dispatch,
             sync_loop=args.sync_loop,
+            max_inflight=getattr(args, "max_inflight", 0),
+            prefetch_depth=getattr(args, "prefetch_depth", 0),
             on_nonfinite=args.on_nonfinite,
             watchdog_abort=args.watchdog_abort,
             no_emergency_checkpoint=args.no_emergency_checkpoint,
@@ -104,6 +152,10 @@ class RunStats:
     evals: int = 0
     sync_checkpoints: int = 0
     async_checkpoints: int = 0
+    # async loop introspection: the measured host<->device RTT and the
+    # in-flight depth the loop ended on (auto-tuned unless --max_inflight)
+    rtt_ms: float = 0.0
+    max_inflight_used: int = 0
 
 
 def make_save_ckpt(session: FederatedSession, checkpoint_dir: str):
@@ -166,6 +218,24 @@ def run_loop(
     )
 
     async_mode = not cfg.sync_loop
+    # auto-tuned overlap depth (ROADMAP follow-up): measure the per-drain
+    # host sync cost once, then keep re-deriving the in-flight depth from
+    # the observed per-round time so the RTT tax stays ~10% of the round —
+    # a tunnelled TPU converges to a deep chain, a local CPU to a shallow
+    # one. --max_inflight / --prefetch_depth stay as manual overrides.
+    rtt_ms = (
+        measure_rtt_ms()
+        if async_mode and (cfg.max_inflight <= 0 or cfg.prefetch_depth <= 0)
+        else 0.0
+    )
+    eff_inflight = (cfg.max_inflight if cfg.max_inflight > 0
+                    else DEFAULT_MAX_INFLIGHT)
+    prefetch_depth = (
+        cfg.prefetch_depth if cfg.prefetch_depth > 0
+        else (4 if rtt_ms > 10.0 else 2)
+    )
+    ema_round_ms = 0.0
+    stats.rtt_ms = rtt_ms
     writer = None
     if async_mode and save_ckpt and cfg.checkpoint_every:
         if session._donate_state:
@@ -183,7 +253,7 @@ def run_loop(
         else:
             writer = AsyncCheckpointWriter(save_ckpt)
     src = (
-        RoundPrefetcher(session, start_round, depth=cfg.prefetch_depth)
+        RoundPrefetcher(session, start_round, depth=prefetch_depth)
         if async_mode else PreparedSource(session, start_round)
     )
 
@@ -194,12 +264,23 @@ def run_loop(
     nonfinite_total = 0
     timer = Timer()
 
+    last_drain_t = time.perf_counter()
+    first_drain = True
+
     def drain(watch: bool = True):
         """Commit every pending dispatch: ONE batched device_get for all
-        their metrics, then in-order publication + metric folding."""
+        their metrics, then in-order publication + metric folding. In auto
+        mode the wall time between drains (boundary work included — an
+        overestimate only ever tunes the depth DOWN toward the safe floor)
+        feeds the next in-flight depth; the FIRST interval is discarded —
+        it carries the round step's jit compile (tens of seconds on the
+        tunnelled target), which would seed the EMA ~1000x high and pin
+        the depth at the floor for many drains."""
         nonlocal pending_rounds, last_m, nonfinite_total
+        nonlocal eff_inflight, ema_round_ms, last_drain_t, first_drain
         if not pending:
             return
+        committed = pending_rounds
         first = session.round  # oldest uncommitted round index
         # the drain legitimately waits out every queued dispatch, so the
         # watchdog threshold scales by the round count and the recorded
@@ -216,6 +297,16 @@ def run_loop(
         pending.clear()
         pending_rounds = 0
         stats.drains += 1
+        now = time.perf_counter()
+        per_round = (now - last_drain_t) * 1e3 / max(committed, 1)
+        last_drain_t = now
+        if first_drain:
+            first_drain = False  # compile-tainted interval: discard
+        else:
+            ema_round_ms = (per_round if ema_round_ms <= 0
+                            else 0.5 * ema_round_ms + 0.5 * per_round)
+            if async_mode and cfg.max_inflight <= 0:
+                eff_inflight = auto_inflight(rtt_ms, ema_round_ms)
 
     def shutdown():
         """Exit-path teardown (preemption/halt): stop the prefetcher and
@@ -276,7 +367,7 @@ def run_loop(
                             # is short
                 if (pending_rounds
                         and (pre.triggered
-                             or pending_rounds >= cfg.max_inflight
+                             or pending_rounds >= eff_inflight
                              or rnd >= cfg.total_rounds
                              or rnd % eval_every == 0
                              or (cfg.checkpoint_every
@@ -340,5 +431,6 @@ def run_loop(
         stats.sync_checkpoints += 1
     stats.rounds = session.round - start_round
     stats.nonfinite_rounds = nonfinite_total
+    stats.max_inflight_used = eff_inflight if async_mode else 0
     stats.wall_s = time.perf_counter() - t0
     return stats

@@ -105,6 +105,27 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "--coordinator_address)")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--num_devices", type=int, default=0, help="0 = all visible")
+    p.add_argument("--mesh", default="",
+                   help="device mesh for the data-parallel federated round: "
+                        "clients=N[,slices=M]. The sampled cohort shards "
+                        "N-ways (xM across pod slices over DCN); each device "
+                        "accumulates its shard's partial Count Sketch and "
+                        "the cross-device merge ships one r x c table per "
+                        "round instead of the dense [d] gradient. Errors if "
+                        "the host exposes fewer devices than the spec needs. "
+                        "Unset = shard over all visible devices (the sharded "
+                        "round is the default whenever > 1 device is "
+                        "visible); combine with --model_parallel/"
+                        "--seq_parallel on the gpt2 CLI")
+    p.add_argument("--max_inflight", type=int, default=0,
+                   help="async loop: drain when this many rounds are "
+                        "dispatched-uncommitted. 0 = auto-tune from the "
+                        "measured host<->device round-trip so the per-drain "
+                        "sync stays ~10%% of the amortized work (tunnelled "
+                        "TPUs get a deep chain, local runs stay shallow)")
+    p.add_argument("--prefetch_depth", type=int, default=0,
+                   help="async round-preparation lookahead; 0 = auto "
+                        "(double buffering, deepened on high-RTT links)")
     # resilience (resilience/: fault injection + failure recovery)
     p.add_argument("--fault_plan", default="",
                    help="deterministic fault-injection plan: ';'-separated "

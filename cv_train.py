@@ -68,7 +68,16 @@ def build(args, fault_plan=None, retry_policy=None):
           f"mode={args.mode}", flush=True)
 
     mode_cfg = mode_config_from_args(args, d)
-    mesh = meshlib.make_mesh(args.num_devices or None) if jax.device_count() > 1 else None
+    if args.mesh:
+        mesh = meshlib.make_mesh_from_spec(args.mesh)
+    elif jax.device_count() > 1:
+        mesh = meshlib.make_mesh(args.num_devices or None)
+    else:
+        mesh = None
+    if mesh is not None:
+        from commefficient_tpu.parallel.distributed import mesh_info
+
+        print(f"mesh: {mesh_info(mesh)}", flush=True)
     session = FederatedSession(
         train_loss_fn=make_classification_loss(model, train=True),
         eval_loss_fn=make_classification_loss(model, train=False),

@@ -117,7 +117,15 @@ def build(args, fault_plan=None, retry_policy=None):
             "scaling behavior is not)"
         )
     mesh = None
-    if args.model_parallel > 1 or args.seq_parallel > 1:
+    if args.mesh:
+        mesh = meshlib.make_mesh_from_spec(
+            args.mesh,
+            model_parallel=args.model_parallel,
+            seq_parallel=args.seq_parallel,
+        )
+        if args.model_parallel > 1:
+            params = tp.shard_params(mesh, params)
+    elif args.model_parallel > 1 or args.seq_parallel > 1:
         mesh = meshlib.make_mesh(
             args.num_devices or None,
             model_parallel=args.model_parallel,
@@ -127,6 +135,10 @@ def build(args, fault_plan=None, retry_policy=None):
             params = tp.shard_params(mesh, params)
     elif jax.device_count() > 1:
         mesh = meshlib.make_mesh(args.num_devices or None)
+    if mesh is not None:
+        from commefficient_tpu.parallel.distributed import mesh_info
+
+        print(f"mesh: {mesh_info(mesh)}", flush=True)
 
     if args.mc_coef > 0:
         from commefficient_tpu.models.losses import make_lm_mc_loss

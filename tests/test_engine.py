@@ -579,7 +579,10 @@ def test_session_adjusts_client_chunk_to_cohort():
         mesh=meshlib.make_mesh(8),  # rounds cohort 12 -> 16
         client_chunk=6,             # divided 12; no longer divides 16
     )
-    assert s.num_workers == 16 and s.cfg.client_chunk == 4
+    # on the 8-way mesh the SPMD round scans chunks WITHIN each shard, so
+    # the chunk adjusts to the per-shard cohort (16/8 = 2), not the global 16
+    assert s.num_workers == 16 and s.cfg.client_shards == 8
+    assert s.cfg.client_chunk == 2
     m = s.run_round(0.1)  # and the round actually runs chunked
     assert np.isfinite(m["loss_sum"])
 
