@@ -953,14 +953,17 @@ def _run_loop_bench(round_ms: float) -> dict:
         )
         fault_plan = FaultPlan.parse(BENCH_FAULT_PLAN)
         if fault_plan is not None:
-            stripped = [s.kind for s in fault_plan.specs if s.kind == "preempt"]
+            stripped = [s.kind for s in fault_plan.specs
+                        if s.kind in ("preempt", "host_preempt")]
             if stripped:
                 fault_plan.specs = [
-                    s for s in fault_plan.specs if s.kind != "preempt"
+                    s for s in fault_plan.specs
+                    if s.kind not in ("preempt", "host_preempt")
                 ]
                 out["fault_plan_note"] = (
-                    "preempt specs stripped: a SIGTERM would exit the bench "
-                    "resumably instead of emitting its JSON line"
+                    "preempt/host_preempt specs stripped: a SIGTERM would "
+                    "exit the bench resumably instead of emitting its JSON "
+                    "line"
                 )
         mode_cfg = ModeConfig(
             mode="sketch", d=d, momentum_type="virtual", error_type="virtual",
@@ -982,6 +985,10 @@ def _run_loop_bench(round_ms: float) -> dict:
             split_compile=BENCH_ENGINE_COMPILE == "split",
             on_nonfinite=os.environ.get("BENCH_ON_NONFINITE", "skip"),
             fault_plan=fault_plan,
+            # BENCH_CLIENT_UPDATE_CLIP arms the sketch-space quarantine so
+            # client_poison chaos benchmarks show per-client rejection cost
+            client_update_clip=float(
+                os.environ.get("BENCH_CLIENT_UPDATE_CLIP", "0")),
         )
         opt = FedOptimizer(lambda _: 0.01, 1)
 
@@ -995,10 +1002,17 @@ def _run_loop_bench(round_ms: float) -> dict:
 
         arm(sync=True, rounds=min(2, RUN_LOOP_ROUNDS))  # compile + warm
         nonfinite = 0
+        cohort = {"clients_dropped": 0, "clients_quarantined": 0,
+                  "degraded_rounds": 0, "requeue_depth_max": 0}
         for label, sync in (("sync", True), ("async", False)):
             stats = arm(sync, RUN_LOOP_ROUNDS)
             wall_round_ms = stats.wall_s * 1e3 / max(stats.rounds, 1)
             nonfinite += stats.nonfinite_rounds
+            cohort["clients_dropped"] += stats.clients_dropped
+            cohort["clients_quarantined"] += stats.clients_quarantined
+            cohort["degraded_rounds"] += stats.degraded_rounds
+            cohort["requeue_depth_max"] = max(
+                cohort["requeue_depth_max"], stats.requeue_depth_max)
             out[label] = {
                 "wall_clock_updates_per_sec": round(
                     workers * stats.rounds / max(stats.wall_s, 1e-9), 2),
@@ -1007,6 +1021,10 @@ def _run_loop_bench(round_ms: float) -> dict:
                 "drains": stats.drains,
             }
         out["nonfinite_rounds"] = nonfinite
+        # degradation cost of a chaos run, in the open: how many clients the
+        # masking/quarantine machinery absorbed while the numbers above were
+        # produced (all zero without BENCH_FAULT_PLAN)
+        out["cohort"] = cohort
         out["async_speedup_vs_sync"] = round(
             out["sync"]["wall_round_ms"] / max(out["async"]["wall_round_ms"],
                                                1e-9), 3)
@@ -1329,10 +1347,17 @@ def run_bench(platform: str) -> dict:
     from commefficient_tpu.resilience import retry_counts
     from commefficient_tpu.utils import checkpoint as _ckpt
 
+    rl_cohort = (result.get("run_loop") or {}).get("cohort", {})
     result["resilience"] = {
         "nonfinite_rounds": rl_nonfinite,
         "retries": retry_counts(),
         "ckpt_save_verify_failures": _ckpt.save_verify_failures(),
+        # cohort-level degradation absorbed by the run-loop arms (masked
+        # clients, quarantined clients, degraded rounds, requeue depth)
+        "clients_dropped": rl_cohort.get("clients_dropped", 0),
+        "clients_quarantined": rl_cohort.get("clients_quarantined", 0),
+        "degraded_rounds": rl_cohort.get("degraded_rounds", 0),
+        "requeue_depth_max": rl_cohort.get("requeue_depth_max", 0),
         **({"fault_plan": BENCH_FAULT_PLAN} if BENCH_FAULT_PLAN else {}),
     }
     return result

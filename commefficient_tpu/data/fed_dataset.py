@@ -153,6 +153,20 @@ class FedDataset:
             return {"x": xs[:, 0], "y": ys[:, 0], "mask": mask[:, 0]}
         return {"x": xs, "y": ys, "mask": mask}
 
+    def empty_batch(self, num: int, batch_size: int, local_iters: int = 1) -> dict:
+        """Placeholder batch for a degraded (fully-masked) cohort whose data
+        failed to load after retries: the exact keys/shapes `client_batch`
+        returns — for this class AND every subclass that overrides the row
+        layout (FedTextDataset etc.), because it just assembles a real batch
+        from a PRIVATE fixed-seed rng (the session's sampling stream must
+        not advance). The content is never trained on: every row sits behind
+        a zero validity mask, which the engine's mask threading makes fully
+        inert (pinned by test_masked_client_garbage_is_inert)."""
+        return self.client_batch(
+            np.random.RandomState(0), np.zeros(num, dtype=np.int64),
+            batch_size, local_iters,
+        )
+
     def eval_batches(self, batch_size: int):
         """Fixed-shape eval iterator over the whole set (pads the tail)."""
         n = len(self.x)

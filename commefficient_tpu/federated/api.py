@@ -11,8 +11,10 @@ it — there are no workers to spawn, no shared memory to allocate.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
+import sys
 import threading
 from typing import Any, Callable
 
@@ -44,6 +46,15 @@ class PreparedRound:
     batch: dict
     sub: Any
     snapshot: tuple
+    # cohort degradation bookkeeping: how many clients this round's validity
+    # mask killed (failed loads / injected drops), and the re-queue state as
+    # of this prepare — (depth for metrics, full queue snapshot so commit
+    # can publish a checkpoint-consistent queue exactly like the RNG
+    # snapshot: prepared-but-uncommitted rounds may already have served or
+    # grown the LIVE queue)
+    masked: int = 0
+    requeue_depth: int = 0
+    requeue: tuple = ()
 
 
 @dataclasses.dataclass
@@ -60,6 +71,11 @@ class InFlightRound:
     lrs: list
     snapshot: tuple
     stacked: bool  # block dispatch: metrics leaves carry a leading [K] axis
+    # per-round host-side degradation counters (aligned with lrs) + the
+    # newest prep's re-queue snapshot, published at commit
+    masked: list = dataclasses.field(default_factory=list)
+    requeue_depths: list = dataclasses.field(default_factory=list)
+    requeue: tuple = ()
 
     @property
     def num_rounds(self) -> int:
@@ -100,6 +116,7 @@ class FederatedSession:
         retry_policy: rtry.RetryPolicy | None = None,
         donate_state: bool = True,
         client_shards: int = 0,
+        client_update_clip: float = 0.0,
     ):
         # client_shards: 0 = derive from the mesh (the default — on a >1-
         # device mesh with a mode in engine.supports_sharded_round's scope
@@ -116,10 +133,20 @@ class FederatedSession:
             mode=mode_cfg, weight_decay=weight_decay, dp_clip=dp_clip,
             dp_noise=dp_noise, client_dropout=client_dropout,
             client_chunk=client_chunk,
+            client_update_clip=client_update_clip,
             # CLI "halt" is a host-side policy on top of the compiled "skip"
             # guard (state stays clean either way; the CLI decides to stop)
             on_nonfinite="skip" if on_nonfinite == "halt" else on_nonfinite,
         )
+        # cohort-degradation re-queue: client ids whose batch load failed (or
+        # were fault-dropped) wait here and displace sampled ids in a later
+        # round's cohort, so a dropped client's data is delayed, not lost.
+        # `_requeue` is the LIVE queue (single producer: prepare_round);
+        # `_requeue_committed` is the round-boundary snapshot checkpoints
+        # write (same discipline as rng_snapshot — prefetch may have served
+        # the live queue for rounds that never commit).
+        self._requeue: collections.deque = collections.deque()
+        self._requeue_committed: tuple = ()
         # resilience hooks (resilience/): a seeded FaultPlan injects failures
         # at this session's named sites; the retry policy wraps data loading.
         # Both default to inert so existing callers see zero change.
@@ -345,14 +372,25 @@ class FederatedSession:
         cohort no deterministic run of this seed would produce."""
         self.rng_snapshot = (self.rng.get_state(), self._rng_key)
 
-    def _load_client_batch(self, ids, rnd: int | None = None) -> dict:
+    def _load_client_batch(self, ids, rnd: int | None = None):
         """Round-batch assembly behind the retry wrapper. The injection site
         fires BEFORE any host RNG is consumed, and a failed attempt restores
         the RNG snapshot, so a retried load replays the identical batch —
         recovery never perturbs the client sequence a resumed run must
         replay bit-for-bit. `rnd` is the GLOBAL round this batch feeds
         (defaults to the session counter; a prefetcher preparing ahead
-        passes the future index so scheduled faults land on their round)."""
+        passes the future index so scheduled faults land on their round).
+
+        Returns (batch, valid_or_None). A load that still fails after
+        --max_retries DEGRADES instead of aborting the run: the round runs
+        over an all-zero batch with every client's validity mask at 0 (the
+        engine's fully-dropped-cohort semantics — momentum decays, state
+        stays clean) and the cohort's ids are re-queued for a later round so
+        their data is delayed, not lost. Loud on stderr; counted per round
+        in metrics (clients_dropped). Note a degraded round consumes no
+        batch-assembly RNG (the failed attempts restored it), so a run that
+        hit a REAL exhausted flake no longer replays an uninterrupted run
+        bit-for-bit — injected faults within the retry budget still do."""
         if rnd is None:
             rnd = self.round
 
@@ -369,10 +407,27 @@ class FederatedSession:
                 self.rng.set_state(rng_state)
                 raise
 
-        return rtry.with_retries(
-            attempt, site="data_load", policy=self.retry_policy,
-            seed=rnd,
-        )
+        try:
+            return rtry.with_retries(
+                attempt, site="data_load", policy=self.retry_policy,
+                seed=rnd,
+            ), None
+        except Exception as e:  # noqa: BLE001 — degrade, don't abort
+            print(
+                f"ERROR: round {rnd} batch load failed after retries "
+                f"({type(e).__name__}: {e}); degrading to a fully-masked "
+                f"cohort and re-queuing its {len(ids)} client(s)",
+                file=sys.stderr, flush=True,
+            )
+            queued = set(self._requeue)
+            self._requeue.extend(
+                int(i) for i in ids if int(i) not in queued)
+            W = len(ids)
+            return (
+                self.train_set.empty_batch(
+                    W, self.local_batch_size, self.cfg.mode.num_local_iters),
+                np.zeros(W, np.float32),
+            )
 
     # -- prepare / dispatch / commit (the runner/ pipeline surface) ----------
     def prepare_round(self, rnd: int | None = None) -> PreparedRound:
@@ -387,16 +442,63 @@ class FederatedSession:
         if rnd is None:
             rnd = self.round + self._inflight_rounds
         ids = self.train_set.sample_clients(self.rng, self.num_workers)
-        batch = self._load_client_batch(ids, rnd)
+        if self._requeue:
+            # serve previously-dropped clients: substitute them into the
+            # sampled cohort. The substitution consumes NO host RNG, so the
+            # sampling stream is identical whether or not anything was
+            # queued — only the cohort's membership changes (by design:
+            # that IS the recovery).
+            ids = self._serve_requeue(ids)
+        batch, valid = self._load_client_batch(ids, rnd)
         if self.fault_plan is not None:
             # nonfinite burst rides the real gradient path (poison the
             # assembled batch); preempt stays a DISPATCH-time site so the
             # SIGTERM lands when the round runs, not when it is prefetched
             batch = self.fault_plan.poison(rnd, batch)
+            batch, valid, dropped = self.fault_plan.client_faults(
+                rnd, batch, valid, len(ids))
+            for p in dropped:
+                # check the LIVE queue per append: overlapping drop specs
+                # can report the same position twice, and a double-queued
+                # client would displace two sampled clients later
+                cid = int(ids[p])
+                if cid not in self._requeue:
+                    self._requeue.append(cid)
+        masked = int(len(ids) - valid.sum()) if valid is not None else 0
+        # the validity mask ALWAYS rides the batch (all-ones in the clean
+        # case) so the compiled program never changes shape when the first
+        # fault hits mid-run — a mid-run recompile on a TPU would stall the
+        # exact round that is already degraded
+        batch = dict(batch)
+        batch[engine.VALID_KEY] = (
+            valid if valid is not None
+            else np.ones(len(ids), np.float32))
         self._rng_key, sub = jax.random.split(self._rng_key)
         return PreparedRound(
-            rnd, ids, batch, sub, (self.rng.get_state(), self._rng_key)
+            rnd, ids, batch, sub, (self.rng.get_state(), self._rng_key),
+            masked=masked, requeue_depth=len(self._requeue),
+            requeue=tuple(self._requeue),
         )
+
+    def _serve_requeue(self, ids):
+        """Substitute queued (previously dropped) client ids into a freshly
+        sampled cohort, FIFO, skipping ids the sample already contains."""
+        ids = np.array(ids, copy=True)
+        in_cohort = {int(i) for i in ids}
+        slot, served = 0, []
+        while self._requeue and slot < len(ids):
+            cid = self._requeue.popleft()
+            if cid in in_cohort:
+                continue  # sampled naturally this round — already served
+            in_cohort.discard(int(ids[slot]))
+            ids[slot] = cid
+            in_cohort.add(cid)
+            served.append(cid)
+            slot += 1
+        if served:
+            print(f"requeue: serving previously-dropped client(s) {served} "
+                  f"({len(self._requeue)} still queued)", flush=True)
+        return ids
 
     def dispatch_round(self, prep: PreparedRound, lr: float) -> InFlightRound:
         """Enqueue one round on the device WITHOUT a host sync. Chains on the
@@ -427,7 +529,10 @@ class FederatedSession:
         self._inflight += 1
         self._inflight_rounds += 1
         return InFlightRound(new_state, new_cstate, metrics, [lr],
-                             prep.snapshot, stacked=False)
+                             prep.snapshot, stacked=False,
+                             masked=[prep.masked],
+                             requeue_depths=[prep.requeue_depth],
+                             requeue=prep.requeue)
 
     def dispatch_block(self, preps: list[PreparedRound], lrs) -> InFlightRound:
         """Enqueue a K-round fused block (ONE device dispatch, lax.scan over
@@ -462,7 +567,10 @@ class FederatedSession:
         self._inflight += 1
         self._inflight_rounds += len(lrs)
         return InFlightRound(new_state, None, ms, lrs,
-                             preps[-1].snapshot, stacked=True)
+                             preps[-1].snapshot, stacked=True,
+                             masked=[p.masked for p in preps],
+                             requeue_depths=[p.requeue_depth for p in preps],
+                             requeue=preps[-1].requeue)
 
     def commit_round(self, infl: InFlightRound, metrics_host=None) -> list[dict]:
         """Publish one dispatched round/block: sync its metrics (unless the
@@ -488,11 +596,16 @@ class FederatedSession:
             for infl, mh in zip(infls, metrics_hosts):
                 if infl.stacked:
                     out.extend(
-                        self._finalize_metrics({k: v[i] for k, v in mh.items()}, lr)
+                        self._finalize_metrics(
+                            {k: v[i] for k, v in mh.items()}, lr,
+                            masked=infl.masked[i],
+                            requeue_depth=infl.requeue_depths[i])
                         for i, lr in enumerate(infl.lrs)
                     )
                 else:
-                    out.append(self._finalize_metrics(mh, infl.lrs[0]))
+                    out.append(self._finalize_metrics(
+                        mh, infl.lrs[0], masked=infl.masked[0],
+                        requeue_depth=infl.requeue_depths[0]))
                 self._inflight -= 1
                 self._inflight_rounds -= infl.num_rounds
             last = infls[-1]
@@ -506,6 +619,7 @@ class FederatedSession:
             if last.new_client_state is not None:
                 self.client_state = last.new_client_state
             self.rng_snapshot = last.snapshot
+            self._requeue_committed = last.requeue
             if self._inflight == 0:
                 self._head_state = None
                 self._head_client_state = None
@@ -519,18 +633,28 @@ class FederatedSession:
         prep = self.prepare_round(self.round)
         return self.commit_round(self.dispatch_round(prep, lr))[0]
 
-    def _finalize_metrics(self, metrics_host: dict, lr: float) -> dict:
+    def _finalize_metrics(self, metrics_host: dict, lr: float,
+                          masked: int = 0, requeue_depth: int = 0) -> dict:
         """Host-side per-round bookkeeping shared by run_round/run_rounds:
         comm accounting (survivor-scaled uplink, measured local_topk
-        down-link), cumulative totals, and the round counter."""
+        down-link), cohort-degradation counters, cumulative totals, and the
+        round counter."""
         m = {k: float(v) for k, v in metrics_host.items()}
         m["lr"] = float(lr)
+        # cohort degradation visible per round: how many clients the
+        # validity mask killed, and how deep the re-queue of displaced
+        # clients ran at this round's preparation
+        m["clients_dropped"] = float(masked)
+        m["requeue_depth"] = float(requeue_depth)
         m.update(self.comm_per_round)
-        # dropped clients never transmit: charge uplink for survivors only
-        # (the static comm_per_round assumes all num_workers upload). The
-        # down-link broadcast still reaches the whole next cohort.
-        if self.cfg.client_dropout > 0 and "participants" in m:
-            m["comm_up_mb"] *= m["participants"] / self.num_workers
+        # dropped/masked clients never transmit: charge uplink for the
+        # clients that actually uploaded (the static comm_per_round assumes
+        # all num_workers do). Quarantined clients DID upload — the server
+        # rejected them after the fact — so they stay charged. The down-link
+        # broadcast still reaches the whole next cohort.
+        if (self.cfg.client_dropout > 0 or masked) and "participants" in m:
+            uploaded = m["participants"] + m.get("clients_quarantined", 0.0)
+            m["comm_up_mb"] *= uploaded / self.num_workers
             m["comm_total_mb"] = m["comm_up_mb"] + m["comm_down_mb"]
         if "down_support" in m:
             # local_topk: replace the static worst-case down-link estimate

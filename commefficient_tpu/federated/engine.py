@@ -85,6 +85,18 @@ class EngineConfig:
     # device and on a client_shards-way mesh (pinned by the CPU-mesh parity
     # tests), while different shard counts differ at fp-reassociation level.
     client_shards: int = 1
+    # Sketch-space quarantine (cohort-level fault tolerance): > 0 rejects any
+    # client whose update L2 norm exceeds this multiple of the RUNNING MEDIAN
+    # of live client norms (kept in server state, seeded by the first round's
+    # cohort median) — and always rejects non-finite updates. A quarantined
+    # client is zeroed out of the merge AND removed from the survivor
+    # renormalization, exactly like a dropped client, so one poisoned or
+    # adversarially large update costs one client, not the round (the
+    # on_nonfinite guard only has to catch what slips past). The norms come
+    # from the per-client (per-shard-partial on the mesh) updates BEFORE the
+    # DP clip — after the clip every norm is <= dp_clip and screening is
+    # vacuous. 0 = off: the compiled program is unchanged.
+    client_update_clip: float = 0.0
 
     def __post_init__(self):
         if self.client_shards < 1:
@@ -98,6 +110,11 @@ class EngineConfig:
         if self.client_chunk < 0:
             raise ValueError(
                 f"client_chunk must be >= 0, got {self.client_chunk}"
+            )
+        if self.client_update_clip < 0:
+            raise ValueError(
+                f"client_update_clip must be >= 0, got "
+                f"{self.client_update_clip}"
             )
         if self.on_nonfinite not in ("off", "skip"):
             raise ValueError(
@@ -134,12 +151,39 @@ def init_server_state(cfg: EngineConfig, params: Any, net_state: Any) -> dict:
             "model without clipping or noise, bypassing the DP mechanism. Use a "
             "normalization-free or GroupNorm model for DP runs."
         )
-    return {
+    state = {
         "params": params,
         "net_state": net_state,
         "mode_state": modes.init_server_state(cfg.mode),
         "round": jnp.zeros((), dtype=jnp.int32),
     }
+    if cfg.client_update_clip > 0:
+        # running median of live client-update L2 norms — the quarantine
+        # threshold's baseline. 0 = "no baseline yet": the first round only
+        # screens non-finite updates and then seeds the median.
+        state["quarantine"] = {"median": jnp.zeros((), dtype=jnp.float32)}
+    return state
+
+
+# Reserved per-client batch leaf: a [W] 0/1 float validity mask the caller
+# (FederatedSession) threads through every round-step variant by riding the
+# batch pytree — it shards/stacks/scans exactly like the client data it
+# masks. 0 = this client is DEAD for the round (failed batch load after
+# retries, an injected client_drop): it contributes zero to the partial
+# sketch, its weight is removed from the renormalization, its persistent
+# state rows keep their pre-round values, and metrics count survivors only —
+# a round with W-k live clients equals the round over just those W-k clients.
+VALID_KEY = "_valid"
+
+
+def split_valid(batch):
+    """Pop the reserved validity-mask leaf off a round batch. Returns
+    (batch_without_mask, valid_or_None); absence = all clients valid (the
+    engine-level default, zero program change)."""
+    if isinstance(batch, dict) and VALID_KEY in batch:
+        batch = dict(batch)
+        return batch, batch.pop(VALID_KEY)
+    return batch, None
 
 
 def participation_mask(rng, num_sampled: int, dropout: float) -> jnp.ndarray:
@@ -184,6 +228,35 @@ def _dp_noise_agg(cfg: EngineConfig, agg: dict, participants, noise_rng) -> dict
             jax.random.fold_in(noise_rng, i), v.shape, v.dtype)
         for i, (k, v) in enumerate(sorted(agg.items()))
     }
+
+
+def _client_norms(updates: jnp.ndarray) -> jnp.ndarray:
+    """[W] L2 norm of each client's flat update (f32 accumulation)."""
+    u = updates.astype(jnp.float32)
+    return jnp.sqrt(jnp.sum(jnp.square(u), axis=1))
+
+
+def _quarantine_mask(cfg: EngineConfig, norms: jnp.ndarray, qmed) -> jnp.ndarray:
+    """[W] bool: client rejected by the sketch-space quarantine. Non-finite
+    norms always quarantine (NaN compares false everywhere, so they need the
+    explicit check); the magnitude screen arms only once a running median
+    exists (qmed > 0)."""
+    bad = ~jnp.isfinite(norms)
+    return bad | ((qmed > 0) & (norms > cfg.client_update_clip * qmed))
+
+
+def _update_running_median(norms, part_eff, old_med):
+    """Next round's quarantine baseline: the median L2 norm over this round's
+    LIVE, non-quarantined clients (sort with dead rows pushed to +inf, then
+    index by the live count). Keeps the previous median when the whole cohort
+    dropped/quarantined — an empty round must not zero the threshold."""
+    live = (part_eff > 0) & jnp.isfinite(norms)
+    n_live = live.sum()
+    s = jnp.sort(jnp.where(live, norms, jnp.inf))
+    lo = jnp.clip((n_live - 1) // 2, 0, norms.shape[0] - 1)
+    hi = jnp.clip(n_live // 2, 0, norms.shape[0] - 1)
+    med = 0.5 * (s[lo] + s[hi])
+    return jnp.where(n_live > 0, med, old_med)
 
 
 def _tree_finite(tree) -> jnp.ndarray:
@@ -242,9 +315,13 @@ def _skip_metrics(ok, out_metrics) -> dict:
     (loss_sum/count/... came from the poisoned forward pass, and one NaN
     loss_sum would NaN the whole eval window), keep participants (the
     clients DID transmit; only the server discards), and emit the
-    nonfinite_rounds flag."""
+    nonfinite_rounds flag. The quarantine keys survive the zeroing like
+    participants: the quarantine verdicts/median are server-side bookkeeping,
+    not training stats from the poisoned forward pass (zeroing the median
+    metric would reset the split path's running threshold)."""
+    keep = ("participants", "clients_quarantined", "quarantine_median")
     out_metrics = {
-        k: v if k == "participants" else jnp.where(ok, v, jnp.zeros_like(v))
+        k: v if k in keep else jnp.where(ok, v, jnp.zeros_like(v))
         for k, v in out_metrics.items()
     }
     out_metrics["nonfinite_rounds"] = (~ok).astype(jnp.float32)
@@ -253,11 +330,12 @@ def _skip_metrics(ok, out_metrics) -> dict:
 
 def _merge_net_state(nstates, net_state, part) -> Any:
     """Mutable model collections (BN stats): average the SURVIVING clients'
-    results; with no survivors, keep the previous stats."""
+    results; with no survivors, keep the previous stats. mask_rows keeps a
+    quarantined client's NaN stats out of the live average."""
     n_live = jnp.maximum(part.sum(), 1.0)
     return jax.tree.map(
         lambda s, prev: jnp.where(
-            part.sum() > 0, (s * modes.bcast(part, s)).sum(0) / n_live, prev
+            part.sum() > 0, modes.mask_rows(part, s).sum(0) / n_live, prev
         ),
         nstates, net_state,
     )
@@ -265,8 +343,9 @@ def _merge_net_state(nstates, net_state, part) -> Any:
 
 def _survivor_metrics(metrics, part) -> dict:
     """Metric sums over the surviving cohort + the participants count that
-    run_round uses to scale the measured uplink."""
-    out = jax.tree.map(lambda m: jnp.sum(m * modes.bcast(part, m), axis=0), metrics)
+    run_round uses to scale the measured uplink (NaN-safe: a masked client's
+    poisoned metrics contribute exact zeros)."""
+    out = jax.tree.map(lambda m: modes.mask_rows(part, m).sum(axis=0), metrics)
     out["participants"] = part.sum()
     return out
 
@@ -274,26 +353,54 @@ def _survivor_metrics(metrics, part) -> dict:
 def _weighted_client_reduce(
     cfg: EngineConfig, grad_client: Callable,
     params, pflat, net_state, batch, client_rngs, part,
+    *, qmed=None, nan_safe: bool = False,
 ):
     """Participation-weighted SUMS over the sampled clients of (clipped)
     updates, mutable-collection contributions, and metric values — the whole
-    client phase of a linear-mode round before normalization.
+    client phase of a linear-mode round before normalization. Returns
+    (wsum, ns_sum, m_sum, part_eff, norms): `part_eff` is the [W] mask of
+    clients that actually contributed (the input mask minus any quarantined
+    clients), `norms` the [W] per-client update L2 norms (None with the
+    quarantine off).
 
     One vmap when cfg.client_chunk is 0; otherwise a lax.scan over chunks of
     client_chunk clients (each chunk vmapped), accumulating additively, so at
     most client_chunk full [d] gradients coexist in HBM (SURVEY.md §7 hard
     part (e)). Linearity of the weighted sum makes chunking exact up to fp
-    summation order."""
+    summation order — which is also what lets the quarantine run per chunk
+    against the replicated running-median threshold (`qmed`, from server
+    state): the verdict never needs the other chunks' norms.
+
+    nan_safe switches the 0/1 weighting from multiply to modes.mask_rows so
+    a masked client carrying NaN/Inf (poisoned update, zeroed dead-client
+    batch) still contributes an exact zero; it is forced on whenever the
+    quarantine is armed, and value-identical to the multiply form on finite
+    data."""
+    nan_safe = nan_safe or cfg.client_update_clip > 0
 
     def chunk(cb, crngs, cpart):
         updates, nstates, metrics = jax.vmap(
             lambda b, r: grad_client(params, pflat, net_state, b, r)
         )(cb, crngs)
+        norms_c = None
+        if cfg.client_update_clip > 0:
+            norms_c = _client_norms(updates)
+            bad = _quarantine_mask(cfg, norms_c, qmed)
+            cpart = cpart * (1.0 - bad.astype(cpart.dtype))
         updates = _clip_updates(cfg, updates)
-        wsum = (updates * cpart[:, None]).sum(axis=0)
-        ns_sum = jax.tree.map(lambda s: (s * modes.bcast(cpart, s)).sum(0), nstates)
-        m_sum = jax.tree.map(lambda m: jnp.sum(m * modes.bcast(cpart, m), axis=0), metrics)
-        return wsum, ns_sum, m_sum
+        if nan_safe:
+            wsum = modes.mask_rows(cpart, updates).sum(axis=0)
+            ns_sum = jax.tree.map(
+                lambda s: modes.mask_rows(cpart, s).sum(0), nstates)
+            m_sum = jax.tree.map(
+                lambda m: modes.mask_rows(cpart, m).sum(axis=0), metrics)
+        else:
+            wsum = (updates * cpart[:, None]).sum(axis=0)
+            ns_sum = jax.tree.map(
+                lambda s: (s * modes.bcast(cpart, s)).sum(0), nstates)
+            m_sum = jax.tree.map(
+                lambda m: jnp.sum(m * modes.bcast(cpart, m), axis=0), metrics)
+        return wsum, ns_sum, m_sum, cpart, norms_c
 
     W = part.shape[0]
     C = cfg.client_chunk
@@ -308,13 +415,18 @@ def _weighted_client_reduce(
           client_rngs.reshape((W // C, C) + client_rngs.shape[1:]),
           part.reshape(W // C, C))
     shapes = jax.eval_shape(chunk, *jax.tree.map(lambda a: a[0], xs))
-    init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes[:3])
 
     def body(carry, x):
-        return jax.tree.map(jnp.add, carry, chunk(*x)), None
+        wsum, ns_sum, m_sum, cpart_eff, norms_c = chunk(*x)
+        carry = jax.tree.map(jnp.add, carry, (wsum, ns_sum, m_sum))
+        return carry, (cpart_eff, norms_c)
 
-    acc, _ = jax.lax.scan(body, init, xs)
-    return acc
+    acc, (pe, norms) = jax.lax.scan(body, init, xs)
+    part_eff = pe.reshape(W)
+    if norms is not None:
+        norms = norms.reshape(W)
+    return acc + (part_eff, norms)
 
 
 def _finalize_client_reduce(mcfg: ModeConfig, wsum, ns_sum, m_sum, net_state, part):
@@ -403,6 +515,7 @@ def make_round_step(
         return delta, nstate, jax.tree.map(lambda m: m.sum(0), metrics)
 
     def step(state, batch, client_rows, lr, rng):
+        batch, valid = split_valid(batch)
         params, net_state = state["params"], state["net_state"]
         pflat, unravel = ravel_pytree(params)
         num_sampled = jax.tree.leaves(batch)[0].shape[0]
@@ -414,7 +527,14 @@ def make_round_step(
         crng, noise_rng, drop_rng = jax.random.split(rng, 3)
         client_rngs = jax.random.split(crng, num_sampled)
         part = participation_mask(drop_rng, num_sampled, cfg.client_dropout)
-        n_live = jnp.maximum(part.sum(), 1.0)
+        if valid is not None:
+            # dead clients (failed load / injected drop) fold into the same
+            # survivor machinery random dropout uses: zero weight, removed
+            # from every renormalization, state rows untouched
+            part = part * valid
+        qmed = (state["quarantine"]["median"]
+                if cfg.client_update_clip > 0 else None)
+        norms = None
 
         if (modes.is_linear(mcfg) and not mcfg.needs_local_state
                 and not mcfg.uses_weight_delta):
@@ -424,12 +544,12 @@ def make_round_step(
             # folds into the same reduction (survivor mean = sum(part·u) /
             # count(part); sum drops the /), and the reduce itself may run
             # chunked (cfg.client_chunk) so W full gradients never coexist.
-            wsum, ns_sum, m_sum = _weighted_client_reduce(
+            wsum, ns_sum, m_sum, part_eff, norms = _weighted_client_reduce(
                 cfg, grad_client, params, pflat, net_state, batch,
-                client_rngs, part,
+                client_rngs, part, qmed=qmed, nan_safe=valid is not None,
             )
             weighted, new_net_state, out_metrics = _finalize_client_reduce(
-                mcfg, wsum, ns_sum, m_sum, net_state, part
+                mcfg, wsum, ns_sum, m_sum, net_state, part_eff
             )
             agg = _compress_reduced(mcfg, weighted)
             new_rows = client_rows
@@ -442,13 +562,23 @@ def make_round_step(
                 updates, nstates, metrics = jax.vmap(
                     lambda cb, r: grad_client(params, pflat, net_state, cb, r)
                 )(batch, client_rngs)
+            part_eff = part
+            if cfg.client_update_clip > 0:
+                norms = _client_norms(updates)
+                bad = _quarantine_mask(cfg, norms, qmed)
+                part_eff = part * (1.0 - bad.astype(part.dtype))
+                # hard-zero the rejected updates so downstream per-client
+                # transforms (top-k, local error rows) never see the poison
+                updates = jnp.where(bad[:, None], jnp.zeros_like(updates),
+                                    updates)
             updates = _clip_updates(cfg, updates)
+            n_live = jnp.maximum(part_eff.sum(), 1.0)
 
             if modes.is_linear(mcfg) and not mcfg.needs_local_state:
                 # weight-delta modes (fedavg/localSGD) on the shortcut: the
                 # local-iteration scan already holds per-client state, so no
                 # chunked reduce — just the survivor-weighted mean of deltas
-                weighted = (updates * part[:, None]).sum(axis=0)
+                weighted = modes.mask_rows(part_eff, updates).sum(axis=0)
                 if mcfg.agg_op != "sum":
                     weighted = weighted / n_live
                 agg = _compress_reduced(mcfg, weighted)
@@ -457,24 +587,32 @@ def make_round_step(
                 wires, vrows = jax.vmap(lambda u, row: modes.client_compress(mcfg, u, row))(
                     updates, client_rows
                 )
-                agg = modes.aggregate(mcfg, wires, weights=part)
-                # dropped clients never transmitted: their persistent local
-                # state (error/momentum rows) stays exactly as it was
+                agg = modes.aggregate(mcfg, wires, weights=part_eff)
+                # dropped/quarantined clients never transmitted (usably):
+                # their persistent local state (error/momentum rows) stays
+                # exactly as it was
                 new_rows = jax.tree.map(
-                    lambda new, old: jnp.where(modes.bcast(part, new) > 0, new, old),
+                    lambda new, old: jnp.where(modes.bcast(part_eff, new) > 0, new, old),
                     vrows, client_rows,
                 )
-            new_net_state = _merge_net_state(nstates, net_state, part)
-            out_metrics = _survivor_metrics(metrics, part)
+            new_net_state = _merge_net_state(nstates, net_state, part_eff)
+            out_metrics = _survivor_metrics(metrics, part_eff)
 
+        new_med = None
+        if cfg.client_update_clip > 0:
+            out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()
+            new_med = _update_running_median(norms, part_eff, qmed)
+            out_metrics["quarantine_median"] = new_med
         agg, new_net_state, new_rows, out_metrics, fin_ok = _guard_nonfinite(
             cfg, agg, new_net_state, net_state, new_rows, client_rows,
             out_metrics,
         )
         if cfg.dp_noise > 0:
             # fin_ok gates the count: a skipped round is a fully-dropped
-            # cohort, and _dp_noise_agg releases nothing for an empty round
-            agg = _dp_noise_agg(cfg, agg, part.sum() * fin_ok, noise_rng)
+            # cohort, and _dp_noise_agg releases nothing for an empty round.
+            # part_eff: a quarantined client released nothing either, so DP
+            # sensitivity calibrates to the clients that actually merged.
+            agg = _dp_noise_agg(cfg, agg, part_eff.sum() * fin_ok, noise_rng)
 
         # weight-delta modes: local steps already carry the client lr; the
         # server applies the averaged delta at the configured server rate
@@ -488,6 +626,8 @@ def make_round_step(
             "mode_state": mode_state,
             "round": state["round"] + 1,
         }
+        if new_med is not None:
+            new_state["quarantine"] = {"median": new_med}
         if mcfg.mode == "local_topk":
             # support of the actually-broadcast delta (SURVEY.md §6 row 4):
             # the union of client supports when momentum keeps nothing extra
@@ -563,28 +703,39 @@ def _normalize_merged_wire(mcfg: ModeConfig, wire_sum: dict, n_live) -> dict:
 
 
 def _merged_sharded_tail(
-    cfg: EngineConfig, state, stacked_wire, stacked_ns, stacked_m, part,
-    lr, noise_rng,
+    cfg: EngineConfig, state, stacked_wire, stacked_ns, stacked_m, part_eff,
+    lr, noise_rng, part=None, norms=None,
 ):
     """Everything after the per-shard client phase, shared verbatim by the
     mesh execution and the single-device reference so they cannot drift:
     ordered merge of the stacked [S, ...] partials (modes.merge_partial_wires
     — an ordered sum, NOT a psum, which is what makes mesh == single-device
-    bit-identical), survivor normalization, non-finite guard, DP noise, and
-    the replicated server step."""
+    bit-identical), survivor normalization, quarantine bookkeeping (the
+    running-median update from the gathered [W] norms), non-finite guard, DP
+    noise, and the replicated server step. `part_eff` is the [W] effective
+    contribution mask (dropout x validity x quarantine) reassembled from the
+    shards; `part`/`norms` only exist with the quarantine armed (part = the
+    pre-quarantine mask, for the quarantined count)."""
     mcfg = cfg.mode
     wire_sum = modes.merge_partial_wires(mcfg, stacked_wire)
     ns_sum = jax.tree.map(lambda x: x.sum(axis=0), stacked_ns)
     m_sum = jax.tree.map(lambda x: x.sum(axis=0), stacked_m)
     pflat, unravel = ravel_pytree(state["params"])
-    agg = _normalize_merged_wire(mcfg, wire_sum, jnp.maximum(part.sum(), 1.0))
+    agg = _normalize_merged_wire(mcfg, wire_sum,
+                                 jnp.maximum(part_eff.sum(), 1.0))
     new_net_state, out_metrics = _merged_survivor_finalize(
-        ns_sum, m_sum, part, state["net_state"])
+        ns_sum, m_sum, part_eff, state["net_state"])
+    new_med = None
+    if cfg.client_update_clip > 0:
+        qmed = state["quarantine"]["median"]
+        out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()
+        new_med = _update_running_median(norms, part_eff, qmed)
+        out_metrics["quarantine_median"] = new_med
     agg, new_net_state, _, out_metrics, fin_ok = _guard_nonfinite(
         cfg, agg, new_net_state, state["net_state"], {}, {}, out_metrics,
     )
     if cfg.dp_noise > 0:
-        agg = _dp_noise_agg(cfg, agg, part.sum() * fin_ok, noise_rng)
+        agg = _dp_noise_agg(cfg, agg, part_eff.sum() * fin_ok, noise_rng)
     delta, mode_state = modes.server_step_sparse(
         mcfg, agg, state["mode_state"], lr)
     new_state = {
@@ -593,6 +744,8 @@ def _merged_sharded_tail(
         "mode_state": mode_state,
         "round": state["round"] + 1,
     }
+    if new_med is not None:
+        new_state["quarantine"] = {"median": new_med}
     return new_state, out_metrics
 
 
@@ -660,14 +813,36 @@ def make_sharded_round_step(
             "client shard); use make_round_step for the unsharded round"
         )
     grad_client = _make_grad_client(loss_fn, cfg)
+    quarantine = cfg.client_update_clip > 0
 
-    def local_phase(params, pflat, net_state, batch_l, rngs_l, part_l):
-        wsum, ns_sum, m_sum = _weighted_client_reduce(
+    def local_phase(params, pflat, net_state, qmed, batch_l, rngs_l, part_l):
+        """One shard's client phase. Returns (wire, ns_sum, m_sum, part_eff)
+        plus, with the quarantine armed, (part_valid, norms) — the per-shard
+        slices the merged tail reassembles into cohort-order [W] vectors."""
+        batch_l, valid_l = split_valid(batch_l)
+        if valid_l is not None:
+            part_l = part_l * valid_l
+        wsum, ns_sum, m_sum, part_eff_l, norms_l = _weighted_client_reduce(
             cfg, grad_client, params, pflat, net_state, batch_l, rngs_l,
-            part_l,
+            part_l, qmed=qmed, nan_safe=valid_l is not None,
         )
         wire, _ = modes.client_compress(mcfg, wsum, {})
-        return wire, ns_sum, m_sum
+        if quarantine:
+            return wire, ns_sum, m_sum, part_eff_l, part_l, norms_l
+        return wire, ns_sum, m_sum, part_eff_l
+
+    def _tail(cfg_state, stacked, lr, noise_rng):
+        """Unpack the per-shard stacks ([S, wl] leaves, shard-index order =
+        cohort order row-major) and run the shared merged tail."""
+        if quarantine:
+            wire_s, ns_s, m_s, pe_s, pv_s, norms_s = stacked
+            return _merged_sharded_tail(
+                cfg, cfg_state, wire_s, ns_s, m_s, pe_s.reshape(-1), lr,
+                noise_rng, part=pv_s.reshape(-1), norms=norms_s.reshape(-1))
+        wire_s, ns_s, m_s, pe_s = stacked
+        return _merged_sharded_tail(
+            cfg, cfg_state, wire_s, ns_s, m_s, pe_s.reshape(-1), lr,
+            noise_rng)
 
     if mesh is None:
         def step(state, batch, client_rows, lr, rng):
@@ -681,6 +856,7 @@ def make_sharded_round_step(
                 )
             wl = W // S
             all_rngs, part, noise_rng = _cohort_streams(cfg, rng, W)
+            qmed = state["quarantine"]["median"] if quarantine else None
             shards = (
                 jax.tree.map(
                     lambda a: a.reshape((S, wl) + a.shape[1:]), batch),
@@ -699,10 +875,10 @@ def make_sharded_round_step(
             # (unrolled, length-1 map, top-level tail) removes it for
             # every mode at once, it only moves which ops carry the ulp.
             stacked = jax.lax.map(
-                lambda xs: local_phase(params, pflat, net_state, *xs), shards
+                lambda xs: local_phase(params, pflat, net_state, qmed, *xs),
+                shards,
             )
-            new_state, out_metrics = _merged_sharded_tail(
-                cfg, state, *stacked, part, lr, noise_rng)
+            new_state, out_metrics = _tail(state, stacked, lr, noise_rng)
             return new_state, client_rows, out_metrics
 
         return step
@@ -722,6 +898,8 @@ def make_sharded_round_step(
     # fusion (fma contraction) can differ from the reference's at the last
     # bit (observed: ~6 table entries at 1e-9 after one momentum round),
     # which would break the bit-identity pin on the server state.
+    n_local_outs = 6 if quarantine else 4
+
     def body(state, batch_l, lr, rng):
         params, net_state = state["params"], state["net_state"]
         pflat, _ = ravel_pytree(params)
@@ -730,24 +908,25 @@ def make_sharded_round_step(
         # device, then this shard's contiguous slice — per-client rng
         # streams are mesh-shape-invariant (see _cohort_streams)
         all_rngs, part, noise_rng = _cohort_streams(cfg, rng, wl * S)
+        qmed = state["quarantine"]["median"] if quarantine else None
         lo = _shard_index(mesh, axis_names) * wl
         rngs_l = jax.lax.dynamic_slice_in_dim(all_rngs, lo, wl)
         part_l = jax.lax.dynamic_slice_in_dim(part, lo, wl)
-        wire_l, ns_l, m_l = local_phase(
-            params, pflat, net_state, batch_l, rngs_l, part_l)
-        # THE cross-device move: gather the [S] partial wires in shard
-        # order; the ordered reduce happens outside, shared with the
-        # reference (merged tail)
+        locals_ = local_phase(
+            params, pflat, net_state, qmed, batch_l, rngs_l, part_l)
+        # THE cross-device move: gather the [S] partial wires (plus the tiny
+        # per-shard effective-mask/norm rows) in shard order; the ordered
+        # reduce happens outside, shared with the reference (merged tail)
         stacked = jax.tree.map(
             lambda x: jax.lax.all_gather(x, axis_names, axis=0),
-            (wire_l, ns_l, m_l),
+            locals_,
         )
-        return stacked + (part, noise_rng)
+        return stacked + (noise_rng,)
 
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(), batch_spec, P(), P()),
-        out_specs=(P(), P(), P(), P(), P()),
+        out_specs=tuple(P() for _ in range(n_local_outs + 1)),
         # outputs ARE replicated (all_gather results and the replicated
         # stream derivations are identical on every device); the static
         # checker just can't see through all_gather
@@ -755,11 +934,9 @@ def make_sharded_round_step(
     )
 
     def step(state, batch, client_rows, lr, rng):
-        stacked_wire, stacked_ns, stacked_m, part, noise_rng = mapped(
-            state, batch, lr, rng)
-        new_state, out_metrics = _merged_sharded_tail(
-            cfg, state, stacked_wire, stacked_ns, stacked_m, part, lr,
-            noise_rng)
+        outs = mapped(state, batch, lr, rng)
+        stacked, noise_rng = outs[:-1], outs[-1]
+        new_state, out_metrics = _tail(state, stacked, lr, noise_rng)
         return new_state, client_rows, out_metrics
 
     return step
@@ -812,25 +989,32 @@ def make_sharded_split_round_step(
 
     axes = meshlib.client_axes(mesh)
 
+    quarantine = cfg.client_update_clip > 0
+
     # As in the fused sharded step, ONLY the per-shard work + gathers live
     # inside shard_map; merges and the server algebra run at jit top level
     # on the replicated stacks so both programs (and the single-device
     # reference) share one compile context for the value-sensitive fp tail.
     def client_body(state, batch_l, lr, rng):
         params, net_state = state["params"], state["net_state"]
+        batch_l, valid_l = split_valid(batch_l)
         pflat, _ = ravel_pytree(params)
         wl = jax.tree.leaves(batch_l)[0].shape[0]
         all_rngs, part, noise_rng = _cohort_streams(cfg, rng, wl * S)
+        qmed = state["quarantine"]["median"] if quarantine else None
         lo = _shard_index(mesh, axis_names) * wl
         rngs_l = jax.lax.dynamic_slice_in_dim(all_rngs, lo, wl)
         part_l = jax.lax.dynamic_slice_in_dim(part, lo, wl)
-        wsum_l, ns_l, m_l = _weighted_client_reduce(
+        if valid_l is not None:
+            part_l = part_l * valid_l
+        wsum_l, ns_l, m_l, pe_l, norms_l = _weighted_client_reduce(
             cfg, grad_client, params, pflat, net_state, batch_l, rngs_l,
-            part_l,
+            part_l, qmed=qmed, nan_safe=valid_l is not None,
         )
-        stacked_ns, stacked_m = jax.tree.map(
-            lambda x: jax.lax.all_gather(x, axis_names, axis=0),
-            (ns_l, m_l),
+        gathered = (ns_l, m_l, pe_l) + ((part_l, norms_l) if quarantine
+                                        else ())
+        stacked = jax.tree.map(
+            lambda x: jax.lax.all_gather(x, axis_names, axis=0), gathered,
         )
         # finiteness of the partials == finiteness of the merged wire
         # (compression propagates every NaN/Inf — the same equivalence
@@ -838,22 +1022,31 @@ def make_sharded_split_round_step(
         # programs share the identical verdict
         parts_ok = jax.lax.all_gather(
             jnp.isfinite(wsum_l).all()[None], axis_names, axis=0).all()
-        return wsum_l[None], stacked_ns, stacked_m, part, noise_rng, parts_ok
+        return (wsum_l[None],) + stacked + (noise_rng, parts_ok)
 
+    n_gathered = 5 if quarantine else 3
     client_mapped = shard_map(
         client_body, mesh=mesh,
         in_specs=(P(), P(axes), P(), P()),
-        out_specs=(P(axes), P(), P(), P(), P(), P()),
+        out_specs=(P(axes),) + tuple(P() for _ in range(n_gathered + 2)),
         check_rep=False,
     )
 
     def client_step(state, batch, lr, rng):
-        wpart, stacked_ns, stacked_m, part, noise_rng, parts_ok = (
-            client_mapped(state, batch, lr, rng))
+        outs = client_mapped(state, batch, lr, rng)
+        wpart, stacked_ns, stacked_m, pe_s = outs[:4]
+        noise_rng, parts_ok = outs[-2], outs[-1]
+        part_eff = pe_s.reshape(-1)
         ns_sum = jax.tree.map(lambda x: x.sum(axis=0), stacked_ns)
         m_sum = jax.tree.map(lambda x: x.sum(axis=0), stacked_m)
         new_net_state, out_metrics = _merged_survivor_finalize(
-            ns_sum, m_sum, part, state["net_state"])
+            ns_sum, m_sum, part_eff, state["net_state"])
+        if quarantine:
+            pv, norms = outs[4].reshape(-1), outs[5].reshape(-1)
+            qmed = state["quarantine"]["median"]
+            out_metrics["clients_quarantined"] = pv.sum() - part_eff.sum()
+            out_metrics["quarantine_median"] = _update_running_median(
+                norms, part_eff, qmed)
         if cfg.on_nonfinite == "skip":
             ok = parts_ok & _tree_finite(new_net_state)
             out_metrics = _skip_metrics(ok, out_metrics)
@@ -874,7 +1067,8 @@ def make_sharded_split_round_step(
         check_rep=False,
     )
 
-    def server_step(state, wpart, new_net_state, participants, lr, noise_rng):
+    def server_step(state, wpart, new_net_state, participants, lr, noise_rng,
+                    qmed=None):
         stacked_wire, parts_ok = server_mapped(wpart)
         pflat, unravel = ravel_pytree(state["params"])
         wire_sum = modes.merge_partial_wires(mcfg, stacked_wire)
@@ -895,12 +1089,21 @@ def make_sharded_split_round_step(
             agg = _dp_noise_agg(cfg, agg, participants, noise_rng)
         delta, mode_state = modes.server_step_sparse(
             mcfg, agg, state["mode_state"], lr)
-        return {
+        new_state = {
             "params": unravel(modes.apply_delta(pflat, delta)),
             "net_state": new_net_state,
             "mode_state": mode_state,
             "round": state["round"] + 1,
         }
+        if quarantine:
+            if qmed is None:
+                raise ValueError(
+                    "client_update_clip > 0: server_step needs the updated "
+                    "running median (metrics['quarantine_median'] from the "
+                    "client program)"
+                )
+            new_state["quarantine"] = {"median": qmed}
+        return new_state
 
     return client_step, server_step
 
@@ -937,7 +1140,10 @@ def make_split_round_step(
         )
     grad_client = _make_grad_client(loss_fn, cfg)
 
+    quarantine = cfg.client_update_clip > 0
+
     def client_step(state, batch, lr, rng):
+        batch, valid = split_valid(batch)
         params, net_state = state["params"], state["net_state"]
         pflat, _ = ravel_pytree(params)
         num_sampled = jax.tree.leaves(batch)[0].shape[0]
@@ -946,14 +1152,21 @@ def make_split_round_step(
         crng, noise_rng, drop_rng = jax.random.split(rng, 3)
         client_rngs = jax.random.split(crng, num_sampled)
         part = participation_mask(drop_rng, num_sampled, cfg.client_dropout)
-        n_live = jnp.maximum(part.sum(), 1.0)
+        if valid is not None:
+            part = part * valid
+        qmed = state["quarantine"]["median"] if quarantine else None
 
-        wsum, ns_sum, m_sum = _weighted_client_reduce(
-            cfg, grad_client, params, pflat, net_state, batch, client_rngs, part
+        wsum, ns_sum, m_sum, part_eff, norms = _weighted_client_reduce(
+            cfg, grad_client, params, pflat, net_state, batch, client_rngs,
+            part, qmed=qmed, nan_safe=valid is not None,
         )
         weighted, new_net_state, out_metrics = _finalize_client_reduce(
-            mcfg, wsum, ns_sum, m_sum, net_state, part
+            mcfg, wsum, ns_sum, m_sum, net_state, part_eff
         )
+        if quarantine:
+            out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()
+            out_metrics["quarantine_median"] = _update_running_median(
+                norms, part_eff, qmed)
         if cfg.on_nonfinite == "skip":
             # same verdict the fused step computes from the compressed agg:
             # compression (sketch sums / dense passthrough) propagates every
@@ -962,7 +1175,8 @@ def make_split_round_step(
             out_metrics = _skip_metrics(ok, out_metrics)
         return weighted, new_net_state, out_metrics, noise_rng
 
-    def server_step(state, weighted, new_net_state, participants, lr, noise_rng):
+    def server_step(state, weighted, new_net_state, participants, lr,
+                    noise_rng, qmed=None):
         pflat, unravel = ravel_pytree(state["params"])
         if cfg.on_nonfinite == "skip":
             ok = jnp.isfinite(weighted).all() & _tree_finite(new_net_state)
@@ -979,12 +1193,21 @@ def make_split_round_step(
             agg = _dp_noise_agg(cfg, agg, participants, noise_rng)
         delta, mode_state = modes.server_step_sparse(
             mcfg, agg, state["mode_state"], lr)
-        return {
+        new_state = {
             "params": unravel(modes.apply_delta(pflat, delta)),
             "net_state": new_net_state,
             "mode_state": mode_state,
             "round": state["round"] + 1,
         }
+        if quarantine:
+            if qmed is None:
+                raise ValueError(
+                    "client_update_clip > 0: server_step needs the updated "
+                    "running median (metrics['quarantine_median'] from the "
+                    "client program)"
+                )
+            new_state["quarantine"] = {"median": qmed}
+        return new_state
 
     return client_step, server_step
 
@@ -1042,12 +1265,15 @@ def compose_split(client_step: Callable, server_step: Callable) -> Callable:
     signature `(state, batch, client_rows, lr, rng) -> (state', rows,
     metrics)`, so call sites (session, bench) stay agnostic of the
     two-program protocol. client_rows pass through untouched — the split
-    scope has no client-local state."""
+    scope has no client-local state. The quarantine's running-median update
+    crosses the program boundary as metrics['quarantine_median'] (absent →
+    qmed=None, quarantine off)."""
 
     def step(state, batch, client_rows, lr, rng):
         weighted, net_state, metrics, noise_rng = client_step(state, batch, lr, rng)
         new_state = server_step(
-            state, weighted, net_state, metrics["participants"], lr, noise_rng
+            state, weighted, net_state, metrics["participants"], lr,
+            noise_rng, qmed=metrics.get("quarantine_median"),
         )
         return new_state, client_rows, metrics
 

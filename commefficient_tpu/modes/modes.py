@@ -154,6 +154,17 @@ def bcast(w: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     return w.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
 
 
+def mask_rows(w: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """NaN-safe re-expression of `x * bcast(w, x)` for 0/1 participation /
+    validity / quarantine masks: a plain multiply propagates a poisoned
+    client's NaNs straight through its ZERO weight (0 * nan = nan), turning
+    "this client contributes nothing" into "this client poisons the sum".
+    Zero-weight rows are hard-zeroed; live rows keep the exact multiply, so
+    for finite data the result is bit-identical to the multiply form."""
+    wb = bcast(w, x)
+    return jnp.where(wb > 0, x * wb, jnp.zeros_like(x))
+
+
 def aggregate(cfg: ModeConfig, wires: dict, weights=None) -> dict:
     """Combine the W client wires (leading axis W) with cfg.agg_op (mean by
     default; sum reproduces FetchSGD Alg. 1's Σ-of-sketches with the scaling
@@ -170,7 +181,10 @@ def aggregate(cfg: ModeConfig, wires: dict, weights=None) -> dict:
     def op(x):
         if weights is None:
             return jnp.sum(x, 0) if cfg.agg_op == "sum" else jnp.mean(x, 0)
-        s = (x * bcast(weights, x)).sum(0)
+        # mask_rows, not a multiply: a masked client may carry NaN/Inf (an
+        # engine-quarantined poisoned update) and must still contribute an
+        # exact zero
+        s = mask_rows(weights, x).sum(0)
         return s if cfg.agg_op == "sum" else s / jnp.maximum(weights.sum(), 1.0)
 
     if cfg.mode == "sketch":

@@ -130,6 +130,25 @@ def initialize_from_args(args, fault_plan=None, retry_policy=None) -> bool:
                       **cluster_kw)
 
 
+def all_hosts_max(value: int) -> int:
+    """Max-reduce a small host-local integer over every process in the job —
+    the agreement primitive behind multi-host coordinated preemption (the
+    SIGTERM flag must become "any host was signalled" before anyone acts on
+    it). Implemented as a process_allgather over the host axis (the
+    `slices`/process dimension of the job): one int32 per host per call,
+    negligible next to a round. Single-process returns the value unchanged
+    without touching any collective, so laptops/CI never pay for it."""
+    import jax
+
+    if jax.process_count() == 1:
+        return int(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.int32(value))
+    return int(np.max(np.asarray(flags)))
+
+
 def mesh_info(mesh) -> dict:
     """Mesh-level topology summary for startup logs: which axes the round
     shards over, how many ways the client cohort splits (= the devices the

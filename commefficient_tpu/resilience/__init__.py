@@ -5,15 +5,20 @@ flakes, and numeric blowups instead of dying silently (the round-5 FEMNIST
 stall the watchdog could only warn about). This package holds the two halves:
 
 - `faults`: a seeded `FaultPlan` that injects failures at named sites and
-  scheduled rounds — simulated preemption (SIGTERM mid-round), checkpoint
-  corruption/partial writes, data-loader stalls, transient
-  `jax.distributed` init failures, NaN/Inf gradient bursts. Everything is
+  scheduled rounds — simulated preemption (SIGTERM mid-round; `host_preempt`
+  signals ONE simulated host), checkpoint corruption/partial writes,
+  data-loader stalls, transient `jax.distributed` init failures, NaN/Inf
+  gradient bursts, and cohort-level client faults (`client_drop` /
+  `client_straggle` / `client_poison` — individual cohort positions masked,
+  stalled, or poisoned inside the round). Everything is
   off unless a plan is supplied (`--fault_plan`), and a given plan replays
   identically run-to-run so chaos tests can pin bit-exact recovery.
 - `retry`: bounded retries with exponential backoff + deterministic jitter,
   wrapped around checkpoint IO, distributed init, and data loading.
 - `preemption`: a SIGTERM handler that finishes the in-flight round, takes
-  an emergency checkpoint, and exits with a resumable status.
+  an emergency checkpoint, and exits with a resumable status — plus
+  `coordinated`, the cross-host max-reduce of the flag that makes every
+  host of a pod finish the SAME round and exit 75 together.
 
 The recovery machinery these prove out lives where the failures happen:
 atomic + checksummed checkpoints in `utils.checkpoint`, the non-finite
@@ -22,7 +27,7 @@ round guard in `federated.engine` (EngineConfig.on_nonfinite), and the
 """
 
 from .faults import FaultPlan, FaultSpec, InjectedFault, InjectedTransientError
-from .preemption import EXIT_RESUMABLE, PreemptionHandler
+from .preemption import EXIT_RESUMABLE, PreemptionHandler, coordinated
 from .retry import RetryPolicy, reset_retry_counts, retry_counts, with_retries
 
 __all__ = [
@@ -33,6 +38,7 @@ __all__ = [
     "InjectedTransientError",
     "PreemptionHandler",
     "RetryPolicy",
+    "coordinated",
     "reset_retry_counts",
     "retry_counts",
     "with_retries",

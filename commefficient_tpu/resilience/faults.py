@@ -26,6 +26,27 @@ entries, each `kind[@round,round,...][:key=val,...]`:
                                 partial write)
     dist_init:times=2           fail `jax.distributed` bootstrap twice
                                 (recovered by retry)
+    client_drop@2:clients=0+3   kill cohort positions 0 and 3 inside round 2:
+                                their batch rows zero, their validity mask
+                                goes 0 (the engine degrades them to masked
+                                clients), and the session re-queues their
+                                client ids for a later round
+    client_straggle@2:clients=1:secs=0.5
+                                position 1's batch assembly stalls 0.5 s in
+                                round 2 (a slow edge device; the round still
+                                completes — watchdog/prefetch fodder)
+    client_poison@2:clients=1:value=big
+                                fill position 1's batch rows so its update
+                                goes adversarially large (value=big, finite)
+                                or non-finite (nan/inf) through the REAL
+                                gradient path — caught per-client by the
+                                sketch-space quarantine (--client_update_clip)
+                                instead of costing the whole round
+    host_preempt@3:host=0       SIGTERM round 3 ONLY on the host whose
+                                jax.process_index() == host — the one-host
+                                preemption the cross-host barrier
+                                (resilience.preemption.coordinated) turns
+                                into an all-hosts same-round exit 75
     seed=7                      recorded on the plan for reproducibility
                                 reporting (every current site is
                                 deterministic — nothing is drawn from it)
@@ -60,7 +81,20 @@ KINDS = {
     "ckpt_corrupt": (),
     "ckpt_partial": (),
     "dist_init": ("times",),
+    # cohort-level sites (client_* target cohort POSITIONS 0..W-1, "+"-
+    # separated since "," separates params): drop/straggle/poison individual
+    # clients inside the round; host_preempt SIGTERMs one simulated host
+    "client_drop": ("clients",),
+    "client_straggle": ("clients", "secs"),
+    "client_poison": ("clients", "value"),
+    "host_preempt": ("host",),
 }
+
+# the client_* sites fire inside a round's preparation: scheduled at or past
+# the run's last round they would silently never inject — the vacuous-chaos-
+# test failure mode this module exists to prevent. FaultPlan.validate_rounds
+# rejects them at launch (the run length isn't known at parse time).
+CLIENT_KINDS = ("client_drop", "client_straggle", "client_poison")
 
 
 class InjectedFault(RuntimeError):
@@ -127,9 +161,21 @@ def _parse_entry(entry: str) -> FaultSpec:
                     params[k] = int(v)
                 elif k == "secs":
                     params[k] = float(v)
+                elif k == "host":
+                    params[k] = int(v)
+                elif k == "clients":
+                    # "+"-separated cohort positions ("," separates params)
+                    pos = tuple(int(p) for p in v.split("+") if p.strip())
+                    if not pos or any(p < 0 for p in pos):
+                        raise ValueError(
+                            "expected '+'-separated non-negative positions")
+                    params[k] = pos
                 elif k == "value":
-                    if v not in ("nan", "inf"):
-                        raise ValueError("expected 'nan' or 'inf'")
+                    allowed = (("nan", "inf", "big") if kind == "client_poison"
+                               else ("nan", "inf"))
+                    if v not in allowed:
+                        raise ValueError(
+                            f"expected one of {'/'.join(allowed)}")
                     params[k] = v
             except ValueError as e:
                 raise ValueError(
@@ -184,6 +230,37 @@ class FaultPlan:
             if s.kind == kind and s.matches(rnd):
                 return s
         return None
+
+    def specs_for(self, kind: str, rnd: int | None = None) -> list[FaultSpec]:
+        """Every matching spec (the client_* sites allow several entries per
+        round, e.g. one drop list and one poison list)."""
+        return [s for s in self.specs if s.kind == kind and s.matches(rnd)]
+
+    def validate_rounds(self, total_rounds: int) -> None:
+        """Launch-time schedule validation against the run's actual length:
+        a client_* or host_preempt site scheduled at round >= total_rounds —
+        or a host_preempt targeting a host index the job doesn't have — can
+        never fire; reject it loudly instead of letting the chaos run pass
+        vacuously."""
+        for s in self.specs:
+            if (s.kind in CLIENT_KINDS or s.kind == "host_preempt") and s.rounds:
+                dead = [r for r in s.rounds if r >= total_rounds]
+                if dead:
+                    raise ValueError(
+                        f"--fault_plan: {s.kind}@{','.join(map(str, dead))} "
+                        f"can never fire — the run ends at round "
+                        f"{total_rounds} (rounds are 0-based global indices)"
+                    )
+            if s.kind == "host_preempt":
+                import jax
+
+                host = int(s.params.get("host", 0))
+                if host >= jax.process_count():
+                    raise ValueError(
+                        f"--fault_plan: host_preempt:host={host} can never "
+                        f"fire — this job has {jax.process_count()} "
+                        "process(es) (host is a 0-based jax.process_index)"
+                    )
 
     def _log(self, msg: str):
         print(f"fault-injection: {msg}", file=sys.stderr, flush=True)
@@ -249,7 +326,11 @@ class FaultPlan:
                 return np.full_like(a, val)
             return a
 
-        out = {k: bad(v) for k, v in batch.items()}
+        # underscore-prefixed leaves are engine-reserved control rows (the
+        # per-client validity mask), not client data — poisoning them would
+        # corrupt the masking machinery itself rather than the gradients
+        out = {k: (v if k.startswith("_") else bad(v))
+               for k, v in batch.items()}
         if poisoned:
             self._log(f"poisoning round {rnd} client batch with {val} "
                       f"({poisoned} float leaves)")
@@ -265,13 +346,102 @@ class FaultPlan:
     def preempt(self, rnd: int):
         """Simulated preemption: deliver a real SIGTERM to this process as
         the scheduled round runs (one-shot). The PreemptionHandler turns it
-        into finish-round -> emergency checkpoint -> resumable exit."""
-        s = self.spec("preempt", rnd)
-        if s is None or ("preempt", rnd) in self._fired:
-            return
-        self._fired.add(("preempt", rnd))
-        self._log(f"injecting SIGTERM mid-round (round {rnd})")
-        os.kill(os.getpid(), signal.SIGTERM)
+        into finish-round -> emergency checkpoint -> resumable exit.
+        `host_preempt` is the multi-host variant: it fires only on the host
+        whose jax.process_index() matches its `host` param (default 0), so
+        on a pod exactly ONE host gets the signal and the cross-host
+        preemption barrier (resilience.preemption.coordinated) has to carry
+        it to the others. Single-process runs have process_index 0, where
+        host_preempt@r:host=0 behaves like preempt@r through the
+        coordinated path."""
+        for kind in ("preempt", "host_preempt"):
+            s = self.spec(kind, rnd)
+            if s is None or (kind, rnd) in self._fired:
+                continue
+            if kind == "host_preempt":
+                import jax
+
+                host = int(s.params.get("host", 0))
+                if jax.process_index() != host:
+                    continue  # another simulated host's turn; stay armed
+            self._fired.add((kind, rnd))
+            self._log(f"injecting SIGTERM mid-round ({kind}, round {rnd})")
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    # ------------------------------------------------- cohort-level sites
+
+    @staticmethod
+    def _positions(s: FaultSpec, num_workers: int, rnd: int) -> tuple:
+        pos = s.params.get("clients", (0,))
+        bad = [p for p in pos if not 0 <= p < num_workers]
+        if bad:
+            # a typo'd position must fail the chaos run loudly, not let it
+            # pass vacuously with the fault never applied
+            raise ValueError(
+                f"fault {s.kind}@{rnd}: cohort positions {bad} out of range "
+                f"for num_workers={num_workers}"
+            )
+        return pos
+
+    def client_faults(self, rnd: int, batch: dict, valid, num_workers: int):
+        """Cohort-level injection inside round `rnd`'s preparation, after the
+        batch is assembled: client_straggle sleeps (a slow edge device),
+        client_poison fills the scheduled positions' rows (nan/inf -> a
+        non-finite per-client update; big -> an adversarially large but
+        finite one), client_drop zeroes the rows AND the validity mask.
+        Returns (batch, valid, dropped_positions); `valid` stays None when
+        nothing dropped. All one-shot per (kind, round)."""
+        for s in self.specs_for("client_straggle", rnd):
+            key = ("client_straggle", rnd, s.params.get("clients", (0,)))
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            pos = self._positions(s, num_workers, rnd)
+            secs = float(s.params.get("secs", 1.0))
+            self._log(f"clients {list(pos)} straggling {secs}s (round {rnd})")
+            time.sleep(secs)
+
+        poison_specs = self.specs_for("client_poison", rnd)
+        drop_specs = self.specs_for("client_drop", rnd)
+        if not poison_specs and not drop_specs:
+            return batch, valid, []
+        batch = {k: (v if k.startswith("_") else np.array(v, copy=True))
+                 for k, v in batch.items()}
+
+        for s in poison_specs:
+            key = ("client_poison", rnd, s.params.get("clients", (0,)))
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            pos = list(self._positions(s, num_workers, rnd))
+            val = s.params.get("value", "nan")
+            fill = {"nan": np.nan, "inf": np.inf, "big": 1e6}[val]
+            for k, v in batch.items():
+                if k.startswith("_") or not np.issubdtype(
+                        v.dtype, np.floating):
+                    continue
+                v[pos] = fill
+            self._log(f"poisoning clients {pos} with {val} (round {rnd})")
+
+        dropped: list[int] = []
+        for s in drop_specs:
+            key = ("client_drop", rnd, s.params.get("clients", (0,)))
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            pos = list(self._positions(s, num_workers, rnd))
+            if valid is None:
+                valid = np.ones(num_workers, np.float32)
+            else:
+                valid = np.array(valid, copy=True)
+            for k, v in batch.items():
+                if not k.startswith("_"):
+                    v[pos] = 0
+            valid[pos] = 0.0
+            dropped.extend(pos)
+            self._log(f"dropping clients {pos} (round {rnd}; masked + "
+                      "re-queued)")
+        return batch, valid, dropped
 
     def corrupt_checkpoint(self, rnd: int, path: str):
         """Post-commit checkpoint damage (one-shot per kind+round):

@@ -23,6 +23,22 @@ import sys
 EXIT_RESUMABLE = 75
 
 
+def coordinated(triggered: bool) -> bool:
+    """Cross-host preemption agreement: the max-reduce of every host's local
+    SIGTERM flag. On a pod, a scheduler may deliver SIGTERM to ONE host;
+    without agreement that host exits mid-schedule while the others block in
+    the next round's collectives — the run hangs AND the hosts disagree about
+    which round was last completed, so no consistent checkpoint exists. The
+    runner calls this once per round-block boundary (every host reaches the
+    same boundary, so the collective call counts line up), and every host
+    acts on the AGREED flag: all finish the same round, checkpoint it, and
+    exit EXIT_RESUMABLE together. Single-process: the local flag, no
+    collective touched."""
+    from ..parallel import distributed
+
+    return bool(distributed.all_hosts_max(int(bool(triggered))))
+
+
 class PreemptionHandler:
     """Context manager installing a flag-setting handler for `signals`
     (default SIGTERM). The previous handlers are restored on exit so nested

@@ -50,7 +50,7 @@ import time
 import jax
 
 from ..federated.api import FederatedSession, FedOptimizer, plan_block
-from ..resilience import EXIT_RESUMABLE, PreemptionHandler
+from ..resilience import EXIT_RESUMABLE, PreemptionHandler, preemption
 from ..utils import checkpoint as ckpt
 from ..utils.logging import Timer
 from ..utils.watchdog import RoundWatchdog
@@ -60,6 +60,13 @@ from .writer import AsyncCheckpointWriter
 
 DEFAULT_MAX_INFLIGHT = 4  # auto-tune's starting point until a round is timed
 AUTO_INFLIGHT_LO, AUTO_INFLIGHT_HI = 2, 16
+
+
+def _process_count() -> int:
+    """Host count of the job — indirection point so tests can simulate a
+    multi-host loop without lying to the rest of jax (orbax checkpointing
+    also reads jax.process_count and would break under a global patch)."""
+    return jax.process_count()
 
 
 def measure_rtt_ms(samples: int = 5) -> float:
@@ -156,6 +163,14 @@ class RunStats:
     # in-flight depth the loop ended on (auto-tuned unless --max_inflight)
     rtt_ms: float = 0.0
     max_inflight_used: int = 0
+    # cohort degradation (bench.py resilience block): clients masked out of
+    # rounds (failed loads / injected drops), clients rejected by the
+    # sketch-space quarantine, rounds that ran degraded at all, and how deep
+    # the dropped-client re-queue got
+    clients_dropped: int = 0
+    clients_quarantined: int = 0
+    degraded_rounds: int = 0
+    requeue_depth_max: int = 0
 
 
 def make_save_ckpt(session: FederatedSession, checkpoint_dir: str):
@@ -163,10 +178,18 @@ def make_save_ckpt(session: FederatedSession, checkpoint_dir: str):
     watchdog's emergency save runs on a timer thread and must not race a
     scheduled/periodic save of the same round — both would target the same
     staging/final dirs), sharing the session's fault plan + retry policy so
-    per-site injection counters stay coherent across the whole run."""
+    per-site injection counters stay coherent across the whole run.
+
+    One writer per JOB, not per host: on a pod the checkpoint dir is shared
+    storage and every host holds the same replicated state, so only process
+    0 writes — two hosts saving the same round would build the identical
+    staging dir name and clobber each other's half-written trees. Non-zero
+    processes return None (callers treat it as 'nothing written here')."""
     lock = threading.Lock()
 
     def save_ckpt():
+        if jax.process_index() != 0:
+            return None
         with lock:
             return ckpt.save(
                 checkpoint_dir, session,
@@ -204,6 +227,14 @@ def run_loop(
     t0 = time.perf_counter()
     eval_every = max(cfg.eval_every, 1)
     start_round = session.round
+    # (client_* fault schedules are validated against the FULL run length by
+    # the CLIs — run_loop may legitimately cover a segment, e.g. bench arms)
+    # multi-host coordinated preemption: with > 1 process the LOCAL SIGTERM
+    # flag must not short-circuit the SPMD schedule (the un-signalled hosts
+    # would block in the next round's collectives) — every preemption
+    # decision goes through the cross-host max-reduce at block boundaries,
+    # where every host's collective call counts line up.
+    process_count = _process_count()
 
     if save_ckpt is None and cfg.checkpoint_dir:
         save_ckpt = make_save_ckpt(session, cfg.checkpoint_dir)
@@ -291,6 +322,14 @@ def run_loop(
         for m in session.commit_rounds(list(pending), hosts):
             last_m = m
             nonfinite_total += int(m.get("nonfinite_rounds", 0))
+            dropped = int(m.get("clients_dropped", 0))
+            quarantined = int(m.get("clients_quarantined", 0))
+            stats.clients_dropped += dropped
+            stats.clients_quarantined += quarantined
+            if dropped or quarantined:
+                stats.degraded_rounds += 1
+            stats.requeue_depth_max = max(
+                stats.requeue_depth_max, int(m.get("requeue_depth", 0)))
             for k, v in m.items():
                 if isinstance(v, (int, float)):
                     totals[k] += v
@@ -362,25 +401,37 @@ def run_loop(
                             if cfg.sync_loop:
                                 drain(watch=False)
                         rnd += 1
-                        if pre.triggered:
+                        if pre.triggered and process_count == 1:
                             break  # stop inside the block: the grace window
-                            # is short
+                            # is short. Multi-host: an early break would
+                            # desync this host's dispatch count from its
+                            # peers' (their collectives would hang), so the
+                            # flag waits for the coordinated boundary check.
+                # cross-host agreement on the preemption flag at the block
+                # boundary: every host sees "any host was signalled" and
+                # they all finish THIS round, checkpoint it, and exit 75
+                # together (single process: just the local flag)
+                preempt_now = (pre.triggered if process_count == 1
+                               else preemption.coordinated(pre.triggered))
                 if (pending_rounds
-                        and (pre.triggered
+                        and (preempt_now
                              or pending_rounds >= eff_inflight
                              or rnd >= cfg.total_rounds
                              or rnd % eval_every == 0
                              or (cfg.checkpoint_every
                                  and rnd % cfg.checkpoint_every == 0))):
                     drain()
-                if pre.triggered:
+                if preempt_now:
                     shutdown()
                     if save_ckpt:
+                        # make_save_ckpt already gates writes to process 0
+                        # (one writer per job; None = not this host's write)
                         path = save_ckpt()
-                        print(
-                            f"preemption: emergency checkpoint at round "
-                            f"{session.round}: {path}", flush=True,
-                        )
+                        if path:
+                            print(
+                                f"preemption: emergency checkpoint at round "
+                                f"{session.round}: {path}", flush=True,
+                            )
                     sys.exit(EXIT_RESUMABLE)
                 if nonfinite_total and cfg.on_nonfinite == "halt":
                     shutdown()
@@ -422,6 +473,9 @@ def run_loop(
             rng_state, rng_key = session.rng_snapshot
             session.rng.set_state(rng_state)
             session._rng_key = rng_key
+            # same discipline for the dropped-client re-queue: uncommitted
+            # prepares may have served (or grown) the live queue
+            session._requeue = collections.deque(session._requeue_committed)
     # shutdown() tolerates a stored async-save failure: the final
     # synchronous save below is the corrective action (it carries its own
     # retries), and an hours-old transient write error must not block it

@@ -40,6 +40,22 @@ from ..resilience import retry as rtry
 
 MANIFEST = "manifest.json"
 _TMP_PREFIX = ".tmp_round_"
+# restore_latest renames a checkpoint that failed verification/restore aside
+# to <name>.damaged: it stops being a restore candidate (no re-verifying a
+# known-bad tree on every resume), stops counting toward save()'s keep-N
+# pruning (damaged trees must not crowd out good ones), and is kept for
+# post-mortem — bounded by _gc_damaged (newest KEEP_DAMAGED survive).
+_DAMAGED_SUFFIX = ".damaged"
+KEEP_DAMAGED = 2
+
+
+def _round_dirs(ckpt_dir: str) -> list[str]:
+    """Restorable-candidate names, sorted: round_* (including .displaced
+    rename-aside copies — same round, same state) minus damaged ones."""
+    return sorted(
+        d for d in os.listdir(ckpt_dir)
+        if d.startswith("round_") and not d.endswith(_DAMAGED_SUFFIX)
+    )
 
 # process-wide count of committed checkpoints that FAILED the post-commit
 # read-back (save-time manifest verification): silent-bitrot-on-write media
@@ -134,6 +150,11 @@ def save(ckpt_dir: str, session, keep: int = 3, fault_plan=None,
         )
         comm_mb_total = float(session.comm_mb_total)
         num_workers = session.num_workers
+        # committed re-queue of dropped clients (cohort fault tolerance):
+        # like the RNG, the COMMITTED snapshot, not the live queue a
+        # prefetcher may already have served for uncommitted rounds
+        requeued = [int(i) for i in
+                    getattr(session, "_requeue_committed", ())]
     final = os.path.abspath(os.path.join(ckpt_dir, f"round_{rnd:08d}"))
     staging = os.path.abspath(os.path.join(ckpt_dir, f"{_TMP_PREFIX}{rnd:08d}"))
 
@@ -171,7 +192,8 @@ def save(ckpt_dir: str, session, keep: int = 3, fault_plan=None,
         # restore (it breaks exact replay).
         with open(os.path.join(staging, "meta.json"), "w") as f:
             json.dump({"comm_mb_total": comm_mb_total,
-                       "num_workers": num_workers}, f)
+                       "num_workers": num_workers,
+                       "requeued": requeued}, f)
         _write_manifest(staging)
         # overwrite (emergency save of a round already checkpointed): rename
         # the committed copy ASIDE first — a delete-then-rename would leave a
@@ -228,7 +250,7 @@ def latest(ckpt_dir: str) -> str | None:
     # would save fine and then crash every --resume
     if not os.path.isdir(ckpt_dir):
         return None
-    rounds = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("round_"))
+    rounds = _round_dirs(ckpt_dir)
     return os.path.abspath(os.path.join(ckpt_dir, rounds[-1])) if rounds else None
 
 
@@ -280,6 +302,12 @@ def restore(path: str, session) -> None:
         with open(meta_file) as f:
             meta = json.load(f)
         session.comm_mb_total = float(meta["comm_mb_total"])
+        if hasattr(session, "_requeue"):
+            import collections
+
+            requeued = [int(i) for i in meta.get("requeued", [])]
+            session._requeue = collections.deque(requeued)
+            session._requeue_committed = tuple(requeued)
         saved_w = meta.get("num_workers")
         if saved_w is not None and saved_w != session.num_workers:
             print(
@@ -295,21 +323,65 @@ def restore(path: str, session) -> None:
         session.comm_mb_total = session.round * session.comm_per_round["comm_total_mb"]
 
 
+def _set_aside_damaged(ckpt_dir: str, name: str) -> None:
+    """Rename a failed candidate to <name>.damaged: no longer a restore/
+    prune candidate (see _DAMAGED_SUFFIX), kept for post-mortem until
+    _gc_damaged reaps it."""
+    src = os.path.join(ckpt_dir, name)
+    dst = src + _DAMAGED_SUFFIX
+    try:
+        if os.path.isdir(dst):
+            shutil.rmtree(dst, ignore_errors=True)
+        os.rename(src, dst)
+    except OSError as e:
+        # best effort (read-only media, races): the restore fallback worked
+        # either way, the rename only dedupes future verification work
+        print(f"warning: could not set damaged checkpoint aside "
+              f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
+
+
+def _gc_damaged(ckpt_dir: str, keep: int = KEEP_DAMAGED) -> int:
+    """Bound the .damaged graveyard: keep the newest `keep`, delete the
+    rest, return the deletion count (loud). Without this, chaos runs with
+    ckpt_corrupt plans grow one immortal damaged tree per injection."""
+    names = sorted(d for d in os.listdir(ckpt_dir)
+                   if d.endswith(_DAMAGED_SUFFIX))
+    stale = names[:-keep] if keep > 0 else names
+    for name in stale:
+        shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+    if stale:
+        print(
+            f"checkpoint GC: deleted {len(stale)} damaged checkpoint(s) "
+            f"beyond the newest {keep} ({', '.join(stale)})",
+            file=sys.stderr, flush=True,
+        )
+    return len(stale)
+
+
 def restore_latest(ckpt_dir: str, session) -> str | None:
     """Restore the newest checkpoint that verifies AND restores, falling
-    back loudly past damaged ones. Returns the restored path, or None when
-    the directory holds no checkpoints (a fresh run). Raises when
-    checkpoints exist but ALL are unrecoverable — silently restarting a
-    long run from round 0 would be the worst outcome."""
+    back loudly past damaged ones — each failed candidate is renamed aside
+    to <name>.damaged (kept for post-mortem, garbage-collected beyond the
+    newest KEEP_DAMAGED) so later resumes never re-verify known-bad trees
+    and save()'s keep-N pruning never counts them. Returns the restored
+    path, or None when the directory holds no checkpoints (a fresh run).
+    Raises when checkpoints exist(ed) but ALL are unrecoverable — silently
+    restarting a long run from round 0 would be the worst outcome."""
     if not os.path.isdir(ckpt_dir):
         return None
-    rounds = sorted(
-        (d for d in os.listdir(ckpt_dir) if d.startswith("round_")),
-        reverse=True,
-    )
+    rounds = sorted(_round_dirs(ckpt_dir), reverse=True)
     if not rounds:
+        if any(d.endswith(_DAMAGED_SUFFIX) for d in os.listdir(ckpt_dir)):
+            # every checkpoint was already set aside as damaged by an
+            # earlier resume: this is NOT a fresh run, refuse round 0
+            raise RuntimeError(
+                f"no restorable checkpoint in {ckpt_dir}: only damaged "
+                "checkpoints remain (set aside by a previous restore)"
+            )
         return None
-    for i, name in enumerate(rounds):
+    restored_path = None
+    skipped = 0
+    for name in rounds:
         path = os.path.abspath(os.path.join(ckpt_dir, name))
         if verify(path) is False:
             print(
@@ -318,6 +390,8 @@ def restore_latest(ckpt_dir: str, session) -> str | None:
                 "verified-good checkpoint",
                 file=sys.stderr, flush=True,
             )
+            _set_aside_damaged(ckpt_dir, name)
+            skipped += 1
             continue
         try:
             restore(path, session)
@@ -328,24 +402,31 @@ def restore_latest(ckpt_dir: str, session) -> str | None:
                 "verified-good checkpoint",
                 file=sys.stderr, flush=True,
             )
+            _set_aside_damaged(ckpt_dir, name)
+            skipped += 1
             continue
-        if i > 0:
-            print(
-                f"recovered: restored {path} after skipping {i} damaged "
-                "checkpoint(s)",
-                file=sys.stderr, flush=True,
-            )
-        return path
-    raise RuntimeError(
-        f"no restorable checkpoint in {ckpt_dir}: all {len(rounds)} "
-        "candidates failed verification or restore"
-    )
+        restored_path = path
+        break
+    _gc_damaged(ckpt_dir)
+    if restored_path is None:
+        raise RuntimeError(
+            f"no restorable checkpoint in {ckpt_dir}: all {len(rounds)} "
+            "candidates failed verification or restore"
+        )
+    if skipped:
+        print(
+            f"recovered: restored {restored_path} after skipping {skipped} "
+            "damaged checkpoint(s)",
+            file=sys.stderr, flush=True,
+        )
+    return restored_path
 
 
 def _prune(ckpt_dir: str, keep: int):
-    names = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("round_"))
+    names = _round_dirs(ckpt_dir)  # damaged trees never count toward keep
     stale = names[:-keep] if keep > 0 else []
     # abandoned staging dirs (crash mid-write) are dead weight: sweep them
     stale += [d for d in os.listdir(ckpt_dir) if d.startswith(_TMP_PREFIX)]
     for name in stale:
         shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+    _gc_damaged(ckpt_dir)

@@ -69,6 +69,14 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                    help="per-round probability each sampled client drops "
                         "before aggregation (straggler simulation; the "
                         "reference has none — a dead worker hangs it)")
+    p.add_argument("--client_update_clip", type=float, default=0.0,
+                   help="sketch-space quarantine: reject any client whose "
+                        "update L2 exceeds this multiple of the running "
+                        "median of live client norms (non-finite updates "
+                        "always rejected) — the client is zeroed out of the "
+                        "merge and removed from the renormalization, so one "
+                        "poisoned update costs one client, not the round. "
+                        "Counted per round as clients_quarantined. 0 = off")
     p.add_argument("--rounds_per_dispatch", type=int, default=1,
                    help="> 1 compiles this many rounds into one program "
                         "(lax.scan) with a single host sync per block — "
@@ -135,7 +143,13 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "(eval loader), nonfinite[:value="
                         "inf] (NaN/Inf gradient burst), ckpt_fail:times=N / "
                         "ckpt_corrupt / ckpt_partial (checkpoint IO), "
-                        "dist_init:times=N (distributed bootstrap), seed=N. "
+                        "dist_init:times=N (distributed bootstrap), "
+                        "client_drop:clients=I+J / client_straggle:clients="
+                        "I,secs=S / client_poison:clients=I,value=nan|inf|"
+                        "big (cohort-level: mask/stall/poison individual "
+                        "clients inside the round), host_preempt:host=K "
+                        "(SIGTERM one simulated host; the cross-host "
+                        "barrier carries it to all), seed=N. "
                         "Unset = zero injection, zero behavior change")
     p.add_argument("--on_nonfinite", default="skip",
                    choices=["off", "skip", "halt"],

@@ -165,6 +165,7 @@ def build(args, fault_plan=None, retry_policy=None):
         dp_clip=args.dp_clip,
         dp_noise=args.dp_noise,
         client_dropout=args.client_dropout,
+        client_update_clip=args.client_update_clip,
         split_compile=args.split_compile,
         client_chunk=args.client_chunk,
         on_nonfinite=args.on_nonfinite,
@@ -253,6 +254,10 @@ def main(argv=None):
 
     rounds_per_epoch = max(1, math.ceil(args.num_clients / session.num_workers))
     total_rounds = args.num_rounds or int(args.num_epochs * rounds_per_epoch)
+    if fault_plan is not None:
+        # launch-time schedule check: a client_* site at round >=
+        # total_rounds could never fire (a vacuous chaos run)
+        fault_plan.validate_rounds(total_rounds)
     opt = FedOptimizer(triangular(args.lr_scale, args.pivot_epoch, args.num_epochs),
                        rounds_per_epoch)
     model = FedModel(session)

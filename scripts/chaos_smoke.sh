@@ -13,12 +13,19 @@
 #
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 #        scripts/chaos_smoke.sh supervisor
+#        scripts/chaos_smoke.sh cohort
 #
 # `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
 # restartPolicy would: it launches the tiny cv_train run with a fault plan
 # that SIGTERMs it twice (rounds 1 and 3) and relaunches with --resume in a
 # loop while the child exits 75 (EX_TEMPFAIL, the resumable contract),
 # asserting the run eventually finishes cleanly after >= 1 relaunch.
+#
+# `cohort` mode drives the cohort-level fault tolerance through the ASYNC
+# runner end-to-end: a client_drop (masked + re-queued) and a client_poison
+# (rejected by the --client_update_clip quarantine) inside one short run,
+# asserting the run finishes all rounds with finite params, the dropped
+# client served back, and exactly one quarantined client. < 2 min on CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -105,6 +112,78 @@ EOF
     fi
     echo "supervisor: PASS (preempt -> exit 75 -> --resume x$relaunches, clean finish)"
     exit 0
+fi
+
+if [[ "${1:-}" == "cohort" ]]; then
+    shift
+    exec timeout -k 10 "${CHAOS_TIMEOUT_S:-300}" python - "$@" <<'EOF'
+# cohort chaos child: the real cv_train.main CLI path (async runner) with
+# the tiny-model substitution the chaos tests use, a client_drop + a
+# client_poison in the plan, and the quarantine armed.
+import numpy as np
+
+import flax.linen as nn
+
+import commefficient_tpu.data.cifar as cifar
+import cv_train
+from commefficient_tpu.runner import loop as rloop
+
+
+class _TinyNet(nn.Module):
+    num_classes: int = 10
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+_orig = cifar.load_cifar_fed
+
+
+def _tiny(*a, **kw):
+    kw.update(synthetic_train=64, synthetic_test=32)
+    return _orig(*a, **kw)
+
+
+cv_train.ResNet9 = _TinyNet
+cv_train.load_cifar_fed = _tiny
+
+stats_box = {}
+_orig_loop = rloop.run_loop
+
+
+def _capture(*a, **kw):
+    stats = _orig_loop(*a, **kw)
+    stats_box["stats"] = stats
+    return stats
+
+
+cv_train.run_loop = _capture
+
+session = cv_train.main([
+    "--dataset", "cifar10", "--mode", "uncompressed", "--num_clients", "8",
+    "--num_workers", "2", "--local_batch_size", "4", "--lr_scale", "0.05",
+    "--weight_decay", "0", "--data_root", "/nonexistent",
+    "--num_rounds", "5", "--client_update_clip", "10",
+    "--fault_plan", "client_drop@1:clients=0;client_poison@2:clients=1,value=big",
+])
+stats = stats_box["stats"]
+assert session.round == 5, session.round
+assert len(session._requeue) == 0, "dropped client never served back"
+import jax
+from jax.flatten_util import ravel_pytree
+flat = np.asarray(ravel_pytree(jax.device_get(session.state["params"]))[0])
+assert np.isfinite(flat).all(), "params went non-finite through the chaos run"
+assert stats.clients_dropped == 1, stats
+assert stats.clients_quarantined == 1, stats
+assert stats.degraded_rounds == 2, stats
+assert stats.requeue_depth_max == 1, stats
+print(f"cohort: PASS (drop masked+requeued, poison quarantined, "
+      f"{stats.rounds} rounds clean; degraded_rounds={stats.degraded_rounds})")
+EOF
 fi
 
 exec timeout -k 10 "${CHAOS_TIMEOUT_S:-600}" \

@@ -6,8 +6,11 @@
 #
 # Covers: mesh-vs-single-device bit parity (3 mode configs), split-vs-fused,
 # hybrid DCN mesh, K-round blocks, checkpoint+resume mid-run on the sharded
-# path, mesh spec parsing, runner auto-inflight policy — plus the engine's
-# existing mesh suite and the bench mesh section's graceful degradation.
+# path, mesh spec parsing, runner auto-inflight policy — plus the cohort
+# fault-tolerance slice (test_cohort_faults.py: masked-cohort bit parity on
+# the mesh path, sketch-space quarantine mesh == single-device), the
+# engine's existing mesh suite and the bench mesh section's graceful
+# degradation.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -15,8 +18,8 @@ export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 python -m pytest tests/test_sharded_round.py tests/test_engine.py \
-    tests/test_client_state_sharding.py -q -m 'not slow' \
-    -p no:cacheprovider "$@"
+    tests/test_client_state_sharding.py tests/test_cohort_faults.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
 
 # bench mesh section must degrade to {"skipped": ...} on ONE device (the
 # real-chip driver path) instead of erroring: assert exactly that, cheaply.

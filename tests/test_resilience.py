@@ -541,6 +541,263 @@ def test_emergency_save_mid_round_keeps_rng_consistent(tiny_cv, tmp_path):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+# --------------------------------------- chaos: cohort-level fault tolerance
+
+
+@pytest.mark.chaos
+def test_client_drop_degrades_round_and_requeues(tiny_cv):
+    """An injected client_drop degrades ONE round (participants down by the
+    dropped count, clients_dropped counted, requeue depth visible) and the
+    dropped client is served back into the next cohort instead of losing its
+    data; training continues normally."""
+    s, _ = cv_train.build(
+        _args(("--fault_plan", "client_drop@1:clients=0")))
+    W = s.num_workers  # the 8-way CPU mesh rounds the cohort up to 8
+    m0 = s.run_round(LR)
+    assert m0["participants"] == W and m0["clients_dropped"] == 0.0
+    m1 = s.run_round(LR)
+    assert m1["clients_dropped"] == 1.0
+    assert m1["participants"] == W - 1
+    assert m1["requeue_depth"] == 1.0
+    assert len(s._requeue) == 1
+    m2 = s.run_round(LR)  # the queued client is substituted into round 2
+    assert m2["requeue_depth"] == 0.0 and len(s._requeue) == 0
+    assert m2["participants"] == W
+    assert np.isfinite(_snap(s)[0]).all()
+
+
+@pytest.mark.chaos
+def test_overlapping_drop_specs_requeue_each_client_once(tiny_cv):
+    """Two client_drop specs naming the same position in the same round must
+    queue that client ONCE — a double-queued id would displace two sampled
+    clients in later rounds and train the same shard twice."""
+    s, _ = cv_train.build(_args((
+        "--fault_plan", "client_drop@1:clients=0;client_drop@1:clients=0+2")))
+    s.run_round(LR)
+    m = s.run_round(LR)
+    assert m["clients_dropped"] == 2.0
+    assert len(s._requeue) == len(set(s._requeue)) == 2
+
+
+@pytest.mark.chaos
+def test_periodic_saves_gated_to_process_zero(tiny_cv, tmp_path, monkeypatch):
+    """make_save_ckpt is the one-writer-per-job gate for EVERY save the
+    runner schedules (periodic, halt, final, emergency — not just the
+    preemption path): a non-zero process writes nothing and returns None."""
+    from commefficient_tpu.runner.loop import make_save_ckpt
+
+    s, _ = cv_train.build(_args())
+    s.run_round(LR)
+    ckdir = str(tmp_path / "ck")
+    save = make_save_ckpt(s, ckdir)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    assert save() is None and not os.path.isdir(ckdir)
+    monkeypatch.undo()
+    path = save()  # process 0: the real write
+    assert path and ckpt.verify(path) is True
+
+
+@pytest.mark.chaos
+def test_cli_rejects_unreachable_client_fault_schedule(tiny_cv):
+    """A client_* site scheduled past the run's end fails at LAUNCH (the CLI
+    validates against the full run length), not silently never-fires."""
+    with pytest.raises(ValueError, match="can never fire"):
+        cv_train.main(_argv(
+            ("--num_rounds", "3", "--fault_plan", "client_drop@5:clients=0")))
+
+
+@pytest.mark.chaos
+def test_client_straggle_is_slow_but_bit_transparent(tiny_cv):
+    """A straggling client stalls its round's preparation (watchdog/overlap
+    fodder) but changes no bits: the run equals the un-faulted run exactly."""
+    a, _ = cv_train.build(_args())
+    b, _ = cv_train.build(
+        _args(("--fault_plan", "client_straggle@1:clients=0,secs=0.3")))
+    for _ in range(2):
+        a.run_round(LR)
+    t0 = time.monotonic()
+    for _ in range(2):
+        b.run_round(LR)
+    assert time.monotonic() - t0 >= 0.3
+    for x, y in zip(
+        jax.tree.leaves(jax.device_get(a.state["params"])),
+        jax.tree.leaves(jax.device_get(b.state["params"])),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.chaos
+def test_client_poison_quarantined_like_a_drop(tiny_cv):
+    """The quarantine acceptance pin through the real CLI path: a
+    client_poison update (adversarially large, through the real gradients)
+    is rejected with params bit-equal to the run where that client is
+    DROPPED instead — and the identical clean run quarantines nothing."""
+    clip = ("--client_update_clip", "10")
+    a, _ = cv_train.build(_args((
+        *clip, "--fault_plan", "client_poison@1:clients=1,value=big")))
+    ma = [a.run_round(LR) for _ in range(2)]
+    assert [m["clients_quarantined"] for m in ma] == [0.0, 1.0]
+    assert ma[1]["participants"] == a.num_workers - 1
+    assert np.isfinite(_snap(a)[0]).all()
+
+    b, _ = cv_train.build(_args((
+        *clip, "--fault_plan", "client_drop@1:clients=1")))
+    for _ in range(2):
+        b.run_round(LR)
+    for x, y in zip(
+        jax.tree.leaves(jax.device_get(a.state["params"])),
+        jax.tree.leaves(jax.device_get(b.state["params"])),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    c, _ = cv_train.build(_args(clip))  # clean run, quarantine armed
+    mc = [c.run_round(LR) for _ in range(2)]
+    assert all(m["clients_quarantined"] == 0.0 for m in mc)
+    assert all(m["participants"] == c.num_workers for m in mc)
+
+
+@pytest.mark.chaos
+def test_client_drop_resume_mid_degraded_run_bit_identical(tiny_cv, tmp_path):
+    """Checkpoint + resume MID-degraded-run: preempted in the same round the
+    drop fired, the re-queue state rides the checkpoint (meta.json), so the
+    resumed run serves the dropped client at the same later round the
+    uninterrupted run does — final params bit-identical."""
+    base = _argv(("--num_rounds", "6"))
+    fault = "client_drop@2:clients=0"
+    sa = cv_train.main(base + ["--fault_plan", fault])
+    assert sa.round == 6
+    params_a = jax.device_get(sa.state["params"])
+
+    ckdir = str(tmp_path / "ck")
+    chaos = ["--checkpoint_dir", ckdir,
+             "--fault_plan", f"{fault};preempt@2"]
+    with pytest.raises(SystemExit) as ei:
+        cv_train.main(base + chaos)
+    assert ei.value.code == EXIT_RESUMABLE
+    # the emergency checkpoint carries the un-served re-queue
+    import json
+
+    latest = sorted(d for d in os.listdir(ckdir)
+                    if d.startswith("round_") and "." not in d)[-1]
+    with open(os.path.join(ckdir, latest, "meta.json")) as f:
+        assert len(json.load(f)["requeued"]) == 1
+
+    sc = cv_train.main(base + chaos + ["--resume"])
+    assert sc.round == 6
+    for x, y in zip(
+        jax.tree.leaves(params_a),
+        jax.tree.leaves(jax.device_get(sc.state["params"])),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.chaos
+def test_host_preempt_fires_only_on_matching_host(tiny_cv, tmp_path):
+    """host_preempt targets ONE simulated host by jax.process_index(): host=0
+    preempts this (single-process, index 0) run through the coordinated
+    path; host=1 does not exist in a single-process job and is rejected at
+    LAUNCH (an unfireable site = a vacuous chaos run), as is a round past
+    the run's end."""
+    base = _argv(("--num_rounds", "4"))
+    ck = ["--checkpoint_dir", str(tmp_path / "ck")]
+    with pytest.raises(SystemExit) as ei:
+        cv_train.main(base + ck + ["--fault_plan", "host_preempt@1:host=0"])
+    assert ei.value.code == EXIT_RESUMABLE
+    with pytest.raises(ValueError, match="can never fire"):
+        cv_train.main(base + ["--fault_plan", "host_preempt@1:host=1"])
+    with pytest.raises(ValueError, match="can never fire"):
+        cv_train.main(base + ["--fault_plan", "host_preempt@9:host=0"])
+
+
+@pytest.mark.chaos
+def test_coordinated_preemption_stops_unsignalled_host(tiny_cv, tmp_path,
+                                                       monkeypatch):
+    """The multi-host acceptance pin, simulated: this 'host' receives NO
+    SIGTERM, but the cross-host max-reduce reports a peer was signalled —
+    the loop must still drain, checkpoint the agreed round, and exit 75
+    (without agreement this host would run to completion while the
+    signalled peer exited, desyncing the job)."""
+    from commefficient_tpu.parallel import distributed
+    from commefficient_tpu.runner import loop as rloop
+
+    calls = {"n": 0}
+
+    def fake_all_hosts_max(v):
+        calls["n"] += 1
+        return 1 if calls["n"] >= 3 else int(v)
+
+    monkeypatch.setattr(rloop, "_process_count", lambda: 2)
+    monkeypatch.setattr(distributed, "all_hosts_max", fake_all_hosts_max)
+    ckdir = str(tmp_path / "ck")
+    with pytest.raises(SystemExit) as ei:
+        cv_train.main(_argv(("--num_rounds", "8", "--checkpoint_dir", ckdir)))
+    assert ei.value.code == EXIT_RESUMABLE
+    assert calls["n"] >= 3  # the agreement ran at round boundaries
+    names = sorted(d for d in os.listdir(ckdir) if d.startswith("round_"))
+    assert names and names[-1] == "round_00000003"  # the agreed round
+    assert ckpt.verify(os.path.join(ckdir, names[-1])) is True
+
+
+# ------------------------------------------- chaos: damaged-checkpoint GC
+
+
+@pytest.mark.chaos
+def test_damaged_checkpoints_set_aside_and_garbage_collected(
+        tiny_cv, tmp_path, capsys):
+    """restore_latest renames failed candidates to *.damaged (they stop
+    being restore/prune candidates) and bounds the graveyard to the newest
+    KEEP_DAMAGED, counting deletions — chaos ckpt_corrupt runs no longer
+    accumulate damaged trees unboundedly."""
+    ckdir = str(tmp_path / "ck")
+    s, _ = cv_train.build(_args())
+    for _ in range(3):
+        s.run_round(LR)
+        ckpt.save(ckdir, s)
+    names = sorted(d for d in os.listdir(ckdir) if d.startswith("round_"))
+    for name in names[-2:]:  # damage the newest two
+        t = FaultPlan._largest_data_file(os.path.join(ckdir, name))
+        with open(t, "r+b") as f:
+            f.truncate(os.path.getsize(t) // 2)
+
+    s2, _ = cv_train.build(_args())
+    restored = ckpt.restore_latest(ckdir, s2)
+    assert restored.endswith(names[0]) and s2.round == 1
+    damaged = sorted(d for d in os.listdir(ckdir) if d.endswith(".damaged"))
+    assert damaged == [f"{names[-2]}.damaged", f"{names[-1]}.damaged"]
+    # damaged trees are no longer candidates: latest() sees only the good one
+    assert ckpt.latest(ckdir) == os.path.abspath(os.path.join(ckdir, names[0]))
+
+    # a third damaged checkpoint pushes past KEEP_DAMAGED=2: GC deletes the
+    # oldest, loudly
+    for _ in range(3):
+        s2.run_round(LR)
+    p4 = ckpt.save(ckdir, s2)  # round_00000004
+    t = FaultPlan._largest_data_file(p4)
+    with open(t, "r+b") as f:
+        f.truncate(os.path.getsize(t) // 2)
+    s3, _ = cv_train.build(_args())
+    ckpt.restore_latest(ckdir, s3)
+    err = capsys.readouterr().err
+    assert "checkpoint GC: deleted 1 damaged" in err
+    damaged = sorted(d for d in os.listdir(ckdir) if d.endswith(".damaged"))
+    assert len(damaged) == 2 and f"{names[-2]}.damaged" not in damaged
+
+
+@pytest.mark.chaos
+def test_all_damaged_dir_refuses_fresh_restart(tiny_cv, tmp_path):
+    """A directory whose every checkpoint was set aside as damaged is NOT a
+    fresh run: a later resume must refuse to silently restart from round 0."""
+    ckdir = str(tmp_path / "ck")
+    s, _ = cv_train.build(_args(("--fault_plan", "ckpt_corrupt@1")))
+    s.run_round(LR)
+    ckpt.save(ckdir, s, fault_plan=s.fault_plan)
+    s2, _ = cv_train.build(_args())
+    with pytest.raises(RuntimeError, match="no restorable checkpoint"):
+        ckpt.restore_latest(ckdir, s2)  # renames the only candidate aside
+    with pytest.raises(RuntimeError, match="only damaged"):
+        ckpt.restore_latest(ckdir, s2)  # second resume: still not "fresh"
+
+
 # ------------------------------------- chaos: the headline preempt -> resume
 
 

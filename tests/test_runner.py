@@ -21,9 +21,7 @@ import pytest
 import jax
 
 import cv_train
-from commefficient_tpu.resilience import (
-    EXIT_RESUMABLE, InjectedTransientError,
-)
+from commefficient_tpu.resilience import EXIT_RESUMABLE
 from commefficient_tpu.runner import AsyncCheckpointWriter, RoundPrefetcher
 from commefficient_tpu.utils import checkpoint as ckpt
 from commefficient_tpu.utils.config import make_parser, resolve_defaults
@@ -196,18 +194,30 @@ def test_prefetcher_serves_rounds_in_order(tiny_cv):
         np.testing.assert_array_equal(np.asarray(pa.sub), np.asarray(pb.sub))
 
 
-def test_prefetcher_propagates_loader_error(tiny_cv):
-    """Retry exhaustion on the prefetch thread re-raises at next(), as
-    loudly as the inline loop would."""
+def test_prefetcher_degrades_exhausted_loader_to_masked_cohort(tiny_cv):
+    """Retry exhaustion no longer kills the run (cohort fault tolerance):
+    the prepared round comes back fully masked (validity all zero, zero
+    batch) with every cohort id re-queued for a later round — on the
+    prefetch thread exactly as inline."""
+    from commefficient_tpu.federated import engine
+
     b, _ = cv_train.build(
         _args(("--fault_plan", "data_fail@0:times=99", "--max_retries", "1"))
     )
     src = RoundPrefetcher(b, 0, depth=2)
     try:
-        with pytest.raises(InjectedTransientError):
-            src.next()
+        prep = src.next()
     finally:
         src.stop()
+    assert prep.masked == b.num_workers
+    np.testing.assert_array_equal(
+        np.asarray(prep.batch[engine.VALID_KEY]),
+        np.zeros(b.num_workers, np.float32))
+    assert prep.requeue_depth == b.num_workers
+    assert sorted(prep.requeue) == sorted(int(i) for i in prep.ids)
+    # the degraded round still runs: fully-dropped-cohort semantics
+    m = b.commit_round(b.dispatch_round(prep, 0.05))[0]
+    assert m["participants"] == 0.0 and m["clients_dropped"] == b.num_workers
 
 
 def test_prefetcher_stop_unblocks_producer(tiny_cv):
