@@ -1,0 +1,95 @@
+"""graftlint — project-aware static analysis for the commefficient-tpu repo.
+
+Four PRs of growth accumulated a set of load-bearing invariants that lived
+only as reviewer lore; this package enforces them mechanically, as an AST
+pass over the source (no imports, no jax, runs anywhere in < 10 s):
+
+====  =========================================  ================================
+code  name                                       contract it enforces
+====  =========================================  ================================
+G001  host-sync-in-round-path                    no hidden host sync (device_get
+                                                 / .item() / np.asarray / float()
+                                                 on traced values) on the round
+                                                 dispatch path outside declared
+                                                 drain points
+G002  unordered-reduction-in-parity-scope        the sketch-merge bit-parity rule:
+                                                 no psum/psum_scatter/all_reduce
+                                                 in parity-pinned modules — the
+                                                 cross-device merge is all_gather
+                                                 + ORDERED sum (csvec.merge_tables)
+G003  reserved-leaf-access                       the `_valid` reserved batch leaf
+                                                 is consumed only via
+                                                 engine.split_valid (and the
+                                                 faults module that injects it)
+G004  raw-checkpoint-write                       checkpoint dirs are written only
+                                                 through utils/checkpoint.py's
+                                                 atomic staging+rename+manifest
+                                                 helpers
+G005  donation-after-use                         arguments listed in a jit's
+                                                 donate_argnums are dead after
+                                                 the call — referencing them
+                                                 reads deleted buffers on TPU
+G006  rng-key-reuse                              a PRNG key feeds ONE consumer;
+                                                 derive with split/fold_in before
+                                                 the next draw
+G007  blocking-call-on-dispatch-thread           no time.sleep / sync file IO /
+                                                 subprocess reachable from the
+                                                 runner's prefetch/dispatch path
+G008  unvalidated-config-read                    engine/runner code reads only
+                                                 args.<flag> names registered
+                                                 through utils/config.py
+====  =========================================  ================================
+
+Run it:
+
+    python -m commefficient_tpu.analysis commefficient_tpu/ [--json]
+    scripts/lint.sh          # graftlint + ruff + mypy, LINT_SKIP=1 to skip
+
+Suppress a site:
+
+    x = np.asarray(dev)  # graftlint: disable=G001 — host-side by construction
+
+(the justification text after the code is free-form but encouraged; an
+unknown rule code in a directive is itself an error, G000). Functions that
+ARE the sanctioned host-sync boundary carry `# graftlint: drain-point` on
+the line above their `def` — G001/G007 go silent for the whole function.
+Grandfathered sites live in `analysis/baseline.json` (`--write-baseline`
+regenerates it; stale entries are reported so the baseline only shrinks).
+
+Adding a rule (~50 LoC): subclass `core.Rule` in a `rules_*` module, give it
+`code`/`name`/`applies()`/`check()`, append it to `ALL_RULES` below, add a
+violating + conforming fixture pair under tests/fixtures/lint/ and a line to
+the README table. Fixture snippets impersonate an in-scope module with a
+`# graftlint: module=commefficient_tpu/...` directive.
+"""
+
+from __future__ import annotations
+
+from .core import Analyzer, Rule, SourceFile, Violation
+from .rules_config import UnvalidatedConfigRead
+from .rules_dataflow import DonationAfterUse, RngKeyReuse
+from .rules_io import RawCheckpointWrite
+from .rules_parity import ReservedLeafAccess, UnorderedReduction
+from .rules_sync import BlockingCallOnDispatchThread, HostSyncInRoundPath
+
+ALL_RULES: tuple[type[Rule], ...] = (
+    HostSyncInRoundPath,
+    UnorderedReduction,
+    ReservedLeafAccess,
+    RawCheckpointWrite,
+    DonationAfterUse,
+    RngKeyReuse,
+    BlockingCallOnDispatchThread,
+    UnvalidatedConfigRead,
+)
+
+RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)
+
+__all__ = [
+    "ALL_RULES",
+    "RULE_CODES",
+    "Analyzer",
+    "Rule",
+    "SourceFile",
+    "Violation",
+]

@@ -1,0 +1,103 @@
+"""CLI: `python -m commefficient_tpu.analysis [paths] [--json] ...`.
+
+Exit status: 0 clean (after suppressions + baseline), 1 violations found,
+2 usage/internal error. `--write-baseline` grandfathers the CURRENT
+findings (G002/G003/G004 refuse grandfathering — those contracts admit
+none) and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import ALL_RULES, RULE_CODES
+from .baseline import DEFAULT_BASELINE, Baseline
+from .core import Analyzer
+from .report import render_json, render_text
+
+# contracts that admit NO grandfathering: parity, reserved leaf, raw
+# checkpoint writes — a violation is a bug today, not debt
+NO_BASELINE_CODES = ("G002", "G003", "G004")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m commefficient_tpu.analysis",
+        description="graftlint: project-aware static analysis "
+                    f"({', '.join(RULE_CODES)})",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to analyze (default: the "
+                        "commefficient_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file (default: analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report grandfathered sites)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="grandfather current findings into --baseline "
+                        "and exit 0 (G002/G003/G004 are never written)")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--report-json", default="", metavar="PATH",
+                   help="additionally write the JSON report to PATH (one "
+                        "analysis run serves both the human text and the "
+                        "archived report)")
+    args = p.parse_args(argv)
+
+    if args.write_baseline and args.select:
+        # a partial-rule rewrite would silently discard every OTHER rule's
+        # grandfathered entries (Baseline.write replaces the whole file)
+        print("--write-baseline cannot be combined with --select: the "
+              "baseline is rewritten whole", file=sys.stderr)
+        return 2
+
+    rules = list(ALL_RULES)
+    if args.select:
+        wanted = {c.strip() for c in args.select.split(",") if c.strip()}
+        unknown = wanted - set(RULE_CODES)
+        if unknown:
+            print(f"unknown rule code(s): {', '.join(sorted(unknown))} "
+                  f"(valid: {', '.join(RULE_CODES)})", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.code in wanted]
+
+    paths = args.paths or None
+    if not paths:
+        import os
+
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+    baseline = (Baseline.empty() if args.no_baseline or args.write_baseline
+                else Baseline.load(args.baseline))
+    try:
+        result = Analyzer(rules=rules, baseline=baseline).run(paths)
+    except (OSError, ValueError) as e:
+        print(f"graftlint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        keep = [v for v in result.violations
+                if v.code not in NO_BASELINE_CODES and v.code != "G000"]
+        refused = len(result.violations) - len(keep)
+        Baseline.write(args.baseline, keep)
+        print(f"graftlint: wrote {len(keep)} baseline entr"
+              f"{'y' if len(keep) == 1 else 'ies'} to {args.baseline}"
+              + (f" (refused {refused}: G000/G002/G003/G004 must be fixed, "
+                 "not grandfathered)" if refused else ""))
+        return 0
+
+    if args.report_json:
+        with open(args.report_json, "w", encoding="utf-8") as f:
+            render_json(result, f)
+    if args.as_json:
+        render_json(result, sys.stdout)
+    else:
+        render_text(result, sys.stdout)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

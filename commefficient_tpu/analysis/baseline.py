@@ -1,0 +1,92 @@
+"""Grandfathered-violation baseline (`analysis/baseline.json`).
+
+An entry matches a violation by (rel path, code, stripped line text) — NOT
+by line number, so unrelated edits above a grandfathered site don't
+invalidate it, while any edit to the offending line itself (or a new copy of
+the pattern elsewhere in the file beyond the granted count) resurfaces the
+violation. Entries that matched nothing are reported as stale: the baseline
+is designed to only ever shrink. The acceptance bar for this repo is that
+G002/G003/G004 (parity, reserved-leaf, raw-checkpoint-write) carry ZERO
+baseline entries — those contracts admit no grandfathering.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover — type-only import cycle guard
+    from .core import Violation
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+class Baseline:
+    def __init__(self, entries: list[dict[str, str]], path: str | None = None):
+        self.path = path
+        self.entries = entries
+        # (rel, code, line_text) -> granted count; consumed by matches()
+        self._budget: collections.Counter[tuple[str, str, str]] = (
+            collections.Counter(self._key(e) for e in entries))
+        self._used: collections.Counter[tuple[str, str, str]] = (
+            collections.Counter())
+
+    @staticmethod
+    def _key(entry: dict[str, str]) -> tuple[str, str, str]:
+        return (entry["path"], entry["code"], entry["line"].strip())
+
+    @classmethod
+    def empty(cls) -> "Baseline":
+        return cls([])
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls([], path=path)
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        entries = data.get("entries", [])
+        for e in entries:
+            missing = {"path", "code", "line"} - set(e)
+            if missing:
+                raise ValueError(
+                    f"baseline {path}: entry {e!r} missing {sorted(missing)}")
+        return cls(entries, path=path)
+
+    def matches(self, v: "Violation") -> bool:
+        key = (v.rel, v.code, v.line_text.strip())
+        if self._used[key] < self._budget[key]:
+            self._used[key] += 1
+            return True
+        return False
+
+    def stale(self) -> list[dict[str, str]]:
+        """Entries whose budget was never (fully) consumed this run."""
+        out: list[dict[str, str]] = []
+        leftover = {
+            k: self._budget[k] - self._used[k]
+            for k in self._budget if self._budget[k] > self._used[k]
+        }
+        for key, n in sorted(leftover.items()):
+            out.extend(
+                [{"path": key[0], "code": key[1], "line": key[2]}] * n)
+        return out
+
+    @staticmethod
+    def write(path: str, violations: list["Violation"]) -> None:
+        """Regenerate a baseline from the current findings. Every entry
+        should carry a `why` a human wrote — the writer seeds it with the
+        enclosing symbol so a naked regeneration is at least attributable."""
+        entries = [
+            {
+                "path": v.rel, "code": v.code, "line": v.line_text.strip(),
+                "why": f"grandfathered in {v.symbol} — justify or fix",
+            }
+            for v in sorted(
+                violations, key=lambda v: (v.rel, v.lineno, v.code))
+        ]
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "entries": entries}, f, indent=2)
+            f.write("\n")

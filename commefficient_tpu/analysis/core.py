@@ -1,0 +1,307 @@
+"""graftlint engine: source model, rule protocol, and the analyzer driver.
+
+Pure `ast` — no imports of the analyzed code, no jax, so the suite runs in a
+bare CPU environment in seconds and can never be broken by a backend.
+
+The unit a rule sees is a `SourceFile`: parsed tree, raw lines, directive
+state, and a function index (qualnames, spans, enclosing-function lookup,
+drain-point marks). Rules are stateless classes with `applies(rel)` scoping
+and `check(src) -> [Violation]`; the `Analyzer` owns file loading, directive
+suppression, and baseline matching, so a rule only ever reports raw findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Iterator
+
+from . import directives
+from .baseline import Baseline
+
+PACKAGE = "commefficient_tpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding. `rel` is the project-relative posix path (what the
+    baseline and reports key on); `symbol` the enclosing function qualname
+    (or '<module>')."""
+
+    code: str
+    name: str
+    rel: str
+    lineno: int
+    col: int
+    message: str
+    fixit: str
+    line_text: str
+    symbol: str
+
+    def format(self) -> str:
+        return (f"{self.rel}:{self.lineno}:{self.col}: {self.code} "
+                f"[{self.name}] {self.message}\n"
+                f"    {self.line_text.strip()}\n"
+                f"    fix: {self.fixit}")
+
+    def as_json(self) -> dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionInfo:
+    qualname: str
+    start: int  # first decorator line (or the def line)
+    def_lineno: int
+    end: int
+    drain_point: bool
+
+
+class SourceFile:
+    """A parsed module plus everything rules commonly need from it."""
+
+    def __init__(self, path: str, rel: str, text: str,
+                 valid_codes: frozenset[str]):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.directives = directives.parse(text, valid_codes)
+        if self.directives.module_override:
+            rel = self.directives.module_override
+        self.rel = rel.replace(os.sep, "/")
+        self.functions = self._index_functions()
+        self.module_aliases = self._index_imports()
+
+    # -- function index ------------------------------------------------------
+
+    def _index_functions(self) -> list[FunctionInfo]:
+        out: list[FunctionInfo] = []
+
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    start = min(
+                        [child.lineno]
+                        + [d.lineno for d in child.decorator_list]
+                    )
+                    end = child.end_lineno or child.lineno
+                    # drain-point: marker on the def/decorator lines or in
+                    # the contiguous comment block directly above them
+                    cand = set(range(start, child.lineno + 1))
+                    ln = start - 1
+                    while ln >= 1 and self.line(ln).lstrip().startswith("#"):
+                        cand.add(ln)
+                        ln -= 1
+                    drain = bool(cand & self.directives.drain_linenos)
+                    out.append(FunctionInfo(qual, start, child.lineno, end,
+                                            drain))
+                    visit(child, f"{qual}.")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.")
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+        return out
+
+    def enclosing_functions(self, lineno: int) -> list[FunctionInfo]:
+        """Every function whose span contains `lineno`, outermost first."""
+        chain = [f for f in self.functions if f.start <= lineno <= f.end]
+        chain.sort(key=lambda f: f.start)
+        return chain
+
+    def enclosing_symbol(self, lineno: int) -> str:
+        chain = self.enclosing_functions(lineno)
+        return chain[-1].qualname if chain else "<module>"
+
+    def in_drain_point(self, lineno: int) -> bool:
+        """True when any enclosing function is a declared drain point."""
+        return any(f.drain_point for f in self.enclosing_functions(lineno))
+
+    # -- import index --------------------------------------------------------
+
+    def _index_imports(self) -> dict[str, str]:
+        """alias -> full module name, for `import x.y as z` and
+        `from x import y` (module-ish targets only). Lets rules resolve
+        `np.asarray` vs `jnp.asarray` without importing anything."""
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve_dotted(self, node: ast.expr) -> str | None:
+        """Dotted name of a call target with the FIRST segment resolved
+        through the import table: `jnp.asarray` -> 'jax.numpy.asarray',
+        `lax.psum` -> 'jax.lax.psum', plain `open` -> 'open'."""
+        parts: list[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        parts.append(cur.id)
+        parts.reverse()
+        parts[0] = self.module_aliases.get(parts[0], parts[0])
+        return ".".join(parts)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Rule:
+    """Base rule. Subclasses set `code`/`name`/`fixit` and implement
+    `check`; `applies` scopes by project-relative path (default: the whole
+    package)."""
+
+    code: str = ""
+    name: str = ""
+    fixit: str = ""
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(f"{PACKAGE}/") or rel.endswith(".py")
+
+    def check(self, src: SourceFile) -> list[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+    def violation(self, src: SourceFile, node: ast.AST, message: str,
+                  fixit: str | None = None) -> Violation:
+        lineno = getattr(node, "lineno", 1)
+        return Violation(
+            code=self.code, name=self.name, rel=src.rel, lineno=lineno,
+            col=getattr(node, "col_offset", 0), message=message,
+            fixit=fixit or self.fixit, line_text=src.line(lineno),
+            symbol=src.enclosing_symbol(lineno),
+        )
+
+
+@dataclasses.dataclass
+class RunResult:
+    violations: list[Violation]       # unsuppressed, unbaselined — failures
+    baselined: list[Violation]        # matched a baseline entry
+    suppressed: int                   # killed by inline/file directives
+    stale_baseline: list[dict[str, str]]  # entries that matched nothing
+    files_checked: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        elif p.endswith(".py") and os.path.isfile(p):
+            yield p
+        else:
+            # a typoed path must fail the gate loudly — silently checking
+            # zero files would leave a permanently-green lint gate
+            raise ValueError(
+                f"not a directory or existing .py file: {p!r}")
+
+
+def project_rel(path: str) -> str:
+    """Project-relative path: anchored at the `commefficient_tpu` package
+    when the path contains it, else the basename. Fixture files override
+    this with a `# graftlint: module=` directive."""
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    marker = f"/{PACKAGE}/"
+    if marker in norm:
+        return PACKAGE + "/" + norm.split(marker, 1)[1]
+    return os.path.basename(norm)
+
+
+class Analyzer:
+    """Load files, run every applicable rule, apply directive suppressions
+    and the baseline. `rules` defaults to ALL_RULES (late import: rule
+    modules import this one)."""
+
+    def __init__(self, rules: Iterable[type[Rule]] | None = None,
+                 baseline: Baseline | None = None):
+        if rules is None:
+            from . import ALL_RULES
+
+            rules = ALL_RULES
+        self.rules: list[Rule] = [r() for r in rules]
+        self.valid_codes = frozenset(r.code for r in self.rules)
+        self.baseline = baseline if baseline is not None else Baseline.empty()
+        self._suppressed = 0
+
+    def check_file(self, path: str) -> list[Violation]:
+        """All raw findings for one file (directive errors included);
+        suppressions and baseline are applied by `run`."""
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        src = SourceFile(path, project_rel(path), text, self.valid_codes)
+        out: list[Violation] = []
+        for lineno, msg in src.directives.errors:
+            out.append(Violation(
+                code=directives.DIRECTIVE_ERROR_CODE, name="bad-directive",
+                rel=src.rel, lineno=lineno, col=0, message=msg,
+                fixit="name a valid rule code (see README rule table)",
+                line_text=src.line(lineno),
+                symbol=src.enclosing_symbol(lineno),
+            ))
+        for rule in self.rules:
+            if rule.applies(src.rel):
+                out.extend(rule.check(src))
+        # suppressions (G000 is never suppressible: a broken directive must
+        # not be silenced by the directive mechanism itself)
+        kept: list[Violation] = []
+        for v in out:
+            if (v.code != directives.DIRECTIVE_ERROR_CODE
+                    and src.directives.disabled(v.code, v.lineno)):
+                self._suppressed += 1
+                continue
+            kept.append(v)
+        return kept
+
+    def run(self, paths: Iterable[str]) -> RunResult:
+        self._suppressed = 0
+        files = list(iter_py_files(paths))
+        failures: list[Violation] = []
+        baselined: list[Violation] = []
+        for path in files:
+            try:
+                found = self.check_file(path)
+            except SyntaxError as e:
+                rel = project_rel(path)
+                failures.append(Violation(
+                    code="G000", name="parse-error", rel=rel,
+                    lineno=e.lineno or 1, col=e.offset or 0,
+                    message=f"could not parse: {e.msg}",
+                    fixit="fix the syntax error", line_text="",
+                    symbol="<module>",
+                ))
+                continue
+            for v in found:
+                if self.baseline.matches(v):
+                    baselined.append(v)
+                else:
+                    failures.append(v)
+        failures.sort(key=lambda v: (v.rel, v.lineno, v.col, v.code))
+        return RunResult(
+            violations=failures, baselined=baselined,
+            suppressed=self._suppressed,
+            stale_baseline=self.baseline.stale(),
+            files_checked=len(files),
+        )

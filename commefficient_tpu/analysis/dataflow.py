@@ -1,0 +1,115 @@
+"""Lightweight intra-module dataflow for the G005/G006 rules.
+
+Deliberately NOT a real dataflow framework: the two rules that need flow
+information (donation-after-use, RNG-key-reuse) both reduce to "within one
+function, order the events touching a local name and look at what happens
+between two of them". Source order is used as the event order — exact for
+straight-line code, an approximation inside branches (documented per rule;
+the repo's round-path code is straight-line where these rules bite).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+Pos = tuple[int, int]  # (lineno, col_offset) — source-order event position
+
+
+def node_pos(node: ast.AST) -> Pos:
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+def node_end(node: ast.AST) -> Pos:
+    return (getattr(node, "end_lineno", 0) or 0,
+            getattr(node, "end_col_offset", 0) or 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class NameEvent:
+    pos: Pos
+    name: str
+    is_store: bool
+    node: ast.Name
+
+
+def name_events(func: ast.AST) -> list[NameEvent]:
+    """Every Name load/store in `func` (nested defs included — a closure
+    capturing a donated buffer is still a use), in source order."""
+    out: list[NameEvent] = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name):
+            out.append(NameEvent(
+                node_pos(node), node.id,
+                isinstance(node.ctx, (ast.Store, ast.Del)), node))
+    out.sort(key=lambda e: e.pos)
+    return out
+
+
+def direct_functions(func: ast.AST) -> Iterator[ast.AST]:
+    """Child statements of `func` excluding nested function bodies — for
+    walks that must stay within one function's own straight-line code."""
+    for child in ast.iter_child_nodes(func):
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+            yield child
+
+
+def walk_in_function(func: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk over `func`'s own body, NOT descending into nested
+    functions/lambdas (their locals are a different scope)."""
+    stack: list[ast.AST] = list(direct_functions(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                stack.append(child)
+
+
+def assign_target_key(node: ast.expr) -> str | None:
+    """Registry key for an assignment target we can track: a plain Name
+    ('step') or a self/cls attribute ('self._step')."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def call_target_key(node: ast.expr) -> str | None:
+    """The same key space for a call's target expression."""
+    return assign_target_key(node)
+
+
+def loop_spans(func: ast.AST) -> list[tuple[Pos, Pos]]:
+    """(start, end) source spans of every for/while loop in the function's
+    own body (comprehensions excluded — their targets rebind per iteration
+    in their own scope)."""
+    spans = []
+    for node in walk_in_function(func):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            spans.append((node_pos(node), node_end(node)))
+    return spans
+
+
+def inside_any(pos: Pos, spans: list[tuple[Pos, Pos]]) -> bool:
+    return any(lo <= pos <= hi for lo, hi in spans)
+
+
+def int_or_tuple_literal(node: ast.expr) -> tuple[int, ...] | None:
+    """Evaluate a donate_argnums-style literal: int or tuple/list of ints."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals: list[int] = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            vals.append(elt.value)
+        return tuple(vals)
+    return None

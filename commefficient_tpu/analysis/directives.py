@@ -1,0 +1,129 @@
+"""`# graftlint:` comment directives.
+
+Three forms, all line-anchored comments:
+
+    # graftlint: disable=G001            suppress these codes on this line
+    # graftlint: disable=G001,G004 — why (or the line directly below)
+    # graftlint: disable-file=G008       suppress for the whole file
+    # graftlint: drain-point             on/above a `def`: this function IS a
+                                         sanctioned host-sync / blocking-IO
+                                         boundary (G001/G007 exempt)
+    # graftlint: module=<relpath>        fixture support: analyze this file as
+                                         if it lived at <relpath> (scoped rules
+                                         fire on test snippets)
+
+Anything after an `—`/`--`/`#` separator in a disable is a free-form
+justification. A directive naming an unknown rule code, or an unknown
+directive verb, is itself reported (code G000) — suppressions must name a
+valid rule code or they rot silently when rules are renumbered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import re
+import tokenize
+
+# the pseudo-code under which malformed directives are reported
+DIRECTIVE_ERROR_CODE = "G000"
+
+_DIRECTIVE_RE = re.compile(r"#\s*graftlint:\s*(?P<body>[^#]*)")
+_CODE_RE = re.compile(r"^G\d{3}$")
+# separators that end the code list and start a free-form justification
+_JUSTIFICATION_SPLIT = re.compile(r"\s+(?:—|--)\s+")
+
+
+@dataclasses.dataclass
+class Directives:
+    """Parsed per-file directive state (see module docstring)."""
+
+    # lineno -> set of codes disabled on that line
+    line_disables: dict[int, set[str]]
+    # codes disabled for the entire file
+    file_disables: set[str]
+    # linenos carrying a drain-point marker
+    drain_linenos: set[int]
+    # fixture impersonation path, or None
+    module_override: str | None
+    # (lineno, message) for malformed directives — surfaced as G000
+    errors: list[tuple[int, str]]
+
+    def disabled(self, code: str, lineno: int) -> bool:
+        """A violation at `lineno` is suppressed by a disable on the same
+        line or on the line directly above it (comment-above style)."""
+        if code in self.file_disables:
+            return True
+        for ln in (lineno, lineno - 1):
+            if code in self.line_disables.get(ln, ()):
+                return True
+        return False
+
+
+def _parse_codes(arg: str, lineno: int, valid_codes: frozenset[str],
+                 errors: list[tuple[int, str]]) -> set[str]:
+    codes: set[str] = set()
+    # strip a trailing justification ("disable=G001 — host-side stacking")
+    arg = _JUSTIFICATION_SPLIT.split(arg, maxsplit=1)[0].strip()
+    for raw in arg.split(","):
+        code = raw.strip()
+        if not code:
+            continue
+        if not _CODE_RE.match(code) or code not in valid_codes:
+            errors.append((
+                lineno,
+                f"unknown rule code {code!r} in graftlint directive "
+                f"(valid: {', '.join(sorted(valid_codes))})",
+            ))
+            continue
+        codes.add(code)
+    return codes
+
+
+def _comments(text: str) -> list[tuple[int, str]]:
+    """(lineno, comment_text) for every real COMMENT token — docstrings and
+    string literals that merely MENTION `# graftlint:` never parse as
+    directives."""
+    out: list[tuple[int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except tokenize.TokenError:  # pragma: no cover — ast.parse catches first
+        pass
+    return out
+
+
+def parse(text: str, valid_codes: frozenset[str]) -> Directives:
+    d = Directives(
+        line_disables={}, file_disables=set(), drain_linenos=set(),
+        module_override=None, errors=[],
+    )
+    for lineno, line in _comments(text):
+        m = _DIRECTIVE_RE.search(line)
+        if m is None:
+            continue
+        body = m.group("body").strip()
+        verb, has_eq, arg = body.partition("=")
+        # a justification may trail the verb itself ("drain-point — why")
+        verb = _JUSTIFICATION_SPLIT.split(verb.strip(), maxsplit=1)[0].strip()
+        if verb == "disable" and has_eq:
+            codes = _parse_codes(arg, lineno, valid_codes, d.errors)
+            if codes:
+                d.line_disables.setdefault(lineno, set()).update(codes)
+        elif verb == "disable-file" and has_eq:
+            d.file_disables.update(
+                _parse_codes(arg, lineno, valid_codes, d.errors))
+        elif verb == "drain-point" and not has_eq:
+            d.drain_linenos.add(lineno)
+        elif verb == "module" and has_eq:
+            d.module_override = arg.strip()
+        elif not verb:
+            d.errors.append((lineno, "empty graftlint directive"))
+        else:
+            d.errors.append((
+                lineno,
+                f"unknown graftlint directive {verb!r} "
+                "(expected disable/disable-file/drain-point/module)",
+            ))
+    return d

@@ -1,0 +1,105 @@
+"""G008 unvalidated-config-read: engine/runner code reads only `args.<name>`
+flags registered through utils/config.py's parser.
+
+Every flag in this repo flows through `make_parser` (where it gets a type,
+a default, choices, and a help string — the coercion surface) and then
+`resolve_defaults`. An `args.foo` read in engine or runner code for a name
+that was never registered is either a typo (AttributeError at runtime, but
+only on the code path that reaches it — often the recovery path that only
+fires mid-incident) or a flag smuggled around the validated surface. The
+registered-name set is extracted statically from utils/config.py's
+`add_argument("--name", ...)` calls (both task variants, union).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+
+def _find_config_source() -> str | None:
+    """utils/config.py, located relative to this package (works from any
+    CWD; graftlint never imports the analyzed code)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    cand = os.path.join(os.path.dirname(here), "utils", "config.py")
+    return cand if os.path.exists(cand) else None
+
+
+def registered_flags(config_path: str | None = None) -> frozenset[str]:
+    """Flag names (normalized: no dashes) registered via add_argument."""
+    path = config_path or _find_config_source()
+    if path is None:
+        return frozenset()
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            flag = node.args[0].value
+            if flag.startswith("-"):
+                names.add(flag.lstrip("-").replace("-", "_"))
+    return frozenset(names)
+
+
+class UnvalidatedConfigRead(Rule):
+    code = "G008"
+    name = "unvalidated-config-read"
+    fixit = ("register the flag in utils/config.py make_parser (type + "
+             "default + help) so it is parsed, coerced, and visible in "
+             "--help; engine/runner code must not grow a shadow flag "
+             "surface")
+
+    SCOPE = (
+        f"{PACKAGE}/federated/",
+        f"{PACKAGE}/runner/",
+    )
+
+    def __init__(self) -> None:
+        self._registered: frozenset[str] | None = None
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.SCOPE)
+
+    @property
+    def registered(self) -> frozenset[str]:
+        if self._registered is None:
+            self._registered = registered_flags()
+        return self._registered
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        if not self.registered:
+            return []  # config.py not found (isolated fixture run): no-op
+        out: list[Violation] = []
+        for node in ast.walk(src.tree):
+            name = self._args_read(node)
+            if name is not None and name not in self.registered:
+                out.append(self.violation(
+                    src, node,
+                    f"`args.{name}` read in engine/runner code but "
+                    "--{} is not registered in utils/config.py".format(
+                        name)))
+        return out
+
+    @staticmethod
+    def _args_read(node: ast.AST) -> str | None:
+        """The flag name when `node` reads an attribute off an argparse
+        namespace: `args.foo` or `getattr(args, "foo"[, default])`."""
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "args"
+                and isinstance(node.ctx, ast.Load)):
+            return node.attr
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "getattr" and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id == "args"
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)):
+            return node.args[1].value
+        return None

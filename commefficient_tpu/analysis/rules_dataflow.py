@@ -1,0 +1,265 @@
+"""G005 donation-after-use and G006 rng-key-reuse — the two rules that need
+the lightweight intra-module dataflow pass (analysis/dataflow.py).
+
+G005: an argument listed in `jax.jit(..., donate_argnums=...)` hands its
+buffer to XLA — on TPU the array is DELETED the moment the call is traced,
+and any later host read raises "Array has been deleted" (or worse, on
+backends that alias silently, reads the output's bytes). CPU ignores
+donation, which is exactly why tests never catch it — the lint has to. The
+pass registers jitted callables assigned to module/class names (literal
+donate_argnums, plus the project's `_state_donation()` helper, which returns
+`(0,)` or `()` — treated as donating 0, its armed case), then walks each
+function for loads of a donated argument after the donating call with no
+intervening rebind.
+
+G006: a threefry PRNG key feeds ONE consumer. Tracked per function: names
+bound from `jax.random.PRNGKey(...)`, `fold_in(...)`, or tuple-unpacked
+`split(...)`; consumers are `jax.random.<draw>(key, ...)` and
+`jax.random.split(key, ...)` (official guidance: a key is dead after you
+split it). `fold_in(key, i)` is derivation, not consumption — folding the
+same parent with distinct ints is the sanctioned fan-out pattern
+(engine._dp_noise_agg). A draw from a loop-invariant key inside a for/while
+also flags: it reuses the key every iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import dataflow
+from .core import Rule, SourceFile, Violation
+
+
+class DonationAfterUse(Rule):
+    code = "G005"
+    name = "donation-after-use"
+    fixit = ("use the jitted call's RETURN value instead of the donated "
+             "input (the buffer is dead), or drop the argument from "
+             "donate_argnums if it must stay readable")
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        registry = self._donating_callables(src)
+        if not registry:
+            return []
+        out: list[Violation] = []
+        for func in self._functions(src):
+            out.extend(self._check_function(src, func, registry))
+        return out
+
+    def _functions(self, src: SourceFile) -> list[ast.AST]:
+        return [node for node in ast.walk(src.tree)
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def _donating_callables(self, src: SourceFile) -> dict[str, tuple[int, ...]]:
+        """key ('step' / 'self._step') -> donated positional indices, from
+        `<key> = jax.jit(fn, donate_argnums=...)` assignments anywhere in
+        the module."""
+        registry: dict[str, tuple[int, ...]] = {}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            key = dataflow.assign_target_key(node.targets[0])
+            if key is None or not isinstance(node.value, ast.Call):
+                continue
+            dotted = src.resolve_dotted(node.value.func)
+            if dotted not in ("jax.jit", "jax.pjit", "jax.jit.jit"):
+                continue
+            donated = self._donated_indices(src, node.value)
+            if donated:
+                registry[key] = donated
+        return registry
+
+    def _donated_indices(self, src: SourceFile,
+                         call: ast.Call) -> tuple[int, ...]:
+        for kw in call.keywords:
+            if kw.arg not in ("donate_argnums", "donate_argnames"):
+                continue
+            lit = dataflow.int_or_tuple_literal(kw.value)
+            if lit is not None:
+                return lit
+            # project-aware: FederatedSession._state_donation() returns
+            # (0,) when donation is armed and () otherwise — lint for the
+            # armed case, the one that deletes buffers on real hardware
+            if isinstance(kw.value, ast.Call):
+                helper = src.resolve_dotted(kw.value.func)
+                if helper and helper.rsplit(".", 1)[-1].endswith(
+                        "_state_donation"):
+                    return (0,)
+        return ()
+
+    def _check_function(self, src: SourceFile, func: ast.AST,
+                        registry: dict[str, tuple[int, ...]]) -> list[Violation]:
+        events = dataflow.name_events(func)
+        # the canonical donation idiom `state, _, _ = step(state, ...)`
+        # rebinds the donated name in the SAME statement — map each call to
+        # the names its enclosing assignment rebinds, since those Store
+        # events textually precede the call's end
+        rebinds: dict[ast.Call, set[str]] = {}
+        for stmt in dataflow.walk_in_function(func):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            names = set()
+            for tgt in stmt.targets:
+                for t in ast.walk(tgt):
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            for sub in ast.walk(stmt.value):
+                if isinstance(sub, ast.Call):
+                    rebinds[sub] = names
+        out: list[Violation] = []
+        for node in dataflow.walk_in_function(func):
+            if not isinstance(node, ast.Call):
+                continue
+            key = dataflow.call_target_key(node.func)
+            if key is None or key not in registry:
+                continue
+            end = dataflow.node_end(node)
+            for idx in registry[key]:
+                if idx >= len(node.args):
+                    continue
+                arg = node.args[idx]
+                if not isinstance(arg, ast.Name):
+                    continue
+                if arg.id in rebinds.get(node, ()):
+                    continue  # rebound by the call's own assignment
+                # first event on this name after the donating call decides:
+                # Load -> reads a deleted buffer; Store -> rebound, safe
+                for ev in events:
+                    if ev.name != arg.id or ev.pos <= end:
+                        continue
+                    if ev.is_store:
+                        break
+                    out.append(self.violation(
+                        src, ev.node,
+                        f"`{arg.id}` was donated to `{key}` (donate_argnums "
+                        f"includes {idx}) at line {node.lineno} and is "
+                        "referenced afterwards — its buffer is deleted on "
+                        "TPU"))
+                    break
+        return out
+
+
+# jax.random draws that consume a key (split included: a key is dead after
+# splitting; fold_in is derivation and deliberately absent)
+_CONSUMERS = frozenset({
+    "split", "normal", "uniform", "bernoulli", "randint", "bits",
+    "truncated_normal", "categorical", "choice", "permutation", "gumbel",
+    "exponential", "laplace", "logistic", "poisson", "gamma", "beta",
+    "dirichlet", "rademacher", "cauchy", "multivariate_normal", "t",
+    "loggamma", "rayleigh", "maxwell", "ball", "orthogonal", "binomial",
+    "geometric", "chisquare", "f", "generalized_normal", "triangular",
+    "wald", "weibull_min",
+})
+_PRODUCERS = frozenset({"PRNGKey", "key", "fold_in", "split", "clone"})
+
+
+class RngKeyReuse(Rule):
+    code = "G006"
+    name = "rng-key-reuse"
+    fixit = ("derive fresh keys first: `k1, k2 = jax.random.split(key)` (or "
+             "fold_in with distinct ints), one consumer per key")
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_function(src, node))
+        return out
+
+    def _check_function(self, src: SourceFile,
+                        func: ast.AST) -> list[Violation]:
+        key_names = self._key_bindings(src, func)
+        if not key_names:
+            return []
+        loops = dataflow.loop_spans(func)
+        # per name: position of its last binding, and of its consumption
+        consumed_at: dict[str, dataflow.Pos] = {}
+        out: list[Violation] = []
+        events = self._ordered_events(src, func)
+        for pos, kind, name, node in events:
+            if kind == "store":
+                consumed_at.pop(name, None)
+                continue
+            if name not in key_names:
+                continue
+            born = key_names[name]
+            if name in consumed_at:
+                out.append(self.violation(
+                    src, node,
+                    f"PRNG key `{name}` already fed a consumer at line "
+                    f"{consumed_at[name][0]} — reusing it correlates the "
+                    "two streams"))
+                continue
+            if (dataflow.inside_any(pos, loops)
+                    and not dataflow.inside_any(born, loops)):
+                out.append(self.violation(
+                    src, node,
+                    f"PRNG key `{name}` is consumed inside a loop but bound "
+                    "outside it — every iteration draws from the same key"))
+                continue
+            consumed_at[name] = pos
+        return out
+
+    def _key_bindings(self, src: SourceFile,
+                      func: ast.AST) -> dict[str, dataflow.Pos]:
+        """name -> binding position, for names bound from a key-producing
+        jax.random call (PRNGKey/fold_in/key, or tuple-unpacked split) —
+        plus every function parameter: a parameter consumed twice is reuse
+        no matter how the key arrived."""
+        keys: dict[str, dataflow.Pos] = {}
+        if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = func.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                keys[arg.arg] = (func.lineno, func.col_offset)
+        for node in dataflow.walk_in_function(func):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            value, target = node.value, node.targets[0]
+            if not isinstance(value, ast.Call):
+                continue
+            fn = self._random_fn(src, value.func)
+            if fn is None or fn not in _PRODUCERS:
+                continue
+            if isinstance(target, ast.Name) and fn != "split":
+                keys[target.id] = dataflow.node_pos(target)
+            elif isinstance(target, ast.Tuple) and fn == "split":
+                for elt in target.elts:
+                    if isinstance(elt, ast.Name):
+                        keys[elt.id] = dataflow.node_pos(elt)
+        return keys
+
+    def _ordered_events(self, src: SourceFile, func: ast.AST) -> list[
+            tuple[dataflow.Pos, str, str, ast.AST]]:
+        """(pos, 'store'|'consume', name, node) in source order: stores of
+        any name, plus key-consuming jax.random calls on Name arguments."""
+        events: list[tuple[dataflow.Pos, str, str, ast.AST]] = []
+        for node in dataflow.walk_in_function(func):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                events.append(
+                    (dataflow.node_pos(node), "store", node.id, node))
+            elif isinstance(node, ast.Call):
+                fn = self._random_fn(src, node.func)
+                if fn in _CONSUMERS and node.args and isinstance(
+                        node.args[0], ast.Name):
+                    events.append((dataflow.node_pos(node), "consume",
+                                   node.args[0].id, node))
+        events.sort(key=lambda e: e[0])
+        return events
+
+    @staticmethod
+    def _random_fn(src: SourceFile, func: ast.expr) -> str | None:
+        """'normal' for a call whose dotted target resolves into
+        jax.random (jax.random.normal, jrandom.normal, `from jax.random
+        import normal`)."""
+        dotted = src.resolve_dotted(func)
+        if dotted is None:
+            return None
+        head, _, last = dotted.rpartition(".")
+        if head.endswith("random") and ("jax" in head or head == "random"):
+            return last
+        if head == "" and dotted in ("PRNGKey", "fold_in"):
+            return dotted
+        return None

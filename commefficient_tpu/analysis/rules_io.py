@@ -1,0 +1,99 @@
+"""G004 raw-checkpoint-write: checkpoint directories are written ONLY
+through utils/checkpoint.py's atomic helpers.
+
+The hardened protocol (stage into `.tmp_round_*`, write the sha256 manifest
+last, `os.rename` commit, read-back verify) is what makes a torn write
+impossible to mistake for a checkpoint and a corrupt one loud at save time.
+A bare `open(ckpt_path, "w")` / `np.save(ckpt_dir/...)` / `pickle.dump`
+anywhere else re-opens the failure classes PR 1 closed: partial trees that
+restore as garbage, unverifiable files, silent clobbers of the only good
+copy. "Targets a checkpoint dir" is a textual heuristic on the file-path
+argument (mentions ckpt/checkpoint/staging/round_) — precise enough in this
+repo, and a fixture-pinned contract for the next rule author.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+_PATH_MARKERS = ("ckpt", "checkpoint", "staging", "round_")
+# write-ish open() modes; bare open(p) defaults to read and stays legal
+_WRITE_MODES = frozenset("wax+")
+
+
+class RawCheckpointWrite(Rule):
+    code = "G004"
+    name = "raw-checkpoint-write"
+    fixit = ("write through utils/checkpoint.py (save/_write_manifest): "
+             "atomic .tmp staging + rename commit + sha256 manifest + "
+             "read-back verify")
+
+    EXEMPT = (f"{PACKAGE}/utils/checkpoint.py",)
+
+    def applies(self, rel: str) -> bool:
+        return rel not in self.EXEMPT
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        handles = self._open_handles(src)
+        out: list[Violation] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._raw_write_target(src, node)
+            if target is None:
+                continue
+            text = ast.unparse(target).lower()
+            # a bare file-handle variable resolves to the path expression of
+            # the open() that bound it (`with open(p) as fh: pickle.dump(o, fh)`)
+            if isinstance(target, ast.Name) and target.id in handles:
+                text = handles[target.id]
+            if any(marker in text for marker in _PATH_MARKERS):
+                out.append(self.violation(
+                    src, node,
+                    "raw write targeting a checkpoint directory "
+                    f"({ast.unparse(target)}) outside utils/checkpoint.py's "
+                    "atomic helpers"))
+        return out
+
+    @staticmethod
+    def _open_handles(src: SourceFile) -> dict[str, str]:
+        """handle-name -> lowercased path-expression text, for names bound
+        by `with open(p) as fh:` or `fh = open(p)` anywhere in the file."""
+        handles: dict[str, str] = {}
+
+        def record(call: ast.expr, target: ast.expr | None) -> None:
+            if (isinstance(call, ast.Call) and isinstance(target, ast.Name)
+                    and src.resolve_dotted(call.func) == "open" and call.args):
+                handles[target.id] = ast.unparse(call.args[0]).lower()
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    record(item.context_expr, item.optional_vars)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                record(node.value, node.targets[0])
+        return handles
+
+    def _raw_write_target(self, src: SourceFile,
+                          node: ast.Call) -> ast.expr | None:
+        """The file-path argument when `node` is a raw write primitive."""
+        dotted = src.resolve_dotted(node.func)
+        if dotted == "open" and node.args:
+            mode = None
+            if len(node.args) >= 2:
+                mode = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+                    and set(mode.value) & _WRITE_MODES):
+                return node.args[0]
+            return None
+        if dotted in ("numpy.save", "numpy.savez", "numpy.savez_compressed"):
+            return node.args[0] if node.args else None
+        if dotted in ("pickle.dump", "cloudpickle.dump", "joblib.dump"):
+            # dump(obj, file) — the file argument is positional index 1
+            return node.args[1] if len(node.args) >= 2 else None
+        return None

@@ -1,0 +1,125 @@
+"""G002 unordered-reduction-in-parity-scope and G003 reserved-leaf-access.
+
+G002 is PR 3's sketch-merge rule made mechanical: modules under the
+bit-parity contract (the federated round, mode transforms, sketch algebra)
+may not introduce `lax.psum` / `psum_scatter` / unordered all-reduces — a
+ring psum reassociates the floating-point reduce per topology and breaks the
+mesh == single-device bit-identity the parity tests pin (arXiv:2007.07682's
+linearity argument makes the ordered partial-sketch merge legal; it says
+nothing about reassociated merges). The sanctioned merge is all_gather +
+ordered sum: `csvec.merge_tables` / `modes.merge_partial_wires`.
+
+G003 guards the `_valid` reserved batch leaf (PR 4): only
+`engine.split_valid` may consume it (and the faults module, which injects
+it). Direct reads of `_`-prefixed batch leaves anywhere else bypass the
+pop-before-compute discipline and leak the control row into gradients.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+_UNORDERED = {"psum", "psum_scatter", "all_reduce"}
+
+
+class UnorderedReduction(Rule):
+    code = "G002"
+    name = "unordered-reduction-in-parity-scope"
+    fixit = ("merge partials with all_gather + ordered sum "
+             "(csvec.merge_tables / modes.merge_partial_wires) — a psum "
+             "reassociates fp and breaks the mesh==single-device parity pin")
+
+    SCOPE = (
+        f"{PACKAGE}/federated/",
+        f"{PACKAGE}/modes/",
+        f"{PACKAGE}/sketch/",
+    )
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.SCOPE)
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = src.resolve_dotted(node.func)
+            if dotted is None:
+                continue
+            last = dotted.rsplit(".", 1)[-1]
+            if last in _UNORDERED:
+                out.append(self.violation(
+                    src, node,
+                    f"{last}() is an unordered cross-device reduction in a "
+                    "module under the bit-parity contract"))
+        return out
+
+
+class ReservedLeafAccess(Rule):
+    code = "G003"
+    name = "reserved-leaf-access"
+    fixit = ("consume the validity mask via engine.split_valid(batch) — it "
+             "pops the leaf and returns (batch, valid) without mutating the "
+             "caller's dict")
+
+    # the one consumer and the one injector of reserved leaves
+    ALLOWED_FUNCTIONS = {"split_valid"}
+    ALLOWED_FILES = (f"{PACKAGE}/resilience/faults.py",)
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(f"{PACKAGE}/") or not rel.startswith("tests/")
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        if src.rel in self.ALLOWED_FILES:
+            return []
+        out: list[Violation] = []
+        for node in ast.walk(src.tree):
+            key_node = self._reserved_key_read(src, node)
+            if key_node is None:
+                continue
+            chain = {f.qualname.rsplit(".", 1)[-1]
+                     for f in src.enclosing_functions(node.lineno)}
+            if chain & self.ALLOWED_FUNCTIONS:
+                continue
+            out.append(self.violation(
+                src, node,
+                "direct read of a reserved `_`-prefixed batch leaf "
+                f"({self._key_repr(key_node)}) outside split_valid/faults"))
+        return out
+
+    def _reserved_key_read(self, src: SourceFile,
+                           node: ast.AST) -> ast.expr | None:
+        """The key expression when `node` READS a reserved leaf: a
+        Load-context subscript `x['_k']` / `x[VALID_KEY]`, or `.get('_k')` /
+        `.pop('_k')`. Writes (Store/Del subscripts) are the injection side
+        and stay legal — prepare_round installs the mask."""
+        if isinstance(node, ast.Subscript):
+            if not isinstance(node.ctx, ast.Load):
+                return None
+            return node.slice if self._is_reserved(src, node.slice) else None
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "pop") and node.args):
+            key = node.args[0]
+            return key if self._is_reserved(src, key) else None
+        return None
+
+    @staticmethod
+    def _is_reserved(src: SourceFile, key: ast.expr) -> bool:
+        if (isinstance(key, ast.Constant) and isinstance(key.value, str)
+                and key.value.startswith("_")):
+            return True
+        # symbolic references to the reserved key constant
+        if isinstance(key, ast.Name) and key.id == "VALID_KEY":
+            return True
+        if isinstance(key, ast.Attribute) and key.attr == "VALID_KEY":
+            return True
+        return False
+
+    @staticmethod
+    def _key_repr(key: ast.expr) -> str:
+        if isinstance(key, ast.Constant):
+            return repr(key.value)
+        return ast.unparse(key)

@@ -1,0 +1,188 @@
+"""G001 host-sync-in-round-path and G007 blocking-call-on-dispatch-thread.
+
+Both enforce the async runner's core promise (runner/loop.py): the dispatch
+path never hides a host synchronization, and the only sanctioned sync points
+are the declared drain points — functions carrying `# graftlint:
+drain-point` above their `def` (the batched-metrics drain, commit, eval, the
+one-shot RTT probe). Everything else that forces a device round-trip or
+blocks the thread must either move behind a drain boundary or carry an
+explicit, justified suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+# modules where ANY value may be a traced array, so float()/bool() on a
+# non-literal is a host sync (compiled-code scope); in the host-side halves
+# (api.py, loop.py) those conversions are ordinary host arithmetic and only
+# the unambiguous sync primitives are flagged
+_COMPILED_SCOPE = (
+    f"{PACKAGE}/modes/",
+    f"{PACKAGE}/sketch/",
+    f"{PACKAGE}/federated/engine.py",
+)
+
+_SYNC_CALLS = ("jax.device_get", "jax.block_until_ready")
+_NUMPY_SYNC_ATTRS = ("asarray", "array")
+
+
+class HostSyncInRoundPath(Rule):
+    code = "G001"
+    name = "host-sync-in-round-path"
+    fixit = ("defer the sync to a drain boundary (runner drain/commit), or "
+             "mark the enclosing function `# graftlint: drain-point` if it "
+             "IS the sanctioned boundary")
+
+    SCOPE = (
+        f"{PACKAGE}/federated/",
+        f"{PACKAGE}/modes/",
+        f"{PACKAGE}/sketch/",
+    )
+    EXACT = (f"{PACKAGE}/runner/loop.py",)
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.SCOPE) or rel in self.EXACT
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        compiled = src.rel.startswith(_COMPILED_SCOPE)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if src.in_drain_point(node.lineno):
+                continue
+            hit = self._classify(src, node, compiled)
+            if hit:
+                out.append(self.violation(src, node, hit))
+        return out
+
+    def _classify(self, src: SourceFile, node: ast.Call,
+                  compiled: bool) -> str | None:
+        dotted = src.resolve_dotted(node.func)
+        if dotted in _SYNC_CALLS:
+            return (f"{dotted}() is a host-device synchronization on the "
+                    "round path, outside any declared drain point")
+        # <expr>.item() / <expr>.block_until_ready()
+        if (isinstance(node.func, ast.Attribute) and not node.args
+                and not node.keywords
+                and node.func.attr in ("item", "block_until_ready")):
+            return (f".{node.func.attr}() forces a device round-trip on the "
+                    "round path, outside any declared drain point")
+        # numpy conversions materialize traced/device values on host
+        if dotted is not None:
+            head, _, attr = dotted.rpartition(".")
+            if head == "numpy" and attr in _NUMPY_SYNC_ATTRS:
+                return (f"np.{attr}() on the round path copies its argument "
+                        "to host (a hidden sync when the value is a device "
+                        "array)")
+        # float()/bool() on a non-literal in compiled-code modules
+        if (compiled and isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "bool") and len(node.args) == 1
+                and isinstance(node.args[0],
+                               (ast.Name, ast.Attribute, ast.Subscript))):
+            return (f"{node.func.id}() on a value in compiled-scope code "
+                    "forces concretization — a host sync under jit tracing")
+        return None
+
+
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep() stalls the dispatch/prefetch thread",
+    "os.system": "os.system() is blocking sync IO on the dispatch path",
+    "open": "synchronous file IO on the dispatch path",
+}
+
+# entry points of the dispatch/prefetch path; reachability is computed over
+# the module's own call graph from these roots
+_ROOT_NAMES = {"run_loop", "next", "prepare_round", "dispatch_round",
+               "dispatch_block"}
+
+
+class BlockingCallOnDispatchThread(Rule):
+    code = "G007"
+    name = "blocking-call-on-dispatch-thread"
+    fixit = ("move the blocking work to the writer/watchdog thread or an "
+             "exit path; drain points and fault-injection sites carry "
+             "`# graftlint: drain-point` / an explicit disable")
+
+    SCOPE = f"{PACKAGE}/runner/"
+    # the async writer runs on its own dedicated thread by design
+    EXEMPT = (f"{PACKAGE}/runner/writer.py",)
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.SCOPE) and rel not in self.EXEMPT
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        reachable = self._reachable(src)
+        out: list[Violation] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            sym = src.enclosing_symbol(node.lineno)
+            if sym not in reachable:
+                continue
+            if src.in_drain_point(node.lineno):
+                continue
+            msg = self._blocking(src, node)
+            if msg:
+                out.append(self.violation(
+                    src, node,
+                    f"{msg} (reachable from the dispatch path via {sym})"))
+        return out
+
+    def _blocking(self, src: SourceFile, node: ast.Call) -> str | None:
+        dotted = src.resolve_dotted(node.func)
+        if dotted in _BLOCKING_CALLS:
+            return _BLOCKING_CALLS[dotted]
+        if dotted and dotted.startswith("subprocess."):
+            return f"{dotted}() launches a blocking subprocess on the " \
+                   "dispatch path"
+        return None
+
+    def _reachable(self, src: SourceFile) -> set[str]:
+        """Qualnames reachable from the dispatch-path roots over same-module
+        calls (Name calls resolve innermost-nested-first, then module level;
+        self.X calls resolve to any same-module method named X)."""
+        by_last: dict[str, set[str]] = {}
+        for f in src.functions:
+            by_last.setdefault(f.qualname.rsplit(".", 1)[-1], set()).add(
+                f.qualname)
+        edges: dict[str, set[str]] = {f.qualname: set()
+                                      for f in src.functions}
+        # one walk: attribute calls and name calls per enclosing function
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            caller = src.enclosing_symbol(node.lineno)
+            if caller == "<module>":
+                continue
+            callee: str | None = None
+            if isinstance(node.func, ast.Name):
+                callee = node.func.id
+            elif (isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id in ("self", "cls")):
+                callee = node.func.attr
+            if callee and callee in by_last:
+                # prefer a nested function of the caller, else any match
+                nested = {q for q in by_last[callee]
+                          if q.startswith(f"{caller}.")}
+                edges.setdefault(caller, set()).update(
+                    nested or by_last[callee])
+        roots = {f.qualname for f in src.functions
+                 if f.qualname.rsplit(".", 1)[-1] in _ROOT_NAMES}
+        seen = set(roots)
+        frontier = list(roots)
+        while frontier:
+            cur = frontier.pop()
+            for nxt in edges.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        # a nested function belongs to its parent's thread context
+        for f in src.functions:
+            if any(f.qualname.startswith(f"{r}.") for r in list(seen)):
+                seen.add(f.qualname)
+        return seen

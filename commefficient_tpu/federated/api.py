@@ -55,6 +55,10 @@ class PreparedRound:
     masked: int = 0
     requeue_depth: int = 0
     requeue: tuple = ()
+    # (cid, enqueued_round) pairs matching `requeue` — the aged policy's
+    # rounds-waiting bookkeeping rides the same committed-snapshot
+    # discipline as the queue itself
+    requeue_ages: tuple = ()
 
 
 @dataclasses.dataclass
@@ -76,6 +80,7 @@ class InFlightRound:
     masked: list = dataclasses.field(default_factory=list)
     requeue_depths: list = dataclasses.field(default_factory=list)
     requeue: tuple = ()
+    requeue_ages: tuple = ()
 
     @property
     def num_rounds(self) -> int:
@@ -117,6 +122,7 @@ class FederatedSession:
         donate_state: bool = True,
         client_shards: int = 0,
         client_update_clip: float = 0.0,
+        requeue_policy: str = "fifo",
     ):
         # client_shards: 0 = derive from the mesh (the default — on a >1-
         # device mesh with a mode in engine.supports_sharded_round's scope
@@ -145,8 +151,24 @@ class FederatedSession:
         # `_requeue_committed` is the round-boundary snapshot checkpoints
         # write (same discipline as rng_snapshot — prefetch may have served
         # the live queue for rounds that never commit).
+        # Serving order is `requeue_policy`: "fifo" (substitution order =
+        # drop order) or "aged" (weighted choice by rounds-waiting from a
+        # DEDICATED pinned RandomState — fairness at high drop rates without
+        # perturbing the host-sampling stream). `_requeue_enqueued` maps a
+        # queued cid to the round it was dropped (advisory: checkpoints
+        # persist only the queue order, so a resumed run restarts ages at 1
+        # — the weights re-diverge within a few rounds).
+        if requeue_policy not in ("fifo", "aged"):
+            raise ValueError(
+                f"requeue_policy must be 'fifo' or 'aged', got "
+                f"{requeue_policy!r}"
+            )
+        self._requeue_policy = requeue_policy
+        self._requeue_enqueued: dict[int, int] = {}
         self._requeue: collections.deque = collections.deque()
         self._requeue_committed: tuple = ()
+        self._requeue_ages_committed: tuple = ()
+        self._seed = seed
         # resilience hooks (resilience/): a seeded FaultPlan injects failures
         # at this session's named sites; the retry policy wraps data loading.
         # Both default to inert so existing callers see zero change.
@@ -420,8 +442,10 @@ class FederatedSession:
                 file=sys.stderr, flush=True,
             )
             queued = set(self._requeue)
-            self._requeue.extend(
-                int(i) for i in ids if int(i) not in queued)
+            for i in ids:
+                if int(i) not in queued:
+                    self._requeue.append(int(i))
+                    self._requeue_enqueued.setdefault(int(i), rnd)
             W = len(ids)
             return (
                 self.train_set.empty_batch(
@@ -448,7 +472,7 @@ class FederatedSession:
             # sampling stream is identical whether or not anything was
             # queued — only the cohort's membership changes (by design:
             # that IS the recovery).
-            ids = self._serve_requeue(ids)
+            ids = self._serve_requeue(ids, rnd)
         batch, valid = self._load_client_batch(ids, rnd)
         if self.fault_plan is not None:
             # nonfinite burst rides the real gradient path (poison the
@@ -464,6 +488,7 @@ class FederatedSession:
                 cid = int(ids[p])
                 if cid not in self._requeue:
                     self._requeue.append(cid)
+                    self._requeue_enqueued.setdefault(cid, rnd)
         masked = int(len(ids) - valid.sum()) if valid is not None else 0
         # the validity mask ALWAYS rides the batch (all-ones in the clean
         # case) so the compiled program never changes shape when the first
@@ -478,27 +503,64 @@ class FederatedSession:
             rnd, ids, batch, sub, (self.rng.get_state(), self._rng_key),
             masked=masked, requeue_depth=len(self._requeue),
             requeue=tuple(self._requeue),
+            requeue_ages=tuple(self._requeue_enqueued.items()),
         )
 
-    def _serve_requeue(self, ids):
+    def _serve_requeue(self, ids, rnd: int = 0):
         """Substitute queued (previously dropped) client ids into a freshly
-        sampled cohort, FIFO, skipping ids the sample already contains."""
-        ids = np.array(ids, copy=True)
+        sampled cohort in `requeue_policy` order, skipping ids the sample
+        already contains. fifo consumes the queue front-first (bit-identical
+        to the pre-policy behavior — pinned by the chaos tests); aged serves
+        a weighted draw by rounds-waiting from `_aged_order`. Neither
+        consumes host-sampling RNG."""
+        # host-side by construction: sampled ids are host numpy, never a
+        # traced array
+        ids = np.array(ids, copy=True)  # graftlint: disable=G001
         in_cohort = {int(i) for i in ids}
-        slot, served = 0, []
-        while self._requeue and slot < len(ids):
-            cid = self._requeue.popleft()
+        order = list(self._requeue)
+        if self._requeue_policy == "aged" and len(order) > 1:
+            order = self._aged_order(order, rnd)
+        slot, served, leftover = 0, [], []
+        for cid in order:
+            if slot >= len(ids):
+                leftover.append(cid)  # no slot left: stays queued
+                continue
             if cid in in_cohort:
-                continue  # sampled naturally this round — already served
+                # sampled naturally this round — already served
+                self._requeue_enqueued.pop(cid, None)
+                continue
             in_cohort.discard(int(ids[slot]))
             ids[slot] = cid
             in_cohort.add(cid)
             served.append(cid)
+            self._requeue_enqueued.pop(cid, None)
             slot += 1
+        self._requeue = collections.deque(leftover)
         if served:
+            # stderr, like the other cohort-degradation diagnostics: the
+            # stdout metrics table must stay machine-parsable
             print(f"requeue: serving previously-dropped client(s) {served} "
-                  f"({len(self._requeue)} still queued)", flush=True)
+                  f"({len(self._requeue)} still queued)",
+                  file=sys.stderr, flush=True)
         return ids
+
+    def _aged_order(self, queue: list, rnd: int) -> list:
+        """Age-weighted serving order (requeue_policy="aged"):
+        Efraimidis–Spirakis one-pass weighted sampling without replacement,
+        weight = rounds-waiting + 1, drawn from a DEDICATED RandomState
+        pinned to (session seed, round) — deterministic, replayable, and
+        zero draws from the host-sampling stream (fifo-vs-aged never
+        changes which clients the round SAMPLES, only which queued clients
+        are served first)."""
+        rs = np.random.RandomState((self._seed * 1_000_003 + rnd) % (2**32))
+        # host ints by construction (queue bookkeeping), never traced
+        ages = np.array(  # graftlint: disable=G001
+            [rnd - self._requeue_enqueued.get(int(c), rnd) + 1
+             for c in queue], np.float64)
+        # larger age -> larger weight -> stochastically earlier: key
+        # u^(1/w) with u ~ U(0,1) sorts weighted-without-replacement
+        keys = rs.random_sample(len(queue)) ** (1.0 / ages)
+        return [queue[i] for i in np.argsort(-keys, kind="stable")]
 
     def dispatch_round(self, prep: PreparedRound, lr: float) -> InFlightRound:
         """Enqueue one round on the device WITHOUT a host sync. Chains on the
@@ -532,7 +594,8 @@ class FederatedSession:
                              prep.snapshot, stacked=False,
                              masked=[prep.masked],
                              requeue_depths=[prep.requeue_depth],
-                             requeue=prep.requeue)
+                             requeue=prep.requeue,
+                             requeue_ages=prep.requeue_ages)
 
     def dispatch_block(self, preps: list[PreparedRound], lrs) -> InFlightRound:
         """Enqueue a K-round fused block (ONE device dispatch, lax.scan over
@@ -552,7 +615,10 @@ class FederatedSession:
         # spike on one chip, defeating the memory story this feature and
         # client_chunk exist for. device transfer happens once, sharded.
         stacked = jax.tree.map(
-            lambda *xs: np.stack([np.asarray(x) for x in xs]),
+            # prep batches are host numpy by construction (prepare_round
+            # assembles them on the host thread), so this asarray is host
+            # stacking, not a device sync
+            lambda *xs: np.stack([np.asarray(x) for x in xs]),  # graftlint: disable=G001
             *[p.batch for p in preps],
         )
         if self.mesh is not None:
@@ -570,8 +636,10 @@ class FederatedSession:
                              preps[-1].snapshot, stacked=True,
                              masked=[p.masked for p in preps],
                              requeue_depths=[p.requeue_depth for p in preps],
-                             requeue=preps[-1].requeue)
+                             requeue=preps[-1].requeue,
+                             requeue_ages=preps[-1].requeue_ages)
 
+    # graftlint: drain-point — commit IS the sanctioned per-round sync
     def commit_round(self, infl: InFlightRound, metrics_host=None) -> list[dict]:
         """Publish one dispatched round/block: sync its metrics (unless the
         caller already fetched them), assign the state futures, run the
@@ -620,6 +688,7 @@ class FederatedSession:
                 self.client_state = last.new_client_state
             self.rng_snapshot = last.snapshot
             self._requeue_committed = last.requeue
+            self._requeue_ages_committed = last.requeue_ages
             if self._inflight == 0:
                 self._head_state = None
                 self._head_client_state = None
@@ -700,6 +769,9 @@ class FederatedSession:
         return self.commit_round(self.dispatch_block(preps, lrs))
 
     # -- evaluation (SURVEY.md §3.4: forward-only, no compression) -----------
+    # graftlint: drain-point — eval runs only at a drained boundary (checked
+    # below: raises if any dispatch is in flight), so its metric syncs are
+    # the sanctioned kind
     def evaluate(self, dataset: FedDataset, batch_size: int = 512) -> dict:
         """Forward-only metrics over the whole eval set. On a mesh the batch
         axis shards over the client axes (eval has no client dimension — it's

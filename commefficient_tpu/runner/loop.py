@@ -69,6 +69,8 @@ def _process_count() -> int:
     return jax.process_count()
 
 
+# graftlint: drain-point — deliberate one-shot sync probe at loop start;
+# the measured RTT is what the in-flight chain amortizes
 def measure_rtt_ms(samples: int = 5) -> float:
     """Median host<->device round-trip of a trivial jitted op + device_get —
     the per-drain sync cost the in-flight chain exists to amortize (tens of
@@ -298,6 +300,8 @@ def run_loop(
     last_drain_t = time.perf_counter()
     first_drain = True
 
+    # graftlint: drain-point — THE drain point: one batched device_get for
+    # every pending round's metrics
     def drain(watch: bool = True):
         """Commit every pending dispatch: ONE batched device_get for all
         their metrics, then in-order publication + metric folding. In auto
@@ -474,8 +478,11 @@ def run_loop(
             session.rng.set_state(rng_state)
             session._rng_key = rng_key
             # same discipline for the dropped-client re-queue: uncommitted
-            # prepares may have served (or grown) the live queue
+            # prepares may have served (or grown) the live queue — restore
+            # the ages WITH it, or the aged policy's weights would diverge
+            # from the committed sequence on session reuse
             session._requeue = collections.deque(session._requeue_committed)
+            session._requeue_enqueued = dict(session._requeue_ages_committed)
     # shutdown() tolerates a stored async-save failure: the final
     # synchronous save below is the corrective action (it carries its own
     # retries), and an hours-old transient write error must not block it

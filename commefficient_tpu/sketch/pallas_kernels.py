@@ -272,6 +272,8 @@ def query_all(spec, table: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarr
 _PROBE: dict = {}
 
 
+# graftlint: drain-point — one-shot availability probe at first use; the
+# block_until_ready is the point (a deferred Mosaic failure must surface HERE)
 def probe(c: int = 1024, r: int = 3) -> tuple[bool, str | None]:
     """Compile and run both kernels once PER (c, r) LAYOUT on the current
     default backend; cache (ok, full traceback). Called by

@@ -75,7 +75,7 @@ class CheckpointVerifyError(RuntimeError):
     exhaustion propagates it to the caller LOUDLY."""
 
 
-def _unpadded_client_state(client_state, num_clients: int):
+def _unpadded_client_state(client_state: Any, num_clients: int) -> Any:
     """Host copy of per-client state with mesh-padding rows stripped, so a
     checkpoint is portable between sharded and unsharded sessions (the mesh
     session pads [num_clients, d] to a multiple of the client-axis size)."""
@@ -91,7 +91,7 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
-def _write_manifest(path: str):
+def _write_manifest(path: str) -> None:
     sums = {}
     for root, _, files in os.walk(path):
         for f in sorted(files):
@@ -308,6 +308,11 @@ def restore(path: str, session) -> None:
             requeued = [int(i) for i in meta.get("requeued", [])]
             session._requeue = collections.deque(requeued)
             session._requeue_committed = tuple(requeued)
+            # queue AGES are advisory and not persisted (the aged policy is
+            # a fairness stub): restored entries restart at rounds-waiting 1
+            if hasattr(session, "_requeue_enqueued"):
+                session._requeue_enqueued = {
+                    cid: session.round for cid in requeued}
         saved_w = meta.get("num_workers")
         if saved_w is not None and saved_w != session.num_workers:
             print(
@@ -422,7 +427,7 @@ def restore_latest(ckpt_dir: str, session) -> str | None:
     return restored_path
 
 
-def _prune(ckpt_dir: str, keep: int):
+def _prune(ckpt_dir: str, keep: int) -> None:
     names = _round_dirs(ckpt_dir)  # damaged trees never count toward keep
     stale = names[:-keep] if keep > 0 else []
     # abandoned staging dirs (crash mid-write) are dead weight: sweep them

@@ -77,6 +77,16 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "merge and removed from the renormalization, so one "
                         "poisoned update costs one client, not the round. "
                         "Counted per round as clients_quarantined. 0 = off")
+    p.add_argument("--requeue_policy", default="fifo",
+                   choices=["fifo", "aged"],
+                   help="serving order for the dropped-client re-queue: "
+                        "fifo (default; substitution order = drop order) or "
+                        "aged (weighted choice by rounds-waiting from a "
+                        "pinned dedicated seed — at high drop rates FIFO "
+                        "can starve recently-dropped clients behind a long "
+                        "head; aged keeps expected wait bounded). Both "
+                        "consume zero host-sampling RNG, so the sampled "
+                        "cohort stream is policy-invariant")
     p.add_argument("--rounds_per_dispatch", type=int, default=1,
                    help="> 1 compiles this many rounds into one program "
                         "(lax.scan) with a single host sync per block — "

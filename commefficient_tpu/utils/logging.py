@@ -14,7 +14,7 @@ import time
 class Timer:
     """Wall-clock phase timer: t = timer(); ... ; dt = timer()."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._last = time.perf_counter()
         self.total = 0.0
 
@@ -29,11 +29,11 @@ class Timer:
 class TableLogger:
     """Fixed-width column table printed incrementally, one row per epoch."""
 
-    def __init__(self, jsonl_path: str | None = None):
+    def __init__(self, jsonl_path: str | None = None) -> None:
         self.columns: list[str] | None = None
         self.jsonl_path = jsonl_path
 
-    def append(self, row: dict):
+    def append(self, row: dict) -> None:
         if self.columns is None:
             self.columns = list(row.keys())
             print("  ".join(f"{c:>12s}" for c in self.columns), flush=True)

@@ -12,7 +12,7 @@ class PiecewiseLinear:
     evaluated at fractional epochs.
     """
 
-    def __init__(self, knots: list[float], values: list[float]):
+    def __init__(self, knots: list[float], values: list[float]) -> None:
         if len(knots) != len(values) or len(knots) < 2:
             raise ValueError("need >= 2 matching knots/values")
         if any(b <= a for a, b in zip(knots, knots[1:])):

@@ -21,7 +21,7 @@ SPECIAL_TOKENS = ("<bos>", "<eos>", "<speaker1>", "<speaker2>", "<pad>")
 class ByteTokenizer:
     """Byte-level tokenizer: 256 byte values + the 5 dialog specials."""
 
-    def __init__(self):
+    def __init__(self) -> None:
         self.bos_id = 256
         self.eos_id = 257
         self.speaker1_id = 258
@@ -32,7 +32,7 @@ class ByteTokenizer:
     def encode(self, text: str) -> list[int]:
         return list(text.encode("utf-8", errors="replace"))
 
-    def decode(self, ids) -> str:
+    def decode(self, ids: "list[int]") -> str:
         return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
 
 
@@ -40,7 +40,7 @@ class HFTokenizer:
     """GPT-2 BPE with the dialog specials appended (ids >= 50257), as the
     reference's `add_special_tokens_` does before fine-tuning."""
 
-    def __init__(self, tok):
+    def __init__(self, tok) -> None:
         self.tok = tok
         tok.add_special_tokens({
             "bos_token": SPECIAL_TOKENS[0],
@@ -58,11 +58,11 @@ class HFTokenizer:
     def encode(self, text: str) -> list[int]:
         return self.tok.encode(text)
 
-    def decode(self, ids) -> str:
+    def decode(self, ids: "list[int]") -> str:
         return self.tok.decode(list(ids))
 
 
-def get_tokenizer():
+def get_tokenizer() -> "ByteTokenizer | HFTokenizer":
     try:
         from transformers import GPT2TokenizerFast
 

@@ -64,7 +64,7 @@ class RoundWatchdog:
         alert=None,
         on_emergency=None,
         on_abort=None,
-    ):
+    ) -> None:
         """factor: stall threshold as a multiple of the median round time.
         min_history: completed rounds before the watchdog arms (first rounds
         include compiles). floor_s: never alert before this many seconds,
@@ -104,7 +104,7 @@ class RoundWatchdog:
         return max(self.factor * self._median(), self.floor_s)
 
     def _arm_stage(self, round_index: int, thr: float, start: float,
-                   stage: int, gen: int):
+                   stage: int, gen: int) -> None:
         """Caller holds self._lock."""
         delay = max(thr * self.LADDER[stage] - (time.monotonic() - start), 0.0)
         self._timer = threading.Timer(
@@ -114,7 +114,7 @@ class RoundWatchdog:
         self._timer.start()
 
     def _fire(self, round_index: int, thr: float, start: float, stage: int,
-              gen: int):
+              gen: int) -> None:
         with self._lock:
             # the round can complete in the instant between this timer
             # expiring and round()'s cancel() — and cancel() cannot stop a

@@ -94,6 +94,7 @@ def build(args, fault_plan=None, retry_policy=None):
         dp_noise=args.dp_noise,
         client_dropout=args.client_dropout,
         client_update_clip=args.client_update_clip,
+        requeue_policy=args.requeue_policy,
         split_compile=args.split_compile,
         client_chunk=args.client_chunk,
         on_nonfinite=args.on_nonfinite,

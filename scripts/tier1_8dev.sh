@@ -14,6 +14,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# static-analysis gate first (graftlint + ruff + mypy, < 60 s, jax-free):
+# a contract violation should fail the slice before any test compiles.
+# LINT_SKIP=1 skips it (escape hatch, e.g. mid-bisect).
+scripts/lint.sh
+
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 

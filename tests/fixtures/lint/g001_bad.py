@@ -1,0 +1,11 @@
+# graftlint: module=commefficient_tpu/federated/fake_dispatch.py
+# G001 violating twin: host syncs on the round path, no drain point.
+import jax
+
+
+def dispatch_round(session, infl):
+    # hidden sync: blocks the dispatch thread on device completion
+    metrics = jax.device_get(infl.metrics)
+    # hidden sync: .item() forces a device round-trip per scalar
+    loss = infl.loss.item()
+    return metrics, loss

@@ -1,0 +1,13 @@
+# graftlint: module=commefficient_tpu/federated/fake_dispatch.py
+# G001 conforming twin: dispatch defers, the declared drain point syncs.
+import jax
+
+
+def dispatch_round(session, infl):
+    # no host sync: metrics stay device arrays until the drain boundary
+    return infl.metrics
+
+
+# graftlint: drain-point — the sanctioned batched sync
+def drain(pending):
+    return jax.device_get([fl.metrics for fl in pending])

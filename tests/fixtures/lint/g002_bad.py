@@ -1,0 +1,8 @@
+# graftlint: module=commefficient_tpu/modes/fake_merge.py
+# G002 violating twin: unordered cross-device reduction in parity scope.
+from jax import lax
+
+
+def merge_partial_tables(tables, axis_names):
+    # a ring psum reassociates the fp sum per topology: parity breaks
+    return lax.psum(tables, axis_names)

@@ -1,0 +1,8 @@
+# graftlint: module=commefficient_tpu/modes/fake_merge.py
+# G002 conforming twin: all_gather + ORDERED sum (the sanctioned merge).
+from jax import lax
+
+
+def merge_partial_tables(table_local, axis_names):
+    stacked = lax.all_gather(table_local, axis_names, axis=0)
+    return stacked.sum(axis=0)

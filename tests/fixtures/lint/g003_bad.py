@@ -1,0 +1,13 @@
+# graftlint: module=commefficient_tpu/federated/fake_step.py
+# G003 violating twin: direct reads of the reserved `_valid` batch leaf.
+VALID_KEY = "_valid"
+
+
+def step(state, batch):
+    valid = batch["_valid"]          # direct subscript read
+    fallback = batch.get("_valid")   # .get read
+    return valid, fallback
+
+
+def step_symbolic(state, batch):
+    return batch[VALID_KEY]          # symbolic read is the same violation

@@ -1,0 +1,22 @@
+# graftlint: module=commefficient_tpu/federated/fake_step.py
+# G003 conforming twin: the mask is INSTALLED by assignment (legal: that is
+# the injection side) and CONSUMED only via split_valid.
+VALID_KEY = "_valid"
+
+
+def split_valid(batch):
+    if isinstance(batch, dict) and VALID_KEY in batch:
+        batch = dict(batch)
+        return batch, batch.pop(VALID_KEY)
+    return batch, None
+
+
+def prepare(batch, valid):
+    batch = dict(batch)
+    batch[VALID_KEY] = valid  # Store context: installing the mask is legal
+    return batch
+
+
+def step(state, batch):
+    batch, valid = split_valid(batch)
+    return state, valid

@@ -1,0 +1,15 @@
+# graftlint: module=commefficient_tpu/resilience/fake_saver.py
+# G004 violating twin: raw writes into a checkpoint dir, no staging/manifest.
+import json
+import os
+import pickle
+
+import numpy as np
+
+
+def save_state(ckpt_dir, state, meta):
+    np.save(os.path.join(ckpt_dir, "state.npy"), state)
+    with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    with open(ckpt_dir + "/rng.pkl", "wb") as fh:
+        pickle.dump(meta, fh)

@@ -1,0 +1,13 @@
+# graftlint: module=commefficient_tpu/federated/fake_session.py
+# G005 violating twin: the donated input is read after the jitted call.
+import jax
+
+
+def body(state, batch):
+    return state
+
+
+def run(state, batch):
+    step = jax.jit(body, donate_argnums=(0,))
+    new_state = step(state, batch)
+    return state["params"], new_state  # `state`'s buffer is deleted on TPU

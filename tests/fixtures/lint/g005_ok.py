@@ -1,0 +1,14 @@
+# graftlint: module=commefficient_tpu/federated/fake_session.py
+# G005 conforming twin: the canonical donation idiom rebinds the name, and
+# only the returned state is read afterwards.
+import jax
+
+
+def body(state, batch):
+    return state
+
+
+def run(state, batch):
+    step = jax.jit(body, donate_argnums=(0,))
+    state = step(state, batch)  # rebind: the old buffer has no readers
+    return state["params"], state

@@ -1,0 +1,18 @@
+# graftlint: module=commefficient_tpu/federated/fake_noise.py
+# G006 violating twin: one key feeds two consumers (correlated streams).
+import jax
+
+
+def sample_batch(shape):
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, shape)
+    y = jax.random.uniform(key, shape)  # reuse: correlated with x
+    return x, y
+
+
+def per_step(key, xs):
+    out = []
+    for x in xs:
+        # loop-invariant key: every iteration draws the same stream
+        out.append(jax.random.normal(key, x.shape))
+    return out

@@ -1,0 +1,16 @@
+# graftlint: module=commefficient_tpu/federated/fake_noise.py
+# G006 conforming twin: split first, one consumer per key; fold_in with
+# distinct ints is derivation, not consumption.
+import jax
+
+
+def sample_batch(shape):
+    kx, ky = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(kx, shape)
+    y = jax.random.uniform(ky, shape)
+    return x, y
+
+
+def per_item(key, xs):
+    return [jax.random.normal(jax.random.fold_in(key, i), x.shape)
+            for i, x in enumerate(xs)]

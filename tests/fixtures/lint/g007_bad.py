@@ -1,0 +1,15 @@
+# graftlint: module=commefficient_tpu/runner/fake_loop.py
+# G007 violating twin: a blocking sleep reachable from the dispatch path
+# (run_loop -> _poll_ready -> time.sleep).
+import time
+
+
+def _poll_ready(session):
+    while not session.ready:
+        time.sleep(0.5)
+
+
+def run_loop(session, cfg):
+    for _ in range(cfg.total_rounds):
+        _poll_ready(session)
+        session.dispatch()

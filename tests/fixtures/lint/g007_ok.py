@@ -1,0 +1,16 @@
+# graftlint: module=commefficient_tpu/runner/fake_loop.py
+# G007 conforming twin: the dispatch path blocks on a condition variable
+# owned by the worker thread; the sleep lives on the writer thread, which
+# is not reachable from run_loop.
+import time
+
+
+def _writer_thread(writer):
+    while writer.alive:
+        time.sleep(0.5)  # not reachable from the dispatch roots
+        writer.flush()
+
+
+def run_loop(session, cfg):
+    for _ in range(cfg.total_rounds):
+        session.dispatch()

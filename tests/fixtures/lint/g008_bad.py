@@ -1,0 +1,8 @@
+# graftlint: module=commefficient_tpu/runner/fake_config.py
+# G008 violating twin: flags read in runner code that were never registered
+# through utils/config.py (typo'd and smuggled).
+def from_args(args):
+    return {
+        "turbo": args.turbo_mode,                 # unregistered flag
+        "depth": getattr(args, "pipeline_depthh", 0),  # typo'd getattr
+    }

@@ -1,0 +1,8 @@
+# graftlint: module=commefficient_tpu/runner/fake_config.py
+# G008 conforming twin: every read is a flag utils/config.py registers.
+def from_args(args):
+    return {
+        "checkpoint_every": args.checkpoint_every,
+        "sync_loop": args.sync_loop,
+        "depth": getattr(args, "prefetch_depth", 0),
+    }

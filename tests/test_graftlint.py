@@ -1,0 +1,276 @@
+"""graftlint (commefficient_tpu/analysis/) — the static-analysis suite.
+
+Three layers:
+
+1. Fixture corpus: per rule code, a minimal VIOLATING snippet must fire
+   (>= 1 finding of exactly that code) and its CONFORMING twin must stay
+   silent for that code. Fixtures impersonate in-scope modules with a
+   `# graftlint: module=` directive, so the scoped rules engage.
+2. The real repo: `--json` over commefficient_tpu/ must exit 0 against the
+   shipped baseline, and the shipped baseline must carry ZERO G002/G003/G004
+   entries (those contracts admit no grandfathering).
+3. Directive hygiene: `# graftlint: disable=` must name a valid rule code
+   (a bad code is itself reported, G000, and is not suppressible).
+
+Pure-host tests: the linter never imports the analyzed code, so none of
+this touches jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from commefficient_tpu.analysis import ALL_RULES, RULE_CODES, Analyzer
+from commefficient_tpu.analysis.baseline import DEFAULT_BASELINE, Baseline
+from commefficient_tpu.analysis.rules_config import registered_flags
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "commefficient_tpu")
+
+
+def _codes(path: str) -> list[str]:
+    result = Analyzer().run([path])
+    return [v.code for v in result.violations]
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+@pytest.mark.parametrize("code", RULE_CODES)
+def test_rule_fires_on_violating_fixture(code):
+    path = os.path.join(FIXTURES, f"{code.lower()}_bad.py")
+    assert os.path.exists(path), f"missing violating fixture for {code}"
+    found = _codes(path)
+    assert code in found, (
+        f"{code} did not fire on its violating fixture (found: {found})")
+
+
+@pytest.mark.parametrize("code", RULE_CODES)
+def test_rule_silent_on_conforming_fixture(code):
+    path = os.path.join(FIXTURES, f"{code.lower()}_ok.py")
+    assert os.path.exists(path), f"missing conforming fixture for {code}"
+    found = _codes(path)
+    assert code not in found, (
+        f"{code} false-positived on its conforming twin (found: {found})")
+
+
+def test_every_rule_has_fixture_pair():
+    # adding a rule without fixtures should fail HERE, not in review
+    for code in RULE_CODES:
+        for suffix in ("bad", "ok"):
+            assert os.path.exists(
+                os.path.join(FIXTURES, f"{code.lower()}_{suffix}.py"))
+
+
+def test_rule_codes_unique_and_well_formed():
+    assert len(set(RULE_CODES)) == len(RULE_CODES)
+    for rule in ALL_RULES:
+        assert rule.code.startswith("G") and len(rule.code) == 4
+        assert rule.name and rule.fixit
+
+
+# ------------------------------------------------------------- the real repo
+
+
+def test_repo_is_clean_under_shipped_baseline():
+    """The acceptance gate: `python -m commefficient_tpu.analysis
+    commefficient_tpu/ --json` exits 0 on the PR head."""
+    out = subprocess.run(
+        [sys.executable, "-m", "commefficient_tpu.analysis", PKG, "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    report = json.loads(out.stdout)
+    assert out.returncode == 0, (
+        f"graftlint found violations:\n"
+        + "\n".join(f"{v['rel']}:{v['lineno']}: {v['code']} {v['message']}"
+                    for v in report["violations"]))
+    assert report["ok"] is True
+    assert report["files_checked"] > 40
+
+
+def test_shipped_baseline_has_no_parity_leaf_or_ckpt_entries():
+    """G002/G003/G004 admit no grandfathering — the shipped baseline must
+    end every PR empty of them."""
+    baseline = Baseline.load(DEFAULT_BASELINE)
+    banned = {e["code"] for e in baseline.entries} & {"G002", "G003", "G004"}
+    assert not banned, f"baseline grandfathers banned codes: {banned}"
+
+
+def test_clis_and_bench_are_clean():
+    paths = [os.path.join(REPO, f)
+             for f in ("cv_train.py", "gpt2_train.py", "bench.py")]
+    result = Analyzer().run(paths)
+    assert result.ok, [v.format() for v in result.violations]
+
+
+# ------------------------------------------------------------- directives
+
+
+def test_disable_must_name_valid_rule_code(tmp_path):
+    bad = tmp_path / "bad_directive.py"
+    bad.write_text(
+        "import jax\n"
+        "x = 1  # graftlint: disable=G999\n"
+        "y = 2  # graftlint: disable=frobnicate\n"
+    )
+    codes = _codes(str(bad))
+    assert codes.count("G000") == 2, codes
+
+
+def test_bad_directive_is_not_suppressible(tmp_path):
+    f = tmp_path / "self_suppress.py"
+    # disabling G000 on the same line must not silence the directive error
+    f.write_text("x = 1  # graftlint: disable=G000\n")
+    assert "G000" in _codes(str(f))
+
+
+def test_valid_disable_suppresses(tmp_path):
+    f = tmp_path / "suppressed.py"
+    f.write_text(
+        "# graftlint: module=commefficient_tpu/modes/fake.py\n"
+        "from jax import lax\n"
+        "def merge(t, ax):\n"
+        "    return lax.psum(t, ax)  # graftlint: disable=G002 — test\n"
+    )
+    result = Analyzer().run([str(f)])
+    assert result.ok
+    assert result.suppressed == 1
+
+
+def test_drain_point_exempts_whole_function(tmp_path):
+    f = tmp_path / "drained.py"
+    f.write_text(
+        "# graftlint: module=commefficient_tpu/federated/fake.py\n"
+        "import jax\n"
+        "# graftlint: drain-point — test boundary\n"
+        "def commit(pending):\n"
+        "    return jax.device_get(pending)\n"
+    )
+    assert "G001" not in _codes(str(f))
+
+
+def test_unknown_directive_verb_is_reported(tmp_path):
+    f = tmp_path / "verb.py"
+    f.write_text("x = 1  # graftlint: frobnicate=G001\n")
+    assert "G000" in _codes(str(f))
+
+
+# ------------------------------------------------------------- baseline
+
+
+def test_baseline_matches_by_line_text_not_lineno(tmp_path):
+    src = tmp_path / "grandfathered.py"
+    src.write_text(
+        "# graftlint: module=commefficient_tpu/runner/fake.py\n"
+        "def from_args(args):\n"
+        "    return args.not_a_flag\n"
+    )
+    result = Analyzer().run([str(src)])
+    (v,) = result.violations
+    bl = Baseline([{"path": v.rel, "code": v.code,
+                    "line": v.line_text.strip()}])
+    # shifting the site down two lines must not invalidate the entry
+    src.write_text(
+        "# graftlint: module=commefficient_tpu/runner/fake.py\n"
+        "\n\n"
+        "def from_args(args):\n"
+        "    return args.not_a_flag\n"
+    )
+    result = Analyzer(baseline=bl).run([str(src)])
+    assert result.ok and len(result.baselined) == 1
+
+
+def test_stale_baseline_entries_are_reported(tmp_path):
+    src = tmp_path / "fixed.py"
+    src.write_text("x = 1\n")
+    bl = Baseline([{"path": "fixed.py", "code": "G008",
+                    "line": "return args.gone"}])
+    result = Analyzer(baseline=bl).run([str(src)])
+    assert result.ok
+    assert len(result.stale_baseline) == 1
+
+
+def test_write_baseline_refuses_banned_codes(tmp_path):
+    src = tmp_path / "mixed.py"
+    src.write_text(
+        "# graftlint: module=commefficient_tpu/modes/fake.py\n"
+        "from jax import lax\n"
+        "def merge(t, ax):\n"
+        "    return lax.psum(t, ax)\n"
+    )
+    bl_path = tmp_path / "baseline.json"
+    out = subprocess.run(
+        [sys.executable, "-m", "commefficient_tpu.analysis", str(src),
+         "--baseline", str(bl_path), "--write-baseline"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    written = json.loads(bl_path.read_text())
+    assert written["entries"] == []  # G002 must be fixed, not grandfathered
+    assert "refused" in out.stdout
+
+
+# ------------------------------------------------------------- G008 plumbing
+
+
+def test_registered_flags_extracted_from_config():
+    flags = registered_flags()
+    # a few load-bearing names from both task variants
+    for name in ("checkpoint_every", "sync_loop", "max_inflight",
+                 "fault_plan", "mesh", "model_parallel", "requeue_policy"):
+        assert name in flags, name
+
+
+def test_typoed_path_fails_loudly():
+    # a gate that silently checks zero files is permanently green — a bad
+    # path must exit 2, not 0
+    out = subprocess.run(
+        [sys.executable, "-m", "commefficient_tpu.analysis",
+         "no_such_dir_xyz"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert out.returncode == 2
+    assert "no_such_dir_xyz" in out.stderr
+
+
+def test_write_baseline_refuses_select():
+    # a partial-rule rewrite would discard other rules' grandfathered
+    # entries (the baseline file is rewritten whole)
+    out = subprocess.run(
+        [sys.executable, "-m", "commefficient_tpu.analysis", PKG,
+         "--select", "G001", "--write-baseline"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert out.returncode == 2
+    assert "cannot be combined" in out.stderr
+
+
+def test_report_json_flag_writes_archive(tmp_path):
+    report = tmp_path / "report.json"
+    out = subprocess.run(
+        [sys.executable, "-m", "commefficient_tpu.analysis",
+         os.path.join(FIXTURES, "g002_ok.py"), "--report-json", str(report)],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert out.returncode == 0
+    assert json.loads(report.read_text())["ok"] is True
+    assert "graftlint:" in out.stdout  # human text still on stdout
+
+
+def test_json_report_shape(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "commefficient_tpu.analysis",
+         os.path.join(FIXTURES, "g002_bad.py"), "--json", "--no-baseline"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert out.returncode == 1
+    report = json.loads(out.stdout)
+    assert report["counts"].get("G002") == 1
+    (v,) = report["violations"]
+    assert {"code", "rel", "lineno", "message", "fixit"} <= set(v)
