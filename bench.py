@@ -326,6 +326,16 @@ BASELINE_BASIS = os.environ.get("BENCH_BASELINE_BASIS", "1") == "1"
 # headline, not a claim. resnet9 only (the flagship the driver measures).
 RUN_LOOP = os.environ.get("BENCH_RUN_LOOP", "1") == "1"
 RUN_LOOP_ROUNDS = int(os.environ.get("BENCH_RUN_LOOP_ROUNDS", 30))
+# Streaming-aggregation service section (serve/): (a) sustained ingest
+# throughput (accepted client-updates/s) through the admission-control path
+# under the diurnal trace, (b) host-memory flatness of the O(1) fold_in
+# client state at a 10M-ID population vs 10k (the no-per-client-table
+# acceptance check), (c) submission-to-merge latency p50/p99 through a REAL
+# served session (invite -> push -> W-of-N close -> dispatch -> commit).
+# resnet9 only, like run_loop; {"skipped": ...} when unavailable.
+SERVE_BENCH = os.environ.get("BENCH_SERVE", "1") == "1"
+SERVE_ROUNDS = int(os.environ.get("BENCH_SERVE_ROUNDS", 12))
+SERVE_POPULATION = int(os.environ.get("BENCH_SERVE_POPULATION", 10_000_000))
 # Mesh scaling section: time the SPMD sharded round (engine.
 # make_sharded_round_step — per-device partial sketch + one table merge)
 # at the same global cohort across 1, 2, 4, ... visible devices, and record
@@ -1041,6 +1051,170 @@ def _run_loop_bench(round_ms: float) -> dict:
     return out
 
 
+def _serve_bench() -> dict:
+    """Streaming-aggregation service measurements (see the SERVE_BENCH
+    comment). Never raises; {"skipped": ...} when the serving deps are
+    unavailable in this environment."""
+    import time as _time
+    import tracemalloc
+
+    import numpy as np
+
+    try:
+        from commefficient_tpu.serve import (
+            AggregationService, IngestQueue, ServeConfig, Submission,
+            TraceConfig, TrafficGenerator,
+        )
+    except Exception as e:  # noqa: BLE001 — the skipped stanza IS the result
+        return {"skipped": f"serve deps unavailable: {type(e).__name__}: {e}"}
+
+    out: dict = {"rounds": SERVE_ROUNDS}
+    try:
+        # (a) ingest throughput: the admission-control hot path alone —
+        # open_round + submit over a realistic accept/reject mix from the
+        # diurnal trace (uninvited pushes bounce, invited ones admit)
+        trace = TraceConfig(population=10_000, base_rate=2_000.0,
+                            burst_rate=0.2, burst_size=100, seed=7)
+        gen = TrafficGenerator(trace)
+        queue = IngestQueue(capacity=65_536, pending_capacity=1024)
+        rs = np.random.RandomState(3)
+        invited = rs.choice(trace.population, size=4096, replace=False)
+        queue.open_round(0, invited)
+        n_sub = 0
+        t0 = _time.perf_counter()
+        for t, ids in gen.arrival_events(6 * 3600.0, 30.0, window_s=1.0):
+            for cid in ids:
+                queue.submit(Submission(client_id=int(cid), round=0,
+                                        latency_s=float(t)))
+                n_sub += 1
+        wall = _time.perf_counter() - t0
+        c = queue.counters()
+        out["ingest"] = {
+            "submissions": n_sub,
+            "submissions_per_sec": round(n_sub / max(wall, 1e-9), 1),
+            "accepted_per_sec": round(c["accepted"] / max(wall, 1e-9), 1),
+            "counters": c,
+        }
+
+        # (b) O(1) client-state memory: derive device classes + response
+        # latencies for identical-size invite batches out of a 10k and a
+        # {SERVE_POPULATION} population — peak host memory must be FLAT
+        # (no per-client table anywhere on the path)
+        def peak_bytes(population: int) -> int:
+            g = TrafficGenerator(TraceConfig(population=population, seed=11))
+            rs = np.random.RandomState(5)
+            tracemalloc.start()
+            for rnd in range(20):
+                ids = rs.randint(0, population, size=4096)
+                g.invite_latencies(rnd, ids)
+            _, peak = tracemalloc.get_traced_memory()
+            tracemalloc.stop()
+            return peak
+
+        small, big = peak_bytes(10_000), peak_bytes(SERVE_POPULATION)
+        out["client_state_memory"] = {
+            "population_small": 10_000,
+            "population_big": SERVE_POPULATION,
+            "peak_bytes_small": small,
+            "peak_bytes_big": big,
+            "big_over_small": round(big / max(small, 1), 3),
+            "flat": bool(big <= 2 * small),
+            "note": "per-(client,round) streams are pure fold_in functions "
+                    "of (seed, id): memory scales with the invite batch, "
+                    "never the population",
+        }
+
+        # (c) submission-to-merge latency through a REAL served session:
+        # wall time from a submission's ACCEPT to the commit that published
+        # its round's merged update
+        params, net_state, _, loss_fn, _, sketch_kw, workers = _resnet9_workload()
+        import jax
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+
+        from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+        from commefficient_tpu.federated.api import FederatedSession
+        from commefficient_tpu.modes.config import ModeConfig
+
+        d = ravel_pytree(params)[0].size
+        rng = np.random.RandomState(0)
+        n_examples = max(512, workers * LOCAL_BATCH * 4)
+        x = rng.randn(n_examples, 32, 32, 3).astype(np.float32)
+        y = rng.randint(0, 10, size=n_examples).astype(np.int32)
+        train_set = FedDataset(
+            x, y, shard_iid(n_examples, max(2 * workers, 8),
+                            np.random.RandomState(1)))
+        mode_cfg = ModeConfig(
+            mode="sketch", d=d, momentum_type="virtual", error_type="virtual",
+            topk_impl=os.environ.get("BENCH_TOPK_IMPL", "approx"),
+            topk_recall=float(os.environ.get("BENCH_TOPK_RECALL", 0.99)),
+            **sketch_kw,
+        )
+        session = FederatedSession(
+            train_loss_fn=loss_fn, eval_loss_fn=loss_fn,
+            params=jax.tree.map(jnp.copy, params),
+            net_state=jax.tree.map(jnp.copy, net_state),
+            mode_cfg=mode_cfg, train_set=train_set, num_workers=workers,
+            local_batch_size=LOCAL_BATCH, weight_decay=5e-4, seed=0,
+            split_compile=BENCH_ENGINE_COMPILE == "split",
+        )
+        quorum = max(workers * 3 // 4, 1)
+        service = AggregationService(
+            session,
+            ServeConfig(quorum=quorum, deadline_s=8.0),
+            traffic=TrafficGenerator(
+                TraceConfig(population=train_set.num_clients, seed=0)),
+        ).start()
+        try:
+            accept_t: dict = {}
+            orig_submit = service.transport.submit
+
+            def timed_submit(sub):
+                status = orig_submit(sub)
+                if status == "ACCEPTED":
+                    accept_t[(sub.round, sub.client_id)] = _time.perf_counter()
+                return status
+
+            service.transport.submit = timed_submit
+            src = service.source()
+            latencies = []
+            t0 = _time.perf_counter()
+            rounds_done = 0
+            for _ in range(SERVE_ROUNDS):
+                prep = src.next()
+                session.commit_round(session.dispatch_round(prep, 0.01))
+                t_commit = _time.perf_counter()
+                latencies.extend(
+                    (t_commit - t) * 1e3 for (r, _), t in accept_t.items()
+                    if r == prep.rnd)
+                accept_t = {k: v for k, v in accept_t.items()
+                            if k[0] != prep.rnd}
+                rounds_done += 1
+            wall = _time.perf_counter() - t0
+            lat = sorted(latencies)
+            out["served_loop"] = {
+                "quorum": quorum,
+                "invited_per_round": workers,
+                "wall_clock_updates_per_sec": round(
+                    sum(1 for _ in lat) / max(wall, 1e-9), 2),
+                "submit_to_merge_ms": {
+                    "p50": round(lat[len(lat) // 2], 2) if lat else None,
+                    "p99": round(lat[min(len(lat) - 1,
+                                         int(len(lat) * 0.99))], 2)
+                    if lat else None,
+                    "n": len(lat),
+                },
+                "rounds_counters": service.assembler.counters(),
+                "note": "first round carries the jit compile; p50 is the "
+                        "honest steady-state figure, p99 the compile tail",
+            }
+        finally:
+            service.close()
+    except Exception as e:  # noqa: BLE001 — partial sections still report
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def _mesh_bench(rt_ms: float) -> dict:
     """Strong-scaling curve of the SPMD sharded round: the SAME global
     cohort (NUM_WORKERS clients) on 1, 2, 4, ... devices, per-device and
@@ -1344,6 +1518,17 @@ def run_bench(platform: str) -> dict:
             result["run_loop"] = {
                 "skipped": "run-loop section measures the flagship resnet9 "
                            "workload (BENCH_MODEL=resnet9)"}
+    if SERVE_BENCH:
+        if BENCH_MODEL == "resnet9":
+            _stage("serve (ingest throughput / O(1) client state / "
+                   "submission-to-merge latency) ...")
+            result["serve"] = _serve_bench()
+            _stage(f"serve: {result['serve']}")
+        else:
+            result["serve"] = {
+                "skipped": "serve section measures the flagship resnet9 "
+                           "workload (BENCH_MODEL=resnet9)"}
+
     # chaos runs are benchmarkable: what the resilience layer absorbed while
     # this process produced the numbers above (nonzero only under
     # BENCH_FAULT_PLAN or real flakes)
@@ -1374,14 +1559,15 @@ def _shrink_for_cpu():
                         ("WARMUP_ROUNDS", 1), ("MICROBENCH_D", 2_000_000),
                         ("MICRO_CHAIN", 3), ("SKETCH_COLS", 65_536),
                         ("TOPK", 8_192), ("PHASE_CHAIN", 2),
-                        ("RUN_LOOP_ROUNDS", 6)]:
+                        ("RUN_LOOP_ROUNDS", 6), ("SERVE_ROUNDS", 4)]:
         env_name = {"NUM_WORKERS": "BENCH_WORKERS", "CHAIN_LEN": "BENCH_CHAIN_LEN",
                     "NUM_CHAINS": "BENCH_CHAINS", "WARMUP_ROUNDS": "BENCH_WARMUP",
                     "MICROBENCH_D": "BENCH_MICRO_D",
                     "MICRO_CHAIN": "BENCH_MICRO_CHAIN",
                     "SKETCH_COLS": "BENCH_COLS", "TOPK": "BENCH_TOPK",
                     "PHASE_CHAIN": "BENCH_PHASE_CHAIN",
-                    "RUN_LOOP_ROUNDS": "BENCH_RUN_LOOP_ROUNDS"}[name]
+                    "RUN_LOOP_ROUNDS": "BENCH_RUN_LOOP_ROUNDS",
+                    "SERVE_ROUNDS": "BENCH_SERVE_ROUNDS"}[name]
         if env_name not in os.environ:
             g[name] = small
     if "BENCH_SCALE_CHECK" not in os.environ:

@@ -4,14 +4,23 @@ Both enforce the async runner's core promise (runner/loop.py): the dispatch
 path never hides a host synchronization, and the only sanctioned sync points
 are the declared drain points — functions carrying `# graftlint:
 drain-point` above their `def` (the batched-metrics drain, commit, eval, the
-one-shot RTT probe). Everything else that forces a device round-trip or
-blocks the thread must either move behind a drain boundary or carry an
-explicit, justified suppression.
+one-shot RTT probe, the serving queue's quorum wait). Everything else that
+forces a device round-trip or blocks the thread must either move behind a
+drain boundary or carry an explicit, justified suppression.
+
+G007's reachability is PACKAGE-level: from the dispatch-path roots it
+follows same-module calls AND import bindings (`from .helper import fn`,
+`mod.fn()` through `from . import mod`) into other modules of the package,
+depth-bounded — a `time.sleep` smuggled behind a helper import is the same
+stall as an inline one. Drain-point declarations and explicit G007 disables
+in the HELPER module stop the traversal (that is how serve/transport.py
+declares its sanctioned blocking points in code).
 """
 
 from __future__ import annotations
 
 import ast
+import os
 
 from .core import PACKAGE, Rule, SourceFile, Violation
 
@@ -92,12 +101,20 @@ _BLOCKING_CALLS = {
     "time.sleep": "time.sleep() stalls the dispatch/prefetch thread",
     "os.system": "os.system() is blocking sync IO on the dispatch path",
     "open": "synchronous file IO on the dispatch path",
+    "socket.create_connection": "socket.create_connection() is a blocking "
+                                "network round-trip on the dispatch path",
 }
 
 # entry points of the dispatch/prefetch path; reachability is computed over
-# the module's own call graph from these roots
+# the package-level call graph from these roots (serve_round/submit are the
+# serving layer's dispatch-path entries)
 _ROOT_NAMES = {"run_loop", "next", "prepare_round", "dispatch_round",
-               "dispatch_block"}
+               "dispatch_block", "serve_round", "submit"}
+
+# cross-module traversal bound: hops of `from .helper import fn` / `mod.fn()`
+# indirection followed before giving up (a sleep buried deeper than this
+# behind imports is beyond honest static reach — raise it if one ever is)
+_MAX_IMPORT_DEPTH = 4
 
 
 class BlockingCallOnDispatchThread(Rule):
@@ -107,15 +124,22 @@ class BlockingCallOnDispatchThread(Rule):
              "exit path; drain points and fault-injection sites carry "
              "`# graftlint: drain-point` / an explicit disable")
 
-    SCOPE = f"{PACKAGE}/runner/"
+    SCOPE = (f"{PACKAGE}/runner/", f"{PACKAGE}/serve/")
     # the async writer runs on its own dedicated thread by design
     EXEMPT = (f"{PACKAGE}/runner/writer.py",)
+
+    def __init__(self) -> None:
+        # per-analyzer-run cache of parsed helper modules (abspath ->
+        # SourceFile | None); reachability is package-level, so one helper
+        # may be consulted from several scoped files
+        self._helpers: dict[str, SourceFile | None] = {}
 
     def applies(self, rel: str) -> bool:
         return rel.startswith(self.SCOPE) and rel not in self.EXEMPT
 
     def check(self, src: SourceFile) -> list[Violation]:
         reachable = self._reachable(src)
+        imports = _import_bindings(src)
         out: list[Violation] = []
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.Call):
@@ -130,6 +154,16 @@ class BlockingCallOnDispatchThread(Rule):
                 out.append(self.violation(
                     src, node,
                     f"{msg} (reachable from the dispatch path via {sym})"))
+                continue
+            # package-level: a call into an IMPORTED helper whose body (or
+            # transitive same-package callees) blocks — the "sleep smuggled
+            # behind a helper import" a module-local graph cannot see
+            imported = self._imported_blocking(src, node, imports)
+            if imported:
+                out.append(self.violation(
+                    src, node,
+                    f"{imported} (reachable from the dispatch path via "
+                    f"{sym}, through a helper import)"))
         return out
 
     def _blocking(self, src: SourceFile, node: ast.Call) -> str | None:
@@ -140,6 +174,97 @@ class BlockingCallOnDispatchThread(Rule):
             return f"{dotted}() launches a blocking subprocess on the " \
                    "dispatch path"
         return None
+
+    # -- package-level traversal ---------------------------------------------
+
+    def _imported_blocking(self, src: SourceFile, node: ast.Call,
+                           imports: dict) -> str | None:
+        """Resolve `fn()` / `mod.fn()` through the file's import bindings to
+        a function in another module of this package (or the fixture's
+        directory) and report the first blocking call reachable from it."""
+        target: tuple[str, str] | None = None
+        if isinstance(node.func, ast.Name):
+            target = imports.get(node.func.id)
+        elif (isinstance(node.func, ast.Attribute)
+              and isinstance(node.func.value, ast.Name)):
+            mod = imports.get(node.func.value.id)
+            if mod is not None and mod[1] == "*module*":
+                target = (mod[0], node.func.attr)
+        if target is None:
+            return None
+        path, func = target
+        return self._func_blocks(path, func, depth=0, seen=set())
+
+    def _func_blocks(self, path: str, func: str, depth: int,
+                     seen: set) -> str | None:
+        """Does module-level function `func` in the module at `path` reach a
+        blocking call (its own body, same-module callees, or further
+        imports, depth-bounded)? Declared drain points — the sanctioned
+        blocking boundaries — and explicit G007 disables stop the
+        traversal."""
+        if depth > _MAX_IMPORT_DEPTH or (path, func) in seen:
+            return None
+        seen.add((path, func))
+        helper = self._load_helper(path)
+        if helper is None:
+            return None
+        fns = [f for f in helper.functions if f.qualname == func]
+        if not fns or any(f.drain_point for f in fns):
+            return None  # undefined here, or a declared sanctioned boundary
+        spans = [(f.start, f.end) for f in fns]
+        imports = _import_bindings(helper)
+        for node in ast.walk(helper.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not any(s <= node.lineno <= e for s, e in spans):
+                continue
+            if helper.enclosing_symbol(node.lineno) != func:
+                # a nested def inside the helper is its own (possibly
+                # thread-targeted) context — don't charge it to the caller
+                continue
+            if helper.in_drain_point(node.lineno):
+                continue
+            if helper.directives.disabled(self.code, node.lineno):
+                continue
+            msg = self._blocking(helper, node)
+            if msg:
+                return (f"{msg} — in {helper.rel}:{node.lineno} "
+                        f"({func})")
+            # same-module callee
+            if isinstance(node.func, ast.Name):
+                callee = node.func.id
+                if any(f.qualname == callee for f in helper.functions):
+                    hit = self._func_blocks(path, callee, depth + 1, seen)
+                    if hit:
+                        return hit
+            # further imports
+            target = None
+            if isinstance(node.func, ast.Name):
+                target = imports.get(node.func.id)
+            elif (isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)):
+                mod = imports.get(node.func.value.id)
+                if mod is not None and mod[1] == "*module*":
+                    target = (mod[0], node.func.attr)
+            if target is not None:
+                hit = self._func_blocks(target[0], target[1], depth + 1, seen)
+                if hit:
+                    return hit
+        return None
+
+    def _load_helper(self, path: str) -> SourceFile | None:
+        if path in self._helpers:
+            return self._helpers[path]
+        src: SourceFile | None = None
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            src = SourceFile(path, _helper_rel(path), text,
+                             frozenset({self.code}))
+        except (OSError, SyntaxError, ValueError):
+            src = None  # unreadable helper: out of static reach
+        self._helpers[path] = src
+        return src
 
     def _reachable(self, src: SourceFile) -> set[str]:
         """Qualnames reachable from the dispatch-path roots over same-module
@@ -186,3 +311,98 @@ class BlockingCallOnDispatchThread(Rule):
             if any(f.qualname.startswith(f"{r}.") for r in list(seen)):
                 seen.add(f.qualname)
         return seen
+
+
+# -- import resolution (package-level reachability) ---------------------------
+
+
+def _helper_rel(path: str) -> str:
+    """Project-relative name for a helper module (fixture helpers override
+    it with their own `# graftlint: module=`, applied by SourceFile)."""
+    from .core import project_rel
+
+    return project_rel(path)
+
+
+def _package_root(start: str) -> str | None:
+    """Nearest ancestor directory CONTAINING the package dir — resolves
+    absolute `commefficient_tpu.*` imports from real modules and from
+    fixture files living outside the package tree alike."""
+    cur = os.path.dirname(os.path.abspath(start))
+    for _ in range(12):
+        if os.path.isdir(os.path.join(cur, PACKAGE)):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return None
+        cur = nxt
+    return None
+
+
+def _import_bindings(src: SourceFile) -> dict[str, tuple[str, str]]:
+    """name -> (module file path, target) for every import that resolves to
+    a file we can statically follow: target is a function name for
+    `from .mod import fn`, or the sentinel "*module*" for module bindings
+    (`from . import mod`, `import pkg.mod as m`) whose attributes are
+    resolved at the call site. Relative imports resolve against the file's
+    REAL directory (which makes fixture-local helper modules work); absolute
+    imports resolve only within this package."""
+    out: dict[str, tuple[str, str]] = {}
+    here = os.path.dirname(os.path.abspath(src.path))
+
+    def module_base(level: int, module: str | None) -> str | None:
+        if level > 0:
+            base = here
+            for _ in range(level - 1):
+                base = os.path.dirname(base)
+        else:
+            if not module or module.split(".")[0] != PACKAGE:
+                return None
+            root = _package_root(src.path)
+            if root is None:
+                return None
+            base = root
+        if module:
+            parts = module.split(".")
+            if level == 0:
+                parts = parts  # starts with PACKAGE, anchored at root
+            base = os.path.join(base, *parts)
+        return base
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom):
+            base = module_base(node.level, node.module)
+            if base is None:
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                bound = a.asname or a.name
+                sub = os.path.join(base, a.name + ".py")
+                mod_file = base + ".py"
+                pkg_init = os.path.join(base, "__init__.py")
+                if os.path.isfile(sub):
+                    out[bound] = (sub, "*module*")
+                elif os.path.isfile(mod_file):
+                    out[bound] = (mod_file, a.name)
+                elif os.path.isfile(pkg_init):
+                    out[bound] = (pkg_init, a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                parts = a.name.split(".")
+                if parts[0] != PACKAGE:
+                    continue  # stdlib/third-party: _BLOCKING_CALLS covers it
+                root = _package_root(src.path)
+                if root is None:
+                    continue
+                mod_file = os.path.join(root, *parts) + ".py"
+                pkg_init = os.path.join(root, *parts, "__init__.py")
+                bound = a.asname or parts[0]
+                if a.asname is None:
+                    continue  # dotted access via the bare package name is
+                    # not a call-site shape resolve_dotted feeds us
+                if os.path.isfile(mod_file):
+                    out[bound] = (mod_file, "*module*")
+                elif os.path.isfile(pkg_init):
+                    out[bound] = (pkg_init, "*module*")
+    return out

@@ -155,9 +155,9 @@ class FederatedSession:
         # drop order) or "aged" (weighted choice by rounds-waiting from a
         # DEDICATED pinned RandomState — fairness at high drop rates without
         # perturbing the host-sampling stream). `_requeue_enqueued` maps a
-        # queued cid to the round it was dropped (advisory: checkpoints
-        # persist only the queue order, so a resumed run restarts ages at 1
-        # — the weights re-diverge within a few rounds).
+        # queued cid to the round it was dropped; checkpoints persist the
+        # committed (cid, enqueued_round) pairs (meta.json requeue_ages), so
+        # a restored entry resumes its REAL rounds-waiting age.
         if requeue_policy not in ("fifo", "aged"):
             raise ValueError(
                 f"requeue_policy must be 'fifo' or 'aged', got "
@@ -369,6 +369,10 @@ class FederatedSession:
         # the measured down-link for local_topk), checkpointed, and restored —
         # deriving it as round * static-estimate overstates resumed runs.
         self.comm_mb_total = 0.0
+        # cumulative cohort-degradation counters (the serving layer's
+        # metrics endpoint reads them; RunStats keeps its own per-loop view)
+        self.clients_dropped_total = 0
+        self.clients_quarantined_total = 0
 
     def _mesh_ctx(self):
         """jax.set_mesh context for steps when the mesh carries axes that ops
@@ -454,6 +458,23 @@ class FederatedSession:
             )
 
     # -- prepare / dispatch / commit (the runner/ pipeline surface) ----------
+    def sample_cohort(self, rnd: int) -> np.ndarray:
+        """The host-sampling half of a round's preparation: draw the cohort
+        from the live sampling stream and substitute queued (previously
+        dropped) clients in. Split out of prepare_round so a serving layer
+        (serve/) can learn the round's INVITE list before any batch work —
+        the stream draws are identical either way, which is what keeps a
+        served round's cohort bit-identical to the batch simulator's."""
+        ids = self.train_set.sample_clients(self.rng, self.num_workers)
+        if self._requeue:
+            # serve previously-dropped clients: substitute them into the
+            # sampled cohort. The substitution consumes NO host RNG, so the
+            # sampling stream is identical whether or not anything was
+            # queued — only the cohort's membership changes (by design:
+            # that IS the recovery).
+            ids = self._serve_requeue(ids, rnd)
+        return ids
+
     def prepare_round(self, rnd: int | None = None) -> PreparedRound:
         """Host-side half of a round: sample the cohort, assemble the batch
         (retry-wrapped, fault sites at `rnd`), split the device PRNG. Draws
@@ -465,14 +486,33 @@ class FederatedSession:
         later rounds are already prepared still resumes bit-identically."""
         if rnd is None:
             rnd = self.round + self._inflight_rounds
-        ids = self.train_set.sample_clients(self.rng, self.num_workers)
-        if self._requeue:
-            # serve previously-dropped clients: substitute them into the
-            # sampled cohort. The substitution consumes NO host RNG, so the
-            # sampling stream is identical whether or not anything was
-            # queued — only the cohort's membership changes (by design:
-            # that IS the recovery).
-            ids = self._serve_requeue(ids, rnd)
+        return self._assemble_round(rnd, self.sample_cohort(rnd))
+
+    def prepare_served_round(self, rnd: int, ids,
+                             arrived) -> PreparedRound:
+        """Round preparation from an EXTERNAL arrival stream (serve/): the
+        cohort `ids` must be exactly what sample_cohort(rnd) returned (the
+        service samples the invite list, announces it, and collects
+        arrivals), and `arrived` is the [W] 0/1 float mask of invitees whose
+        submission made the W-of-N close. No-shows and stragglers are
+        handled EXACTLY like client_drop faults — rows zeroed, validity
+        masked, client re-queued — so a served short cohort is bit-identical
+        to the batch-simulator round that drops the same positions (the PR 4
+        masking parity extends to the serving path by construction)."""
+        # host-side by construction: the arrival mask comes from the
+        # assembler's host bookkeeping, never a traced array
+        arrived = np.asarray(arrived, np.float32)  # graftlint: disable=G001
+        if len(arrived) != len(ids):
+            raise ValueError(
+                f"arrival mask covers {len(arrived)} clients but the round "
+                f"invited {len(ids)}")
+        return self._assemble_round(rnd, ids, arrived=arrived)
+
+    def _assemble_round(self, rnd: int, ids,
+                        arrived=None) -> PreparedRound:
+        """Shared tail of round preparation: batch assembly (retry-wrapped,
+        fault sites at `rnd`), no-show masking for served rounds, validity
+        threading, the device PRNG split, and the post-draw snapshot."""
         batch, valid = self._load_client_batch(ids, rnd)
         if self.fault_plan is not None:
             # nonfinite burst rides the real gradient path (poison the
@@ -485,6 +525,31 @@ class FederatedSession:
                 # check the LIVE queue per append: overlapping drop specs
                 # can report the same position twice, and a double-queued
                 # client would displace two sampled clients later
+                cid = int(ids[p])
+                if cid not in self._requeue:
+                    self._requeue.append(cid)
+                    self._requeue_enqueued.setdefault(cid, rnd)
+        if arrived is not None and (arrived == 0.0).any():
+            # served round closed short of the full invite list: no-shows
+            # get the client_drop treatment (rows zeroed, mask 0, re-queued)
+            # at the same point in the preparation the fault site uses, so
+            # the two paths stay bit-identical
+            no_show = [int(p) for p in np.flatnonzero(arrived == 0.0)]
+            if valid is None:
+                valid = np.ones(len(ids), np.float32)
+            else:
+                # host numpy by construction (loader validity mask)
+                valid = np.array(valid, copy=True)  # graftlint: disable=G001
+            batch = {k: (v if k.startswith("_")
+                         # prep batches are host numpy (assembled on the
+                         # host thread), so the copy is host work
+                         else np.array(v, copy=True))  # graftlint: disable=G001
+                     for k, v in batch.items()}
+            for k, v in batch.items():
+                if not k.startswith("_"):
+                    v[no_show] = 0
+            valid[no_show] = 0.0
+            for p in no_show:
                 cid = int(ids[p])
                 if cid not in self._requeue:
                     self._requeue.append(cid)
@@ -715,6 +780,8 @@ class FederatedSession:
         # clients ran at this round's preparation
         m["clients_dropped"] = float(masked)
         m["requeue_depth"] = float(requeue_depth)
+        self.clients_dropped_total += int(masked)
+        self.clients_quarantined_total += int(m.get("clients_quarantined", 0))
         m.update(self.comm_per_round)
         # dropped/masked clients never transmit: charge uplink for the
         # clients that actually uploaded (the static comm_per_round assumes

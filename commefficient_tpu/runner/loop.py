@@ -189,6 +189,9 @@ def make_save_ckpt(session: FederatedSession, checkpoint_dir: str):
     processes return None (callers treat it as 'nothing written here')."""
     lock = threading.Lock()
 
+    # graftlint: drain-point — checkpoint writes ARE sanctioned blocking
+    # work: sync-mode saves run on the dispatch thread at round boundaries
+    # by design (the async writer moves the periodic ones off it)
     def save_ckpt():
         if jax.process_index() != 0:
             return None
@@ -211,6 +214,7 @@ def run_loop(
     build_row=None,
     logger=None,
     save_ckpt=None,
+    source=None,
 ) -> RunStats:
     """Run the training loop from session.round to cfg.total_rounds.
 
@@ -220,6 +224,13 @@ def run_loop(
     numeric metric key since the previous eval row. Either may be None (no
     eval / no logging — bench runs). save_ckpt defaults to make_save_ckpt
     when cfg.checkpoint_dir is set.
+
+    source: an external round source (next() -> PreparedRound in round
+    order, stop()) — the serving layer (serve/ServedSource) passes one so
+    the SERVICE drives the loop from its arrival stream instead of the loop
+    pulling clients through the sampling prefetcher. When given, the loop
+    neither wraps nor replaces it (the source owns its own overlap policy);
+    default None builds the usual PreparedSource/RoundPrefetcher pair.
 
     Exits the process (not returns) on preemption (EXIT_RESUMABLE) and on
     --on_nonfinite halt, after the same drain/save sequence the CLIs used
@@ -285,7 +296,7 @@ def run_loop(
             )
         else:
             writer = AsyncCheckpointWriter(save_ckpt)
-    src = (
+    src = source if source is not None else (
         RoundPrefetcher(session, start_round, depth=prefetch_depth)
         if async_mode else PreparedSource(session, start_round)
     )

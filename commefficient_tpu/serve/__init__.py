@@ -1,0 +1,50 @@
+"""Streaming aggregation service: the batch simulator inverted.
+
+FetchSGD's deployment story is millions of clients *pushing* sketch
+updates at an always-on aggregator — the Count Sketch's linearity makes the
+server-side merge of asynchronously-arriving updates cheap. This package is
+that inversion over the existing engine/runner machinery:
+
+- `ingest`    — bounded arrival queue with admission control (backpressure,
+  duplicate / out-of-round rejection, early-push buffering)
+- `transport` — in-process (tests/bench/parity) and loopback-socket
+  (JSON-lines wire realism) submission fronts
+- `assembler` — over-provisioned cohorts that close at W-of-N arrivals;
+  stragglers and no-shows masked + re-queued via the PR 4 `_valid`/
+  `_requeue` machinery, so a short cohort is bit-identical to the round
+  over its survivors
+- `clients`   — O(1)-per-participant client state: fold_in-derived per-
+  client streams and device classes, no per-client table (10M-ID safe)
+- `traffic`   — trace-driven generator: diurnal load, bursts, device
+  classes with distinct straggle distributions (test harness + BENCH_SERVE)
+- `metrics`   — the ops surface: /metrics JSON endpoint (round, queue
+  depth, arrival rate, quarantine/requeue counters)
+- `service`   — `AggregationService` + `ServedSource`: the service drives
+  `runner.run_loop(source=...)` instead of the loop pulling clients
+
+Both CLIs expose it as `--serve {inproc,socket}` (+ `--serve_quorum`,
+`--serve_deadline`, `--serve_trace`, `--serve_metrics_port`).
+"""
+
+from .assembler import ClosedRound, CohortAssembler
+from .ingest import IngestQueue, Submission
+from .metrics import MetricsServer
+from .service import AggregationService, ServeConfig, ServedSource
+from .traffic import TraceConfig, TrafficGenerator
+from .transport import InProcessTransport, SocketTransport, submit_over_socket
+
+__all__ = [
+    "AggregationService",
+    "ClosedRound",
+    "CohortAssembler",
+    "IngestQueue",
+    "InProcessTransport",
+    "MetricsServer",
+    "ServeConfig",
+    "ServedSource",
+    "SocketTransport",
+    "Submission",
+    "TraceConfig",
+    "TrafficGenerator",
+    "submit_over_socket",
+]

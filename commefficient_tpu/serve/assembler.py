@@ -1,0 +1,135 @@
+"""Cohort assembler: over-provisioned rounds that close at W-of-N.
+
+A round INVITES the full cohort the session sampled (N = num_workers — the
+over-provisioning) and CLOSES when W invitees have arrived (the quorum) or
+the deadline passes, whichever is first. Everyone in the invite list who
+missed the close — stragglers (arrived after the W-th arrival or past the
+deadline) and no-shows (never arrived) — is masked out of the round and
+re-queued through the session's `_requeue` fairness machinery, so a short
+cohort is bit-identical to the batch-simulator round over its survivors
+(the PR 4 `_valid` masking parity, now fed by a real arrival stream).
+
+Two close disciplines:
+
+- **virtual** (default, in-process transport): arrivals carry simulated
+  latencies; the close is a pure function of the submission set — sort by
+  (latency, client_id), the W-th latency is the close time, everything at
+  or under min(close, deadline) is in. Deterministic, wall-clock-free.
+- **wall** (socket transport): block on the ingest queue's condition for
+  quorum-or-timeout; arrival ORDER (recv_order) decides the cut. Realistic,
+  used by the socket demo path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .ingest import IngestQueue
+
+
+@dataclasses.dataclass(frozen=True)
+class ClosedRound:
+    """One closed round: the invite list, who made the cut, and the close
+    bookkeeping the metrics endpoint and bench read."""
+
+    rnd: int
+    invited: np.ndarray         # [N] int64 cohort (session.sample_cohort)
+    arrived: np.ndarray         # [N] float32 0/1 — made the W-of-N close
+    latencies: np.ndarray       # [N] float64 submission latency (inf = none)
+    closed_by: str              # "quorum" | "deadline"
+    close_latency_s: float      # virtual close time (W-th arrival latency)
+    stragglers: int             # submitted, but after the close
+    no_shows: int               # never submitted
+
+    @property
+    def survivors(self) -> int:
+        return int(self.arrived.sum())
+
+
+class CohortAssembler:
+    def __init__(self, queue: IngestQueue, quorum: int, deadline_s: float):
+        if quorum < 1:
+            raise ValueError(f"quorum must be >= 1, got {quorum}")
+        self.queue = queue
+        self.quorum = quorum
+        self.deadline_s = deadline_s
+        # cumulative close counters (metrics endpoint)
+        self.rounds_closed = 0
+        self.closed_by_quorum = 0
+        self.closed_by_deadline = 0
+        self.stragglers_total = 0
+        self.no_shows_total = 0
+
+    def close_virtual(self, rnd: int, invited) -> ClosedRound:
+        """Close on simulated latencies (see module docstring). The queue's
+        accepted arrivals are ranked by (latency, client_id); the quorum-th
+        latency — capped at the deadline — is the close."""
+        arrivals = self.queue.close_round()
+        invited = np.asarray(invited, np.int64)
+        pos = {int(c): i for i, c in enumerate(invited)}
+        lat = np.full(len(invited), np.inf)
+        for a in arrivals:
+            if int(a.client_id) in pos:  # uninvited never got accepted, but
+                lat[pos[int(a.client_id)]] = a.latency_s  # stay defensive
+        order = np.lexsort((invited, lat))  # latency, then cid tie-break
+        in_time = lat[order] <= self.deadline_s
+        n_in_time = int(in_time.sum())
+        if n_in_time >= self.quorum:
+            close = float(lat[order][self.quorum - 1])
+            closed_by = "quorum"
+        else:
+            close = self.deadline_s
+            closed_by = "deadline"
+        arrived = (lat <= close).astype(np.float32)
+        return self._finish(rnd, invited, arrived, lat, closed_by, close)
+
+    def close_wall(self, rnd: int, invited) -> ClosedRound:
+        """Close on real arrival order: wait for quorum-or-deadline on the
+        queue, then cut at the quorum-th ARRIVAL (recv order). Latencies in
+        the result are the submitted ones (accounting only)."""
+        self.queue.wait_for(self.quorum, self.deadline_s)
+        arrivals = self.queue.close_round()
+        invited = np.asarray(invited, np.int64)
+        pos = {int(c): i for i, c in enumerate(invited)}
+        lat = np.full(len(invited), np.inf)
+        arrived = np.zeros(len(invited), np.float32)
+        made_cut = sorted(arrivals, key=lambda a: a.recv_order)[:self.quorum]
+        for a in arrivals:
+            if int(a.client_id) in pos:
+                lat[pos[int(a.client_id)]] = a.latency_s
+        for a in made_cut:
+            if int(a.client_id) in pos:
+                arrived[pos[int(a.client_id)]] = 1.0
+        closed_by = "quorum" if len(arrivals) >= self.quorum else "deadline"
+        close = (max((a.latency_s for a in made_cut), default=self.deadline_s)
+                 if closed_by == "quorum" else self.deadline_s)
+        return self._finish(rnd, invited, arrived, lat, closed_by, close)
+
+    def _finish(self, rnd, invited, arrived, lat, closed_by,
+                close) -> ClosedRound:
+        submitted = np.isfinite(lat)
+        stragglers = int((submitted & (arrived == 0.0)).sum())
+        no_shows = int((~submitted).sum())
+        self.rounds_closed += 1
+        if closed_by == "quorum":
+            self.closed_by_quorum += 1
+        else:
+            self.closed_by_deadline += 1
+        self.stragglers_total += stragglers
+        self.no_shows_total += no_shows
+        return ClosedRound(
+            rnd=rnd, invited=invited, arrived=arrived, latencies=lat,
+            closed_by=closed_by, close_latency_s=float(close),
+            stragglers=stragglers, no_shows=no_shows,
+        )
+
+    def counters(self) -> dict[str, int]:
+        return {
+            "rounds_closed": self.rounds_closed,
+            "closed_by_quorum": self.closed_by_quorum,
+            "closed_by_deadline": self.closed_by_deadline,
+            "stragglers": self.stragglers_total,
+            "no_shows": self.no_shows_total,
+        }

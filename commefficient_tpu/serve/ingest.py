@@ -1,0 +1,241 @@
+"""Ingest layer: admission control for client sketch submissions.
+
+The paper's deployment story (FetchSGD §1) is millions of clients *pushing*
+updates at an always-on aggregator; the linearity of the Count Sketch makes
+the server-side merge of asynchronously-arriving updates cheap. This module
+is the front door of that inversion: a bounded, thread-safe arrival queue
+with explicit admission decisions — every submission is either ACCEPTED into
+the open round or rejected with a reason the transport echoes back to the
+client (`QUEUE_FULL` is the backpressure signal a well-behaved client backs
+off on).
+
+Admission rules, in check order:
+
+- ``CLOSED``       — the service is shutting down (or no round ever opened).
+- ``QUEUE_FULL``   — the bounded queue is at capacity: backpressure.
+- ``OUT_OF_ROUND`` — the submission names a round that is not the open one.
+  Late (already-closed round) is always rejected; EARLY (the round after the
+  open one — or after the last CLOSED one while the server is mid-merge
+  between rounds) is buffered in the bounded pending queue and admitted when
+  that round opens — a pushing client does not resubmit just because the
+  server is mid-merge.
+- ``NOT_INVITED``  — the client is not in the open round's cohort.
+- ``DUPLICATE``    — the client already has an accepted submission this
+  round (an at-least-once transport may retry; the merge must not double
+  count a client).
+
+All counters are cumulative over the service lifetime and feed the metrics
+endpoint (serve/metrics.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+# rejection reasons (wire-visible: the socket transport echoes them)
+ACCEPTED = "ACCEPTED"
+CLOSED = "CLOSED"
+QUEUE_FULL = "QUEUE_FULL"
+OUT_OF_ROUND = "OUT_OF_ROUND"
+NOT_INVITED = "NOT_INVITED"
+DUPLICATE = "DUPLICATE"
+BUFFERED = "BUFFERED"  # early submission parked for the next round
+
+
+@dataclasses.dataclass(frozen=True)
+class Submission:
+    """One client push. `latency_s` is the client's submission delay relative
+    to the round's invite (simulated by the traffic generator; a real client
+    would stamp send time) — the assembler's VIRTUAL clock orders arrivals
+    by it, so a served round is a pure function of the submission set.
+    `payload_bytes` sizes the (simulated) sketch blob for wire accounting."""
+
+    client_id: int
+    round: int
+    latency_s: float = 0.0
+    payload_bytes: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """An accepted submission, as the assembler sees it."""
+
+    client_id: int
+    latency_s: float
+    recv_order: int  # wall arrival order (tie-break + socket-mode ordering)
+
+
+class IngestQueue:
+    """Bounded arrival queue for ONE open round plus a bounded pending
+    buffer of early submissions. Thread-safe: transports submit from their
+    own threads; the assembler consumes under the same lock."""
+
+    def __init__(self, capacity: int = 1024, pending_capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.pending_capacity = max(pending_capacity, 0)
+        self._cv = threading.Condition()
+        self._open_round: int | None = None
+        # the round an early push may target while NO round is open (the
+        # server is mid-merge between close_round(r) and open_round(r+1)):
+        # a client must not have to resubmit just because it raced the merge
+        self._next_round: int | None = None
+        self._invited: dict[int, int] = {}  # client_id -> cohort position
+        self._arrivals: list[Arrival] = []
+        self._seen: set[int] = set()
+        self._closed = False
+        # early submissions for round open+1: (client_id, latency_s) in
+        # arrival order, deduped; drained into arrivals at the next open
+        self._pending: list[tuple[int, float]] = []
+        self._recv_counter = 0
+        # optional accept hook (the service feeds its arrival-rate window);
+        # called with n=1 under the queue lock — must be cheap and must not
+        # call back into the queue
+        self.on_accept = None
+        # cumulative admission counters (metrics endpoint)
+        self.accepted = 0
+        self.buffered = 0
+        self.rejected_full = 0
+        self.rejected_dup = 0
+        self.rejected_out_of_round = 0
+        self.rejected_uninvited = 0
+        self.rejected_closed = 0
+
+    # -- round lifecycle (assembler side) ------------------------------------
+
+    def open_round(self, rnd: int, invited_ids) -> None:
+        """Open round `rnd` for the given cohort. Pending early submissions
+        from invited clients are admitted immediately (recv order preserved);
+        pending entries from clients NOT in this cohort stay parked for the
+        round after (they pushed for "whatever opens next")."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("IngestQueue is closed")
+            self._open_round = rnd
+            self._next_round = rnd + 1
+            self._invited = {int(c): i for i, c in enumerate(invited_ids)}
+            self._arrivals = []
+            self._seen = set()
+            still_pending: list[tuple[int, float]] = []
+            for cid, latency in self._pending:
+                if cid in self._invited and cid not in self._seen:
+                    self._admit(cid, latency)
+                else:
+                    still_pending.append((cid, latency))
+            self._pending = still_pending
+            self._cv.notify_all()
+
+    def close_round(self) -> list[Arrival]:
+        """Close the open round and return its arrivals (submission-order).
+        Subsequent submissions naming the closed round are OUT_OF_ROUND."""
+        with self._cv:
+            out = list(self._arrivals)
+            self._open_round = None
+            self._invited = {}
+            self._arrivals = []
+            self._seen = set()
+            return out
+
+    def arrivals(self) -> list[Arrival]:
+        """Snapshot of the open round's arrivals so far."""
+        with self._cv:
+            return list(self._arrivals)
+
+    # graftlint: drain-point — the serving queue's sanctioned wait: the
+    # assembler blocks HERE (wall-clock transports) for quorum or deadline
+    def wait_for(self, count: int, timeout_s: float) -> list[Arrival]:
+        """Block until >= `count` arrivals or `timeout_s` elapses; return
+        the arrival snapshot. Wall-clock close for the socket transport —
+        the in-process path closes on virtual latencies instead."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: len(self._arrivals) >= count or self._closed,
+                timeout=timeout_s,
+            )
+            return list(self._arrivals)
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    # -- submission (transport side) -----------------------------------------
+
+    def submit(self, sub: Submission) -> str:
+        """Admission decision for one submission (see module docstring for
+        the rule order). Returns ACCEPTED/BUFFERED or a rejection reason."""
+        with self._cv:
+            if self._closed:
+                self.rejected_closed += 1
+                return CLOSED
+            cid = int(sub.client_id)
+            if self._open_round is None or sub.round != self._open_round:
+                if (self._next_round is not None
+                        and sub.round == self._next_round):
+                    # early push for the next round: park it, bounded
+                    # (dup before full: a retry of an already-parked push is
+                    # a DUPLICATE even when the buffer has no room left)
+                    if any(c == cid for c, _ in self._pending):
+                        self.rejected_dup += 1
+                        return DUPLICATE
+                    if len(self._pending) >= self.pending_capacity:
+                        self.rejected_full += 1
+                        return QUEUE_FULL
+                    self._pending.append((cid, float(sub.latency_s)))
+                    self.buffered += 1
+                    return BUFFERED
+                self.rejected_out_of_round += 1
+                return OUT_OF_ROUND
+            if cid not in self._invited:
+                self.rejected_uninvited += 1
+                return NOT_INVITED
+            if cid in self._seen:
+                self.rejected_dup += 1
+                return DUPLICATE
+            if len(self._arrivals) >= self.capacity:
+                self.rejected_full += 1
+                return QUEUE_FULL
+            self._admit(cid, float(sub.latency_s))
+            self._cv.notify_all()
+            return ACCEPTED
+
+    def _admit(self, cid: int, latency_s: float) -> None:
+        """Record an accepted arrival (lock held)."""
+        self._arrivals.append(Arrival(cid, latency_s, self._recv_counter))
+        self._recv_counter += 1
+        self._seen.add(cid)
+        self.accepted += 1
+        if self.on_accept is not None:
+            self.on_accept(1)
+
+    # -- introspection --------------------------------------------------------
+
+    def depth(self) -> int:
+        """Open-round arrivals + parked early submissions (the 'queue
+        depth' the metrics endpoint reports)."""
+        with self._cv:
+            return len(self._arrivals) + len(self._pending)
+
+    def pending_snapshot(self) -> list[tuple[int, float]]:
+        """Checkpointable view of the early-submission buffer."""
+        with self._cv:
+            return list(self._pending)
+
+    def restore_pending(self, pending) -> None:
+        """Re-seed the early-submission buffer from a checkpoint."""
+        with self._cv:
+            self._pending = [(int(c), float(s)) for c, s in pending]
+
+    def counters(self) -> dict[str, int]:
+        with self._cv:
+            return {
+                "accepted": self.accepted,
+                "buffered": self.buffered,
+                "rejected_full": self.rejected_full,
+                "rejected_dup": self.rejected_dup,
+                "rejected_out_of_round": self.rejected_out_of_round,
+                "rejected_uninvited": self.rejected_uninvited,
+                "rejected_closed": self.rejected_closed,
+            }

@@ -1,0 +1,111 @@
+"""Ops surface: the service's metrics snapshot + a loopback HTTP endpoint.
+
+`GET /metrics` returns one JSON object (no query params, no auth — this is
+a loopback operator surface, the moral equivalent of a /healthz):
+
+    round                  committed round number of the backing session
+    queue_depth            open-round arrivals + parked early submissions
+    arrival_rate_per_s     accepted submissions/s (sliding 60 s window)
+    submissions            cumulative admission counters (accepted, buffered,
+                           rejected_full/_dup/_out_of_round/_uninvited/_closed)
+    rounds                 assembler close counters (rounds_closed,
+                           closed_by_quorum/_deadline, stragglers, no_shows)
+    requeue_depth          dropped/no-show clients waiting for re-service
+    clients_quarantined    sketch-space quarantine rejections (cumulative,
+                           from the run stats when the loop reports them)
+
+The HTTP server is a stdlib ThreadingHTTPServer on its own daemon thread —
+it never touches the dispatch path. Anything but GET /metrics is a 404.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+
+class RateWindow:
+    """Sliding-window event rate: record(n) on accept, rate() = events/s
+    over the trailing `window_s`. O(events in window) memory, thread-safe.
+    record() runs under the ingest queue's lock (on_accept), so both ends
+    must be O(1) amortized — hence the deque, not a list."""
+
+    def __init__(self, window_s: float = 60.0, clock=time.monotonic):
+        self.window_s = window_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._events: collections.deque[tuple[float, int]] = (
+            collections.deque())
+
+    def record(self, n: int = 1) -> None:
+        now = self._clock()
+        with self._lock:
+            self._events.append((now, n))
+            self._trim(now)
+
+    def rate(self) -> float:
+        now = self._clock()
+        with self._lock:
+            self._trim(now)
+            total = sum(n for _, n in self._events)
+        return total / self.window_s
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+
+class MetricsServer:
+    """Loopback HTTP endpoint over a snapshot callable."""
+
+    def __init__(self, snapshot: Callable[[], dict], host: str = "127.0.0.1",
+                 port: int = 0):
+        self._snapshot = snapshot
+        handler = self._make_handler()
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-metrics",
+            daemon=True)
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def _make_handler(self):
+        snapshot = self._snapshot
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                if self.path.rstrip("/") not in ("/metrics", ""):
+                    self.send_error(404)
+                    return
+                try:
+                    body = json.dumps(snapshot()).encode()
+                except Exception as e:  # noqa: BLE001 — a broken snapshot
+                    # must 500, not kill the handler thread silently
+                    self.send_error(500, f"{type(e).__name__}: {e}")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # stdout stays machine-parsable
+                pass
+
+        return Handler

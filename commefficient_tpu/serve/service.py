@@ -1,0 +1,286 @@
+"""The aggregation service: a continuously-running server over the engine.
+
+`AggregationService` owns the serving stack — ingest queue, transport,
+cohort assembler, traffic source, metrics endpoint — and exposes it to the
+runner as a `ServedSource`: the round source `runner.run_loop(source=...)`
+pulls from INSTEAD of the batch simulator's sampling prefetcher. Per round:
+
+    1. `session.sample_cohort(rnd)`     — the invite list (same host-RNG
+                                          draws as the batch simulator:
+                                          THIS is what the parity pin rests
+                                          on)
+    2. `queue.open_round(rnd, invites)` — parked early submissions from
+                                          invited clients admit instantly
+    3. traffic / external clients push  — transport.submit -> admission
+                                          control (dup / out-of-round /
+                                          backpressure)
+    4. assembler closes at W-of-N       — quorum or deadline; stragglers
+                                          and no-shows identified
+    5. `session.prepare_served_round`   — survivors run; the rest are
+                                          masked + re-queued exactly like
+                                          client_drop faults
+
+The device pipeline stays the runner's: dispatch/commit overlap, deferred
+metrics, checkpoint writer — the service only replaces WHERE cohorts come
+from.
+
+Checkpoint discipline: the early-submission buffer is snapshotted per round
+boundary (`_pending_by_round`) and published to checkpoints through
+`session.serve_meta` (utils/checkpoint.py writes it into meta.json); a
+restored session's `restored_serve_meta` re-seeds the buffer, so resume
+replays the identical arrival stream the uninterrupted run saw — the same
+committed-snapshot discipline the host RNG and the re-queue ride.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+
+from .assembler import ClosedRound, CohortAssembler
+from .ingest import IngestQueue
+from .metrics import MetricsServer, RateWindow
+from .traffic import TraceConfig, TrafficGenerator
+from .transport import InProcessTransport, SocketTransport
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Service shape (mirrors the --serve_* CLI flags)."""
+
+    quorum: int = 0          # W-of-N close; 0 = full cohort (N-of-N)
+    deadline_s: float = 4.0  # virtual deadline for the round close
+    transport: str = "inproc"   # "inproc" | "socket"
+    port: int = 0            # socket transport bind port (0 = ephemeral)
+    metrics_port: int = -1   # >= 0 starts the HTTP endpoint (0 = ephemeral)
+    queue_capacity: int = 1024
+    pending_capacity: int = 256
+
+    @classmethod
+    def from_args(cls, args) -> "ServeConfig":
+        return cls(
+            quorum=getattr(args, "serve_quorum", 0),
+            deadline_s=getattr(args, "serve_deadline", 4.0),
+            transport=args.serve,
+            port=getattr(args, "serve_port", 0),
+            metrics_port=getattr(args, "serve_metrics_port", -1),
+        )
+
+
+class AggregationService:
+    """See module docstring. `session` is a FederatedSession; `traffic` a
+    TrafficGenerator (or None for a purely external-client service, socket
+    transport only)."""
+
+    def __init__(self, session, cfg: ServeConfig,
+                 traffic: TrafficGenerator | None = None):
+        if cfg.transport not in ("inproc", "socket"):
+            raise ValueError(
+                f"serve transport must be inproc|socket, got {cfg.transport!r}")
+        quorum = cfg.quorum or session.num_workers
+        if not 1 <= quorum <= session.num_workers:
+            raise ValueError(
+                f"--serve_quorum {cfg.quorum} must be in [1, num_workers="
+                f"{session.num_workers}] — the quorum closes an "
+                "over-provisioned cohort, it cannot exceed the invite list")
+        if traffic is None and cfg.transport == "inproc":
+            raise ValueError(
+                "inproc transport with no traffic generator would serve "
+                "zero submissions: every round would close at deadline "
+                "fully degraded (pass a TrafficGenerator, or use the "
+                "socket transport with external clients)")
+        self.session = session
+        self.cfg = dataclasses.replace(cfg, quorum=quorum)
+        self.traffic = traffic
+        self.queue = IngestQueue(capacity=cfg.queue_capacity,
+                                 pending_capacity=cfg.pending_capacity)
+        self.assembler = CohortAssembler(self.queue, quorum, cfg.deadline_s)
+        self.transport = (
+            SocketTransport(self.queue, port=cfg.port)
+            if cfg.transport == "socket" else InProcessTransport(self.queue))
+        self._rate = RateWindow()
+        self.queue.on_accept = self._rate.record
+        self.metrics_server = (
+            MetricsServer(self.metrics_snapshot, port=cfg.metrics_port)
+            if cfg.metrics_port >= 0 else None)
+        # per-round-boundary snapshots of the early-submission buffer:
+        # _pending_by_round[r] = buffer state a run positioned at committed
+        # round r must start from (checkpoints persist the committed one)
+        self._meta_lock = threading.Lock()
+        self._pending_by_round: dict[int, list] = {}
+        restored = getattr(session, "restored_serve_meta", None)
+        if restored:
+            self.queue.restore_pending(restored.get("pending", []))
+            print(f"serve: restored {len(restored.get('pending', []))} "
+                  "pending early submission(s) from checkpoint meta",
+                  file=sys.stderr, flush=True)
+        self._pending_by_round[session.round] = self.queue.pending_snapshot()
+        # checkpoint hook: utils/checkpoint.save calls this under the
+        # session's mutate_lock and writes the result into meta.json
+        session.serve_meta = self._serve_meta
+        self._started = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "AggregationService":
+        if not self._started:
+            self.transport.start()
+            if self.metrics_server is not None:
+                self.metrics_server.start()
+            self._started = True
+        return self
+
+    def close(self) -> None:
+        self.queue.shutdown()
+        self.transport.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+        self._started = False
+
+    def __enter__(self) -> "AggregationService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- the round source -----------------------------------------------------
+
+    def source(self, start_round: int | None = None) -> "ServedSource":
+        """The runner-facing round source (run_loop(source=...))."""
+        return ServedSource(
+            self, self.session.round if start_round is None else start_round)
+
+    def serve_round(self, rnd: int):
+        """One full served round preparation: invite, collect, close at
+        W-of-N, mask + re-queue the casualties. Returns (PreparedRound,
+        ClosedRound)."""
+        ids = self.session.sample_cohort(rnd)
+        self.queue.open_round(rnd, ids)
+        if self.traffic is not None:
+            self.traffic.respond_to_invites(
+                rnd, ids, self.transport.submit, self.cfg.deadline_s)
+            closed = self.assembler.close_virtual(rnd, ids)
+        else:
+            # external clients: wall-clock W-of-N (socket transport)
+            closed = self.assembler.close_wall(rnd, ids)
+        prep = self.session.prepare_served_round(rnd, ids, closed.arrived)
+        return prep, closed
+
+    # -- checkpoint + metrics surfaces ----------------------------------------
+
+    def _record_boundary(self, next_round: int) -> None:
+        """Snapshot the pending buffer as the state a run positioned at
+        `next_round` starts from; prune snapshots behind the committed
+        round (they can never be restored to)."""
+        with self._meta_lock:
+            self._pending_by_round[next_round] = self.queue.pending_snapshot()
+            committed = self.session.round
+            for r in [r for r in self._pending_by_round if r < committed]:
+                del self._pending_by_round[r]
+
+    def _serve_meta(self) -> dict:
+        """Checkpoint payload: the pending buffer AS OF the committed round
+        (the session's round counter under the caller's mutate_lock), not
+        the live buffer a later prepared round may already have drained."""
+        with self._meta_lock:
+            committed = self.session.round
+            pending = self._pending_by_round.get(
+                committed, self.queue.pending_snapshot())
+            return {"round": committed,
+                    "pending": [[int(c), float(s)] for c, s in pending]}
+
+    def rewind_to_committed(self) -> None:
+        """Restore the live pending buffer to the committed boundary — the
+        serve-side twin of run_loop's host-RNG rewind, so a session (and
+        service) reused after an interrupted loop replays identically."""
+        with self._meta_lock:
+            pending = self._pending_by_round.get(self.session.round)
+        if pending is not None:
+            self.queue.restore_pending(pending)
+
+    def metrics_snapshot(self) -> dict:
+        """The /metrics payload (see serve/metrics.py for field docs)."""
+        s = self.session
+        return {
+            "round": int(s.round),
+            "queue_depth": self.queue.depth(),
+            "arrival_rate_per_s": round(self._rate.rate(), 3),
+            "submissions": self.queue.counters(),
+            "rounds": self.assembler.counters(),
+            "requeue_depth": len(s._requeue),
+            "clients_dropped": int(getattr(s, "clients_dropped_total", 0)),
+            "clients_quarantined": int(
+                getattr(s, "clients_quarantined_total", 0)),
+            "quorum": self.cfg.quorum,
+            "invited_per_round": s.num_workers,
+            "deadline_s": self.cfg.deadline_s,
+            "transport": self.cfg.transport,
+        }
+
+
+class ServedSource:
+    """run_loop round source backed by the service (the PreparedSource
+    protocol: next() -> PreparedRound in strict round order, stop()).
+
+    next() runs the whole invite->collect->close cycle synchronously on the
+    dispatch thread — the device pipeline still overlaps (dispatch N+1
+    queues while N computes), and in virtual-latency mode the close never
+    sleeps. The per-round ClosedRound is kept on `last_closed` for the
+    loop's observers (chaos smoke, bench)."""
+
+    def __init__(self, service: AggregationService, start_round: int):
+        self.service = service
+        self._next = start_round
+        self.last_closed: ClosedRound | None = None
+        self.closed_rounds: list[ClosedRound] = []
+        service._record_boundary(start_round)
+
+    def next(self):
+        rnd = self._next
+        prep, closed = self.service.serve_round(rnd)
+        self.last_closed = closed
+        self.closed_rounds.append(closed)
+        self._next = rnd + 1
+        self.service._record_boundary(rnd + 1)
+        return prep
+
+    def stop(self):
+        # the loop may have served rounds that never commit (preemption,
+        # early exit): rewind the pending buffer with the host RNG
+        self.service.rewind_to_committed()
+
+
+def service_from_args(args, session) -> AggregationService | None:
+    """Build + start the service for a CLI run (both CLIs call this after
+    checkpoint restore, so a resumed service picks up the persisted pending
+    queue). None when --serve off. The traffic trace defaults its
+    population to the dataset's client count and its seed to --seed unless
+    the spec pins them."""
+    if getattr(args, "serve", "off") == "off":
+        return None
+    spec = getattr(args, "serve_trace", "")
+    trace = TraceConfig.parse(spec)
+    # which keys the spec PINNED, parsed the same way parse() does (a raw
+    # substring test would miss "population = 500" and silently override)
+    pinned = {p.partition("=")[0].strip()
+              for p in spec.split(",") if p.strip()}
+    if "population" not in pinned:
+        trace = dataclasses.replace(trace, population=args.num_clients)
+    if "seed" not in pinned:
+        trace = dataclasses.replace(trace, seed=args.seed)
+    service = AggregationService(
+        session, ServeConfig.from_args(args),
+        traffic=TrafficGenerator(trace)).start()
+    addr = service.transport.address
+    maddr = (service.metrics_server.address
+             if service.metrics_server is not None else None)
+    print(
+        f"serve: {service.cfg.transport} transport"
+        + (f" on {addr[0]}:{addr[1]}" if addr else "")
+        + f", quorum {service.cfg.quorum}/{session.num_workers}, "
+        + f"deadline {service.cfg.deadline_s}s, trace {trace}"
+        + (f", metrics http://{maddr[0]}:{maddr[1]}/metrics" if maddr else ""),
+        flush=True,
+    )
+    return service

@@ -152,9 +152,19 @@ def save(ckpt_dir: str, session, keep: int = 3, fault_plan=None,
         num_workers = session.num_workers
         # committed re-queue of dropped clients (cohort fault tolerance):
         # like the RNG, the COMMITTED snapshot, not the live queue a
-        # prefetcher may already have served for uncommitted rounds
+        # prefetcher may already have served for uncommitted rounds. The
+        # rounds-waiting ages ride along so a restored --requeue_policy aged
+        # queue resumes each entry's REAL age instead of restarting at 1.
         requeued = [int(i) for i in
                     getattr(session, "_requeue_committed", ())]
+        requeue_ages = [[int(c), int(r)] for c, r in
+                        getattr(session, "_requeue_ages_committed", ())]
+        # serving-layer state (serve/): the service registers a callable
+        # returning a JSON-safe dict (pending arrival queue etc.) snapshotted
+        # at the committed round boundary; None when the session is driven by
+        # the batch simulator
+        serve_provider = getattr(session, "serve_meta", None)
+        serve_meta = serve_provider() if callable(serve_provider) else None
     final = os.path.abspath(os.path.join(ckpt_dir, f"round_{rnd:08d}"))
     staging = os.path.abspath(os.path.join(ckpt_dir, f"{_TMP_PREFIX}{rnd:08d}"))
 
@@ -193,7 +203,10 @@ def save(ckpt_dir: str, session, keep: int = 3, fault_plan=None,
         with open(os.path.join(staging, "meta.json"), "w") as f:
             json.dump({"comm_mb_total": comm_mb_total,
                        "num_workers": num_workers,
-                       "requeued": requeued}, f)
+                       "requeued": requeued,
+                       "requeue_ages": requeue_ages,
+                       **({"serve": serve_meta}
+                          if serve_meta is not None else {})}, f)
         _write_manifest(staging)
         # overwrite (emergency save of a round already checkpointed): rename
         # the committed copy ASIDE first — a delete-then-rename would leave a
@@ -308,11 +321,19 @@ def restore(path: str, session) -> None:
             requeued = [int(i) for i in meta.get("requeued", [])]
             session._requeue = collections.deque(requeued)
             session._requeue_committed = tuple(requeued)
-            # queue AGES are advisory and not persisted (the aged policy is
-            # a fairness stub): restored entries restart at rounds-waiting 1
             if hasattr(session, "_requeue_enqueued"):
+                # rounds-waiting ages resume exactly (requeue_ages pairs);
+                # entries a pre-age checkpoint doesn't cover restart at the
+                # restored round (rounds-waiting 1 — the old behavior)
+                ages = {int(c): int(r)
+                        for c, r in meta.get("requeue_ages", [])}
                 session._requeue_enqueued = {
-                    cid: session.round for cid in requeued}
+                    cid: ages.get(cid, session.round) for cid in requeued}
+                session._requeue_ages_committed = tuple(
+                    session._requeue_enqueued.items())
+        # serving-layer state for serve/ to pick up when it attaches to the
+        # restored session (pending arrival queue etc.); absent = empty
+        session.restored_serve_meta = meta.get("serve")
         saved_w = meta.get("num_workers")
         if saved_w is not None and saved_w != session.num_workers:
             print(

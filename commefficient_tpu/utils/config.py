@@ -87,6 +87,40 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "head; aged keeps expected wait bounded). Both "
                         "consume zero host-sampling RNG, so the sampled "
                         "cohort stream is policy-invariant")
+    # streaming aggregation service (serve/): clients PUSH submissions at a
+    # continuously-running aggregator instead of the loop pulling them
+    p.add_argument("--serve", default="off",
+                   choices=["off", "inproc", "socket"],
+                   help="run as a streaming aggregation service: cohorts "
+                        "assemble from a PUSH arrival stream (trace-driven "
+                        "traffic generator) with W-of-N round close, "
+                        "admission control, and backpressure, instead of "
+                        "the loop sampling clients itself. inproc = "
+                        "in-process submissions (deterministic; the parity "
+                        "path), socket = loopback-TCP JSON-lines wire. "
+                        "off (default) = the batch simulator")
+    p.add_argument("--serve_quorum", type=int, default=0,
+                   help="W of the W-of-N round close: the round closes as "
+                        "soon as this many of the --num_workers invited "
+                        "clients have submitted; stragglers and no-shows "
+                        "are masked + re-queued (bit-identical to the "
+                        "round over the survivors). 0 = full cohort")
+    p.add_argument("--serve_deadline", type=float, default=4.0,
+                   help="round-close deadline in (virtual) seconds: a "
+                        "round short of quorum closes degraded here")
+    p.add_argument("--serve_trace", default="",
+                   help="traffic-generator trace spec, 'k=v,...' over "
+                        "population/base_rate/diurnal_amplitude/"
+                        "diurnal_period_s/burst_rate/burst_size/seed "
+                        "(serve.TraceConfig); unset = defaults with "
+                        "population=num_clients and seed=--seed")
+    p.add_argument("--serve_port", type=int, default=0,
+                   help="--serve socket: loopback bind port (0 = ephemeral)")
+    p.add_argument("--serve_metrics_port", type=int, default=-1,
+                   help=">= 0 serves GET /metrics (JSON: round, queue "
+                        "depth, arrival rate, quarantine/requeue counters) "
+                        "on this loopback port (0 = ephemeral, printed at "
+                        "startup); -1 = no endpoint")
     p.add_argument("--rounds_per_dispatch", type=int, default=1,
                    help="> 1 compiles this many rounds into one program "
                         "(lax.scan) with a single host sync per block — "

@@ -30,6 +30,7 @@ from commefficient_tpu.models.losses import make_lm_loss
 from commefficient_tpu.parallel import mesh as meshlib, tp
 from commefficient_tpu.resilience import FaultPlan, RetryPolicy
 from commefficient_tpu.runner import RunnerConfig, run_loop
+from commefficient_tpu.serve.service import service_from_args
 from commefficient_tpu.utils import checkpoint as ckpt
 from commefficient_tpu.utils.config import make_parser, mode_config_from_args, resolve_defaults
 from commefficient_tpu.utils.logging import TableLogger
@@ -302,17 +303,29 @@ def main(argv=None):
             row["val_f1"] = f1_eval(model.params, rnd)
         return row
 
+    # --serve: the streaming aggregation service drives the loop from its
+    # push arrival stream (built AFTER restore so a resumed service picks
+    # up the persisted pending-submission queue)
+    service = service_from_args(args, session)
+
     # the shared harness owns the loop: block planning, async prefetch /
     # deferred metrics / overlapped checkpoint writes (or the --sync_loop
     # serial path), watchdog escalation, preemption, non-finite halt
-    run_loop(
-        session, opt,
-        RunnerConfig.from_args(
-            args, total_rounds, args.eval_every or min(rounds_per_epoch, 200)),
-        eval_fn=lambda: model.eval(valid_set, args.eval_batch_size),
-        build_row=build_row,
-        logger=logger,
-    )
+    try:
+        run_loop(
+            session, opt,
+            RunnerConfig.from_args(
+                args, total_rounds, args.eval_every or min(rounds_per_epoch, 200)),
+            eval_fn=lambda: model.eval(valid_set, args.eval_batch_size),
+            build_row=build_row,
+            logger=logger,
+            source=service.source() if service is not None else None,
+        )
+    finally:
+        if service is not None:
+            print(f"serve: final metrics {service.metrics_snapshot()}",
+                  flush=True)
+            service.close()
 
     if args.profile_dir:
         jax.profiler.stop_trace()

@@ -14,6 +14,7 @@
 # Usage: scripts/chaos_smoke.sh [extra pytest args]
 #        scripts/chaos_smoke.sh supervisor
 #        scripts/chaos_smoke.sh cohort
+#        scripts/chaos_smoke.sh serve
 #
 # `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
 # restartPolicy would: it launches the tiny cv_train run with a fault plan
@@ -26,6 +27,13 @@
 # (rejected by the --client_update_clip quarantine) inside one short run,
 # asserting the run finishes all rounds with finite params, the dropped
 # client served back, and exactly one quarantined client. < 2 min on CPU.
+#
+# `serve` mode drives the STREAMING AGGREGATION SERVICE (serve/) end-to-end
+# through the real cv_train CLI: the trace-driven traffic generator pushes
+# submissions at the in-process transport, rounds close at W-of-N, and
+# injected client_drop/client_straggle faults ride the service path —
+# asserting every round closed (quorum or deadline), the W-of-N masking
+# fired, and the no-show/dropped clients went through the re-queue. < 2 min.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -183,6 +191,100 @@ assert stats.degraded_rounds == 2, stats
 assert stats.requeue_depth_max == 1, stats
 print(f"cohort: PASS (drop masked+requeued, poison quarantined, "
       f"{stats.rounds} rounds clean; degraded_rounds={stats.degraded_rounds})")
+EOF
+fi
+
+if [[ "${1:-}" == "serve" ]]; then
+    shift
+    exec timeout -k 10 "${CHAOS_TIMEOUT_S:-300}" python - "$@" <<'EOF'
+# serve chaos child: the real cv_train.main CLI path in --serve mode (tiny
+# model substitution), over-provisioned cohorts closing at 3-of-4 with the
+# traffic generator's device classes producing organic stragglers/no-shows,
+# PLUS injected client_drop + client_straggle faults through the service
+# path. Asserts the W-of-N close machinery, the masking, and the re-queue
+# counters all fired.
+import numpy as np
+
+import flax.linen as nn
+
+import commefficient_tpu.data.cifar as cifar
+import cv_train
+from commefficient_tpu.runner import loop as rloop
+from commefficient_tpu.serve import service as serve_service
+
+
+class _TinyNet(nn.Module):
+    num_classes: int = 10
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+_orig = cifar.load_cifar_fed
+
+
+def _tiny(*a, **kw):
+    kw.update(synthetic_train=64, synthetic_test=32)
+    return _orig(*a, **kw)
+
+
+cv_train.ResNet9 = _TinyNet
+cv_train.load_cifar_fed = _tiny
+
+box = {}
+_orig_loop = rloop.run_loop
+_orig_svc = serve_service.service_from_args
+
+
+def _capture_loop(*a, **kw):
+    stats = _orig_loop(*a, **kw)
+    box["stats"] = stats
+    return stats
+
+
+def _capture_svc(*a, **kw):
+    svc = _orig_svc(*a, **kw)
+    box["service"] = svc
+    return svc
+
+
+cv_train.run_loop = _capture_loop
+cv_train.service_from_args = _capture_svc
+
+session = cv_train.main([
+    "--dataset", "cifar10", "--mode", "uncompressed", "--num_clients", "8",
+    "--num_workers", "4", "--local_batch_size", "4", "--lr_scale", "0.05",
+    "--weight_decay", "0", "--data_root", "/nonexistent",
+    "--num_rounds", "6", "--serve", "inproc", "--serve_quorum", "3",
+    "--serve_deadline", "2.0",
+    "--fault_plan",
+    "client_drop@2:clients=0;client_straggle@3:clients=1,secs=1",
+])
+stats, svc = box["stats"], box["service"]
+m = svc.metrics_snapshot()
+print("serve chaos metrics:", m)
+assert session.round == 6, session.round
+rounds = m["rounds"]
+assert rounds["rounds_closed"] == 6, rounds
+assert rounds["closed_by_quorum"] + rounds["closed_by_deadline"] == 6
+# the traffic's flaky device class + the injected drop produced casualties
+# that the masking/re-queue machinery absorbed
+assert stats.clients_dropped >= 1, stats
+assert stats.requeue_depth_max >= 1, stats
+assert m["submissions"]["accepted"] >= 6 * 3 - rounds["closed_by_deadline"] * 3
+import jax
+from jax.flatten_util import ravel_pytree
+flat = np.asarray(ravel_pytree(jax.device_get(session.state["params"]))[0])
+assert np.isfinite(flat).all(), "params went non-finite through the serve run"
+print(f"serve: PASS (6 W-of-N rounds closed "
+      f"[quorum={rounds['closed_by_quorum']} deadline={rounds['closed_by_deadline']}], "
+      f"clients_dropped={stats.clients_dropped}, "
+      f"requeue_depth_max={stats.requeue_depth_max}, "
+      f"stragglers={rounds['stragglers']}, no_shows={rounds['no_shows']})")
 EOF
 fi
 

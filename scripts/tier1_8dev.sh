@@ -9,8 +9,9 @@
 # path, mesh spec parsing, runner auto-inflight policy — plus the cohort
 # fault-tolerance slice (test_cohort_faults.py: masked-cohort bit parity on
 # the mesh path, sketch-space quarantine mesh == single-device), the
-# engine's existing mesh suite and the bench mesh section's graceful
-# degradation.
+# serving layer (test_serve.py: served-round W-of-N bit parity fused AND
+# sharded, CLI serve runs riding the 8-device mesh), the engine's existing
+# mesh suite and the bench mesh section's graceful degradation.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -24,6 +25,7 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 python -m pytest tests/test_sharded_round.py tests/test_engine.py \
     tests/test_client_state_sharding.py tests/test_cohort_faults.py \
+    tests/test_serve.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 
 # bench mesh section must degrade to {"skipped": ...} on ONE device (the

@@ -1,0 +1,11 @@
+# graftlint: module=commefficient_tpu/runner/fake_loop2.py
+# G007 package-level violating twin: the sleep is smuggled behind a helper
+# IMPORT (run_loop -> wait_ready in another module) — the case the
+# module-local reachability used to miss.
+from .g007_import_helper_bad import wait_ready
+
+
+def run_loop(session, cfg):
+    for _ in range(cfg.total_rounds):
+        wait_ready(session)
+        session.dispatch()

@@ -1,0 +1,10 @@
+# graftlint: module=commefficient_tpu/runner/fake_helper_ok.py
+# Conforming helper twin: the same blocking wait, but DECLARED as the
+# sanctioned boundary — package-level G007 stops at a drain-point.
+import time
+
+
+# graftlint: drain-point — the sanctioned serving-queue wait
+def wait_ready(session):
+    while not session.ready:
+        time.sleep(0.5)

@@ -1,0 +1,11 @@
+# graftlint: module=commefficient_tpu/runner/fake_loop2.py
+# G007 package-level conforming twin: the imported helper's wait is a
+# DECLARED sanctioned boundary (its module marks the def as a drain point),
+# so the cross-module traversal stops there.
+from .g007_import_helper_ok import wait_ready
+
+
+def run_loop(session, cfg):
+    for _ in range(cfg.total_rounds):
+        wait_ready(session)
+        session.dispatch()

@@ -60,6 +60,22 @@ def test_rule_silent_on_conforming_fixture(code):
         f"{code} false-positived on its conforming twin (found: {found})")
 
 
+def test_g007_fires_through_helper_import():
+    """Package-level reachability: a time.sleep smuggled behind a helper
+    IMPORT (run_loop -> other_module.wait_ready) must fire G007 — the case
+    the old module-local call graph missed."""
+    found = _codes(os.path.join(FIXTURES, "g007_import_bad.py"))
+    assert "G007" in found, found
+
+
+def test_g007_import_traversal_stops_at_drain_point():
+    """The same import shape with the helper's wait DECLARED a drain point
+    (in the helper's own module) must stay silent — that is how the serve/
+    transports declare their sanctioned blocking points in code."""
+    found = _codes(os.path.join(FIXTURES, "g007_import_ok.py"))
+    assert "G007" not in found, found
+
+
 def test_every_rule_has_fixture_pair():
     # adding a rule without fixtures should fail HERE, not in review
     for code in RULE_CODES:

@@ -1,0 +1,618 @@
+"""Streaming aggregation service (serve/) — ISSUE 6 tentpole.
+
+Four layers:
+
+1. Host-pure unit coverage of the ingest layer (admission control:
+   backpressure, duplicate, out-of-round, early buffering), the W-of-N
+   assembler, the O(1) fold_in client state, the traffic generator, and
+   both transports (in-process + loopback socket).
+2. THE acceptance pin: a served W-of-N round — same arrivals — is
+   bit-identical (params + logged metrics) to the batch-simulator round
+   that drops the same cohort positions via the fault plan, fused AND on
+   the sharded single-device reference program.
+3. Checkpoint discipline: requeue AGES and the pending arrival queue
+   round-trip through meta.json; a preempted --serve run resumes
+   bit-identical to the uninterrupted one through the real CLI.
+4. The ops surface: /metrics endpoint fields over a live service.
+
+The session-level tests use the same tiny-MLP/synthetic-data substitution
+as tests/test_runner.py (serving logic is model-agnostic)."""
+
+import json
+import os
+import tracemalloc
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+import cv_train
+from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+from commefficient_tpu.federated.api import FederatedSession
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.resilience import EXIT_RESUMABLE, FaultPlan
+from commefficient_tpu.serve import (
+    AggregationService,
+    CohortAssembler,
+    IngestQueue,
+    ServeConfig,
+    SocketTransport,
+    Submission,
+    TraceConfig,
+    TrafficGenerator,
+    submit_over_socket,
+)
+from commefficient_tpu.serve import clients as cl
+from commefficient_tpu.serve.ingest import (
+    ACCEPTED,
+    BUFFERED,
+    DUPLICATE,
+    NOT_INVITED,
+    OUT_OF_ROUND,
+    QUEUE_FULL,
+)
+from commefficient_tpu.serve.metrics import MetricsServer
+from commefficient_tpu.utils import checkpoint as ckpt
+from commefficient_tpu.utils.config import make_parser, resolve_defaults
+
+LR = 0.05
+
+
+# ---------------------------------------------------------------- ingest layer
+
+
+def _sub(cid, rnd=0, latency=0.1):
+    return Submission(client_id=cid, round=rnd, latency_s=latency)
+
+
+def test_ingest_accepts_invited_and_rejects_uninvited():
+    q = IngestQueue(capacity=8)
+    q.open_round(0, [1, 2, 3])
+    assert q.submit(_sub(1)) == ACCEPTED
+    assert q.submit(_sub(9)) == NOT_INVITED
+    assert q.counters()["accepted"] == 1
+    assert q.counters()["rejected_uninvited"] == 1
+
+
+def test_ingest_rejects_duplicate_submission():
+    q = IngestQueue(capacity=8)
+    q.open_round(0, [1, 2])
+    assert q.submit(_sub(1)) == ACCEPTED
+    assert q.submit(_sub(1)) == DUPLICATE  # at-least-once transport retry
+    assert q.counters()["rejected_dup"] == 1
+    assert len(q.arrivals()) == 1  # the merge never double-counts
+
+
+def test_ingest_backpressure_on_full_queue():
+    q = IngestQueue(capacity=2)
+    q.open_round(0, [1, 2, 3])
+    assert q.submit(_sub(1)) == ACCEPTED
+    assert q.submit(_sub(2)) == ACCEPTED
+    assert q.submit(_sub(3)) == QUEUE_FULL  # the backpressure signal
+    assert q.counters()["rejected_full"] == 1
+
+
+def test_ingest_rejects_late_out_of_round():
+    q = IngestQueue(capacity=8)
+    q.open_round(3, [1, 2])
+    assert q.submit(_sub(1, rnd=2)) == OUT_OF_ROUND  # already-closed round
+    assert q.submit(_sub(1, rnd=9)) == OUT_OF_ROUND  # far-future round
+    assert q.counters()["rejected_out_of_round"] == 2
+
+
+def test_ingest_buffers_early_submission_for_next_round():
+    """A push for round r+1 while r is open parks in the pending buffer and
+    admits the moment r+1 opens — a pushing client never resubmits."""
+    q = IngestQueue(capacity=8, pending_capacity=4)
+    q.open_round(0, [1, 2])
+    assert q.submit(_sub(5, rnd=1, latency=0.7)) == BUFFERED
+    assert q.depth() == 1  # parked submissions count toward queue depth
+    q.close_round()
+    q.open_round(1, [5, 6])
+    arr = q.arrivals()
+    assert [a.client_id for a in arr] == [5]
+    assert arr[0].latency_s == 0.7
+    # a parked client NOT invited to round 1 stays parked
+    q.close_round()
+    q.open_round(2, [7])
+    assert q.submit(_sub(9, rnd=3)) == BUFFERED
+    q.close_round()
+    assert q.pending_snapshot() == [(9, 0.1)]
+
+
+def test_ingest_buffers_early_push_during_mid_merge_window():
+    """The server is mid-merge between close_round(r) and open_round(r+1)
+    (no round open): a push for r+1 must BUFFER, not bounce OUT_OF_ROUND —
+    a pushing client never resubmits just because it raced the merge."""
+    q = IngestQueue(capacity=8)
+    q.open_round(0, [1, 2])
+    q.close_round()  # mid-merge: nothing open
+    assert q.submit(_sub(1, rnd=1, latency=0.2)) == BUFFERED
+    assert q.submit(_sub(1, rnd=2)) == OUT_OF_ROUND  # beyond next: rejected
+    q.open_round(1, [1, 9])
+    assert [a.client_id for a in q.arrivals()] == [1]
+
+
+def test_ingest_pending_buffer_is_bounded():
+    q = IngestQueue(capacity=8, pending_capacity=1)
+    q.open_round(0, [1])
+    assert q.submit(_sub(5, rnd=1)) == BUFFERED
+    assert q.submit(_sub(6, rnd=1)) == QUEUE_FULL
+    assert q.submit(_sub(5, rnd=1)) == DUPLICATE
+
+
+# ------------------------------------------------------------ W-of-N assembler
+
+
+def _closed(latencies, quorum, deadline, invited=None):
+    inv = list(invited or range(len(latencies)))
+    q = IngestQueue(capacity=64)
+    q.open_round(0, inv)
+    for cid, lat in zip(inv, latencies):
+        if np.isfinite(lat) and lat <= deadline:
+            q.submit(Submission(client_id=cid, round=0, latency_s=lat))
+    asm = CohortAssembler(q, quorum, deadline)
+    return asm.close_virtual(0, inv), asm
+
+
+def test_assembler_closes_at_quorum():
+    """5 invited, quorum 3: the 3 fastest make the cut; the 4th (finite but
+    slower than the close) is a straggler; inf is a no-show."""
+    closed, asm = _closed([0.5, 0.1, 2.0, 0.3, np.inf], quorum=3, deadline=3.0)
+    assert closed.closed_by == "quorum"
+    np.testing.assert_array_equal(closed.arrived, [1, 1, 0, 1, 0])
+    assert closed.close_latency_s == 0.5
+    assert closed.stragglers == 1 and closed.no_shows == 1
+    assert asm.counters()["closed_by_quorum"] == 1
+
+
+def test_assembler_closes_at_deadline_when_short_of_quorum():
+    closed, asm = _closed([0.5, np.inf, np.inf, 9.0], quorum=3, deadline=1.0)
+    assert closed.closed_by == "deadline"
+    np.testing.assert_array_equal(closed.arrived, [1, 0, 0, 0])
+    assert closed.survivors == 1
+    # 9.0 > deadline: the traffic layer never submitted it -> no-show
+    assert closed.no_shows == 3
+    assert asm.counters()["closed_by_deadline"] == 1
+
+
+def test_assembler_wall_close_cuts_at_recv_order():
+    q = IngestQueue(capacity=8)
+    inv = [10, 11, 12]
+    q.open_round(0, inv)
+    q.submit(_sub(12, latency=0.9))
+    q.submit(_sub(10, latency=0.1))
+    asm = CohortAssembler(q, quorum=2, deadline_s=0.05)
+    closed = asm.close_wall(0, inv)
+    # recv order (12 then 10) decides, not the latency metadata
+    np.testing.assert_array_equal(closed.arrived, [1.0, 0.0, 1.0])
+    assert closed.closed_by == "quorum"
+
+
+# ------------------------------------------------- O(1) fold_in client state
+
+
+def test_fold_in_host_deterministic_and_vectorized():
+    ids = np.array([0, 1, 2, 10_000_000 - 1], np.int64)
+    a = cl.fold_in_host(42, ids)
+    b = cl.fold_in_host(42, ids)
+    np.testing.assert_array_equal(a, b)
+    assert len(set(a.tolist())) == len(ids)  # no trivial collisions
+    assert cl.fold_in_host(42, 1) != cl.fold_in_host(43, 1)  # seed folds in
+    # scalar == vectorized element
+    assert cl.fold_in_host(42, 2) == a[2]
+
+
+def test_device_class_stable_and_weighted():
+    ids = np.arange(20_000)
+    idx = cl.device_class_index(7, ids)
+    np.testing.assert_array_equal(idx, cl.device_class_index(7, ids))
+    frac = np.bincount(idx, minlength=3) / len(ids)
+    want = np.array([c.weight for c in cl.DEFAULT_CLASSES])
+    np.testing.assert_allclose(frac, want / want.sum(), atol=0.02)
+
+
+def test_response_latency_mixes_classes_and_no_shows():
+    ids = np.arange(10_000)
+    lat = cl.response_latency_s(3, ids, rnd=5)
+    assert np.isinf(lat).any() and np.isfinite(lat).any()
+    assert (lat[np.isfinite(lat)] > 0).all()
+    # round folds in: a different round redraws
+    lat2 = cl.response_latency_s(3, ids, rnd=6)
+    assert not np.array_equal(lat, lat2)
+    np.testing.assert_array_equal(lat, cl.response_latency_s(3, ids, rnd=5))
+
+
+def test_client_state_is_o1_at_10m_population():
+    """The 10M-ID acceptance check in unit form: deriving latencies for
+    invite batches drawn from a 10M-ID universe allocates memory
+    proportional to the BATCH, never the population (no table anywhere)."""
+    def peak(population):
+        rs = np.random.RandomState(0)
+        tracemalloc.start()
+        for rnd in range(8):
+            ids = rs.randint(0, population, size=2048)
+            cl.response_latency_s(11, ids, rnd)
+        _, p = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        return p
+
+    small, big = peak(10_000), peak(10_000_000)
+    assert big <= 2 * small, (small, big)
+    assert big < 32 << 20  # and absolutely tiny vs any 10M-row table
+
+
+# ------------------------------------------------------------------- traffic
+
+
+def test_trace_config_parse_and_rejects_unknown_keys():
+    t = TraceConfig.parse("population=500,base_rate=9.5,burst_rate=0.25")
+    assert (t.population, t.base_rate, t.burst_rate) == (500, 9.5, 0.25)
+    assert TraceConfig.parse("") == TraceConfig()
+    with pytest.raises(ValueError, match="unknown key"):
+        TraceConfig.parse("populaton=5")
+    with pytest.raises(ValueError, match="bad value"):
+        TraceConfig.parse("population=lots")
+
+
+def test_diurnal_rate_shape():
+    g = TrafficGenerator(TraceConfig(base_rate=100, diurnal_amplitude=0.5,
+                                     diurnal_period_s=86400))
+    trough, peak = g.rate_at(0.0), g.rate_at(43200.0)
+    assert trough == pytest.approx(50.0) and peak == pytest.approx(150.0)
+
+
+def test_arrival_events_deterministic_and_window_independent():
+    g = TrafficGenerator(TraceConfig(population=1000, base_rate=50, seed=9))
+    a = [(t, ids.tolist()) for t, ids in g.arrival_events(0.0, 10.0)]
+    b = [(t, ids.tolist()) for t, ids in g.arrival_events(0.0, 10.0)]
+    assert a == b and a
+    assert all(0 <= i < 1000 for _, ids in a for i in ids)
+
+
+def test_respond_to_invites_submits_in_latency_order_within_deadline():
+    g = TrafficGenerator(TraceConfig(population=100, seed=1))
+    got = []
+    sent = g.respond_to_invites(0, np.arange(40), lambda s: got.append(s),
+                                deadline_s=2.0)
+    assert sent == len(got) > 0
+    lats = [s.latency_s for s in got]
+    assert lats == sorted(lats)
+    assert all(lat <= 2.0 for lat in lats)
+    expected = g.invite_latencies(0, np.arange(40))
+    assert sent == int((expected[np.isfinite(expected)] <= 2.0).sum())
+
+
+# ---------------------------------------------------------- socket transport
+
+
+def test_socket_transport_round_trips_admission_decisions():
+    q = IngestQueue(capacity=4)
+    q.open_round(2, [7, 8])
+    t = SocketTransport(q)
+    t.start()
+    try:
+        addr = t.address
+        assert submit_over_socket(
+            addr, Submission(client_id=7, round=2, latency_s=0.3)) == ACCEPTED
+        assert t.submit(
+            Submission(client_id=7, round=2)) == DUPLICATE
+        assert submit_over_socket(
+            addr, Submission(client_id=7, round=0)) == OUT_OF_ROUND
+        assert submit_over_socket(
+            addr, Submission(client_id=99, round=2)) == NOT_INVITED
+    finally:
+        t.stop()
+    arr = q.arrivals()
+    assert [a.client_id for a in arr] == [7]
+    assert arr[0].latency_s == 0.3
+
+
+# --------------------------------------------------- session-level fixtures
+
+
+def _quad_loss(params, net_state, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    err = pred - jax.nn.one_hot(batch["y"], pred.shape[-1])
+    mask = batch["mask"]
+    count = jnp.maximum(mask.sum(), 1.0)
+    per_ex = (err ** 2).sum(-1)
+    return (per_ex * mask).sum() / count, {
+        "net_state": net_state,
+        "metrics": {"loss_sum": (per_ex * mask).sum(), "count": mask.sum()}}
+
+
+def _tiny_session(shards=0, seed=0, fault_plan=None, requeue_policy="fifo",
+                  num_clients=12, workers=4, din=6, dout=3):
+    rs = np.random.RandomState(0)
+    x = rs.randn(96, din).astype(np.float32)
+    w_true = rs.randn(din, dout).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int32)
+    train = FedDataset(x, y, shard_iid(len(x), num_clients,
+                                       np.random.RandomState(1)))
+    params = {"w": jnp.asarray(rs.randn(din, dout).astype(np.float32) * 0.1),
+              "b": jnp.zeros(dout)}
+    d = ravel_pytree(params)[0].size
+    return FederatedSession(
+        train_loss_fn=_quad_loss, eval_loss_fn=_quad_loss,
+        params=params, net_state={},
+        mode_cfg=ModeConfig(mode="uncompressed", d=d, momentum=0.9,
+                            momentum_type="virtual", error_type="none"),
+        train_set=train, num_workers=workers, local_batch_size=4,
+        seed=seed, client_shards=shards, fault_plan=fault_plan,
+        requeue_policy=requeue_policy,
+    )
+
+
+def _serve_rounds(session, n, quorum=2, deadline=1.0, trace_seed=5):
+    """Run n served rounds; returns (metrics rows, per-round dropped
+    positions)."""
+    svc = AggregationService(
+        session, ServeConfig(quorum=quorum, deadline_s=deadline),
+        traffic=TrafficGenerator(
+            TraceConfig(population=session.train_set.num_clients,
+                        seed=trace_seed)),
+    ).start()
+    src = svc.source()
+    rows, drops = [], []
+    try:
+        for _ in range(n):
+            prep = src.next()
+            drops.append(sorted(
+                int(p) for p in
+                np.flatnonzero(np.asarray(prep.batch["_valid"]) == 0.0)))
+            rows.append(session.commit_round(
+                session.dispatch_round(prep, LR))[0])
+    finally:
+        svc.close()
+    return rows, drops
+
+
+def _drop_plan(drops):
+    return ";".join(
+        f"client_drop@{r}:clients=" + "+".join(map(str, pos))
+        for r, pos in enumerate(drops) if pos)
+
+
+def _assert_params_equal(sa, sb):
+    for x, y in zip(
+        jax.tree.leaves(jax.device_get(sa.state["params"])),
+        jax.tree.leaves(jax.device_get(sb.state["params"])),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------- THE parity acceptance pin
+
+
+@pytest.mark.parametrize("shards", [0, 2], ids=["fused", "sharded"])
+def test_served_round_bit_identical_to_batch_simulator(shards):
+    """A served W-of-N round — quorum close, stragglers/no-shows masked and
+    re-queued — is bit-identical (params + every logged metric) to the
+    batch-simulator round that drops the SAME positions via the fault plan,
+    on the fused path and on the sharded single-device reference program."""
+    a = _tiny_session(shards=shards)
+    rows_a, drops = _serve_rounds(a, 3, quorum=2, deadline=1.0)
+    assert any(drops), "trace produced no casualties; pin would be vacuous"
+
+    plan = FaultPlan.parse(_drop_plan(drops))
+    b = _tiny_session(shards=shards, fault_plan=plan)
+    rows_b = [b.run_round(LR) for _ in range(3)]
+
+    for ra, rb in zip(rows_a, rows_b):
+        assert set(ra) == set(rb)
+        for k in ra:
+            assert ra[k] == rb[k], (k, ra[k], rb[k])
+    _assert_params_equal(a, b)
+    # the re-queues evolved identically too (served no-shows == faulted drops)
+    assert list(a._requeue) == list(b._requeue)
+    assert a._requeue_enqueued == b._requeue_enqueued
+
+
+def test_full_arrival_round_is_bit_identical_to_plain_round():
+    """When every invitee arrives inside the quorum window the served round
+    must be EXACTLY the batch-simulator round: same cohort, same batch,
+    same key chain — the serving layer is a pure re-plumbing."""
+    a = _tiny_session()
+    svc = AggregationService(
+        a, ServeConfig(quorum=a.num_workers, deadline_s=1e9),
+        traffic=TrafficGenerator(
+            TraceConfig(population=a.train_set.num_clients, seed=5)),
+    ).start()
+    try:
+        src = svc.source()
+        rows_a = [a.commit_round(a.dispatch_round(src.next(), LR))[0]
+                  for _ in range(2)]
+    finally:
+        svc.close()
+    b = _tiny_session()
+    rows_b = [b.run_round(LR) for _ in range(2)]
+    for ra, rb in zip(rows_a, rows_b):
+        for k in ra:
+            assert ra[k] == rb[k], k
+    _assert_params_equal(a, b)
+
+
+# ----------------------------------------------- checkpoint: ages + pending
+
+
+def test_requeue_ages_persist_through_checkpoint(tmp_path):
+    """Satellite: --requeue_policy aged ages resume their REAL rounds-waiting
+    from meta.json instead of restarting at 1 — the aged serving order after
+    resume matches the uninterrupted session's exactly."""
+    plan = FaultPlan.parse("client_drop@0:clients=0+1;client_drop@1:clients=2")
+    a = _tiny_session(fault_plan=plan, requeue_policy="aged", workers=3)
+    a.run_round(LR)
+    a.run_round(LR)
+    assert a._requeue_enqueued  # queued casualties carry their drop rounds
+    path = ckpt.save(str(tmp_path), a)
+
+    b = _tiny_session(requeue_policy="aged", workers=3)
+    ckpt.restore(path, b)
+    assert b._requeue_enqueued == a._requeue_enqueued
+    assert list(b._requeue) == list(a._requeue)
+    # behavioral pin: the aged weighted order (a function of the AGES) now
+    # serves identically on both sessions for the rounds that follow
+    for _ in range(3):
+        ma, mb = a.run_round(LR), b.run_round(LR)
+        assert ma["loss_sum"] == mb["loss_sum"]
+    assert list(a._requeue) == list(b._requeue)
+    _assert_params_equal(a, b)
+
+
+def test_pending_arrival_queue_persists_through_checkpoint(tmp_path):
+    """The early-submission buffer rides meta.json: a service rebuilt on a
+    restored session sees the parked pushes again."""
+    a = _tiny_session()
+    svc = AggregationService(
+        a, ServeConfig(quorum=2, deadline_s=1.0),
+        traffic=TrafficGenerator(
+            TraceConfig(population=a.train_set.num_clients, seed=5)),
+    ).start()
+    try:
+        src = svc.source()
+        prep = src.next()
+        # park an early push for the NEXT round while round 1 is not open
+        a.commit_round(a.dispatch_round(prep, LR))
+        svc.queue.open_round(1, [])  # open so round-2 pushes are "early"
+        assert svc.queue.submit(
+            Submission(client_id=3, round=2, latency_s=0.4)) == BUFFERED
+        svc._record_boundary(1)
+        path = ckpt.save(str(tmp_path), a)
+    finally:
+        svc.close()
+
+    b = _tiny_session()
+    ckpt.restore(path, b)
+    assert b.restored_serve_meta["pending"] == [[3, 0.4]]
+    svc_b = AggregationService(
+        b, ServeConfig(quorum=2, deadline_s=1.0),
+        traffic=TrafficGenerator(
+            TraceConfig(population=b.train_set.num_clients, seed=5)))
+    try:
+        assert svc_b.queue.pending_snapshot() == [(3, 0.4)]
+    finally:
+        svc_b.close()
+
+
+@pytest.fixture()
+def tiny_cv(tmp_path, monkeypatch):
+    import flax.linen as nn
+
+    import commefficient_tpu.data.cifar as cifar_mod
+
+    orig = cifar_mod.load_cifar_fed
+
+    def tiny(*a, **kw):
+        kw.update(synthetic_train=64, synthetic_test=32)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(cv_train, "load_cifar_fed", tiny)
+
+    class _TinyNet(nn.Module):
+        num_classes: int = 10
+        dtype: str = "float32"
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(self.num_classes)(x)
+
+    monkeypatch.setattr(cv_train, "ResNet9", _TinyNet)
+    return tmp_path
+
+
+def _argv(extra=()):
+    return [
+        "--dataset", "cifar10", "--mode", "uncompressed", "--num_clients", "8",
+        "--num_workers", "2", "--local_batch_size", "4", "--lr_scale", "0.05",
+        "--weight_decay", "0", "--data_root", "/nonexistent", *extra,
+    ]
+
+
+@pytest.mark.chaos
+def test_cli_serve_preempt_resume_bit_identical(tiny_cv, tmp_path):
+    """The served CLI run (W-of-N, requeue, trace traffic) preempted
+    mid-run resumes BIT-IDENTICAL to the uninterrupted served run — the
+    arrival stream, requeue ages, and pending queue all restore from
+    meta.json (acceptance criterion 3's checkpoint half)."""
+    serve_flags = ("--serve", "inproc", "--serve_quorum", "5",
+                   "--serve_deadline", "2.0", "--num_rounds", "4")
+    sa = cv_train.main(_argv(serve_flags))  # uninterrupted reference
+
+    ckdir = str(tmp_path / "ck")
+    chaos = ["--checkpoint_dir", ckdir, "--checkpoint_every", "2",
+             "--fault_plan", "preempt@2"]
+    with pytest.raises(SystemExit) as ei:
+        cv_train.main(_argv(serve_flags) + chaos)
+    assert ei.value.code == EXIT_RESUMABLE
+    sc = cv_train.main(_argv(serve_flags) + chaos + ["--resume"])
+    assert sc.round == 4
+    _assert_params_equal(sa, sc)
+    assert list(sa._requeue) == list(sc._requeue)
+    assert sa._requeue_enqueued == sc._requeue_enqueued
+
+
+@pytest.mark.chaos
+def test_cli_serve_end_to_end_with_aged_requeue(tiny_cv):
+    """--serve inproc + --requeue_policy aged through the real CLI: the run
+    finishes every round with finite params and no leaked service threads."""
+    import threading
+
+    before = {t.name for t in threading.enumerate()}
+    s = cv_train.main(_argv(("--serve", "inproc", "--serve_quorum", "5",
+                             "--serve_deadline", "2.0", "--num_rounds", "4",
+                             "--requeue_policy", "aged")))
+    assert s.round == 4
+    flat = np.asarray(ravel_pytree(jax.device_get(s.state["params"]))[0])
+    assert np.isfinite(flat).all()
+    leaked = {t.name for t in threading.enumerate()} - before
+    assert not {n for n in leaked if n.startswith("serve-")}, leaked
+
+
+# --------------------------------------------------------------- ops surface
+
+
+def test_metrics_endpoint_serves_service_snapshot():
+    a = _tiny_session()
+    svc = AggregationService(
+        a, ServeConfig(quorum=2, deadline_s=1.0, metrics_port=0),
+        traffic=TrafficGenerator(
+            TraceConfig(population=a.train_set.num_clients, seed=5)),
+    ).start()
+    try:
+        src = svc.source()
+        a.commit_round(a.dispatch_round(src.next(), LR))
+        host, port = svc.metrics_server.address
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=5) as resp:
+            m = json.loads(resp.read())
+        for field in ("round", "queue_depth", "arrival_rate_per_s",
+                      "submissions", "rounds", "requeue_depth",
+                      "clients_dropped", "clients_quarantined", "quorum"):
+            assert field in m, field
+        assert m["round"] == 1
+        assert m["rounds"]["rounds_closed"] == 1
+        assert m["submissions"]["accepted"] >= 2
+        # non-metrics paths 404
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{host}:{port}/other", timeout=5)
+    finally:
+        svc.close()
+
+
+def test_service_refuses_bad_configs():
+    a = _tiny_session()
+    with pytest.raises(ValueError, match="quorum"):
+        AggregationService(a, ServeConfig(quorum=99),
+                           traffic=TrafficGenerator(TraceConfig()))
+    with pytest.raises(ValueError, match="traffic"):
+        AggregationService(a, ServeConfig(quorum=2))
+    with pytest.raises(ValueError, match="transport"):
+        AggregationService(a, ServeConfig(quorum=2, transport="carrier-pigeon"),
+                           traffic=TrafficGenerator(TraceConfig()))
