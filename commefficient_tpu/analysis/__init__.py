@@ -38,6 +38,11 @@ G007  blocking-call-on-dispatch-thread           no time.sleep / sync file IO /
 G008  unvalidated-config-read                    engine/runner code reads only
                                                  args.<flag> names registered
                                                  through utils/config.py
+G009  obs-call-in-compiled-scope                 tracing/metrics are host-only:
+                                                 no obs API call (span/instant,
+                                                 counter.inc, registry access)
+                                                 inside jit/shard_map bodies in
+                                                 the parity modules
 ====  =========================================  ================================
 
 Run it:
@@ -69,6 +74,7 @@ from .core import Analyzer, Rule, SourceFile, Violation
 from .rules_config import UnvalidatedConfigRead
 from .rules_dataflow import DonationAfterUse, RngKeyReuse
 from .rules_io import RawCheckpointWrite
+from .rules_obs import ObsCallInCompiledScope
 from .rules_parity import ReservedLeafAccess, UnorderedReduction
 from .rules_sync import BlockingCallOnDispatchThread, HostSyncInRoundPath
 
@@ -81,6 +87,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     RngKeyReuse,
     BlockingCallOnDispatchThread,
     UnvalidatedConfigRead,
+    ObsCallInCompiledScope,
 )
 
 RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)

@@ -1,0 +1,85 @@
+"""G009 obs-call-in-compiled-scope.
+
+Tracing is host-only BY CONTRACT (obs/'s load-bearing promise): a span,
+instant, counter.inc, or registry access inside compiled scope — the
+jit/shard_map bodies that live in the parity modules (modes/, sketch/,
+federated/engine.py) — is wrong in every outcome. Under tracing it runs
+once at trace time (so per-round "telemetry" silently freezes at the first
+round's values), and anything that tries to read a traced value to record
+it forces a concretization, i.e. the exact hidden host sync G001 exists to
+ban. The obs layer instruments the HOST halves (runner, federated/api,
+serve, resilience) instead; this rule keeps it that way mechanically.
+
+Detection (same whole-module compiled-scope treatment G001 uses):
+
+- any call resolving through the import table into the obs package
+  (`span(...)` via `from ..obs.trace import span`, `obtrace.instant(...)`,
+  `obs.registry.default()`, ...);
+- method calls `.inc(...)` / `.observe(...)` — the counter/histogram
+  mutation surface (no jax/numpy API shares these names, so the receiver
+  does not need resolving);
+- any method call on a receiver named `REGISTRY`/`registry`.
+
+`.set(...)` is deliberately NOT matched bare: `arr.at[idx].set(v)` is the
+jax scatter idiom all over compiled scope — gauge writes are caught by the
+import-resolution path instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+# whole modules where any function may be (part of) a jit/shard_map body —
+# the same compiled scope G001's float()/bool() check uses
+_COMPILED_SCOPE = (
+    f"{PACKAGE}/modes/",
+    f"{PACKAGE}/sketch/",
+    f"{PACKAGE}/federated/engine.py",
+)
+
+# counter/histogram mutators: distinctive enough to flag on name alone
+_MUTATOR_ATTRS = ("inc", "observe")
+
+_REGISTRY_NAMES = ("REGISTRY", "registry")
+
+
+class ObsCallInCompiledScope(Rule):
+    code = "G009"
+    name = "obs-call-in-compiled-scope"
+    fixit = ("hoist the obs call to the host-side caller (runner/, "
+             "federated/api.py, serve/, resilience/): tracing is host-only "
+             "by contract — a compiled body runs once at trace time, so "
+             "the telemetry would freeze or force a host sync")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(_COMPILED_SCOPE)
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = self._classify(src, node)
+            if msg:
+                out.append(self.violation(src, node, msg))
+        return out
+
+    def _classify(self, src: SourceFile, node: ast.Call) -> str | None:
+        dotted = src.resolve_dotted(node.func)
+        if dotted is not None:
+            parts = dotted.split(".")
+            if "obs" in parts or dotted.startswith(f"{PACKAGE}.obs"):
+                return (f"{dotted}() is an obs API call inside compiled "
+                        "scope — tracing/metrics are host-only")
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATOR_ATTRS:
+                return (f".{node.func.attr}() mutates a registry metric "
+                        "inside compiled scope — counters/histograms are "
+                        "host-only")
+            if (isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in _REGISTRY_NAMES):
+                return (f"{node.func.value.id}.{node.func.attr}() accesses "
+                        "the metrics registry inside compiled scope")
+        return None

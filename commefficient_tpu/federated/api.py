@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from ..data.fed_dataset import FedDataset, prefetch_iter
 from ..modes import modes
 from ..modes.config import ModeConfig
+from ..obs import trace as obtrace
 from ..parallel import mesh as meshlib
 from ..resilience import retry as rtry
 from ..utils.comm import round_comm_mb
@@ -512,7 +513,15 @@ class FederatedSession:
                         arrived=None) -> PreparedRound:
         """Shared tail of round preparation: batch assembly (retry-wrapped,
         fault sites at `rnd`), no-show masking for served rounds, validity
-        threading, the device PRNG split, and the post-draw snapshot."""
+        threading, the device PRNG split, and the post-draw snapshot.
+        Traced on the `federated` track (this runs on the prefetch thread
+        in async mode — the trace shows it overlapping device compute)."""
+        with obtrace.span("federated", "prepare_round", round=rnd,
+                          cohort=len(ids)):
+            return self._assemble_round_traced(rnd, ids, arrived)
+
+    def _assemble_round_traced(self, rnd: int, ids,
+                               arrived=None) -> PreparedRound:
         batch, valid = self._load_client_batch(ids, rnd)
         if self.fault_plan is not None:
             # nonfinite burst rides the real gradient path (poison the
@@ -555,6 +564,9 @@ class FederatedSession:
                     self._requeue.append(cid)
                     self._requeue_enqueued.setdefault(cid, rnd)
         masked = int(len(ids) - valid.sum()) if valid is not None else 0
+        if masked:
+            obtrace.instant("federated", "cohort_degraded", round=rnd,
+                            clients=masked)
         # the validity mask ALWAYS rides the batch (all-ones in the clean
         # case) so the compiled program never changes shape when the first
         # fault hits mid-run — a mid-run recompile on a TPU would stall the
@@ -602,6 +614,9 @@ class FederatedSession:
             slot += 1
         self._requeue = collections.deque(leftover)
         if served:
+            obtrace.instant("federated", "requeue_serve", round=rnd,
+                            clients=[int(c) for c in served],
+                            still_queued=len(self._requeue))
             # stderr, like the other cohort-degradation diagnostics: the
             # stdout metrics table must stay machine-parsable
             print(f"requeue: serving previously-dropped client(s) {served} "

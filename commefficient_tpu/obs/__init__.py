@@ -1,0 +1,69 @@
+"""obs — unified observability: round tracing, metrics registry, profiler.
+
+Three pieces, one contract (host-only, sync-free, bit-transparent):
+
+- ``obs.trace``    — span/event tracer on named per-subsystem tracks
+  (runner, device, writer, serve-ingest, assembler, federated,
+  resilience), exported as Chrome-trace/Perfetto JSON (``--trace PATH``)
+  and/or a line-buffered JSONL event stream (``--trace_events PATH``).
+  Device-phase durations are DEFERRED: recorded as host timestamps at
+  dispatch, resolved into spans at the runner's existing drain boundary —
+  tracing never adds a host sync to the round path, and a traced run is
+  pinned bit-identical to an untraced one.
+- ``obs.registry`` — process-wide counter/gauge/histogram/meter registry;
+  the single source of truth RunStats, serve's /metrics snapshot, and
+  bench's resilience/serve/obs blocks read from.
+- ``obs.profiler`` — a ``jax.profiler`` capture window around whole rounds
+  (``--profile_rounds START:END``), degrading to a loud no-op where the
+  profiler is unavailable.
+
+The contract is machine-enforced: graftlint G009 bans obs API calls inside
+compiled scope (jit/shard_map bodies in the parity modules) — a span or a
+counter.inc inside a traced function would either silently no-op per trace
+or force a concretization; either way it lies.
+"""
+
+from __future__ import annotations
+
+from . import export, profiler, registry, trace
+from .profiler import ProfileWindow
+from .registry import Registry
+from .trace import Tracer
+
+
+def configure_from_args(args) -> bool:
+    """Arm (or disarm) the global tracer from the CLI flag surface; returns
+    whether tracing is on. Called once per main() so back-to-back runs in
+    one process (tests) each get a fresh event buffer."""
+    trace_path = getattr(args, "trace", "") or None
+    events_path = getattr(args, "trace_events", "") or None
+    trace.configure(trace_path, events_path)
+    return trace.get().enabled
+
+
+def flush_trace() -> str | None:
+    """Write the Chrome trace (if armed); note where it landed — on
+    stderr, like every other diagnostic (the stdout metrics table must
+    stay machine-parsable)."""
+    import sys
+
+    tracer = trace.get()
+    n = tracer.event_count()
+    path = trace.flush()
+    if path:
+        print(f"obs: trace written to {path} ({n} events)",
+              file=sys.stderr, flush=True)
+    return path
+
+
+__all__ = [
+    "ProfileWindow",
+    "Registry",
+    "Tracer",
+    "configure_from_args",
+    "export",
+    "flush_trace",
+    "profiler",
+    "registry",
+    "trace",
+]

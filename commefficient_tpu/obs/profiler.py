@@ -1,0 +1,141 @@
+"""`jax.profiler` capture window: `--profile_rounds START:END`.
+
+Whole-run profiler traces (`--profile_dir` alone) are unusable at scale —
+hours of trace for a question about one steady-state round. The window
+wraps WHOLE rounds instead: `start_trace` fires just before round START
+dispatches, `stop_trace` after the drain that COMMITS round END, so the
+capture covers complete dispatch->compute->commit cycles of the async
+pipeline (starting or stopping mid-round would split in-flight work across
+the capture edge and make the profile lie).
+
+Where the profiler is unavailable (no jax, a backend without profiling
+support, a second concurrent capture), the window degrades to a LOUD
+no-op: one stderr line, the run continues untouched — observability must
+never take down the run it observes. jax imports stay inside the start/
+stop methods so this module (and the rest of obs/) is importable in a
+bare, jax-free environment.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def parse_rounds_spec(spec: str) -> tuple[int, int] | None:
+    """'START:END' (inclusive, 0-based global round indices) -> (start,
+    end); None for empty. Malformed specs raise ValueError at launch — a
+    typo must not surface hours later as a silently-missing capture."""
+    if not spec or not spec.strip():
+        return None
+    head, sep, tail = spec.partition(":")
+    try:
+        if not sep:
+            raise ValueError("missing ':'")
+        start, end = int(head), int(tail)
+    except ValueError:
+        raise ValueError(
+            f"--profile_rounds expects START:END (two integers), got "
+            f"{spec!r}") from None
+    if start < 0 or end < start:
+        raise ValueError(
+            f"--profile_rounds {spec!r}: need 0 <= START <= END")
+    return start, end
+
+
+class ProfileWindow:
+    """Programmatic start_trace/stop_trace around rounds [start, end].
+
+    The runner calls `on_dispatch(rnd)` before each round's dispatch and
+    `on_committed(committed_round)` after each drain; `close()` on the
+    loop's exit path force-stops a window the run ended inside."""
+
+    def __init__(self, start: int, end: int, log_dir: str):
+        if not log_dir:
+            raise ValueError(
+                "--profile_rounds needs --profile_dir (the capture has to "
+                "be written somewhere)")
+        self.start = start
+        self.end = end
+        self.log_dir = log_dir
+        self._active = False
+        self._done = False
+
+    @classmethod
+    def parse(cls, spec: str, log_dir: str) -> "ProfileWindow | None":
+        rounds = parse_rounds_spec(spec)
+        if rounds is None:
+            return None
+        return cls(rounds[0], rounds[1], log_dir)
+
+    def _note(self, msg: str) -> None:
+        print(f"obs: profile window — {msg}", file=sys.stderr, flush=True)
+
+    def on_dispatch(self, rnd: int, rounds: int = 1) -> None:
+        """`rnd` is the first round about to dispatch, `rounds` the size of
+        the dispatch block — the capture starts as soon as a block OVERLAPS
+        the window (a fused block cannot be split, so the capture is a
+        round-aligned superset). A window entirely behind the run (resume
+        past it) is declared dead LOUDLY instead of silently arming at the
+        wrong rounds."""
+        if self._active or self._done:
+            return
+        if rnd > self.end:
+            self._note(
+                f"rounds {self.start}:{self.end} are behind the run "
+                f"(dispatching round {rnd}, e.g. a resume past the "
+                "window); no capture will be taken")
+            self._done = True
+            return
+        if rnd + rounds <= self.start:
+            return  # block ends before the window opens
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.log_dir)
+        except Exception as e:  # noqa: BLE001 — LOUD no-op by contract
+            self._note(
+                f"jax profiler unavailable ({type(e).__name__}: {e}); "
+                f"--profile_rounds {self.start}:{self.end} degrades to a "
+                "no-op and the run continues unprofiled")
+            self._done = True
+            return
+        self._active = True
+        self._note(f"start_trace at round {rnd} -> {self.log_dir}")
+
+    def on_committed(self, committed_round: int) -> None:
+        """Stop once every round of the window has COMMITTED (the drain
+        published round `end`, i.e. the session counter moved past it)."""
+        if self._active and committed_round > self.end:
+            self._stop(f"stop_trace after round {self.end} committed")
+
+    def declare_unreachable(self, total_rounds: int) -> None:
+        """Loud launch-time rejection: the runner calls this when the
+        window starts at or past the run's last round (the capture could
+        never begin — the silently-missing-capture failure mode)."""
+        self._note(
+            f"--profile_rounds {self.start}:{self.end} can never fire — "
+            f"the run ends at round {total_rounds} (rounds are 0-based "
+            "global indices); no capture will be taken")
+        self._done = True
+
+    def close(self) -> None:
+        if self._active:
+            self._stop("run ended inside the window; stop_trace at exit")
+        elif not self._done:
+            # backstop for segment runs the launch check cannot see: the
+            # loop ended before the window ever opened
+            self._note(
+                f"run ended before rounds {self.start}:{self.end} "
+                "dispatched; no capture was taken")
+            self._done = True
+
+    def _stop(self, why: str) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._note(why)
+        except Exception as e:  # noqa: BLE001 — LOUD no-op by contract
+            self._note(f"stop_trace failed ({type(e).__name__}: {e})")
+        self._active = False
+        self._done = True

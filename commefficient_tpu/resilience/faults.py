@@ -68,6 +68,9 @@ import time
 
 import numpy as np
 
+from ..obs import registry as obreg
+from ..obs import trace as obtrace
+
 # allowed param keys per kind: a typo'd key ("time=5" for "times=5") must
 # fail parse, not silently fall back to the default and under-inject — the
 # vacuous-chaos-test failure mode this module exists to prevent
@@ -265,6 +268,15 @@ class FaultPlan:
     def _log(self, msg: str):
         print(f"fault-injection: {msg}", file=sys.stderr, flush=True)
 
+    @staticmethod
+    def _mark(kind: str, rnd, **args):
+        """Every injection lands as a trace instant on the resilience
+        track (with its round number — the chaos trace smoke asserts this)
+        and bumps the registry's injected-faults counter."""
+        obreg.default().counter("resilience_faults_injected_total").inc()
+        obtrace.instant("resilience", f"fault:{kind}",
+                        round=rnd if rnd is None else int(rnd), **args)
+
     # ---------------------------------------------------------- named sites
 
     def fire_transient(self, kind: str, rnd: int | None = None):
@@ -279,6 +291,7 @@ class FaultPlan:
         if n < times:
             self._attempts[key] = n + 1
             self._log(f"{kind} transient failure {n + 1}/{times} (round {rnd})")
+            self._mark(kind, rnd, attempt=n + 1, times=times)
             raise InjectedTransientError(
                 f"injected {kind} failure {n + 1}/{times} (round {rnd})"
             )
@@ -293,6 +306,7 @@ class FaultPlan:
             self._fired.add(("stall", rnd))
             secs = float(s.params.get("secs", 1.0))
             self._log(f"stalling data load {secs}s (round {rnd})")
+            self._mark("stall", rnd, secs=secs)
             time.sleep(secs)
         self.fire_transient("data_fail", rnd)
 
@@ -306,6 +320,7 @@ class FaultPlan:
             self._fired.add(("eval_stall", rnd))
             secs = float(s.params.get("secs", 1.0))
             self._log(f"stalling eval load {secs}s (round {rnd})")
+            self._mark("eval_stall", rnd, secs=secs)
             time.sleep(secs)
 
     def poison(self, rnd: int, batch: dict):
@@ -334,6 +349,7 @@ class FaultPlan:
         if poisoned:
             self._log(f"poisoning round {rnd} client batch with {val} "
                       f"({poisoned} float leaves)")
+            self._mark("nonfinite", rnd, leaves=poisoned)
         else:
             # e.g. token-id batches (gpt2/personachat) are all-int: nothing
             # to poison, and claiming otherwise would make a chaos test
@@ -366,6 +382,7 @@ class FaultPlan:
                     continue  # another simulated host's turn; stay armed
             self._fired.add((kind, rnd))
             self._log(f"injecting SIGTERM mid-round ({kind}, round {rnd})")
+            self._mark(kind, rnd)
             os.kill(os.getpid(), signal.SIGTERM)
 
     # ------------------------------------------------- cohort-level sites
@@ -399,6 +416,7 @@ class FaultPlan:
             pos = self._positions(s, num_workers, rnd)
             secs = float(s.params.get("secs", 1.0))
             self._log(f"clients {list(pos)} straggling {secs}s (round {rnd})")
+            self._mark("client_straggle", rnd, clients=list(pos), secs=secs)
             time.sleep(secs)
 
         poison_specs = self.specs_for("client_poison", rnd)
@@ -422,6 +440,7 @@ class FaultPlan:
                     continue
                 v[pos] = fill
             self._log(f"poisoning clients {pos} with {val} (round {rnd})")
+            self._mark("client_poison", rnd, clients=pos, value=val)
 
         dropped: list[int] = []
         for s in drop_specs:
@@ -441,6 +460,7 @@ class FaultPlan:
             dropped.extend(pos)
             self._log(f"dropping clients {pos} (round {rnd}; masked + "
                       "re-queued)")
+            self._mark("client_drop", rnd, clients=pos)
         return batch, valid, dropped
 
     def corrupt_checkpoint(self, rnd: int, path: str):
@@ -467,6 +487,7 @@ class FaultPlan:
                 with open(target, "r+b") as f:
                     f.truncate(max(os.path.getsize(target) // 2, 1))
                 self._log(f"truncated checkpoint file: {target} (round {rnd})")
+            self._mark(kind, rnd)
 
     @staticmethod
     def _largest_data_file(path: str) -> str | None:

@@ -65,6 +65,14 @@ class PreemptionHandler:
                 file=sys.stderr,
                 flush=True,
             )
+            # signal-safe variant: skips the JSONL sink (the handler may
+            # have interrupted a write on that very handle) and appends
+            # only to the in-memory buffer under the tracer's RLock
+            from ..obs import trace as obtrace
+
+            obtrace.get().instant_signal_safe(
+                "resilience", "sigterm",
+                signal=signal.Signals(signum).name)
         self.triggered = True
 
     def __enter__(self) -> "PreemptionHandler":

@@ -29,6 +29,8 @@ from typing import Callable
 
 import numpy as np
 
+from ..obs import registry as obreg
+
 # process-wide per-site count of FAILED attempts (each one either backed off
 # and retried, or exhausted the budget) — the benchmarkable footprint of a
 # chaos run: bench.py surfaces this dict in its JSON so "the run recovered
@@ -40,6 +42,7 @@ _RETRY_COUNTS: dict[str, int] = {}
 def _count_failure(site: str) -> None:
     with _COUNTS_LOCK:
         _RETRY_COUNTS[site] = _RETRY_COUNTS.get(site, 0) + 1
+    obreg.default().counter("resilience_retries_total").inc()
 
 
 def retry_counts() -> dict[str, int]:
@@ -99,6 +102,15 @@ def with_retries(
             return fn()
         except policy.retry_on as e:  # noqa: PERF203 — retry loop
             _count_failure(site)
+            # trace instant per failed attempt; `round` is the caller's
+            # jitter seed, which the wired sites key by global round (the
+            # chaos trace smoke asserts retry instants land on the right
+            # round; non-round sites pass 0)
+            from ..obs import trace as obtrace
+
+            obtrace.instant("resilience", f"retry:{site}",
+                            attempt=attempt + 1, round=seed,
+                            error=type(e).__name__)
             if attempt >= policy.max_retries:
                 log(
                     f"retry[{site}]: attempt {attempt + 1}/"

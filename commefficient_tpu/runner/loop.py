@@ -50,6 +50,9 @@ import time
 import jax
 
 from ..federated.api import FederatedSession, FedOptimizer, plan_block
+from ..obs import registry as obreg
+from ..obs import trace as obtrace
+from ..obs.profiler import ProfileWindow
 from ..resilience import EXIT_RESUMABLE, PreemptionHandler, preemption
 from ..utils import checkpoint as ckpt
 from ..utils.logging import Timer
@@ -132,6 +135,11 @@ class RunnerConfig:
     on_nonfinite: str = "skip"  # the CLI-level halt policy ("halt" stops)
     watchdog_abort: bool = False
     no_emergency_checkpoint: bool = False
+    # observability: a jax.profiler capture window around whole rounds
+    # ("START:END"; empty = off) written into profile_dir — see
+    # obs/profiler.py for the start/stop-at-round-boundary semantics
+    profile_rounds: str = ""
+    profile_dir: str = ""
 
     @classmethod
     def from_args(cls, args, total_rounds: int, eval_every: int):
@@ -147,12 +155,23 @@ class RunnerConfig:
             on_nonfinite=args.on_nonfinite,
             watchdog_abort=args.watchdog_abort,
             no_emergency_checkpoint=args.no_emergency_checkpoint,
+            profile_rounds=getattr(args, "profile_rounds", ""),
+            profile_dir=getattr(args, "profile_dir", ""),
         )
 
 
 @dataclasses.dataclass
 class RunStats:
-    """What the loop did — bench.py's run_loop section reads these."""
+    """What the loop did — bench.py's run_loop section reads these.
+
+    Since the obs/ layer landed these are a per-run VIEW over the
+    process-wide metrics registry: run_loop increments named registry
+    counters (runner_rounds_total, cohort_clients_dropped_total, ...) at
+    the same points it always counted, takes a RegistryMark at loop start,
+    and fills this dataclass from the deltas at loop end — so RunStats,
+    serve's /metrics snapshot, and bench's resilience block all read the
+    SAME numbers. (Concurrent run_loops in one process would cross-count;
+    the loops in this repo — bench arms, the CLIs — run sequentially.)"""
 
     rounds: int = 0
     wall_s: float = 0.0
@@ -240,6 +259,22 @@ def run_loop(
     t0 = time.perf_counter()
     eval_every = max(cfg.eval_every, 1)
     start_round = session.round
+    # observability: every operational count goes through the process-wide
+    # registry (obs/registry.py) and RunStats is carved out of it via this
+    # mark's deltas at loop end; the tracer (obs/trace.py) is a no-op
+    # unless the CLI armed it (--trace / --trace_events)
+    reg = obreg.default()
+    mark = reg.mark()
+    tracer = obtrace.get()
+    phase_hist = {ph: reg.histogram(f"runner_phase_{ph}_ms")
+                  for ph in obreg.RUNNER_PHASES}
+    profile = ProfileWindow.parse(cfg.profile_rounds, cfg.profile_dir)
+    if profile is not None and profile.start >= cfg.total_rounds:
+        # same contract as FaultPlan.validate_rounds: a window the run can
+        # never reach must be loud at launch, not a silently-missing
+        # capture discovered hours later
+        profile.declare_unreachable(cfg.total_rounds)
+        profile = None
     # (client_* fault schedules are validated against the FULL run length by
     # the CLIs — run_loop may legitimately cover a segment, e.g. bench arms)
     # multi-host coordinated preemption: with > 1 process the LOCAL SIGTERM
@@ -303,6 +338,10 @@ def run_loop(
 
     pending: collections.deque = collections.deque()  # in-flight dispatches
     pending_rounds = 0
+    # per-dispatch (trace timestamp, first round, round count): the
+    # deferred device-phase spans — resolved at the drain that commits
+    # them, never by a mid-round sync (the deferred-metrics discipline)
+    dispatch_marks: collections.deque = collections.deque()
     totals: collections.defaultdict = collections.defaultdict(float)
     last_m: dict | None = None
     nonfinite_total = 0
@@ -331,26 +370,60 @@ def run_loop(
         # the drain legitimately waits out every queued dispatch, so the
         # watchdog threshold scales by the round count and the recorded
         # time is normalized back to a per-round figure (true median)
+        t_drain0 = time.perf_counter()
         with (watchdog.round(first, rounds=pending_rounds)
               if watch else contextlib.nullcontext()):
-            hosts = jax.device_get([fl.metrics for fl in pending])
-        for m in session.commit_rounds(list(pending), hosts):
-            last_m = m
-            nonfinite_total += int(m.get("nonfinite_rounds", 0))
-            dropped = int(m.get("clients_dropped", 0))
-            quarantined = int(m.get("clients_quarantined", 0))
-            stats.clients_dropped += dropped
-            stats.clients_quarantined += quarantined
-            if dropped or quarantined:
-                stats.degraded_rounds += 1
-            stats.requeue_depth_max = max(
-                stats.requeue_depth_max, int(m.get("requeue_depth", 0)))
-            for k, v in m.items():
-                if isinstance(v, (int, float)):
-                    totals[k] += v
+            with tracer.span("runner", "drain", round_first=first,
+                             rounds=committed):
+                hosts = jax.device_get([fl.metrics for fl in pending])
+        phase_hist["drain"].observe((time.perf_counter() - t_drain0) * 1e3)
+        # deferred device-phase spans: each dispatch recorded only a host
+        # timestamp; the span closes HERE, where its rounds are known done
+        end_us = tracer.now_us()
+        while dispatch_marks:
+            ts_us, d_first, d_n = dispatch_marks.popleft()
+            tracer.complete(
+                "device", f"rounds {d_first}..{d_first + d_n - 1}",
+                ts_us, end_us - ts_us, round_first=d_first, rounds=d_n)
+        t_commit0 = time.perf_counter()
+        with tracer.span("runner", "commit", round_first=first,
+                         rounds=committed):
+            for i, m in enumerate(session.commit_rounds(list(pending),
+                                                        hosts)):
+                rnd_i = first + i
+                last_m = m
+                nf = int(m.get("nonfinite_rounds", 0))
+                nonfinite_total += nf
+                dropped = int(m.get("clients_dropped", 0))
+                quarantined = int(m.get("clients_quarantined", 0))
+                depth = int(m.get("requeue_depth", 0))
+                reg.counter("runner_nonfinite_rounds_total").inc(nf)
+                reg.counter("cohort_clients_dropped_total").inc(dropped)
+                reg.counter("cohort_clients_quarantined_total").inc(
+                    quarantined)
+                if dropped or quarantined:
+                    reg.counter("cohort_degraded_rounds_total").inc()
+                reg.gauge("cohort_requeue_depth").set(depth)
+                stats.requeue_depth_max = max(stats.requeue_depth_max, depth)
+                tracer.instant("runner", "commit_round", round=rnd_i)
+                if quarantined:
+                    tracer.instant("resilience", "quarantine", round=rnd_i,
+                                   clients=quarantined)
+                for k, v in m.items():
+                    if isinstance(v, (int, float)):
+                        totals[k] += v
+        phase_hist["commit"].observe((time.perf_counter() - t_commit0) * 1e3)
         pending.clear()
         pending_rounds = 0
-        stats.drains += 1
+        reg.counter("runner_rounds_total").inc(committed)
+        reg.counter("runner_drains_total").inc()
+        if profile is not None:
+            profile.on_committed(session.round)
+        on_committed = getattr(src, "on_committed", None)
+        if on_committed is not None:
+            # serving layer hook: submission-to-merge latencies resolve at
+            # the commit that published their round's merged update
+            on_committed(session.round)
         now = time.perf_counter()
         per_round = (now - last_drain_t) * 1e3 / max(committed, 1)
         last_drain_t = now
@@ -386,6 +459,11 @@ def run_loop(
                 lrs = plan_block(opt, rnd, cfg.total_rounds, eval_every,
                                  cfg.checkpoint_every, cfg.rounds_per_dispatch)
                 if len(lrs) > 1 and session.supports_block_dispatch:
+                    # a fused block cannot split, so the capture window
+                    # arms on OVERLAP (round-aligned superset); the
+                    # per-round fallback below keeps per-round precision
+                    if profile is not None:
+                        profile.on_dispatch(rnd, rounds=len(lrs))
                     # one dispatch for the block; the watchdog times the
                     # block (prefetch pull included — a stalled loader is a
                     # stall the ladder should see). In async mode a dispatch
@@ -393,8 +471,23 @@ def run_loop(
                     # feed the learned round-time median (record=False) —
                     # the boundary drain records the true per-round time.
                     with watchdog.round(rnd, record=cfg.sync_loop):
-                        preps = [src.next() for _ in lrs]
-                        pending.append(session.dispatch_block(preps, lrs))
+                        t_p0 = time.perf_counter()
+                        with tracer.span("runner", "prepare", round=rnd,
+                                         rounds=len(lrs)):
+                            preps = [src.next() for _ in lrs]
+                        phase_hist["prepare"].observe(
+                            (time.perf_counter() - t_p0) * 1e3)
+                        t_d0 = time.perf_counter()
+                        t_mark = tracer.now_us()
+                        with tracer.span("runner", "dispatch", round=rnd,
+                                         rounds=len(lrs)):
+                            pending.append(session.dispatch_block(preps, lrs))
+                        # marked only AFTER the dispatch succeeded: a
+                        # raising dispatch must not leave a stale mark the
+                        # next drain would resolve into a phantom span
+                        dispatch_marks.append((t_mark, rnd, len(lrs)))
+                        phase_hist["dispatch"].observe(
+                            (time.perf_counter() - t_d0) * 1e3)
                         if len(pending) > 1:
                             pending[-2].release_state()  # superseded head
                         pending_rounds += len(lrs)
@@ -406,10 +499,25 @@ def run_loop(
                     # fallback): keep the watchdog per-round so a hang is
                     # detected at round, not block, granularity
                     for j, lr in enumerate(lrs):
+                        if profile is not None:
+                            profile.on_dispatch(rnd + j)
                         with watchdog.round(rnd + j, record=cfg.sync_loop):
-                            pending.append(
-                                session.dispatch_round(src.next(), lr)
-                            )
+                            t_p0 = time.perf_counter()
+                            with tracer.span("runner", "prepare",
+                                             round=rnd + j):
+                                prep = src.next()
+                            phase_hist["prepare"].observe(
+                                (time.perf_counter() - t_p0) * 1e3)
+                            t_d0 = time.perf_counter()
+                            t_mark = tracer.now_us()
+                            with tracer.span("runner", "dispatch",
+                                             round=rnd + j):
+                                pending.append(
+                                    session.dispatch_round(prep, lr)
+                                )
+                            dispatch_marks.append((t_mark, rnd + j, 1))
+                            phase_hist["dispatch"].observe(
+                                (time.perf_counter() - t_d0) * 1e3)
                             if len(pending) > 1:
                                 pending[-2].release_state()  # superseded
                             pending_rounds += 1
@@ -437,6 +545,8 @@ def run_loop(
                                  and rnd % cfg.checkpoint_every == 0))):
                     drain()
                 if preempt_now:
+                    tracer.instant("resilience", "preempt_boundary",
+                                   round=session.round)
                     shutdown()
                     if save_ckpt:
                         # make_save_ckpt already gates writes to process 0
@@ -462,13 +572,16 @@ def run_loop(
                         and rnd % cfg.checkpoint_every == 0):
                     if writer is not None:
                         writer.request()  # off the round path
-                        stats.async_checkpoints += 1
+                        reg.counter("runner_ckpt_async_total").inc()
                     else:
-                        save_ckpt()
-                        stats.sync_checkpoints += 1
+                        with tracer.span("runner", "checkpoint_sync",
+                                         round=session.round):
+                            save_ckpt()
+                        reg.counter("runner_ckpt_sync_total").inc()
                 if rnd % eval_every == 0 or rnd >= cfg.total_rounds:
-                    ev = eval_fn() if eval_fn is not None else {}
-                    stats.evals += 1
+                    with tracer.span("runner", "eval", round=session.round):
+                        ev = eval_fn() if eval_fn is not None else {}
+                    reg.counter("runner_evals_total").inc()
                     if build_row is not None and logger is not None:
                         logger.append(build_row(
                             rnd=rnd, m=last_m, totals=dict(totals), ev=ev,
@@ -476,6 +589,8 @@ def run_loop(
                         ))
                     totals.clear()
     finally:
+        if profile is not None:
+            profile.close()
         src.stop()
         # the prefetcher may have prepared (drawn host RNG / split the
         # device key for) rounds that were never dispatched; rewind the
@@ -500,9 +615,21 @@ def run_loop(
     shutdown()
     if save_ckpt:
         save_ckpt()  # final checkpoint, synchronous (durable before return)
-        stats.sync_checkpoints += 1
+        reg.counter("runner_ckpt_sync_total").inc()
+    # RunStats = this run's registry deltas (see the dataclass docstring):
+    # the registry is the single source of truth, RunStats its per-run view
     stats.rounds = session.round - start_round
-    stats.nonfinite_rounds = nonfinite_total
+    stats.nonfinite_rounds = int(mark.delta("runner_nonfinite_rounds_total"))
+    stats.drains = int(mark.delta("runner_drains_total"))
+    stats.evals = int(mark.delta("runner_evals_total"))
+    stats.sync_checkpoints = int(mark.delta("runner_ckpt_sync_total"))
+    stats.async_checkpoints = int(mark.delta("runner_ckpt_async_total"))
+    stats.clients_dropped = int(mark.delta("cohort_clients_dropped_total"))
+    stats.clients_quarantined = int(
+        mark.delta("cohort_clients_quarantined_total"))
+    stats.degraded_rounds = int(mark.delta("cohort_degraded_rounds_total"))
     stats.max_inflight_used = eff_inflight if async_mode else 0
+    reg.gauge("runner_rtt_ms").set(rtt_ms)
+    reg.gauge("runner_max_inflight").set(stats.max_inflight_used)
     stats.wall_s = time.perf_counter() - t0
     return stats

@@ -38,6 +38,8 @@ from __future__ import annotations
 import sys
 import threading
 
+from ..obs import trace as obtrace
+
 
 class AsyncCheckpointWriter:
     def __init__(self, save_fn, alert=None):
@@ -81,7 +83,11 @@ class AsyncCheckpointWriter:
                 self._pending = False
                 self._busy = True
             try:
-                path = self._save_fn()
+                # the save span rides the writer's OWN track: overlap with
+                # the runner track's dispatch spans is exactly what the
+                # trace exists to show
+                with obtrace.span("writer", "checkpoint_save"):
+                    path = self._save_fn()
                 with self._cv:
                     self.saves_completed += 1
                     self.last_path = path

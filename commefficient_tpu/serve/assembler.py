@@ -26,6 +26,7 @@ import dataclasses
 
 import numpy as np
 
+from ..obs import trace as obtrace
 from .ingest import IngestQueue
 
 
@@ -42,6 +43,10 @@ class ClosedRound:
     close_latency_s: float      # virtual close time (W-th arrival latency)
     stragglers: int             # submitted, but after the close
     no_shows: int               # never submitted
+    # [N] float64 host ACCEPT timestamps (perf_counter; inf = never
+    # accepted) aligned with `invited` — the obs layer turns these into
+    # submission-to-merge spans when the round's merge commits
+    wall_ts: np.ndarray | None = None
 
     @property
     def survivors(self) -> int:
@@ -70,9 +75,11 @@ class CohortAssembler:
         invited = np.asarray(invited, np.int64)
         pos = {int(c): i for i, c in enumerate(invited)}
         lat = np.full(len(invited), np.inf)
+        walls = np.full(len(invited), np.inf)
         for a in arrivals:
             if int(a.client_id) in pos:  # uninvited never got accepted, but
                 lat[pos[int(a.client_id)]] = a.latency_s  # stay defensive
+                walls[pos[int(a.client_id)]] = a.wall_t
         order = np.lexsort((invited, lat))  # latency, then cid tie-break
         in_time = lat[order] <= self.deadline_s
         n_in_time = int(in_time.sum())
@@ -83,7 +90,8 @@ class CohortAssembler:
             close = self.deadline_s
             closed_by = "deadline"
         arrived = (lat <= close).astype(np.float32)
-        return self._finish(rnd, invited, arrived, lat, closed_by, close)
+        return self._finish(rnd, invited, arrived, lat, closed_by, close,
+                            walls)
 
     def close_wall(self, rnd: int, invited) -> ClosedRound:
         """Close on real arrival order: wait for quorum-or-deadline on the
@@ -94,21 +102,24 @@ class CohortAssembler:
         invited = np.asarray(invited, np.int64)
         pos = {int(c): i for i, c in enumerate(invited)}
         lat = np.full(len(invited), np.inf)
+        walls = np.full(len(invited), np.inf)
         arrived = np.zeros(len(invited), np.float32)
         made_cut = sorted(arrivals, key=lambda a: a.recv_order)[:self.quorum]
         for a in arrivals:
             if int(a.client_id) in pos:
                 lat[pos[int(a.client_id)]] = a.latency_s
+                walls[pos[int(a.client_id)]] = a.wall_t
         for a in made_cut:
             if int(a.client_id) in pos:
                 arrived[pos[int(a.client_id)]] = 1.0
         closed_by = "quorum" if len(arrivals) >= self.quorum else "deadline"
         close = (max((a.latency_s for a in made_cut), default=self.deadline_s)
                  if closed_by == "quorum" else self.deadline_s)
-        return self._finish(rnd, invited, arrived, lat, closed_by, close)
+        return self._finish(rnd, invited, arrived, lat, closed_by, close,
+                            walls)
 
     def _finish(self, rnd, invited, arrived, lat, closed_by,
-                close) -> ClosedRound:
+                close, walls=None) -> ClosedRound:
         submitted = np.isfinite(lat)
         stragglers = int((submitted & (arrived == 0.0)).sum())
         no_shows = int((~submitted).sum())
@@ -119,10 +130,14 @@ class CohortAssembler:
             self.closed_by_deadline += 1
         self.stragglers_total += stragglers
         self.no_shows_total += no_shows
+        obtrace.instant(
+            "assembler", f"close:{closed_by}", round=int(rnd),
+            survivors=int(arrived.sum()), stragglers=stragglers,
+            no_shows=no_shows)
         return ClosedRound(
             rnd=rnd, invited=invited, arrived=arrived, latencies=lat,
             closed_by=closed_by, close_latency_s=float(close),
-            stragglers=stragglers, no_shows=no_shows,
+            stragglers=stragglers, no_shows=no_shows, wall_ts=walls,
         )
 
     def counters(self) -> dict[str, int]:

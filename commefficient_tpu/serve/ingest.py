@@ -32,6 +32,9 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
+
+from ..obs import trace as obtrace
 
 # rejection reasons (wire-visible: the socket transport echoes them)
 ACCEPTED = "ACCEPTED"
@@ -64,6 +67,9 @@ class Arrival:
     client_id: int
     latency_s: float
     recv_order: int  # wall arrival order (tie-break + socket-mode ordering)
+    # host wall timestamp (perf_counter) of the ACCEPT: the start of the
+    # submission-to-merge latency the obs layer resolves at commit
+    wall_t: float = 0.0
 
 
 class IngestQueue:
@@ -165,7 +171,22 @@ class IngestQueue:
 
     def submit(self, sub: Submission) -> str:
         """Admission decision for one submission (see module docstring for
-        the rule order). Returns ACCEPTED/BUFFERED or a rejection reason."""
+        the rule order). Returns ACCEPTED/BUFFERED or a rejection reason.
+        Every decision is a trace instant on the serve-ingest track, linked
+        to the later merge span by the `submission` id (r<round>/c<cid>)."""
+        status = self._decide(sub)
+        if obtrace.get().enabled:
+            # guard BEFORE building args: this is the admission hot path
+            # (the ingest bench pushes ~1e5 submissions/s through it), and
+            # an untraced server must pay one attribute check, not two
+            # f-strings per message
+            obtrace.instant(
+                "serve-ingest", f"submit:{status}",
+                submission=f"r{int(sub.round)}/c{int(sub.client_id)}",
+                round=int(sub.round), client=int(sub.client_id))
+        return status
+
+    def _decide(self, sub: Submission) -> str:
         with self._cv:
             if self._closed:
                 self.rejected_closed += 1
@@ -203,7 +224,8 @@ class IngestQueue:
 
     def _admit(self, cid: int, latency_s: float) -> None:
         """Record an accepted arrival (lock held)."""
-        self._arrivals.append(Arrival(cid, latency_s, self._recv_counter))
+        self._arrivals.append(
+            Arrival(cid, latency_s, self._recv_counter, time.perf_counter()))
         self._recv_counter += 1
         self._seen.add(cid)
         self.accepted += 1

@@ -13,6 +13,18 @@ a loopback operator surface, the moral equivalent of a /healthz):
     requeue_depth          dropped/no-show clients waiting for re-service
     clients_quarantined    sketch-space quarantine rejections (cumulative,
                            from the run stats when the loop reports them)
+    latency_ms             submission-to-merge latency {p50, p99, count} —
+                           accept wall time to the commit that published the
+                           round's merged update (obs registry histogram
+                           `serve_submit_to_merge_ms`)
+    round_phase_ms         per-phase round wall-clock {p50, p99, count} for
+                           prepare/dispatch/drain/commit (obs registry
+                           `runner_phase_*_ms` histograms)
+
+The rate/latency/phase numbers all come from the obs registry — the
+process-wide single source of truth the runner and serving layers write to
+(the old local `RateWindow` moved there as `obs.registry.Meter`, which
+service.py obtains via `Registry.meter("serve_arrival_rate")`).
 
 The HTTP server is a stdlib ThreadingHTTPServer on its own daemon thread —
 it never touches the dispatch path. Anything but GET /metrics is a 404.
@@ -20,44 +32,10 @@ it never touches the dispatch path. Anything but GET /metrics is a 404.
 
 from __future__ import annotations
 
-import collections
 import json
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
-
-
-class RateWindow:
-    """Sliding-window event rate: record(n) on accept, rate() = events/s
-    over the trailing `window_s`. O(events in window) memory, thread-safe.
-    record() runs under the ingest queue's lock (on_accept), so both ends
-    must be O(1) amortized — hence the deque, not a list."""
-
-    def __init__(self, window_s: float = 60.0, clock=time.monotonic):
-        self.window_s = window_s
-        self._clock = clock
-        self._lock = threading.Lock()
-        self._events: collections.deque[tuple[float, int]] = (
-            collections.deque())
-
-    def record(self, n: int = 1) -> None:
-        now = self._clock()
-        with self._lock:
-            self._events.append((now, n))
-            self._trim(now)
-
-    def rate(self) -> float:
-        now = self._clock()
-        with self._lock:
-            self._trim(now)
-            total = sum(n for _, n in self._events)
-        return total / self.window_s
-
-    def _trim(self, now: float) -> None:
-        cutoff = now - self.window_s
-        while self._events and self._events[0][0] < cutoff:
-            self._events.popleft()
 
 
 class MetricsServer:

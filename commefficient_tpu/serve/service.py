@@ -37,10 +37,13 @@ from __future__ import annotations
 import dataclasses
 import sys
 import threading
+import time
 
+from ..obs import registry as obreg
+from ..obs import trace as obtrace
 from .assembler import ClosedRound, CohortAssembler
 from .ingest import IngestQueue
-from .metrics import MetricsServer, RateWindow
+from .metrics import MetricsServer
 from .traffic import TraceConfig, TrafficGenerator
 from .transport import InProcessTransport, SocketTransport
 
@@ -99,8 +102,23 @@ class AggregationService:
         self.transport = (
             SocketTransport(self.queue, port=cfg.port)
             if cfg.transport == "socket" else InProcessTransport(self.queue))
-        self._rate = RateWindow()
+        # all rate/latency metrics live in the process-wide obs registry —
+        # the same store the runner's phase histograms land in, so the
+        # /metrics endpoint reads ONE source of truth
+        self.registry = obreg.default()
+        self._rate = self.registry.meter("serve_arrival_rate")
+        self._latency = self.registry.histogram("serve_submit_to_merge_ms")
+        # the registry is process-wide (the single-source contract), but a
+        # service must not claim a PREDECESSOR's merges as its own: count
+        # is baselined at construction, and the meter's 60 s sliding
+        # window ages the old service's arrivals out on its own. (Window
+        # percentiles can briefly include predecessor observations after
+        # an in-process restart — the CLIs run one service per process.)
+        self._latency_base = self._latency.count
         self.queue.on_accept = self._rate.record
+        # closed-but-unmerged rounds: their submission-to-merge latencies
+        # resolve when the runner's drain COMMITS them (record_merges)
+        self._unmerged: list[ClosedRound] = []
         self.metrics_server = (
             MetricsServer(self.metrics_snapshot, port=cfg.metrics_port)
             if cfg.metrics_port >= 0 else None)
@@ -155,17 +173,57 @@ class AggregationService:
         """One full served round preparation: invite, collect, close at
         W-of-N, mask + re-queue the casualties. Returns (PreparedRound,
         ClosedRound)."""
-        ids = self.session.sample_cohort(rnd)
-        self.queue.open_round(rnd, ids)
-        if self.traffic is not None:
-            self.traffic.respond_to_invites(
-                rnd, ids, self.transport.submit, self.cfg.deadline_s)
-            closed = self.assembler.close_virtual(rnd, ids)
-        else:
-            # external clients: wall-clock W-of-N (socket transport)
-            closed = self.assembler.close_wall(rnd, ids)
-        prep = self.session.prepare_served_round(rnd, ids, closed.arrived)
+        with obtrace.span("assembler", "serve_round", round=rnd):
+            ids = self.session.sample_cohort(rnd)
+            self.queue.open_round(rnd, ids)
+            if self.traffic is not None:
+                self.traffic.respond_to_invites(
+                    rnd, ids, self.transport.submit, self.cfg.deadline_s)
+                closed = self.assembler.close_virtual(rnd, ids)
+            else:
+                # external clients: wall-clock W-of-N (socket transport)
+                closed = self.assembler.close_wall(rnd, ids)
+            prep = self.session.prepare_served_round(rnd, ids, closed.arrived)
+        with self._meta_lock:
+            self._unmerged.append(closed)
         return prep, closed
+
+    def record_merges(self, committed_round: int | None = None) -> int:
+        """Resolve submission-to-merge latency for every closed round the
+        session has COMMITTED (round < committed): observe each accepted
+        submission's accept->commit wall time into the registry histogram
+        and emit one deferred span per submission on the serve-ingest
+        track, linked to its admission instant by the r<rnd>/c<cid>
+        submission id. The runner calls this from its drain boundary (the
+        ServedSource.on_committed hook); direct drivers (bench, tests)
+        call it after their own commits. Returns how many submissions were
+        resolved."""
+        committed = (self.session.round if committed_round is None
+                     else committed_round)
+        with self._meta_lock:
+            ready = [c for c in self._unmerged if c.rnd < committed]
+            self._unmerged = [c for c in self._unmerged
+                              if c.rnd >= committed]
+        now_wall = time.perf_counter()
+        now_us = obtrace.now_us()
+        n = 0
+        for closed in ready:
+            if closed.wall_ts is None:
+                continue
+            for pos, cid in enumerate(closed.invited):
+                wall = float(closed.wall_ts[pos])
+                if closed.arrived[pos] == 0.0 or wall == float("inf"):
+                    continue  # masked out of the merge, or never accepted
+                lat_ms = (now_wall - wall) * 1e3
+                self._latency.observe(lat_ms)
+                obtrace.complete(
+                    "serve-ingest",
+                    f"submission r{closed.rnd}/c{int(cid)}",
+                    now_us - lat_ms * 1e3, lat_ms * 1e3,
+                    submission=f"r{closed.rnd}/c{int(cid)}",
+                    round=int(closed.rnd), client=int(cid))
+                n += 1
+        return n
 
     # -- checkpoint + metrics surfaces ----------------------------------------
 
@@ -193,14 +251,20 @@ class AggregationService:
     def rewind_to_committed(self) -> None:
         """Restore the live pending buffer to the committed boundary — the
         serve-side twin of run_loop's host-RNG rewind, so a session (and
-        service) reused after an interrupted loop replays identically."""
+        service) reused after an interrupted loop replays identically.
+        Served-but-never-committed rounds also drop out of the unmerged
+        list: their submissions never merged, so no latency resolves."""
         with self._meta_lock:
             pending = self._pending_by_round.get(self.session.round)
+            self._unmerged = [c for c in self._unmerged
+                              if c.rnd < self.session.round]
         if pending is not None:
             self.queue.restore_pending(pending)
 
     def metrics_snapshot(self) -> dict:
-        """The /metrics payload (see serve/metrics.py for field docs)."""
+        """The /metrics payload (see serve/metrics.py for field docs). The
+        latency and phase figures read straight from the obs registry —
+        the same histograms the runner and record_merges write."""
         s = self.session
         return {
             "round": int(s.round),
@@ -212,6 +276,15 @@ class AggregationService:
             "clients_dropped": int(getattr(s, "clients_dropped_total", 0)),
             "clients_quarantined": int(
                 getattr(s, "clients_quarantined_total", 0)),
+            # submission-to-merge latency (accept -> committing drain);
+            # count is THIS service's merges (baselined at construction)
+            "latency_ms": {**self._latency.summary(),
+                           "count": self._latency.count - self._latency_base},
+            # where the round's wall-clock goes, per phase (runner-written)
+            "round_phase_ms": {
+                ph: self.registry.histogram(f"runner_phase_{ph}_ms").summary()
+                for ph in obreg.RUNNER_PHASES
+            },
             "quorum": self.cfg.quorum,
             "invited_per_round": s.num_workers,
             "deadline_s": self.cfg.deadline_s,
@@ -244,6 +317,11 @@ class ServedSource:
         self._next = rnd + 1
         self.service._record_boundary(rnd + 1)
         return prep
+
+    def on_committed(self, committed_round: int):
+        """runner drain hook: submission-to-merge latencies resolve at the
+        commit that published their round's merged update."""
+        self.service.record_merges(committed_round)
 
     def stop(self):
         # the loop may have served rounds that never commit (preemption,

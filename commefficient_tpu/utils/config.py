@@ -237,6 +237,29 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--checkpoint_every", type=int, default=0, help="rounds; 0 = never")
     p.add_argument("--log_jsonl", default="")
+    # observability (obs/): round tracing + metrics registry + profiler
+    p.add_argument("--trace", default="",
+                   help="write a Chrome-trace/Perfetto JSON of the run "
+                        "here: host-side spans on named tracks (runner, "
+                        "device, writer, serve-ingest, assembler, "
+                        "federated, resilience) with deferred device-phase "
+                        "durations resolved at drain boundaries — zero "
+                        "host syncs added, traced run bit-identical to "
+                        "untraced. Open in chrome://tracing or "
+                        "ui.perfetto.dev")
+    p.add_argument("--trace_events", default="",
+                   help="append obs events as JSONL here (one schema-"
+                        "versioned object per span/instant, line-buffered "
+                        "whole-line writes — crash-safe); independent of "
+                        "--trace, both may be set")
+    p.add_argument("--profile_rounds", default="",
+                   help="START:END — programmatic jax.profiler capture "
+                        "window: start_trace before round START "
+                        "dispatches, stop_trace after round END commits "
+                        "(whole rounds, async pipeline included). Needs "
+                        "--profile_dir; degrades to a loud no-op where "
+                        "the profiler is unavailable. Without this flag "
+                        "--profile_dir still captures the whole run")
     p.add_argument("--profile_dir", default="", help="write a jax.profiler trace here")
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"],
                    help="model compute dtype (params/BN/logits stay float32); "
@@ -329,6 +352,21 @@ def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
             "--watchdog_abort needs --checkpoint_dir: aborting without an "
             "emergency checkpoint would lose the run instead of resuming it"
         )
+    if getattr(args, "profile_rounds", ""):
+        # validate the window at launch: a typo'd spec (or a missing
+        # output dir) must not surface hours later as a silently-absent
+        # capture
+        from ..obs.profiler import parse_rounds_spec
+
+        try:
+            parse_rounds_spec(args.profile_rounds)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+        if not getattr(args, "profile_dir", ""):
+            raise SystemExit(
+                "--profile_rounds needs --profile_dir (the capture has to "
+                "be written somewhere)"
+            )
     return args
 
 

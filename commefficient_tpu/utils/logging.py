@@ -3,12 +3,24 @@
 The reference prints fixed-width epoch tables; we keep that surface and add a
 JSONL sink so runs are machine-readable (the rebuild's observability upgrade,
 SURVEY.md §5 "Metrics / logging").
+
+The JSONL sink is crash-safe by construction: the file is opened ONCE in
+append mode with line buffering, every row lands as a single whole-line
+write followed by a flush, and each row carries a `schema` version field —
+so a process killed mid-run leaves only complete, parseable JSON lines
+(tests/test_obs.py pins this with a SIGKILLed child), and a consumer can
+tell which row shape it is reading. The obs tracer's event sink
+(obs/trace.py) follows the same discipline.
 """
 
 from __future__ import annotations
 
 import json
 import time
+
+# bump when a row's FIELD SEMANTICS change (not when callers add columns —
+# the row dict is caller-shaped; schema versions the envelope discipline)
+JSONL_SCHEMA_VERSION = 1
 
 
 class Timer:
@@ -27,11 +39,14 @@ class Timer:
 
 
 class TableLogger:
-    """Fixed-width column table printed incrementally, one row per epoch."""
+    """Fixed-width column table printed incrementally, one row per epoch.
+    The optional JSONL sink appends `{"schema": N, **row}` per row (the
+    stdout table prints the caller's columns unchanged)."""
 
     def __init__(self, jsonl_path: str | None = None) -> None:
         self.columns: list[str] | None = None
         self.jsonl_path = jsonl_path
+        self._jsonl = None
 
     def append(self, row: dict) -> None:
         if self.columns is None:
@@ -46,5 +61,16 @@ class TableLogger:
                 cells.append(f"{str(v):>12s}")
         print("  ".join(cells), flush=True)
         if self.jsonl_path:
-            with open(self.jsonl_path, "a") as f:
-                f.write(json.dumps(row) + "\n")
+            if self._jsonl is None:
+                # opened once, line-buffered: every append below is one
+                # whole-line write + flush, so a kill between rows can
+                # never leave a torn line
+                self._jsonl = open(self.jsonl_path, "a", buffering=1)
+            self._jsonl.write(
+                json.dumps({"schema": JSONL_SCHEMA_VERSION, **row}) + "\n")
+            self._jsonl.flush()
+
+    def close(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
+from commefficient_tpu import obs
 from commefficient_tpu.data.cifar import load_cifar_fed
 from commefficient_tpu.data.femnist import load_femnist_fed
 from commefficient_tpu.federated.api import FederatedSession, FedModel, FedOptimizer
@@ -112,6 +113,9 @@ def build(args, fault_plan=None, retry_policy=None):
 
 def main(argv=None):
     args = resolve_defaults(make_parser("cv").parse_args(argv))
+    # arm (or disarm) the obs tracer before anything emits — a traced run
+    # is pinned bit-identical to an untraced one (tests/test_obs.py)
+    obs.configure_from_args(args)
     fault_plan = FaultPlan.parse(args.fault_plan)
     retry_policy = RetryPolicy(max_retries=args.max_retries)
     from commefficient_tpu.parallel import distributed
@@ -137,7 +141,9 @@ def main(argv=None):
             opt.round = session.round
             print(f"resumed from {path} at round {session.round}", flush=True)
 
-    if args.profile_dir:
+    if args.profile_dir and not args.profile_rounds:
+        # whole-run profiler capture; with --profile_rounds the runner owns
+        # a start/stop window around the named rounds instead
         jax.profiler.start_trace(args.profile_dir)
 
     logger = TableLogger(args.log_jsonl or None)
@@ -183,8 +189,12 @@ def main(argv=None):
             print(f"serve: final metrics {service.metrics_snapshot()}",
                   flush=True)
             service.close()
+        # flush the Chrome trace even on the preemption/halt exit paths
+        # (sys.exit raises through here): a truncated run with no trace
+        # would be useless exactly when the trace matters most
+        obs.flush_trace()
 
-    if args.profile_dir:
+    if args.profile_dir and not args.profile_rounds:
         jax.profiler.stop_trace()
     return session
 

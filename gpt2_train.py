@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
+from commefficient_tpu import obs
 from commefficient_tpu.data.personachat import load_personachat_fed
 from commefficient_tpu.federated.api import FederatedSession, FedModel, FedOptimizer
 from commefficient_tpu.models.gpt2 import SMALL, TINY, GPT2LMHead
@@ -242,6 +243,9 @@ def make_f1_eval(args, model, tok, valid_set):
 
 def main(argv=None):
     args = resolve_defaults(make_parser("gpt2").parse_args(argv))
+    # arm (or disarm) the obs tracer before anything emits — a traced run
+    # is pinned bit-identical to an untraced one (tests/test_obs.py)
+    obs.configure_from_args(args)
     fault_plan = FaultPlan.parse(args.fault_plan)
     retry_policy = RetryPolicy(max_retries=args.max_retries)
     from commefficient_tpu.parallel import distributed
@@ -271,7 +275,9 @@ def main(argv=None):
             opt.round = session.round
             print(f"resumed from {path} at round {session.round}", flush=True)
 
-    if args.profile_dir:
+    if args.profile_dir and not args.profile_rounds:
+        # whole-run profiler capture; with --profile_rounds the runner owns
+        # a start/stop window around the named rounds instead
         jax.profiler.start_trace(args.profile_dir)
 
     logger = TableLogger(args.log_jsonl or None)
@@ -326,8 +332,12 @@ def main(argv=None):
             print(f"serve: final metrics {service.metrics_snapshot()}",
                   flush=True)
             service.close()
+        # flush the Chrome trace even on the preemption/halt exit paths
+        # (sys.exit raises through here): a truncated run with no trace
+        # would be useless exactly when the trace matters most
+        obs.flush_trace()
 
-    if args.profile_dir:
+    if args.profile_dir and not args.profile_rounds:
         jax.profiler.stop_trace()
     return session
 

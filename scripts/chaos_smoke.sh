@@ -15,6 +15,7 @@
 #        scripts/chaos_smoke.sh supervisor
 #        scripts/chaos_smoke.sh cohort
 #        scripts/chaos_smoke.sh serve
+#        scripts/chaos_smoke.sh trace
 #
 # `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
 # restartPolicy would: it launches the tiny cv_train run with a fault plan
@@ -34,6 +35,12 @@
 # injected client_drop/client_straggle faults ride the service path —
 # asserting every round closed (quorum or deadline), the W-of-N masking
 # fired, and the no-show/dropped clients went through the re-queue. < 2 min.
+#
+# `trace` mode drives the OBSERVABILITY layer (obs/) under chaos: a real
+# cv_train run with --fault_plan AND --trace, ending in an injected
+# preemption (exit 75) — asserting the exported Chrome trace contains the
+# fault/retry/preemption instants with their correct round numbers, and
+# that the trace still flushed on the resumable exit path. < 1 min CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -285,6 +292,90 @@ print(f"serve: PASS (6 W-of-N rounds closed "
       f"clients_dropped={stats.clients_dropped}, "
       f"requeue_depth_max={stats.requeue_depth_max}, "
       f"stragglers={rounds['stragglers']}, no_shows={rounds['no_shows']})")
+EOF
+fi
+
+if [[ "${1:-}" == "trace" ]]; then
+    shift
+    exec timeout -k 10 "${CHAOS_TIMEOUT_S:-300}" python - "$@" <<'EOF'
+# trace chaos child: the real cv_train.main CLI path (tiny-model
+# substitution) with a fault plan AND --trace armed. The run is preempted
+# at round 4 (exit 75); the Chrome trace must still flush on that exit
+# path and must carry the fault/retry/preemption instants with their
+# correct round numbers — chaos is only debuggable if it is observable.
+import json
+import os
+import tempfile
+
+import flax.linen as nn
+
+import commefficient_tpu.data.cifar as cifar
+import cv_train
+from commefficient_tpu.resilience import EXIT_RESUMABLE
+
+
+class _TinyNet(nn.Module):
+    num_classes: int = 10
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+_orig = cifar.load_cifar_fed
+
+
+def _tiny(*a, **kw):
+    kw.update(synthetic_train=64, synthetic_test=32)
+    return _orig(*a, **kw)
+
+
+cv_train.ResNet9 = _TinyNet
+cv_train.load_cifar_fed = _tiny
+
+tdir = tempfile.mkdtemp()
+trace_path = os.path.join(tdir, "trace.json")
+rc = 0
+try:
+    cv_train.main([
+        "--dataset", "cifar10", "--mode", "uncompressed", "--num_clients",
+        "8", "--num_workers", "2", "--local_batch_size", "4", "--lr_scale",
+        "0.05", "--weight_decay", "0", "--data_root", "/nonexistent",
+        "--num_rounds", "6", "--checkpoint_dir", os.path.join(tdir, "ck"),
+        "--fault_plan",
+        "data_fail@1:times=1;client_drop@2:clients=0;preempt@4",
+        "--trace", trace_path,
+    ])
+except SystemExit as e:
+    rc = e.code
+assert rc == EXIT_RESUMABLE, f"expected resumable exit 75, got {rc!r}"
+assert os.path.exists(trace_path), "trace did not flush on the exit path"
+ev = json.load(open(trace_path))["traceEvents"]
+
+
+def instants(name):
+    return [e for e in ev if e.get("ph") == "i" and e["name"] == name]
+
+
+assert any(e["args"].get("round") == 1 for e in instants("fault:data_fail")), \
+    "data_fail instant missing/misplaced"
+assert any(e["args"].get("round") == 1 for e in instants("retry:data_load")), \
+    "retry instant missing/misplaced"
+assert any(e["args"].get("round") == 2
+           for e in instants("fault:client_drop")), \
+    "client_drop instant missing/misplaced"
+assert any(e["args"].get("round") == 4 for e in instants("fault:preempt")), \
+    "preempt instant missing/misplaced"
+assert instants("sigterm"), "SIGTERM handler instant missing"
+assert instants("preempt_boundary"), "runner preemption-boundary instant missing"
+spans = [e for e in ev if e.get("ph") == "X"]
+assert any(e["name"] == "prepare" for e in spans)
+assert any(e["name"] == "drain" for e in spans)
+print(f"trace: PASS (fault/retry/preemption instants on their rounds; "
+      f"{len(ev)} events, flushed through exit 75)")
 EOF
 fi
 

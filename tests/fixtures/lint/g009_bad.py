@@ -1,0 +1,20 @@
+# graftlint: module=commefficient_tpu/federated/engine.py
+# G009 violating twin: obs API calls inside compiled scope — a jitted round
+# step that tries to trace/count from inside the traced body.
+from ..obs import trace as obtrace
+from ..obs.registry import default
+from ..obs.trace import span
+
+
+def make_round_step(cfg):
+    reg = default()  # obs registry access in compiled scope
+
+    def round_step(state, batch):
+        with span("runner", "inner_step"):  # span inside the traced body
+            update = batch["g"] * 0.1
+        obtrace.instant("federated", "step_done")  # instant in traced body
+        reg.counter("rounds").inc()  # counter mutation in traced body
+        registry.gauge("depth").set(1.0)  # registry receiver access
+        return state, update
+
+    return round_step

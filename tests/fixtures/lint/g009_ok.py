@@ -1,0 +1,16 @@
+# graftlint: module=commefficient_tpu/federated/engine.py
+# G009 conforming twin: the compiled body stays pure — jax idioms that
+# LOOK like metric mutation (.at[].set scatter) are not obs calls, and the
+# host-side telemetry happens in the caller (runner/api), not here.
+import jax.numpy as jnp
+
+
+def make_round_step(cfg):
+    def round_step(state, batch, idx):
+        update = batch["g"] * 0.1
+        # the jax scatter idiom: .set() on an .at[] view is not a gauge
+        table = state["table"].at[idx].set(update)
+        metrics = {"participants": jnp.sum(batch["mask"])}
+        return {**state, "table": table}, metrics
+
+    return round_step

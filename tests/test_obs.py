@@ -1,0 +1,611 @@
+"""obs/ — unified tracing, metrics registry, and profiler capture.
+
+The acceptance pins:
+
+1. A traced run is BIT-IDENTICAL to an untraced run — params and every
+   logged row — on the fused and the sharded (client_shards=2 reference)
+   paths: the tracer only reads host clocks, never RNG or device state.
+2. The exporter emits valid Chrome-trace JSON (ph/ts/dur/pid/tid fields,
+   thread_name metadata naming the tracks).
+3. A served run's trace shows LINKED submission->merge spans (same
+   r<rnd>/c<cid> id as the admission instants) plus distinct prepare/
+   dispatch/drain/commit phases per round.
+4. The registry is thread-safe under the ingest path and is the single
+   source RunStats is carved from (mark deltas).
+5. The jax.profiler window starts/stops at the right round boundaries and
+   degrades to a LOUD no-op where the profiler is unavailable.
+6. TableLogger's JSONL sink survives a SIGKILLed process with only whole
+   JSON lines on disk (crash-safe observability is table stakes).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+import cv_train
+from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+from commefficient_tpu.federated.api import FederatedSession, FedOptimizer
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.obs import registry as obreg
+from commefficient_tpu.obs import trace as obtrace
+from commefficient_tpu.obs.profiler import ProfileWindow, parse_rounds_spec
+from commefficient_tpu.runner import RunnerConfig, run_loop
+from commefficient_tpu.serve import (
+    AggregationService, IngestQueue, ServeConfig, Submission, TraceConfig,
+    TrafficGenerator,
+)
+
+LR = 0.05
+
+
+@pytest.fixture(autouse=True)
+def _disarm_tracer():
+    """Every test leaves the global tracer disarmed (configure() with no
+    paths resets the buffer and disables emission)."""
+    yield
+    obtrace.configure()
+
+
+@pytest.fixture()
+def tiny_cv(tmp_path, monkeypatch):
+    import flax.linen as nn
+
+    import commefficient_tpu.data.cifar as cifar_mod
+
+    orig = cifar_mod.load_cifar_fed
+
+    def tiny(*a, **kw):
+        kw.update(synthetic_train=64, synthetic_test=32)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(cv_train, "load_cifar_fed", tiny)
+
+    class _TinyNet(nn.Module):
+        num_classes: int = 10
+        dtype: str = "float32"
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(self.num_classes)(x)
+
+    monkeypatch.setattr(cv_train, "ResNet9", _TinyNet)
+    return tmp_path
+
+
+def _argv(extra=()):
+    return [
+        "--dataset", "cifar10", "--mode", "uncompressed", "--num_clients", "8",
+        "--num_workers", "2", "--local_batch_size", "4", "--lr_scale", "0.05",
+        "--weight_decay", "0", "--data_root", "/nonexistent", *extra,
+    ]
+
+
+def _quad_loss(params, net_state, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    err = pred - jax.nn.one_hot(batch["y"], pred.shape[-1])
+    mask = batch["mask"]
+    count = jnp.maximum(mask.sum(), 1.0)
+    per_ex = (err ** 2).sum(-1)
+    return (per_ex * mask).sum() / count, {
+        "net_state": net_state,
+        "metrics": {"loss_sum": (per_ex * mask).sum(), "count": mask.sum()}}
+
+
+def _tiny_session(shards=0, seed=0, num_clients=12, workers=4, din=6, dout=3):
+    rs = np.random.RandomState(0)
+    x = rs.randn(96, din).astype(np.float32)
+    w_true = rs.randn(din, dout).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int32)
+    train = FedDataset(x, y, shard_iid(len(x), num_clients,
+                                       np.random.RandomState(1)))
+    params = {"w": jnp.asarray(rs.randn(din, dout).astype(np.float32) * 0.1),
+              "b": jnp.zeros(dout)}
+    d = ravel_pytree(params)[0].size
+    return FederatedSession(
+        train_loss_fn=_quad_loss, eval_loss_fn=_quad_loss,
+        params=params, net_state={},
+        mode_cfg=ModeConfig(mode="uncompressed", d=d, momentum=0.9,
+                            momentum_type="virtual", error_type="none"),
+        train_set=train, num_workers=workers, local_batch_size=4,
+        seed=seed, client_shards=shards,
+    )
+
+
+def _assert_params_equal(sa, sb):
+    for x, y in zip(
+        jax.tree.leaves(jax.device_get(sa.state["params"])),
+        jax.tree.leaves(jax.device_get(sb.state["params"])),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _rows(path):
+    rows = [json.loads(line) for line in open(path)]
+    for r in rows:
+        r.pop("time_s")
+    return rows
+
+
+# ------------------------------------------------- THE bit-identity pins
+
+
+@pytest.mark.parametrize("shards", [0, 2], ids=["fused", "sharded"])
+def test_traced_rounds_bit_identical_to_untraced(shards, tmp_path):
+    """Tracing reads host clocks only: round metrics and final params of a
+    traced session must equal an untraced one's to the last bit — fused
+    AND on the sharded single-device reference program."""
+    a = _tiny_session(shards=shards)
+    rows_a = [a.run_round(LR) for _ in range(3)]
+
+    obtrace.configure(trace_path=str(tmp_path / "t.json"),
+                      jsonl_path=str(tmp_path / "ev.jsonl"))
+    b = _tiny_session(shards=shards)
+    rows_b = [b.run_round(LR) for _ in range(3)]
+    obtrace.configure()
+
+    assert rows_a == rows_b
+    _assert_params_equal(a, b)
+
+
+@pytest.mark.chaos
+def test_traced_cli_run_bit_identical_to_untraced(tiny_cv, tmp_path):
+    """Full CLI run (async runner, eval cadence mid-run) with --trace +
+    --trace_events vs without: params and every logged JSONL row must be
+    bit-identical, and the trace must land with runner spans in it."""
+    base = _argv(("--num_rounds", "4", "--eval_every", "2"))
+    la, lb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    trace_path = str(tmp_path / "run_trace.json")
+    sa = cv_train.main(base + ["--log_jsonl", la])
+    sb = cv_train.main(base + ["--log_jsonl", lb, "--trace", trace_path,
+                               "--trace_events",
+                               str(tmp_path / "ev.jsonl")])
+    assert sa.round == sb.round == 4
+    _assert_params_equal(sa, sb)
+    assert _rows(la) == _rows(lb)
+    ev = json.load(open(trace_path))["traceEvents"]
+    names = {e["name"] for e in ev if e["ph"] == "X"}
+    assert {"prepare", "dispatch", "drain", "commit", "eval"} <= names
+    # the federated prepare span ran on the prefetch thread and still landed
+    assert "prepare_round" in names
+
+
+# ----------------------------------------------------- exporter schema
+
+
+def test_chrome_trace_export_schema(tmp_path):
+    path = str(tmp_path / "t.json")
+    obtrace.configure(trace_path=path)
+    with obtrace.span("runner", "phase", round=0):
+        pass
+    obtrace.instant("resilience", "fault:test", round=1)
+    obtrace.complete("device", "rounds 0..0", obtrace.now_us(), 123.0,
+                     rounds=1)
+    out = obtrace.flush()
+    assert out == path
+    doc = json.load(open(path))
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    assert {e["ph"] for e in evs} <= {"X", "i", "M"}
+    for e in evs:
+        assert {"ph", "pid", "tid", "name"} <= set(e), e
+        if e["ph"] in ("X", "i"):
+            assert isinstance(e["ts"], (int, float))
+            assert "args" in e and "cat" in e
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    track_names = {e["args"]["name"] for e in evs
+                   if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"runner", "device", "writer", "serve-ingest", "assembler",
+            "federated", "resilience"} <= track_names
+    # instants keep their args (the chaos smoke greps rounds out of these)
+    inst = [e for e in evs if e["ph"] == "i"]
+    assert inst and inst[0]["args"]["round"] == 1
+
+
+def test_jsonl_event_sink_schema_and_whole_lines(tmp_path):
+    path = tmp_path / "events.jsonl"
+    obtrace.configure(jsonl_path=str(path))
+    with obtrace.span("runner", "drain", rounds=2):
+        pass
+    obtrace.instant("federated", "requeue_serve", round=3, clients=[1])
+    obtrace.configure()  # closes the sink
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    for line in lines:
+        ev = json.loads(line)
+        assert ev["schema"] == obtrace.EVENT_SCHEMA_VERSION
+        assert ev["track"] in ("runner", "federated")
+        assert "ts" in ev and "name" in ev
+
+
+def test_jsonl_stream_outlives_buffer_cap(tmp_path, capsys):
+    """The bounded in-memory buffer caps the Chrome trace, not the on-disk
+    JSONL stream: past max_events the stream keeps writing and the first
+    drop is announced loudly (a --trace_events-only run never reaches
+    flush()'s dropped-events note)."""
+    path = tmp_path / "ev.jsonl"
+    t = obtrace.Tracer(max_events=2)
+    t.configure(trace_path=str(tmp_path / "t.json"), jsonl_path=str(path))
+    for i in range(5):
+        t.instant("runner", f"e{i}")
+    assert t.event_count() == 2 and t.dropped_events == 3
+    assert len(path.read_text().splitlines()) == 5
+    assert "trace buffer full" in capsys.readouterr().err
+
+
+def test_tracer_disabled_is_noop_and_bounded(tmp_path):
+    t = obtrace.Tracer(max_events=3)
+    with t.span("runner", "x"):
+        pass
+    t.instant("runner", "y")
+    assert t.event_count() == 0  # disarmed: nothing buffered
+    t.configure(trace_path=str(tmp_path / "t.json"))
+    for i in range(10):
+        t.instant("runner", f"e{i}")
+    assert t.event_count() == 3  # bounded buffer
+    assert t.dropped_events == 7
+    doc = json.load(open(t.flush()))
+    assert doc["otherData"]["dropped_events"] == 7
+
+
+# ------------------------------------------- serve: linked merge spans
+
+
+def test_serve_trace_links_submissions_and_shows_round_phases(tmp_path):
+    """4-round served run through the REAL runner (sync loop => every
+    round drains): the trace must show prepare/dispatch/drain/commit per
+    round, submission->merge spans linked to their admission instants by
+    the r<rnd>/c<cid> id, and the /metrics snapshot must surface the
+    latency_ms / round_phase_ms histograms — the PR's acceptance shape."""
+    obtrace.configure(trace_path=str(tmp_path / "serve.json"))
+    sess = _tiny_session()
+    svc = AggregationService(
+        sess, ServeConfig(quorum=2, deadline_s=5.0),
+        traffic=TrafficGenerator(
+            TraceConfig(population=sess.train_set.num_clients, seed=5)),
+    ).start()
+    lat_before = svc._latency.count
+    try:
+        run_loop(sess, FedOptimizer(lambda _: LR, 1),
+                 RunnerConfig(total_rounds=4, eval_every=4, sync_loop=True),
+                 source=svc.source())
+        assert sess.round == 4
+        snap = svc.metrics_snapshot()
+    finally:
+        svc.close()
+    evs = obtrace.get().events()
+    spans = [e for e in evs if e["ph"] == "X"]
+    inst = [e for e in evs if e["ph"] == "i"]
+    sub_spans = [s for s in spans if s["name"].startswith("submission r")]
+    for r in range(4):
+        assert any(s["name"] == "prepare" and s["args"].get("round") == r
+                   for s in spans), f"round {r}: no prepare span"
+        assert any(s["name"] == "dispatch" and s["args"].get("round") == r
+                   for s in spans), f"round {r}: no dispatch span"
+        for phase in ("drain", "commit"):
+            assert any(
+                s["name"] == phase
+                and s["args"]["round_first"] <= r
+                < s["args"]["round_first"] + s["args"]["rounds"]
+                for s in spans), f"round {r}: no {phase} span"
+        assert any(i_["name"] == "commit_round"
+                   and i_["args"]["round"] == r for i_ in inst)
+        assert any(s["args"]["round"] == r for s in sub_spans), (
+            f"round {r}: no submission->merge span")
+    # linked: every merge span's submission id appeared as an ACCEPT
+    accept_ids = {i_["args"]["submission"] for i_ in inst
+                  if i_["name"] == "submit:ACCEPTED"}
+    merge_ids = {s["args"]["submission"] for s in sub_spans}
+    assert merge_ids and merge_ids <= accept_ids
+    assert all(s["dur"] >= 0 for s in sub_spans)
+    # the registry histogram counted exactly the merged submissions
+    assert svc._latency.count - lat_before == len(sub_spans)
+    # /metrics reads the same registry
+    assert snap["latency_ms"]["count"] >= len(sub_spans)
+    assert snap["latency_ms"]["p50"] is not None
+    for phase in ("prepare", "dispatch", "drain", "commit"):
+        assert snap["round_phase_ms"][phase]["p50"] is not None, phase
+
+
+def test_fresh_service_does_not_claim_predecessor_merges():
+    """The latency histogram is process-wide (single-source contract), but
+    a NEW service's /metrics must report ITS merges, not a predecessor's:
+    the count is baselined at construction."""
+    first = _tiny_session()
+    svc1 = AggregationService(
+        first, ServeConfig(quorum=2, deadline_s=5.0),
+        traffic=TrafficGenerator(
+            TraceConfig(population=first.train_set.num_clients, seed=5)),
+    ).start()
+    try:
+        src = svc1.source()
+        first.commit_round(first.dispatch_round(src.next(), LR))
+        src.on_committed(first.round)
+        assert svc1.metrics_snapshot()["latency_ms"]["count"] >= 2
+    finally:
+        svc1.close()
+    second = _tiny_session()
+    svc2 = AggregationService(
+        second, ServeConfig(quorum=2, deadline_s=5.0),
+        traffic=TrafficGenerator(
+            TraceConfig(population=second.train_set.num_clients, seed=5)),
+    ).start()
+    try:
+        assert svc2.metrics_snapshot()["latency_ms"]["count"] == 0
+    finally:
+        svc2.close()
+
+
+def test_instant_signal_safe_skips_jsonl_sink(tmp_path):
+    """The SIGTERM handler's instant must land in the in-memory buffer but
+    never the JSONL handle (the handler may have interrupted a write on
+    that very handle — an interleaved write would tear a line)."""
+    path = tmp_path / "ev.jsonl"
+    t = obtrace.Tracer()
+    t.configure(jsonl_path=str(path))
+    t.instant("resilience", "normal")
+    t.instant_signal_safe("resilience", "sigterm")
+    assert t.event_count() == 2
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [ev["name"] for ev in lines] == ["normal"]
+
+
+def test_served_source_on_committed_resolves_latencies():
+    """Direct-driver path (bench's shape): record_merges resolves only
+    COMMITTED rounds, and served-but-uncommitted rounds drop out on
+    stop()."""
+    sess = _tiny_session()
+    svc = AggregationService(
+        sess, ServeConfig(quorum=2, deadline_s=5.0),
+        traffic=TrafficGenerator(
+            TraceConfig(population=sess.train_set.num_clients, seed=5)),
+    ).start()
+    before = svc._latency.count
+    try:
+        src = svc.source()
+        prep = src.next()
+        assert svc.record_merges() == 0  # nothing committed yet
+        sess.commit_round(sess.dispatch_round(prep, LR))
+        src.on_committed(sess.round)
+        n = svc._latency.count - before
+        assert n >= 2  # at least the quorum's submissions merged
+        src.next()  # served, never dispatched/committed
+        src.stop()
+        assert svc.record_merges() == 0  # uncommitted round was discarded
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------- registry contracts
+
+
+def test_registry_kinds_marks_and_percentiles():
+    reg = obreg.Registry()
+    c = reg.counter("c")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    m = reg.mark()
+    c.inc(5)
+    assert m.delta("c") == 5
+    assert m.delta("never_seen") == 0  # born after the mark: full value
+    g = reg.gauge("g")
+    g.set(2)
+    g.set(1)
+    assert g.value == 1 and g.max == 2
+    h = reg.histogram("h")
+    for i in range(100):
+        h.observe(i)
+    assert h.count == 100
+    assert h.percentile(50) == 50
+    s = h.summary()
+    assert s["p50"] == 50 and s["p99"] == 99 and s["count"] == 100
+    assert reg.histogram("h") is h  # get-or-create
+    with pytest.raises(TypeError, match="one name, one kind"):
+        reg.gauge("c")
+    mt = reg.meter("m", window_s=10.0)
+    mt.record(5)
+    assert mt.rate() == 0.5
+    snap = reg.snapshot()
+    assert snap["c"] == 8.0 and snap["h"]["p50"] == 50
+
+
+def test_histogram_window_bounds_memory():
+    h = obreg.Histogram("h", window=64)
+    for i in range(1000):
+        h.observe(float(i))
+    assert h.count == 1000  # cumulative count survives the window
+    assert h.percentile(0) >= 936  # percentiles over the recent window
+
+
+def test_registry_thread_safe_under_ingest_path():
+    """8 transport threads hammering submit() with the accept hook wired
+    to registry metrics (the live serve shape): every accept must count
+    exactly once everywhere."""
+    reg = obreg.Registry()
+    accepted = reg.counter("accepted")
+    rate = reg.meter("rate")
+    lat = reg.histogram("lat")
+
+    def hook(n):
+        accepted.inc(n)
+        rate.record(n)
+        lat.observe(0.5)
+
+    n_threads, per_thread = 8, 500
+    q = IngestQueue(capacity=n_threads * per_thread + 1)
+    q.on_accept = hook
+    q.open_round(0, list(range(n_threads * per_thread)))
+
+    def worker(k):
+        for cid in range(k * per_thread, (k + 1) * per_thread):
+            q.submit(Submission(client_id=cid, round=0))
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    assert q.accepted == total
+    assert int(accepted.value) == total
+    assert lat.count == total
+
+
+def test_runstats_is_a_registry_delta_view():
+    """run_loop fills RunStats from registry mark deltas — the registry
+    counters must advance by exactly what the stats report."""
+    reg = obreg.default()
+    before_rounds = reg.counter("runner_rounds_total").value
+    before_drains = reg.counter("runner_drains_total").value
+    s = _tiny_session()
+    stats = run_loop(s, FedOptimizer(lambda _: LR, 1),
+                     RunnerConfig(total_rounds=3, eval_every=3))
+    assert stats.rounds == 3
+    assert reg.counter("runner_rounds_total").value - before_rounds == 3
+    assert (reg.counter("runner_drains_total").value - before_drains
+            == stats.drains >= 1)
+    assert stats.evals == 1
+    # the phase histograms populated (the serve endpoint reads these)
+    for phase in ("prepare", "dispatch", "drain", "commit"):
+        assert reg.histogram(f"runner_phase_{phase}_ms").count > 0, phase
+
+
+# ------------------------------------------------------- profiler window
+
+
+def test_profile_rounds_spec_validation():
+    assert parse_rounds_spec("") is None
+    assert parse_rounds_spec("2:5") == (2, 5)
+    for bad in ("5", "a:b", "3:1", "-1:2"):
+        with pytest.raises(ValueError):
+            parse_rounds_spec(bad)
+    with pytest.raises(ValueError, match="profile_dir"):
+        ProfileWindow(0, 1, "")
+
+
+def test_profile_window_start_stop_at_round_boundaries(tmp_path, monkeypatch):
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    pw = ProfileWindow.parse("1:2", str(tmp_path))
+    pw.on_dispatch(0)
+    assert calls == []  # before the window
+    pw.on_dispatch(1)
+    assert calls == [("start", str(tmp_path))]
+    pw.on_committed(2)  # round 1 committed; round 2 (END) still open
+    assert len(calls) == 1
+    pw.on_committed(3)  # round 2 committed -> stop
+    assert calls[-1] == ("stop",)
+    pw.on_dispatch(1)  # window is one-shot
+    assert len(calls) == 2
+
+
+def test_profile_window_block_overlap_and_resume_past(tmp_path, monkeypatch,
+                                                      capsys):
+    """A fused dispatch block OVERLAPPING the window starts the capture (a
+    block cannot be split, so the capture is a round-aligned superset);
+    a run that begins PAST the window declares it dead loudly instead of
+    silently arming at the wrong rounds."""
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append("start"))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append("stop"))
+    pw = ProfileWindow.parse("5:6", str(tmp_path))
+    pw.on_dispatch(0, rounds=4)  # block [0..3]: ends before the window
+    assert calls == []
+    pw.on_dispatch(4, rounds=4)  # block [4..7] contains round 5 -> start
+    assert calls == ["start"]
+    pw.on_committed(8)
+    assert calls == ["start", "stop"]
+
+    pw2 = ProfileWindow.parse("5:6", str(tmp_path))
+    pw2.on_dispatch(8)  # resumed run already past the window
+    assert calls == ["start", "stop"]  # no capture armed
+    assert "behind the run" in capsys.readouterr().err
+    pw2.on_dispatch(5)  # declared dead: stays dead
+    assert calls == ["start", "stop"]
+
+
+def test_profile_window_degrades_to_loud_noop(tmp_path, monkeypatch, capsys):
+    def boom(d):
+        raise RuntimeError("no profiler on this backend")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    pw = ProfileWindow.parse("0:1", str(tmp_path))
+    pw.on_dispatch(0)  # must not raise
+    err = capsys.readouterr().err
+    assert "degrades to a no-op" in err
+    pw.on_committed(5)
+    pw.close()  # nothing active: both no-ops
+
+
+# --------------------------------------------- crash-safe JSONL logging
+
+
+def test_tablelogger_rows_carry_schema_version(tmp_path, capsys):
+    from commefficient_tpu.utils.logging import (
+        JSONL_SCHEMA_VERSION, TableLogger,
+    )
+
+    path = tmp_path / "rows.jsonl"
+    t = TableLogger(str(path))
+    t.append({"round": 0, "loss": 1.5})
+    t.append({"round": 1, "loss": 1.25})
+    t.close()
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [r["schema"] for r in rows] == [JSONL_SCHEMA_VERSION] * 2
+    assert rows[1]["round"] == 1
+    # the stdout table prints the CALLER's columns (no schema column)
+    out = capsys.readouterr().out
+    assert "schema" not in out
+
+
+def test_tablelogger_killed_process_leaves_whole_lines(tmp_path):
+    """SIGKILL a process mid-logging: every line already on disk must be a
+    complete JSON object (line-buffered single-write append discipline)."""
+    path = tmp_path / "rows.jsonl"
+    child = (
+        "import os, sys\n"
+        "sys.stdout = open(os.devnull, 'w')\n"
+        "from commefficient_tpu.utils.logging import TableLogger\n"
+        f"t = TableLogger({str(path)!r})\n"
+        "i = 0\n"
+        "while True:\n"
+        "    t.append({'round': i, 'loss': i * 0.5, 'pad': 'x' * 256})\n"
+        "    i += 1\n"
+    )
+    p = subprocess.Popen([sys.executable, "-c", child])
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if path.exists() and path.stat().st_size > 8192:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("child never wrote enough rows")
+    finally:
+        p.kill()
+        p.wait()
+    lines = path.read_text().splitlines()
+    assert len(lines) >= 10
+    for i, line in enumerate(lines):
+        row = json.loads(line)  # a torn line would raise here
+        assert row["round"] == i
