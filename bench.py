@@ -333,6 +333,13 @@ RUN_LOOP_ROUNDS = int(os.environ.get("BENCH_RUN_LOOP_ROUNDS", 30))
 # acceptance check), (c) submission-to-merge latency p50/p99 through a REAL
 # served session (invite -> push -> W-of-N close -> dispatch -> commit).
 # resnet9 only, like run_loop; {"skipped": ...} when unavailable.
+# ravel-vs-layerwise sketch accumulation A/B on the run_loop bench (resnet9
+# only): updates/s + per-round ms through the REAL async runner for both
+# --sketch_path arms, plus the HBM headline — peak live-buffer bytes of the
+# compiled fused round program per arm (XLA memory_analysis: temp + output,
+# arguments excluded since both arms bind identical params/batch buffers).
+# BENCH_SKETCH_PATH=0 disables (the tier-1 smoke does).
+SKETCH_PATH_BENCH = os.environ.get("BENCH_SKETCH_PATH", "1") == "1"
 SERVE_BENCH = os.environ.get("BENCH_SERVE", "1") == "1"
 SERVE_ROUNDS = int(os.environ.get("BENCH_SERVE_ROUNDS", 12))
 SERVE_POPULATION = int(os.environ.get("BENCH_SERVE_POPULATION", 10_000_000))
@@ -1079,6 +1086,165 @@ def _run_loop_bench(round_ms: float) -> dict:
     return out
 
 
+def _sketch_path_bench(round_ms: float) -> dict:
+    """--sketch_path ravel vs layerwise on the run_loop bench: one warm
+    FederatedSession per arm (same seed, same synthetic shards, same
+    compiled-arm discipline as _run_loop_bench), driven through the REAL
+    async runner — wall-clock updates/s and per-round ms per arm — plus the
+    HBM headline: peak live-buffer bytes of each arm's compiled fused round
+    program (XLA memory_analysis; temp + output bytes — the buffers the
+    program itself owns; argument bytes excluded, both arms bind the same
+    params/batch). The layerwise arm never materializes the flat [d]
+    gradient, so its peak should sit strictly below ravel's at matched
+    dims. Also re-confirms the obs contract on the NEW arm: tracing the
+    layerwise run adds < ~2%. Never raises."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+    from commefficient_tpu.federated import engine
+    from commefficient_tpu.federated.api import FederatedSession, FedOptimizer
+    from commefficient_tpu.modes.config import ModeConfig
+    from commefficient_tpu.runner import RunnerConfig, run_loop
+
+    rounds = RUN_LOOP_ROUNDS
+    out: dict = {"rounds_per_arm": rounds}
+    try:
+        params, net_state, _, loss_fn, _, sketch_kw, workers = _resnet9_workload()
+        from jax.flatten_util import ravel_pytree
+
+        d = ravel_pytree(params)[0].size
+        out["d"] = d
+        rng = np.random.RandomState(0)
+        n_examples = max(512, workers * LOCAL_BATCH * 4)
+        x = rng.randn(n_examples, 32, 32, 3).astype(np.float32)
+        y = rng.randint(0, 10, size=n_examples).astype(np.int32)
+
+        def make_session(sketch_path):
+            return FederatedSession(
+                train_loss_fn=loss_fn,
+                eval_loss_fn=loss_fn,
+                params=jax.tree.map(jnp.copy, params),
+                net_state=jax.tree.map(jnp.copy, net_state),
+                mode_cfg=ModeConfig(
+                    mode="sketch", d=d, momentum_type="virtual",
+                    error_type="virtual",
+                    topk_impl=os.environ.get("BENCH_TOPK_IMPL", "approx"),
+                    topk_recall=float(
+                        os.environ.get("BENCH_TOPK_RECALL", 0.99)),
+                    **sketch_kw,
+                ),
+                train_set=FedDataset(
+                    x, y, shard_iid(n_examples, max(2 * workers, 8),
+                                    np.random.RandomState(1))),
+                num_workers=workers,
+                local_batch_size=LOCAL_BATCH,
+                weight_decay=5e-4,
+                seed=0,
+                split_compile=BENCH_ENGINE_COMPILE == "split",
+                sketch_path=sketch_path,
+            )
+
+        def arm(session, sync, n):
+            cfg = RunnerConfig(
+                total_rounds=session.round + n,
+                eval_every=session.round + n,
+                sync_loop=sync,
+            )
+            return run_loop(session, FedOptimizer(lambda _: 0.01, 1), cfg)
+
+        # ---- peak live-buffer bytes of the compiled fused round program.
+        # Abstract batch from a throwaway session's real prepared round, so
+        # the analyzed program binds exactly what the timed arms bind.
+        probe = make_session("ravel")
+        prep = probe.prepare_round(0)
+        batch_abs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.asarray(a).shape,
+                                           np.asarray(a).dtype),
+            dict(prep.batch))
+        import dataclasses as _dc
+
+        mem = {}
+        for label in ("ravel", "layerwise"):
+            cfg = _dc.replace(probe.cfg, sketch_path=label)
+            step = jax.jit(engine.make_round_step(loss_fn, cfg))
+            state = engine.init_server_state(
+                cfg, jax.tree.map(jnp.copy, params),
+                jax.tree.map(jnp.copy, net_state))
+            try:
+                ma = step.lower(
+                    state, batch_abs, {},
+                    jax.ShapeDtypeStruct((), np.float32),
+                    jax.random.PRNGKey(0),
+                ).compile().memory_analysis()
+                mem[label] = {
+                    "temp_bytes": int(ma.temp_size_in_bytes),
+                    "output_bytes": int(ma.output_size_in_bytes),
+                    "argument_bytes": int(ma.argument_size_in_bytes),
+                    "peak_live_buffer_bytes": int(
+                        ma.temp_size_in_bytes + ma.output_size_in_bytes),
+                }
+            except Exception as e:  # noqa: BLE001 — degrade to skipped
+                mem[label] = {"skipped": f"memory_analysis unavailable: "
+                                         f"{type(e).__name__}: {e}"}
+        out["memory"] = mem
+        if all("peak_live_buffer_bytes" in m for m in mem.values()):
+            delta = (mem["ravel"]["peak_live_buffer_bytes"]
+                     - mem["layerwise"]["peak_live_buffer_bytes"])
+            out["memory"]["peak_live_buffer_bytes_delta"] = delta
+            out["memory"]["note"] = (
+                "delta = ravel - layerwise peak (temp + output) of the "
+                "compiled fused round program; positive = the layerwise "
+                "arm's live set is smaller (no flat [d] gradient, no flat "
+                "params copy)")
+
+        # ---- timed arms through the real async runner, warm
+        for label in ("ravel", "layerwise"):
+            session = make_session(label)
+            arm(session, sync=True, n=min(2, rounds))  # compile + warm
+            stats = arm(session, sync=False, n=rounds)
+            wall_round_ms = stats.wall_s * 1e3 / max(stats.rounds, 1)
+            out[label] = {
+                "wall_clock_updates_per_sec": round(
+                    workers * stats.rounds / max(stats.wall_s, 1e-9), 2),
+                "wall_round_ms": round(wall_round_ms, 2),
+                "host_overhead_ms": round(wall_round_ms - round_ms, 2),
+            }
+            if label == "layerwise":
+                # obs re-confirmation on the NEW arm: the deferred
+                # device-phase spans (now carrying sketch_path=) still add
+                # zero syncs — expect < ~2% like the ravel run_loop arm
+                import tempfile
+
+                from commefficient_tpu.obs import trace as obtrace
+
+                obtrace.configure(trace_path=os.path.join(
+                    tempfile.mkdtemp(prefix="bench_lw_obs_"), "trace.json"))
+                try:
+                    t_stats = arm(session, sync=False, n=rounds)
+                finally:
+                    obtrace.configure()
+                traced_ms = t_stats.wall_s * 1e3 / max(t_stats.rounds, 1)
+                out["obs"] = {
+                    "untraced_wall_round_ms": round(wall_round_ms, 2),
+                    "traced_wall_round_ms": round(traced_ms, 2),
+                    "tracing_overhead_pct": round(
+                        100.0 * (traced_ms - wall_round_ms)
+                        / max(wall_round_ms, 1e-9), 2),
+                    "note": "layerwise async arm re-run with --trace armed; "
+                            "device spans carry sketch_path=layerwise",
+                }
+        if "wall_round_ms" in out.get("ravel", {}):
+            out["layerwise_vs_ravel_round_ms_ratio"] = round(
+                out["layerwise"]["wall_round_ms"]
+                / max(out["ravel"]["wall_round_ms"], 1e-9), 3)
+    except Exception as e:  # noqa: BLE001 — the stanza IS the result
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def _serve_bench() -> dict:
     """Streaming-aggregation service measurements (see the SERVE_BENCH
     comment). Never raises; {"skipped": ...} when the serving deps are
@@ -1538,6 +1704,17 @@ def run_bench(platform: str) -> dict:
             result["run_loop"] = {
                 "skipped": "run-loop section measures the flagship resnet9 "
                            "workload (BENCH_MODEL=resnet9)"}
+    if SKETCH_PATH_BENCH:
+        if BENCH_MODEL == "resnet9":
+            _stage("sketch_path (ravel vs layerwise accumulation) ...")
+            result["sketch_path"] = _sketch_path_bench(round_ms)
+            _stage(f"sketch_path: {result['sketch_path']}")
+        else:
+            result["sketch_path"] = {
+                "skipped": "sketch_path section measures the flagship "
+                           "resnet9 workload (BENCH_MODEL=resnet9); at "
+                           "GPT-2 dims run it with BENCH_MODEL=resnet9 "
+                           "overridden dims or on-chip"}
     if SERVE_BENCH:
         if BENCH_MODEL == "resnet9":
             _stage("serve (ingest throughput / O(1) client state / "

@@ -43,6 +43,13 @@ G009  obs-call-in-compiled-scope                 tracing/metrics are host-only:
                                                  counter.inc, registry access)
                                                  inside jit/shard_map bodies in
                                                  the parity modules
+G010  flat-ravel-in-round-path                   the dense [d] gradient never
+                                                 materializes by accident:
+                                                 ravel_pytree/jax.flatten_util
+                                                 calls in the round-path
+                                                 compiled scope only inside
+                                                 functions declared
+                                                 `# graftlint: sketch-boundary`
 ====  =========================================  ================================
 
 Run it:
@@ -76,6 +83,7 @@ from .rules_dataflow import DonationAfterUse, RngKeyReuse
 from .rules_io import RawCheckpointWrite
 from .rules_obs import ObsCallInCompiledScope
 from .rules_parity import ReservedLeafAccess, UnorderedReduction
+from .rules_sketch import FlatRavelInRoundPath
 from .rules_sync import BlockingCallOnDispatchThread, HostSyncInRoundPath
 
 ALL_RULES: tuple[type[Rule], ...] = (
@@ -88,6 +96,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     BlockingCallOnDispatchThread,
     UnvalidatedConfigRead,
     ObsCallInCompiledScope,
+    FlatRavelInRoundPath,
 )
 
 RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)

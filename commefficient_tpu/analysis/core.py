@@ -56,6 +56,7 @@ class FunctionInfo:
     def_lineno: int
     end: int
     drain_point: bool
+    sketch_boundary: bool = False
 
 
 class SourceFile:
@@ -88,16 +89,19 @@ class SourceFile:
                         + [d.lineno for d in child.decorator_list]
                     )
                     end = child.end_lineno or child.lineno
-                    # drain-point: marker on the def/decorator lines or in
-                    # the contiguous comment block directly above them
+                    # drain-point / sketch-boundary: marker on the def/
+                    # decorator lines or in the contiguous comment block
+                    # directly above them
                     cand = set(range(start, child.lineno + 1))
                     ln = start - 1
                     while ln >= 1 and self.line(ln).lstrip().startswith("#"):
                         cand.add(ln)
                         ln -= 1
                     drain = bool(cand & self.directives.drain_linenos)
+                    sketch = bool(
+                        cand & self.directives.sketch_boundary_linenos)
                     out.append(FunctionInfo(qual, start, child.lineno, end,
-                                            drain))
+                                            drain, sketch))
                     visit(child, f"{qual}.")
                 elif isinstance(child, ast.ClassDef):
                     visit(child, f"{prefix}{child.name}.")
@@ -120,6 +124,12 @@ class SourceFile:
     def in_drain_point(self, lineno: int) -> bool:
         """True when any enclosing function is a declared drain point."""
         return any(f.drain_point for f in self.enclosing_functions(lineno))
+
+    def in_sketch_boundary(self, lineno: int) -> bool:
+        """True when any enclosing function is a declared flat/ravel
+        boundary of the sketch path (G010's sanctioned sites)."""
+        return any(f.sketch_boundary
+                   for f in self.enclosing_functions(lineno))
 
     # -- import index --------------------------------------------------------
 

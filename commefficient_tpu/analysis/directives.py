@@ -8,6 +8,11 @@ Three forms, all line-anchored comments:
     # graftlint: drain-point             on/above a `def`: this function IS a
                                          sanctioned host-sync / blocking-IO
                                          boundary (G001/G007 exempt)
+    # graftlint: sketch-boundary         on/above a `def`: this function IS a
+                                         declared flat/ravel boundary of the
+                                         sketch path (G010 exempt) — the
+                                         ravel-path code that concatenates the
+                                         gradient ON PURPOSE
     # graftlint: module=<relpath>        fixture support: analyze this file as
                                          if it lived at <relpath> (scoped rules
                                          fire on test snippets)
@@ -44,6 +49,9 @@ class Directives:
     file_disables: set[str]
     # linenos carrying a drain-point marker
     drain_linenos: set[int]
+    # linenos carrying a sketch-boundary marker (G010's sanctioned ravel
+    # sites — the declared flat boundary of the sketch path)
+    sketch_boundary_linenos: set[int]
     # fixture impersonation path, or None
     module_override: str | None
     # (lineno, message) for malformed directives — surfaced as G000
@@ -97,7 +105,7 @@ def _comments(text: str) -> list[tuple[int, str]]:
 def parse(text: str, valid_codes: frozenset[str]) -> Directives:
     d = Directives(
         line_disables={}, file_disables=set(), drain_linenos=set(),
-        module_override=None, errors=[],
+        sketch_boundary_linenos=set(), module_override=None, errors=[],
     )
     for lineno, line in _comments(text):
         m = _DIRECTIVE_RE.search(line)
@@ -116,6 +124,8 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
                 _parse_codes(arg, lineno, valid_codes, d.errors))
         elif verb == "drain-point" and not has_eq:
             d.drain_linenos.add(lineno)
+        elif verb == "sketch-boundary" and not has_eq:
+            d.sketch_boundary_linenos.add(lineno)
         elif verb == "module" and has_eq:
             d.module_override = arg.strip()
         elif not verb:
@@ -124,6 +134,7 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
             d.errors.append((
                 lineno,
                 f"unknown graftlint directive {verb!r} "
-                "(expected disable/disable-file/drain-point/module)",
+                "(expected disable/disable-file/drain-point/"
+                "sketch-boundary/module)",
             ))
     return d

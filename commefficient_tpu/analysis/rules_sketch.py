@@ -1,0 +1,72 @@
+"""G010 flat-ravel-in-round-path.
+
+Sketch-as-you-backprop's load-bearing promise (sketch/layerwise.py): on the
+layerwise path the dense [d] gradient NEVER materializes — per-layer blocks
+fold straight into the r x c table, and peak live memory is O(r*c) plus one
+leaf instead of O(d) (+ the raveled copy + the [W, d] client stacks, the HBM
+ceiling ravel_pytree used to pin). A casual `ravel_pytree(...)` added to the
+round-path compiled scope re-introduces exactly that flat vector — silently,
+since the result is numerically identical — so the flat boundary must be
+DECLARED, not accidental.
+
+Detection:
+
+- any call resolving through the import table to
+  `jax.flatten_util.ravel_pytree` (or anything else under
+  `jax.flatten_util`), in the round-path compiled scope (modes/, sketch/,
+  federated/engine.py — the same whole-module treatment G001/G009 use);
+- unless an enclosing function carries `# graftlint: sketch-boundary`: the
+  ravel path's own functions ARE the declared flat boundary
+  (sketch_path="ravel" is the seed behavior and stays supported — the rule
+  bans *undeclared* flat materialization, not the ravel path itself).
+
+The `import` statement alone is not flagged (it moves no bytes); only the
+call that materializes the flat vector is.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+# round-path compiled scope: the modules whose functions may be (part of)
+# the compiled round program — same scope G009 uses
+_COMPILED_SCOPE = (
+    f"{PACKAGE}/modes/",
+    f"{PACKAGE}/sketch/",
+    f"{PACKAGE}/federated/engine.py",
+)
+
+_FLAT_PREFIX = "jax.flatten_util"
+
+
+class FlatRavelInRoundPath(Rule):
+    code = "G010"
+    name = "flat-ravel-in-round-path"
+    fixit = ("accumulate per-leaf instead (sketch/layerwise.py: "
+             "accumulate_leaf/sketch_tree/apply_delta_tree), or — if this "
+             "function IS the ravel path's declared flat boundary — mark "
+             "its def with `# graftlint: sketch-boundary` and say why")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(_COMPILED_SCOPE)
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = src.resolve_dotted(node.func)
+            if dotted is None or not dotted.startswith(f"{_FLAT_PREFIX}."):
+                continue
+            if src.in_sketch_boundary(node.lineno):
+                continue
+            out.append(self.violation(
+                src, node,
+                f"{dotted}() materializes the flat [d] vector in the "
+                "round-path compiled scope outside the declared sketch "
+                "boundary — the layerwise path exists so that vector "
+                "never has to exist",
+            ))
+        return out

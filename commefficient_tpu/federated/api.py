@@ -124,6 +124,7 @@ class FederatedSession:
         client_shards: int = 0,
         client_update_clip: float = 0.0,
         requeue_policy: str = "fifo",
+        sketch_path: str = "ravel",
     ):
         # client_shards: 0 = derive from the mesh (the default — on a >1-
         # device mesh with a mode in engine.supports_sharded_round's scope
@@ -141,6 +142,11 @@ class FederatedSession:
             dp_noise=dp_noise, client_dropout=client_dropout,
             client_chunk=client_chunk,
             client_update_clip=client_update_clip,
+            # sketch_path="layerwise": per-layer gradient blocks fold
+            # straight into the Count-Sketch table (sketch/layerwise.py) —
+            # the flat [d] gradient never materializes; pinned
+            # bit-identical to the default ravel path
+            sketch_path=sketch_path,
             # CLI "halt" is a host-side policy on top of the compiled "skip"
             # guard (state stays clean either way; the CLI decides to stop)
             on_nonfinite="skip" if on_nonfinite == "halt" else on_nonfinite,
@@ -380,7 +386,9 @@ class FederatedSession:
         resolve ambiently (ring attention's 'seq'); nullcontext otherwise so
         plain client-DP/TP meshes change nothing."""
         if self.mesh is not None and meshlib.SEQ_AXIS in self.mesh.axis_names:
-            return jax.set_mesh(self.mesh)
+            from ..utils import jax_compat
+
+            return jax_compat.set_mesh(self.mesh)
         return contextlib.nullcontext()
 
     def _state_donation(self) -> tuple:

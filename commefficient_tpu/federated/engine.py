@@ -85,6 +85,22 @@ class EngineConfig:
     # device and on a client_shards-way mesh (pinned by the CPU-mesh parity
     # tests), while different shard counts differ at fp-reassociation level.
     client_shards: int = 1
+    # How the round's sketch table is built (mode=sketch only):
+    # - "ravel" (default): every layer's gradient is concatenated into one
+    #   flat [d] vector (ravel_pytree) and compressed in one shot — the
+    #   seed behavior, bit-for-bit.
+    # - "layerwise": per-layer gradients come off the backward pass as a
+    #   pytree and each leaf folds DIRECTLY into the running r x c table
+    #   (sketch/layerwise.py) — the flat [d] gradient, its [W, d] /
+    #   [chunk, d] per-client stacks, and the flat params copy for the
+    #   delta apply never materialize. Pinned BIT-identical to the ravel
+    #   path (fused, split, sharded): sketch addition is the same ordered
+    #   float sum either way (csvec._sketch_vec_rotation's explicit slab
+    #   fold). Caveats: quarantine/dp_clip client norms are folded from
+    #   per-leaf partial sums (values equal to the flat norm only up to fp
+    #   association), and the random hash family requires num_blocks == 1
+    #   (the blocked ravel oracle associates differently).
+    sketch_path: str = "ravel"
     # Sketch-space quarantine (cohort-level fault tolerance): > 0 rejects any
     # client whose update L2 norm exceeds this multiple of the RUNNING MEDIAN
     # of live client norms (kept in server state, seeded by the first round's
@@ -120,6 +136,29 @@ class EngineConfig:
             raise ValueError(
                 f"on_nonfinite must be 'off' or 'skip', got {self.on_nonfinite!r}"
             )
+        if self.sketch_path not in ("ravel", "layerwise"):
+            raise ValueError(
+                f"sketch_path must be 'ravel' or 'layerwise', got "
+                f"{self.sketch_path!r}"
+            )
+        if self.sketch_path == "layerwise":
+            if self.mode.mode != "sketch":
+                raise ValueError(
+                    "sketch_path='layerwise' accumulates per-layer gradient "
+                    "blocks into the Count-Sketch table, so it requires "
+                    f"mode='sketch'; mode={self.mode.mode!r} has no table "
+                    "to accumulate into"
+                )
+            if self.mode.hash_family == "random" and self.mode.num_blocks != 1:
+                raise ValueError(
+                    "sketch_path='layerwise' with hash_family='random' "
+                    "requires num_blocks=1: the blocked ravel oracle sums "
+                    "per-block partial tables (a different fp association "
+                    "than the continuous coordinate fold), which would "
+                    "break the layerwise==ravel bit-parity contract. Use "
+                    "num_blocks=1 (layerwise transients are O(leaf) anyway) "
+                    "or hash_family='rotation'."
+                )
         if self.dp_noise > 0 and self.dp_clip <= 0:
             raise ValueError("dp_noise > 0 requires dp_clip > 0 (unbounded "
                              "sensitivity has no meaningful noise scale)")
@@ -429,6 +468,103 @@ def _weighted_client_reduce(
     return acc + (part_eff, norms)
 
 
+def _client_norms_tree(updates_tree) -> jnp.ndarray:
+    """[W] per-client update L2 norms from a PYTREE of [W, ...] leaves:
+    per-leaf squared sums folded in ravel leaf order (f32 accumulation).
+    The layerwise counterpart of `_client_norms` — equal to the flat-vector
+    norm only up to fp association (the flat path reduces one contiguous
+    [d] axis; this folds per-leaf partials), which is why the quarantine
+    median metric is pinned across sketch paths at tolerance, not bitwise."""
+    total = None
+    for leaf in jax.tree.leaves(updates_tree):
+        s = jnp.sum(jnp.square(leaf.astype(jnp.float32)),
+                    axis=tuple(range(1, leaf.ndim)))
+        total = s if total is None else total + s
+    return jnp.sqrt(total)
+
+
+def _clip_updates_tree(cfg: EngineConfig, updates_tree):
+    """Per-client L2 clip over a pytree of [W, ...] leaves (DP) — the tree
+    mirror of `_clip_updates` (same clip factor formula; the norm folds per
+    leaf, see _client_norms_tree)."""
+    if cfg.dp_clip <= 0:
+        return updates_tree
+    nrm = _client_norms_tree(updates_tree)
+    fac = jnp.minimum(1.0, cfg.dp_clip / jnp.maximum(nrm, 1e-12))
+    return jax.tree.map(lambda l: l * modes.bcast(fac, l), updates_tree)
+
+
+def _weighted_client_reduce_tree(
+    cfg: EngineConfig, grad_client_tree: Callable,
+    params, net_state, batch, client_rngs, part,
+    *, qmed=None, nan_safe: bool = False,
+):
+    """The layerwise (`sketch_path="layerwise"`) mirror of
+    `_weighted_client_reduce`: identical participation weighting, validity
+    masking, quarantine screen, DP clip, and chunked-scan structure — but
+    per-client updates stay a PYTREE of per-layer leaves ([W, ...leaf]) and
+    the weighted sums are taken per leaf, so the flat [d] gradient (and its
+    [W, d]/[chunk, d] stacks) never materializes. Per coordinate the
+    client-axis sums are the same ordered fp reduction as the flat path's,
+    which is what keeps the downstream sketch bit-identical. Returns
+    (wsum_tree, ns_sum, m_sum, part_eff, norms). Kept as a deliberate
+    structural mirror rather than a shared polymorphic body: the ravel
+    path's compiled program must stay byte-for-byte the seed's."""
+    nan_safe = nan_safe or cfg.client_update_clip > 0
+
+    def chunk(cb, crngs, cpart):
+        updates, nstates, metrics = jax.vmap(
+            lambda b, r: grad_client_tree(params, net_state, b, r)
+        )(cb, crngs)
+        norms_c = None
+        if cfg.client_update_clip > 0:
+            norms_c = _client_norms_tree(updates)
+            bad = _quarantine_mask(cfg, norms_c, qmed)
+            cpart = cpart * (1.0 - bad.astype(cpart.dtype))
+        updates = _clip_updates_tree(cfg, updates)
+        if nan_safe:
+            wsum = jax.tree.map(
+                lambda l: modes.mask_rows(cpart, l).sum(axis=0), updates)
+            ns_sum = jax.tree.map(
+                lambda s: modes.mask_rows(cpart, s).sum(0), nstates)
+            m_sum = jax.tree.map(
+                lambda m: modes.mask_rows(cpart, m).sum(axis=0), metrics)
+        else:
+            wsum = jax.tree.map(
+                lambda l: (l * modes.bcast(cpart, l)).sum(axis=0), updates)
+            ns_sum = jax.tree.map(
+                lambda s: (s * modes.bcast(cpart, s)).sum(0), nstates)
+            m_sum = jax.tree.map(
+                lambda m: jnp.sum(m * modes.bcast(cpart, m), axis=0), metrics)
+        return wsum, ns_sum, m_sum, cpart, norms_c
+
+    W = part.shape[0]
+    C = cfg.client_chunk
+    if not C or C >= W:
+        return chunk(batch, client_rngs, part)
+    if W % C:
+        raise ValueError(
+            f"client_chunk={C} must divide the sampled cohort ({W})"
+        )
+    re = lambda a: a.reshape((W // C, C) + a.shape[1:])  # noqa: E731
+    xs = (jax.tree.map(re, batch),
+          client_rngs.reshape((W // C, C) + client_rngs.shape[1:]),
+          part.reshape(W // C, C))
+    shapes = jax.eval_shape(chunk, *jax.tree.map(lambda a: a[0], xs))
+    init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes[:3])
+
+    def body(carry, x):
+        wsum, ns_sum, m_sum, cpart_eff, norms_c = chunk(*x)
+        carry = jax.tree.map(jnp.add, carry, (wsum, ns_sum, m_sum))
+        return carry, (cpart_eff, norms_c)
+
+    acc, (pe, norms) = jax.lax.scan(body, init, xs)
+    part_eff = pe.reshape(W)
+    if norms is not None:
+        norms = norms.reshape(W)
+    return acc + (part_eff, norms)
+
+
 def _finalize_client_reduce(mcfg: ModeConfig, wsum, ns_sum, m_sum, net_state, part):
     """Normalize the weighted SUMS from `_weighted_client_reduce`: the reduced
     update (survivor mean unless agg_op=sum), the survivor-mean mutable
@@ -453,6 +589,18 @@ def _compress_reduced(mcfg: ModeConfig, weighted) -> dict:
     return modes.aggregate(mcfg, jax.tree.map(lambda x: x[None], agg))
 
 
+# graftlint: sketch-boundary — THE ravel path's sanctioned flat params
+# materialization: every round-path `pflat, unravel` routes through here so
+# the step bodies themselves stay G010-guarded (a ravel_pytree added inside
+# one fires the rule; the layerwise path never calls this)
+def _ravel_params(params):
+    """Flat [d] params view + unravel for sketch_path="ravel"."""
+    return ravel_pytree(params)
+
+
+# graftlint: sketch-boundary — the ravel path's declared flat boundary: the
+# per-client gradient is raveled here ON PURPOSE (sketch_path="ravel", the
+# seed behavior); the layerwise path uses _make_grad_client_tree instead
 def _make_grad_client(loss_fn: Callable, cfg: EngineConfig) -> Callable:
     """One client's contribution for grad-based modes: flat gradient (+ weight
     decay, applied client-side as in the reference workers — SURVEY.md §3.1),
@@ -467,6 +615,55 @@ def _make_grad_client(loss_fn: Callable, cfg: EngineConfig) -> Callable:
         return gflat, aux["net_state"], aux["metrics"]
 
     return grad_client
+
+
+def _make_grad_client_tree(loss_fn: Callable, cfg: EngineConfig) -> Callable:
+    """The layerwise mirror of `_make_grad_client`: per-layer gradients stay
+    a pytree (no ravel — each leaf folds straight into the sketch table
+    downstream). Weight decay applies per leaf, unconditionally like the
+    flat path's `gflat + wd * pflat` (same per-coordinate arithmetic, so
+    wd == 0 keeps the identical ±0.0 additions)."""
+
+    def grad_client(params, net_state, cbatch, rng):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, net_state, cbatch, rng
+        )
+        grads = jax.tree.map(
+            lambda g, p: g + cfg.weight_decay * p, grads, params)
+        return grads, aux["net_state"], aux["metrics"]
+
+    return grad_client
+
+
+def _layerwise_normalize(mcfg: ModeConfig, wsum_tree, n_live):
+    """Survivor normalization of the per-leaf weighted sums — the tree
+    mirror of `_finalize_client_reduce`'s `wsum / n_live` (elementwise, so
+    the downstream sketch sees the identical values)."""
+    if mcfg.agg_op == "sum":
+        return wsum_tree
+    return jax.tree.map(lambda l: l / n_live, wsum_tree)
+
+
+def _layerwise_compress(mcfg: ModeConfig, tree, plan) -> dict:
+    """Fold a (normalized or partial) update pytree into the sketch wire —
+    the layerwise counterpart of `_compress_reduced`/`client_compress` for
+    mode=sketch, bit-identical to sketching the raveled vector."""
+    from ..sketch import layerwise as sketch_layerwise
+
+    return {"table": sketch_layerwise.sketch_tree(
+        mcfg.sketch_spec, tree, plan)}
+
+
+def _layerwise_plan(mcfg: ModeConfig, params):
+    from ..sketch import layerwise as sketch_layerwise
+
+    return sketch_layerwise.make_block_plan(mcfg.sketch_spec, params)
+
+
+def _layerwise_apply(params, delta: dict, plan):
+    from ..sketch import layerwise as sketch_layerwise
+
+    return sketch_layerwise.apply_delta_tree(params, delta, plan)
 
 
 def make_round_step(
@@ -488,7 +685,13 @@ def make_round_step(
     """
     mcfg = cfg.mode
     grad_client = _make_grad_client(loss_fn, cfg)
+    layerwise = cfg.sketch_path == "layerwise"
+    grad_client_tree = (_make_grad_client_tree(loss_fn, cfg) if layerwise
+                        else None)
 
+    # graftlint: sketch-boundary — weight-delta modes (fedavg/localSGD) run
+    # their local-SGD loop over the flat params by design; out of the
+    # layerwise scope (mode=sketch never takes this branch)
     def local_sgd_client(params, pflat, net_state, cbatch, rng, lr):
         _, unravel = ravel_pytree(params)
         # client-local momentum over the local iterations (fedavg "local
@@ -517,7 +720,10 @@ def make_round_step(
     def step(state, batch, client_rows, lr, rng):
         batch, valid = split_valid(batch)
         params, net_state = state["params"], state["net_state"]
-        pflat, unravel = ravel_pytree(params)
+        if layerwise:
+            plan = _layerwise_plan(mcfg, params)
+        else:
+            pflat, unravel = _ravel_params(params)
         num_sampled = jax.tree.leaves(batch)[0].shape[0]
         # Dedicated streams: in JAX's threefry PRNG, fold_in(key, i) ==
         # split(key, n)[i], so deriving the DP noise key by folding the same
@@ -544,14 +750,31 @@ def make_round_step(
             # folds into the same reduction (survivor mean = sum(part·u) /
             # count(part); sum drops the /), and the reduce itself may run
             # chunked (cfg.client_chunk) so W full gradients never coexist.
-            wsum, ns_sum, m_sum, part_eff, norms = _weighted_client_reduce(
-                cfg, grad_client, params, pflat, net_state, batch,
-                client_rngs, part, qmed=qmed, nan_safe=valid is not None,
-            )
-            weighted, new_net_state, out_metrics = _finalize_client_reduce(
-                mcfg, wsum, ns_sum, m_sum, net_state, part_eff
-            )
-            agg = _compress_reduced(mcfg, weighted)
+            if layerwise:
+                # sketch-as-you-backprop: per-layer grads reduce per leaf
+                # and fold straight into the running r x c table — the flat
+                # [d] gradient never materializes (bit-identical to the
+                # ravel branch below, see EngineConfig.sketch_path)
+                wsum, ns_sum, m_sum, part_eff, norms = (
+                    _weighted_client_reduce_tree(
+                        cfg, grad_client_tree, params, net_state, batch,
+                        client_rngs, part, qmed=qmed,
+                        nan_safe=valid is not None,
+                    ))
+                weighted = _layerwise_normalize(
+                    mcfg, wsum, jnp.maximum(part_eff.sum(), 1.0))
+                new_net_state, out_metrics = _merged_survivor_finalize(
+                    ns_sum, m_sum, part_eff, net_state)
+                agg = _layerwise_compress(mcfg, weighted, plan)
+            else:
+                wsum, ns_sum, m_sum, part_eff, norms = _weighted_client_reduce(
+                    cfg, grad_client, params, pflat, net_state, batch,
+                    client_rngs, part, qmed=qmed, nan_safe=valid is not None,
+                )
+                weighted, new_net_state, out_metrics = _finalize_client_reduce(
+                    mcfg, wsum, ns_sum, m_sum, net_state, part_eff
+                )
+                agg = _compress_reduced(mcfg, weighted)
             new_rows = client_rows
         else:
             if mcfg.uses_weight_delta:
@@ -620,8 +843,10 @@ def make_round_step(
         server_lr = jnp.float32(mcfg.server_lr) if mcfg.uses_weight_delta else lr
         delta, mode_state = modes.server_step_sparse(
             mcfg, agg, state["mode_state"], server_lr)
+        new_params = (_layerwise_apply(params, delta, plan) if layerwise
+                      else unravel(modes.apply_delta(pflat, delta)))
         new_state = {
-            "params": unravel(modes.apply_delta(pflat, delta)),
+            "params": new_params,
             "net_state": new_net_state,
             "mode_state": mode_state,
             "round": state["round"] + 1,
@@ -717,10 +942,12 @@ def _merged_sharded_tail(
     shards; `part`/`norms` only exist with the quarantine armed (part = the
     pre-quarantine mask, for the quarantined count)."""
     mcfg = cfg.mode
+    layerwise = cfg.sketch_path == "layerwise"
     wire_sum = modes.merge_partial_wires(mcfg, stacked_wire)
     ns_sum = jax.tree.map(lambda x: x.sum(axis=0), stacked_ns)
     m_sum = jax.tree.map(lambda x: x.sum(axis=0), stacked_m)
-    pflat, unravel = ravel_pytree(state["params"])
+    if not layerwise:
+        pflat, unravel = _ravel_params(state["params"])
     agg = _normalize_merged_wire(mcfg, wire_sum,
                                  jnp.maximum(part_eff.sum(), 1.0))
     new_net_state, out_metrics = _merged_survivor_finalize(
@@ -738,8 +965,12 @@ def _merged_sharded_tail(
         agg = _dp_noise_agg(cfg, agg, part_eff.sum() * fin_ok, noise_rng)
     delta, mode_state = modes.server_step_sparse(
         mcfg, agg, state["mode_state"], lr)
+    new_params = (
+        _layerwise_apply(state["params"], delta,
+                         _layerwise_plan(mcfg, state["params"]))
+        if layerwise else unravel(modes.apply_delta(pflat, delta)))
     new_state = {
-        "params": unravel(modes.apply_delta(pflat, delta)),
+        "params": new_params,
         "net_state": new_net_state,
         "mode_state": mode_state,
         "round": state["round"] + 1,
@@ -813,20 +1044,35 @@ def make_sharded_round_step(
             "client shard); use make_round_step for the unsharded round"
         )
     grad_client = _make_grad_client(loss_fn, cfg)
+    layerwise = cfg.sketch_path == "layerwise"
+    grad_client_tree = (_make_grad_client_tree(loss_fn, cfg) if layerwise
+                        else None)
     quarantine = cfg.client_update_clip > 0
 
     def local_phase(params, pflat, net_state, qmed, batch_l, rngs_l, part_l):
         """One shard's client phase. Returns (wire, ns_sum, m_sum, part_eff)
         plus, with the quarantine armed, (part_valid, norms) — the per-shard
-        slices the merged tail reassembles into cohort-order [W] vectors."""
+        slices the merged tail reassembles into cohort-order [W] vectors.
+        On the layerwise path the shard's partial Count Sketch accumulates
+        straight from the per-leaf weighted sums — the shard's dense [d]
+        partial never exists either (pflat is None there)."""
         batch_l, valid_l = split_valid(batch_l)
         if valid_l is not None:
             part_l = part_l * valid_l
-        wsum, ns_sum, m_sum, part_eff_l, norms_l = _weighted_client_reduce(
-            cfg, grad_client, params, pflat, net_state, batch_l, rngs_l,
-            part_l, qmed=qmed, nan_safe=valid_l is not None,
-        )
-        wire, _ = modes.client_compress(mcfg, wsum, {})
+        if layerwise:
+            wsum, ns_sum, m_sum, part_eff_l, norms_l = (
+                _weighted_client_reduce_tree(
+                    cfg, grad_client_tree, params, net_state, batch_l,
+                    rngs_l, part_l, qmed=qmed, nan_safe=valid_l is not None,
+                ))
+            wire = _layerwise_compress(mcfg, wsum,
+                                       _layerwise_plan(mcfg, params))
+        else:
+            wsum, ns_sum, m_sum, part_eff_l, norms_l = _weighted_client_reduce(
+                cfg, grad_client, params, pflat, net_state, batch_l, rngs_l,
+                part_l, qmed=qmed, nan_safe=valid_l is not None,
+            )
+            wire, _ = modes.client_compress(mcfg, wsum, {})
         if quarantine:
             return wire, ns_sum, m_sum, part_eff_l, part_l, norms_l
         return wire, ns_sum, m_sum, part_eff_l
@@ -847,7 +1093,7 @@ def make_sharded_round_step(
     if mesh is None:
         def step(state, batch, client_rows, lr, rng):
             params, net_state = state["params"], state["net_state"]
-            pflat, _ = ravel_pytree(params)
+            pflat = None if layerwise else _ravel_params(params)[0]
             W = jax.tree.leaves(batch)[0].shape[0]
             if W % S:
                 raise ValueError(
@@ -883,8 +1129,9 @@ def make_sharded_round_step(
 
         return step
 
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..utils.jax_compat import shard_map
 
     from ..parallel import mesh as meshlib
 
@@ -902,7 +1149,7 @@ def make_sharded_round_step(
 
     def body(state, batch_l, lr, rng):
         params, net_state = state["params"], state["net_state"]
-        pflat, _ = ravel_pytree(params)
+        pflat = None if layerwise else _ravel_params(params)[0]
         wl = jax.tree.leaves(batch_l)[0].shape[0]
         # replicated derivation of the FULL cohort's streams on every
         # device, then this shard's contiguous slice — per-client rng
@@ -963,6 +1210,14 @@ def make_sharded_split_round_step(
     signature arity as make_split_round_step, so compose_split and the
     session's split wiring work unchanged. Bit-identical to
     make_sharded_round_step on the same mesh (pinned in tests).
+
+    sketch_path="layerwise": each shard's partial Count Sketch accumulates
+    from the per-leaf weighted sums INSIDE the client program (pure-JAX
+    roll+add — still Mosaic-free) and is all_gathered there, so the program
+    boundary carries the replicated [S, r, c] partial tables instead of a
+    per-device-resident [S, d] dense stack; neither the flat gradient nor
+    the flat params copy ever exists. The server program keeps the
+    Pallas-bearing unsketch/query algebra.
     """
     mcfg = cfg.mode
     _sharded_scope_check(mcfg)
@@ -981,9 +1236,13 @@ def make_sharded_split_round_step(
             f"{S}-way client mesh"
         )
     grad_client = _make_grad_client(loss_fn, cfg)
+    layerwise = cfg.sketch_path == "layerwise"
+    grad_client_tree = (_make_grad_client_tree(loss_fn, cfg) if layerwise
+                        else None)
 
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..utils.jax_compat import shard_map
 
     from ..parallel import mesh as meshlib
 
@@ -998,7 +1257,7 @@ def make_sharded_split_round_step(
     def client_body(state, batch_l, lr, rng):
         params, net_state = state["params"], state["net_state"]
         batch_l, valid_l = split_valid(batch_l)
-        pflat, _ = ravel_pytree(params)
+        pflat = None if layerwise else _ravel_params(params)[0]
         wl = jax.tree.leaves(batch_l)[0].shape[0]
         all_rngs, part, noise_rng = _cohort_streams(cfg, rng, wl * S)
         qmed = state["quarantine"]["median"] if quarantine else None
@@ -1007,10 +1266,25 @@ def make_sharded_split_round_step(
         part_l = jax.lax.dynamic_slice_in_dim(part, lo, wl)
         if valid_l is not None:
             part_l = part_l * valid_l
-        wsum_l, ns_l, m_l, pe_l, norms_l = _weighted_client_reduce(
-            cfg, grad_client, params, pflat, net_state, batch_l, rngs_l,
-            part_l, qmed=qmed, nan_safe=valid_l is not None,
-        )
+        if layerwise:
+            wsum_l, ns_l, m_l, pe_l, norms_l = _weighted_client_reduce_tree(
+                cfg, grad_client_tree, params, net_state, batch_l, rngs_l,
+                part_l, qmed=qmed, nan_safe=valid_l is not None,
+            )
+            # this shard's partial table, built straight from the per-leaf
+            # sums: the dense [d] partial never exists, and the [r, c]
+            # table is what crosses the program boundary (gathered below)
+            table_l = _layerwise_compress(
+                mcfg, wsum_l, _layerwise_plan(mcfg, params))["table"]
+            wire_out = jax.lax.all_gather(table_l, axis_names, axis=0)
+            fin_l = jnp.isfinite(table_l).all()[None]
+        else:
+            wsum_l, ns_l, m_l, pe_l, norms_l = _weighted_client_reduce(
+                cfg, grad_client, params, pflat, net_state, batch_l, rngs_l,
+                part_l, qmed=qmed, nan_safe=valid_l is not None,
+            )
+            wire_out = wsum_l[None]
+            fin_l = jnp.isfinite(wsum_l).all()[None]
         gathered = (ns_l, m_l, pe_l) + ((part_l, norms_l) if quarantine
                                         else ())
         stacked = jax.tree.map(
@@ -1020,15 +1294,17 @@ def make_sharded_split_round_step(
         # (compression propagates every NaN/Inf — the same equivalence
         # make_split_round_step already relies on); gathered here so both
         # programs share the identical verdict
-        parts_ok = jax.lax.all_gather(
-            jnp.isfinite(wsum_l).all()[None], axis_names, axis=0).all()
-        return (wsum_l[None],) + stacked + (noise_rng, parts_ok)
+        parts_ok = jax.lax.all_gather(fin_l, axis_names, axis=0).all()
+        return (wire_out,) + stacked + (noise_rng, parts_ok)
 
     n_gathered = 5 if quarantine else 3
     client_mapped = shard_map(
         client_body, mesh=mesh,
         in_specs=(P(), P(axes), P(), P()),
-        out_specs=(P(axes),) + tuple(P() for _ in range(n_gathered + 2)),
+        # layerwise: the boundary object is the gathered [S, r, c] table
+        # stack, replicated; ravel: the [S, d] dense partials, sharded
+        out_specs=((P() if layerwise else P(axes),)
+                   + tuple(P() for _ in range(n_gathered + 2))),
         check_rep=False,
     )
 
@@ -1069,8 +1345,14 @@ def make_sharded_split_round_step(
 
     def server_step(state, wpart, new_net_state, participants, lr, noise_rng,
                     qmed=None):
-        stacked_wire, parts_ok = server_mapped(wpart)
-        pflat, unravel = ravel_pytree(state["params"])
+        if layerwise:
+            # wpart is the replicated [S, r, c] partial-table stack the
+            # client program gathered; nothing dense to compress here
+            stacked_wire = {"table": wpart}
+            parts_ok = jnp.isfinite(wpart).all()
+        else:
+            stacked_wire, parts_ok = server_mapped(wpart)
+            pflat, unravel = _ravel_params(state["params"])
         wire_sum = modes.merge_partial_wires(mcfg, stacked_wire)
         agg = _normalize_merged_wire(
             mcfg, wire_sum, jnp.maximum(participants, 1.0))
@@ -1089,8 +1371,12 @@ def make_sharded_split_round_step(
             agg = _dp_noise_agg(cfg, agg, participants, noise_rng)
         delta, mode_state = modes.server_step_sparse(
             mcfg, agg, state["mode_state"], lr)
+        new_params = (
+            _layerwise_apply(state["params"], delta,
+                             _layerwise_plan(mcfg, state["params"]))
+            if layerwise else unravel(modes.apply_delta(pflat, delta)))
         new_state = {
-            "params": unravel(modes.apply_delta(pflat, delta)),
+            "params": new_params,
             "net_state": new_net_state,
             "mode_state": mode_state,
             "round": state["round"] + 1,
@@ -1128,6 +1414,12 @@ def make_split_round_step(
     both take the linear-mode shortcut — which is also the supported scope
     (linear mode, no client-local state, no weight-delta local loop), exactly
     the flagship sketch configuration.
+
+    sketch_path="layerwise" moves the table accumulation INTO the client
+    program (pure-JAX roll+add — Mosaic-free by construction, so the
+    isolation story is intact; the Pallas-bearing unsketch/query algebra
+    stays in the server program) and the program boundary carries the r x c
+    wire table instead of the dense [d] reduced update.
     """
     mcfg = cfg.mode
     if not (modes.is_linear(mcfg) and not mcfg.needs_local_state
@@ -1139,13 +1431,16 @@ def make_split_round_step(
             f"{mcfg.momentum_type!r} needs the fused make_round_step"
         )
     grad_client = _make_grad_client(loss_fn, cfg)
+    layerwise = cfg.sketch_path == "layerwise"
+    grad_client_tree = (_make_grad_client_tree(loss_fn, cfg) if layerwise
+                        else None)
 
     quarantine = cfg.client_update_clip > 0
 
     def client_step(state, batch, lr, rng):
         batch, valid = split_valid(batch)
         params, net_state = state["params"], state["net_state"]
-        pflat, _ = ravel_pytree(params)
+        pflat = None if layerwise else _ravel_params(params)[0]
         num_sampled = jax.tree.leaves(batch)[0].shape[0]
         # identical stream derivation to the fused step (see its comment on
         # fold_in collisions), so split == fused holds bit-for-bit
@@ -1156,13 +1451,27 @@ def make_split_round_step(
             part = part * valid
         qmed = state["quarantine"]["median"] if quarantine else None
 
-        wsum, ns_sum, m_sum, part_eff, norms = _weighted_client_reduce(
-            cfg, grad_client, params, pflat, net_state, batch, client_rngs,
-            part, qmed=qmed, nan_safe=valid is not None,
-        )
-        weighted, new_net_state, out_metrics = _finalize_client_reduce(
-            mcfg, wsum, ns_sum, m_sum, net_state, part_eff
-        )
+        if layerwise:
+            wsum, ns_sum, m_sum, part_eff, norms = (
+                _weighted_client_reduce_tree(
+                    cfg, grad_client_tree, params, net_state, batch,
+                    client_rngs, part, qmed=qmed, nan_safe=valid is not None,
+                ))
+            weighted = _layerwise_compress(
+                mcfg,
+                _layerwise_normalize(mcfg, wsum,
+                                     jnp.maximum(part_eff.sum(), 1.0)),
+                _layerwise_plan(mcfg, params))
+            new_net_state, out_metrics = _merged_survivor_finalize(
+                ns_sum, m_sum, part_eff, net_state)
+        else:
+            wsum, ns_sum, m_sum, part_eff, norms = _weighted_client_reduce(
+                cfg, grad_client, params, pflat, net_state, batch, client_rngs,
+                part, qmed=qmed, nan_safe=valid is not None,
+            )
+            weighted, new_net_state, out_metrics = _finalize_client_reduce(
+                mcfg, wsum, ns_sum, m_sum, net_state, part_eff
+            )
         if quarantine:
             out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()
             out_metrics["quarantine_median"] = _update_running_median(
@@ -1171,16 +1480,20 @@ def make_split_round_step(
             # same verdict the fused step computes from the compressed agg:
             # compression (sketch sums / dense passthrough) propagates every
             # NaN/Inf, so finiteness of `weighted` == finiteness of the wire
-            ok = jnp.isfinite(weighted).all() & _tree_finite(new_net_state)
+            # (on the layerwise path `weighted` IS the wire table — the
+            # identical object the fused guard inspects)
+            ok = _tree_finite(weighted) & _tree_finite(new_net_state)
             out_metrics = _skip_metrics(ok, out_metrics)
         return weighted, new_net_state, out_metrics, noise_rng
 
     def server_step(state, weighted, new_net_state, participants, lr,
                     noise_rng, qmed=None):
-        pflat, unravel = ravel_pytree(state["params"])
+        if not layerwise:
+            pflat, unravel = _ravel_params(state["params"])
         if cfg.on_nonfinite == "skip":
-            ok = jnp.isfinite(weighted).all() & _tree_finite(new_net_state)
-            weighted = jnp.where(ok, weighted, jnp.zeros_like(weighted))
+            ok = _tree_finite(weighted) & _tree_finite(new_net_state)
+            weighted = jax.tree.map(
+                lambda a: jnp.where(ok, a, jnp.zeros_like(a)), weighted)
             new_net_state = jax.tree.map(
                 lambda new, old: jnp.where(ok, new, old),
                 new_net_state, state["net_state"],
@@ -1188,13 +1501,17 @@ def make_split_round_step(
             # a skipped round transmits nothing and must release nothing:
             # zero the count so _dp_noise_agg's empty-round gate kicks in
             participants = participants * ok
-        agg = _compress_reduced(mcfg, weighted)
+        agg = weighted if layerwise else _compress_reduced(mcfg, weighted)
         if cfg.dp_noise > 0:
             agg = _dp_noise_agg(cfg, agg, participants, noise_rng)
         delta, mode_state = modes.server_step_sparse(
             mcfg, agg, state["mode_state"], lr)
+        new_params = (
+            _layerwise_apply(state["params"], delta,
+                             _layerwise_plan(mcfg, state["params"]))
+            if layerwise else unravel(modes.apply_delta(pflat, delta)))
         new_state = {
-            "params": unravel(modes.apply_delta(pflat, delta)),
+            "params": new_params,
             "net_state": new_net_state,
             "mode_state": mode_state,
             "round": state["round"] + 1,

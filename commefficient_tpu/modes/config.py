@@ -45,6 +45,23 @@ class ModeConfig:
     # makes the question moot by construction.
     topk_recall: float = 0.95  # approx_max_k recall_target for
     # topk_impl="approx" and for oversample's preselect pass.
+    server_state: str = "dense"  # representation of the SERVER optimizer
+    # state (Vvelocity/Verror): "dense" keeps the [d] vectors (the seed
+    # behavior, bit-for-bit); "sketch" keeps them as r x c Count-Sketch
+    # tables updated by table arithmetic (arXiv:1902.00179 — momentum and
+    # error feedback in sketch space), with `unsketch_topk` unchanged
+    # downstream, so server memory stops scaling with d: O(r*c) replaces
+    # O(2d). Scope: the top-k-release modes (true_topk; local_topk with
+    # error_type virtual) — mode=sketch already IS sketch-state
+    # (FetchSGD Alg. 1), both values are accepted there and mean the same
+    # thing. The client wire stays what the mode says it is (dense for
+    # true_topk/local_topk), so the DP noise hook keeps its calibrated
+    # dense-wire sensitivity; the server sketches AFTER aggregation/noise.
+    # Exactness: with c >= d (and the rotation family) every row is a
+    # signed permutation — collisions are impossible, estimates are exact,
+    # and sketch-state is BIT-identical to dense-state (pinned in
+    # tests/test_layerwise.py); with c < d it is the FetchSGD-style
+    # approximation (heavy hitters survive, small coordinates blur).
     agg_op: str = "mean"  # how client wires combine: "mean" | "sum".
     # FetchSGD Alg. 1 writes the round sketch as a sum over client sketches
     # (SURVEY.md §3.1) with the scaling absorbed into the learning rate; this
@@ -78,6 +95,31 @@ class ModeConfig:
             raise ValueError(f"bad error_type {self.error_type!r}")
         if self.agg_op not in ("mean", "sum"):
             raise ValueError(f"bad agg_op {self.agg_op!r}; expected 'mean' or 'sum'")
+        if self.server_state not in ("dense", "sketch"):
+            raise ValueError(
+                f"bad server_state {self.server_state!r}; expected 'dense' "
+                "or 'sketch'")
+        if self.server_state == "sketch" and self.mode != "sketch":
+            if self.mode not in ("true_topk", "local_topk"):
+                raise ValueError(
+                    f"server_state='sketch' needs a top-k release to stay in "
+                    f"sketch space; mode={self.mode!r} releases a dense delta "
+                    "(querying every coordinate back out would materialize "
+                    "[d] and defeat the O(r*c) state)"
+                )
+            if self.mode == "local_topk" and self.error_type != "virtual":
+                raise ValueError(
+                    "server_state='sketch' with mode='local_topk' requires "
+                    "error_type='virtual': only the virtual-error branch "
+                    "releases a top-k (the others release lr*V densely, "
+                    "which a sketch-resident V cannot produce without "
+                    "querying every coordinate back out)"
+                )
+            if self.num_cols <= 0:
+                raise ValueError(
+                    "server_state='sketch' requires num_cols > 0 (the "
+                    "r x c table shape comes from num_rows/num_cols)"
+                )
         if self.server_lr != 1.0 and self.mode not in ("fedavg", "localSGD"):
             raise ValueError(
                 "server_lr applies only to weight-delta modes (fedavg/localSGD); "

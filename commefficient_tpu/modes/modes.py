@@ -70,8 +70,11 @@ def is_linear(cfg: ModeConfig) -> bool:
 
 def init_server_state(cfg: ModeConfig) -> dict:
     """Vvelocity / Verror, shaped for the mode. Always present (zeros) so the
-    step signature is mode-independent; unused pieces are never touched."""
-    if cfg.mode == "sketch":
+    step signature is mode-independent; unused pieces are never touched.
+    server_state="sketch" keeps the state as r x c tables for the top-k
+    release modes too (see ModeConfig.server_state) — O(r*c) server memory
+    instead of O(2d)."""
+    if cfg.mode == "sketch" or cfg.server_state == "sketch":
         shape = cfg.sketch_spec.table_shape
     else:
         shape = (cfg.d,)
@@ -254,6 +257,35 @@ def server_step_sparse(
         return {"idx": idx, "vals": vals}, {"Vvelocity": V, "Verror": E}
 
     g = agg["dense"]
+
+    if (cfg.server_state == "sketch"
+            and cfg.mode in ("true_topk", "local_topk")):
+        # Count-sketched server optimizer state (arXiv:1902.00179): the
+        # client wire stays dense (DP noise above already calibrated to
+        # it), but momentum and virtual error feedback live as r x c
+        # tables — V = rho*V + sketch(g) — and the release is
+        # unsketch_topk, exactly the FetchSGD tail. Server memory is
+        # O(r*c) instead of O(2d). With c >= d (rotation family) every
+        # row is a signed permutation, estimates are exact, and this
+        # branch is BIT-identical to the dense branches below (pinned in
+        # tests/test_layerwise.py); with c < d it is the sketch
+        # approximation. local_topk reaches here only with
+        # error_type='virtual' (ModeConfig validation): the other error
+        # types release dense deltas a sketch-resident V cannot produce.
+        spec = cfg.sketch_spec
+        V = rho * sstate["Vvelocity"] + csvec.sketch_vec(spec, g)
+        use_error = cfg.error_type == "virtual"
+        E = sstate["Verror"] + lr * V if use_error else lr * V
+        idx, vals = csvec.unsketch_topk(spec, E, cfg.k, impl=cfg.topk_impl,
+                                        recall=cfg.topk_recall)
+        if use_error:
+            V, E = csvec.mask_transmitted(spec, V, E, idx, vals)
+            return {"idx": idx, "vals": vals}, {"Vvelocity": V, "Verror": E}
+        # no error accumulator: mask V's transmitted mass only (the sketch
+        # analogue of true_topk's V.at[idx].set(0))
+        V = V - csvec.sketch_sparse(spec, idx, csvec.query(spec, V, idx))
+        return {"idx": idx, "vals": vals}, {
+            "Vvelocity": V, "Verror": sstate["Verror"]}
 
     if cfg.mode == "true_topk":
         V = rho * sstate["Vvelocity"] + g

@@ -266,6 +266,9 @@ def run_loop(
     reg = obreg.default()
     mark = reg.mark()
     tracer = obtrace.get()
+    # device-phase span attribute: which sketch accumulation program the
+    # session compiled (EngineConfig.sketch_path; "ravel" unless layerwise)
+    sketch_path = getattr(session.cfg, "sketch_path", "ravel")
     phase_hist = {ph: reg.histogram(f"runner_phase_{ph}_ms")
                   for ph in obreg.RUNNER_PHASES}
     profile = ProfileWindow.parse(cfg.profile_rounds, cfg.profile_dir)
@@ -378,13 +381,17 @@ def run_loop(
                 hosts = jax.device_get([fl.metrics for fl in pending])
         phase_hist["drain"].observe((time.perf_counter() - t_drain0) * 1e3)
         # deferred device-phase spans: each dispatch recorded only a host
-        # timestamp; the span closes HERE, where its rounds are known done
+        # timestamp; the span closes HERE, where its rounds are known done.
+        # sketch_path names the compiled round variant (ravel | layerwise)
+        # so a trace shows which accumulation program the device time
+        # belongs to when A/B-ing the two arms.
         end_us = tracer.now_us()
         while dispatch_marks:
             ts_us, d_first, d_n = dispatch_marks.popleft()
             tracer.complete(
                 "device", f"rounds {d_first}..{d_first + d_n - 1}",
-                ts_us, end_us - ts_us, round_first=d_first, rounds=d_n)
+                ts_us, end_us - ts_us, round_first=d_first, rounds=d_n,
+                sketch_path=sketch_path)
         t_commit0 = time.perf_counter()
         with tracer.span("runner", "commit", round_first=first,
                          rounds=committed):

@@ -13,12 +13,24 @@ from .csvec import (
     unsketch_topk,
     zero_table,
 )
+from .layerwise import (
+    BlockPlan,
+    accumulate_leaf,
+    apply_delta_tree,
+    make_block_plan,
+    sketch_tree,
+)
 
 __all__ = [
+    "BlockPlan",
     "CSVecSpec",
+    "accumulate_leaf",
+    "apply_delta_tree",
+    "make_block_plan",
     "query",
     "query_all",
     "sketch_sparse",
+    "sketch_tree",
     "sketch_vec",
     "to_dense",
     "unsketch_threshold",

@@ -172,7 +172,19 @@ def _pallas_interpret() -> bool:
 
 def _sketch_vec_rotation(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
     """Dense accumulate, rotation family: per row, sign the vector, roll each
-    slab by its shift, and add slabs — no scatter. O(r·d) VPU work."""
+    slab by its shift, and add slabs — no scatter. O(r·d) VPU work.
+
+    The slab reduction is an EXPLICIT left fold (lax.scan in slab order),
+    not a `.sum(axis=0)`: XLA lowers an axis reduce as a tree whose shape
+    depends on the array extent, while the layerwise accumulation path
+    (sketch/layerwise.py) folds each leaf's slabs into the running table
+    one at a time. Making the oracle the same ordered fold is what lets
+    `accumulate_leaf` over any leaf partition reproduce this function
+    BIT-identically — the contract the engine's `--sketch_path` parity
+    pin rests on. (Per bucket both orders are the plain sequential sum
+    t_0 + t_1 + ... over slabs; a boundary slab split across two leaves
+    contributes its value from the owning leaf and an exact +0.0 from the
+    other, which IEEE addition ignores.)"""
     v_slabs = _pad_to_slabs(spec, v)  # zero-pad ⇒ padded coords contribute 0
     idx = jnp.arange(spec.num_slabs * spec.c, dtype=jnp.int32)
     _, ks = row_keys(spec.seed, spec.r)
@@ -181,7 +193,14 @@ def _sketch_vec_rotation(spec: CSVecSpec, v: jnp.ndarray) -> jnp.ndarray:
     def row_table(args):
         k_sign, row_shifts = args
         signed = v_slabs * sign_hash(idx, k_sign, dtype=v.dtype).reshape(v_slabs.shape)
-        return jax.vmap(_roll_right)(signed, row_shifts).sum(axis=0)
+
+        def body(acc, xs):
+            slab, shift = xs
+            return acc + _roll_right(slab, shift), None
+
+        out, _ = jax.lax.scan(
+            body, jnp.zeros((spec.c,), v.dtype), (signed, row_shifts))
+        return out
 
     # sequential over the r rows (r is tiny) to bound transients to O(d)
     return jax.lax.map(row_table, (ks, shifts))

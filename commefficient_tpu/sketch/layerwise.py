@@ -1,0 +1,245 @@
+"""Layerwise Count-Sketch accumulation — the dense [d] gradient never exists.
+
+FetchSGD only ever needs the SKETCH of the round's gradient, yet the ravel
+path still concatenates every layer into one flat [d] vector before
+compressing (`ravel_pytree` measured 6.15 ms/round at GPT-2 dims, and the
+flat copy + the [W, d] / [chunk, d] per-client stacks are the HBM ceiling).
+The sketch is linear over coordinate blocks, so it can be accumulated
+block-by-block as each layer's gradient comes off the backward pass:
+
+    table = 0
+    for leaf in grads:                     # pytree leaf order = ravel order
+        table = accumulate_leaf(spec, table, leaf, offset(leaf))
+
+Peak sketch-side memory is O(r*c) (the running table) plus ONE leaf's
+transient instead of O(d) — the prerequisite for models whose dense
+gradient doesn't fit beside the activations.
+
+Block plan
+----------
+
+`make_block_plan(spec, tree)` precomputes, once per model, each leaf's
+static placement: its global index offset in ravel order, its size, and —
+for the rotation family — which slab range of the CSVec it touches
+(`s0`, `num_slabs`, `front`): the per-(row, slab) shifts for exactly those
+slabs are the leaf's "block hashes", sliced from `hashing.slab_shifts`
+inside the trace (hashes themselves stay derived-on-the-fly from the seed,
+as everywhere in this package — nothing is materialised per coordinate).
+
+Bit-parity contract
+-------------------
+
+`sketch_tree(spec, tree)` is BIT-identical to
+`csvec.sketch_vec(spec, ravel_pytree(tree)[0])`, for both hash families:
+
+- rotation: `_sketch_vec_rotation` reduces slabs as an explicit left fold
+  (in slab order, from a zero carry); `accumulate_leaf` continues the same
+  fold, slab by slab, through the running table. A slab split across two
+  leaves receives its value from the owning positions and an exact ±0.0
+  from the other leaf's padding — IEEE `x + (±0.0) == x` (for x != -0.0),
+  so the per-bucket addition sequence is unchanged. Pinned in
+  tests/test_layerwise.py.
+- random: the oracle's `segment_sum` and `table.at[...].add` both apply
+  scatter updates in coordinate order onto the running operand, so the
+  per-bucket fold is the same sequence. `num_blocks > 1` chunks the ravel
+  oracle into per-block partial tables (a DIFFERENT association), so the
+  layerwise engine path rejects that combination rather than silently
+  shipping a not-bit-equal round (rotation ignores num_blocks entirely).
+
+The Pallas kernels are deliberately NOT routed here: they compute whole-d
+tables (and materialise the padded vector), which is exactly what this
+path exists to avoid. Layerwise accumulation is pure-JAX (roll + add /
+scatter-add), VPU-shaped, and kernel-eligible later via the same probe
+discipline if a per-leaf kernel earns its keep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .csvec import CSVecSpec, _roll_right, zero_table
+from .hashing import row_keys, sign_hash, slab_shifts
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafBlock:
+    """Static placement of one pytree leaf in the raveled [d] order."""
+
+    offset: int  # global index of the leaf's first coordinate
+    size: int
+    # rotation family: the slab range [s0, s0 + num_slabs) this leaf's
+    # coordinates fall into, and the leaf's position within slab s0
+    s0: int
+    num_slabs: int
+    front: int
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockPlan:
+    """Per-leaf block plan for a model: leaf -> global offset -> CSVec slab
+    range, precomputed once (static python ints — safe to close over in a
+    jitted round step). Leaf order is `jax.tree.leaves` order, which is
+    exactly `ravel_pytree`'s concatenation order."""
+
+    spec: CSVecSpec
+    blocks: tuple[LeafBlock, ...]
+
+    @property
+    def d(self) -> int:
+        return self.blocks[-1].offset + self.blocks[-1].size if self.blocks else 0
+
+
+def _leaf_block(spec: CSVecSpec, offset: int, n: int) -> LeafBlock:
+    """The one place slab placement is derived from (offset, size)."""
+    s0 = offset // spec.c
+    s1 = (offset + n - 1) // spec.c
+    return LeafBlock(offset=offset, size=n, s0=s0, num_slabs=s1 - s0 + 1,
+                     front=offset - s0 * spec.c)
+
+
+def make_block_plan(spec: CSVecSpec, tree) -> BlockPlan:
+    """Build the plan from a params/grads pytree (or its eval_shape)."""
+    blocks: list[LeafBlock] = []
+    off = 0
+    for leaf in jax.tree.leaves(tree):
+        n = int(jnp.size(leaf)) if not hasattr(leaf, "size") else int(leaf.size)
+        if n == 0:
+            continue
+        blocks.append(_leaf_block(spec, off, n))
+        off += n
+    if off != spec.d:
+        raise ValueError(
+            f"block plan covers {off} coordinates but the sketch spec has "
+            f"d={spec.d}: the plan must be built from the same pytree the "
+            "round sketches"
+        )
+    return BlockPlan(spec=spec, blocks=tuple(blocks))
+
+
+def _accumulate_leaf_rotation(
+    spec: CSVecSpec, table: jnp.ndarray, v: jnp.ndarray, blk: LeafBlock
+) -> jnp.ndarray:
+    """Fold one leaf's [n] coordinates into the running table, continuing
+    `_sketch_vec_rotation`'s slab-order left fold (see module docstring)."""
+    c = spec.c
+    # slab-aligned buffer for just this leaf's slab range; positions owned
+    # by neighbouring leaves (or beyond d) stay exact zeros
+    buf = jnp.zeros((blk.num_slabs * c,), v.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, v, (blk.front,))
+    idx = jnp.arange(blk.num_slabs * c, dtype=jnp.int32) + jnp.int32(blk.s0 * c)
+    _, ks = row_keys(spec.seed, spec.r)
+    shifts = slab_shifts(spec.seed, spec.r, spec.num_slabs, c)
+    shifts = jax.lax.slice_in_dim(shifts, blk.s0, blk.s0 + blk.num_slabs,
+                                  axis=1)  # [r, num_slabs]
+
+    def row_update(args):
+        tab_row, k_sign, row_shifts = args
+        signed = (buf * sign_hash(idx, k_sign, dtype=v.dtype)
+                  ).reshape(blk.num_slabs, c)
+
+        def body(acc, xs):
+            slab, shift = xs
+            return acc + _roll_right(slab, shift), None
+
+        out, _ = jax.lax.scan(body, tab_row, (signed, row_shifts))
+        return out
+
+    # sequential over the r rows, like the oracle — transients stay O(leaf)
+    return jax.lax.map(row_update, (table, ks, shifts))
+
+
+def _accumulate_leaf_random(
+    spec: CSVecSpec, table: jnp.ndarray, v: jnp.ndarray, blk: LeafBlock
+) -> jnp.ndarray:
+    """Scatter-add one leaf's contributions onto the running table in
+    coordinate order — the same per-bucket update sequence the num_blocks=1
+    oracle's segment_sum applies."""
+    from .csvec import _block_hashes
+
+    idx = blk.offset + jnp.arange(blk.size, dtype=jnp.int32)
+    buckets, signs = _block_hashes(spec, idx, v.dtype)  # [r, n] each
+    contrib = signs * v[None, :]
+    rows = jnp.broadcast_to(
+        jnp.arange(spec.r, dtype=jnp.int32)[:, None], buckets.shape)
+    return table.at[rows, buckets].add(contrib)
+
+
+def _accumulate(spec: CSVecSpec, table: jnp.ndarray, v: jnp.ndarray,
+                blk: LeafBlock) -> jnp.ndarray:
+    if spec.family == "rotation":
+        return _accumulate_leaf_rotation(spec, table, v, blk)
+    return _accumulate_leaf_random(spec, table, v, blk)
+
+
+def accumulate_leaf(
+    spec: CSVecSpec, table: jnp.ndarray, leaf_grad: jnp.ndarray, offset: int
+) -> jnp.ndarray:
+    """Fold one layer's gradient block into the running [r, c] table without
+    ever forming the flat vector. `offset` is the leaf's global index in
+    ravel order; any leaf shape is accepted (flattened row-major, which is
+    what ravel_pytree concatenates)."""
+    v = leaf_grad.reshape(-1)
+    n = v.shape[0]
+    if offset < 0 or offset + n > spec.d:
+        raise ValueError(
+            f"leaf block [{offset}, {offset + n}) falls outside d={spec.d}")
+    return _accumulate(spec, table, v, _leaf_block(spec, offset, n))
+
+
+def sketch_tree(spec: CSVecSpec, tree, plan: BlockPlan | None = None
+                ) -> jnp.ndarray:
+    """Sketch a gradient pytree into an [r, c] table, leaf by leaf — equal
+    BIT-for-BIT to `csvec.sketch_vec(spec, ravel_pytree(tree)[0])` (rotation
+    family any num_blocks; random family num_blocks == 1). Each leaf is
+    consumed independently, so XLA can free its buffer as soon as its fold
+    completes — peak live memory is the table plus one leaf, not [d]."""
+    if plan is None:
+        plan = make_block_plan(spec, tree)
+    leaves = [l for l in jax.tree.leaves(tree) if l.size]
+    if len(leaves) != len(plan.blocks):
+        raise ValueError(
+            f"tree has {len(leaves)} non-empty leaves but the plan covers "
+            f"{len(plan.blocks)}")
+    table = zero_table(spec, leaves[0].dtype if leaves else jnp.float32)
+    for leaf, blk in zip(leaves, plan.blocks):
+        v = leaf.reshape(-1)
+        if v.shape[0] != blk.size:
+            raise ValueError(
+                f"leaf at offset {blk.offset} has {v.shape[0]} coordinates, "
+                f"plan says {blk.size}: plan built from a different model")
+        table = _accumulate(spec, table, v, blk)
+    return table
+
+
+def apply_delta_tree(params, delta: dict, plan: BlockPlan | None = None,
+                     spec: CSVecSpec | None = None):
+    """`params - delta` for a k-sparse wire delta ({"idx", "vals"}), applied
+    per leaf — the layerwise counterpart of
+    `unravel(modes.apply_delta(ravel_pytree(params)[0], delta))`, bit-equal
+    to it (each selected coordinate receives the identical `x + (-v)`;
+    out-of-leaf and padding entries add an exact -0.0, which IEEE addition
+    ignores) without materialising the flat [d] params copy."""
+    if plan is None:
+        if spec is None:
+            raise ValueError("apply_delta_tree needs a plan or a spec")
+        plan = make_block_plan(spec, params)
+    idx, vals = delta["idx"], delta["vals"]
+    leaves, treedef = jax.tree.flatten(params)
+    out, bi = [], 0
+    for leaf in leaves:
+        if leaf.size == 0:
+            out.append(leaf)
+            continue
+        blk = plan.blocks[bi]
+        bi += 1
+        lo = blk.offset
+        local = idx - lo
+        ok = (idx >= lo) & (idx < lo + blk.size)
+        safe = jnp.clip(local, 0, blk.size - 1)
+        flat = leaf.reshape(-1).at[safe].add(
+            -jnp.where(ok, vals, 0.0).astype(leaf.dtype))
+        out.append(flat.reshape(leaf.shape))
+    return jax.tree.unflatten(treedef, out)

@@ -69,10 +69,12 @@ def _worst_case_vmem(c: int, r: int) -> int:
     return (2 * r + 6) * c * 4
 
 
-def _compiler_params(c: int, r: int) -> pltpu.CompilerParams:
+def _compiler_params(c: int, r: int):
+    from ..utils import jax_compat
+
     need = _worst_case_vmem(c, r)
     limit = _VMEM_SMALL_BYTES if need <= _VMEM_SMALL_BYTES else _VMEM_LARGE_BYTES
-    return pltpu.CompilerParams(vmem_limit_bytes=limit)
+    return jax_compat.tpu_compiler_params(vmem_limit_bytes=limit)
 
 
 def supported(spec) -> bool:

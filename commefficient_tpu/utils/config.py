@@ -40,6 +40,33 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
     p.add_argument("--topk_recall", type=float, default=0.95,
                    help="approx_max_k recall_target for --topk_impl approx "
                         "and for oversample's preselect pass")
+    p.add_argument("--sketch_path", default="ravel",
+                   choices=["ravel", "layerwise"],
+                   help="mode=sketch only: how the round's Count-Sketch "
+                        "table is built. ravel (default) concatenates every "
+                        "layer into one flat [d] gradient before "
+                        "compressing (the reference flat path); layerwise "
+                        "folds "
+                        "each layer's gradient block straight into the "
+                        "running r x c table as it comes off the backward "
+                        "pass — the dense [d] gradient (and the flat "
+                        "params copy for the delta apply) never "
+                        "materializes, so peak sketch-side memory is "
+                        "O(r*c) + one layer instead of O(d). Pinned "
+                        "bit-identical to ravel (fused, split, sharded)")
+    p.add_argument("--server_state", default="dense",
+                   choices=["dense", "sketch"],
+                   help="server optimizer state representation: dense "
+                        "(default; [d] Vvelocity/Verror, the seed "
+                        "behavior) or sketch (momentum + virtual error "
+                        "feedback kept as r x c Count-Sketch tables — "
+                        "server memory stops scaling with d; true_topk "
+                        "and local_topk-with-virtual-error only; "
+                        "mode=sketch is already sketch-state and accepts "
+                        "both). With --num_cols >= d the sketch is a "
+                        "lossless signed permutation and matches dense "
+                        "bit-for-bit; below that it is the FetchSGD-style "
+                        "approximation")
     p.add_argument("--agg_op", default="mean", choices=["mean", "sum"],
                    help="client-wire aggregation: mean (cohort-size-independent "
                         "default) or sum (FetchSGD Alg. 1 semantics — use with "
@@ -389,4 +416,5 @@ def mode_config_from_args(args: argparse.Namespace, d: int) -> ModeConfig:
         agg_op=args.agg_op,
         topk_impl=args.topk_impl,
         topk_recall=args.topk_recall,
+        server_state=args.server_state,
     )

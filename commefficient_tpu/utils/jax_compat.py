@@ -1,0 +1,108 @@
+"""Version-bridging aliases for jax APIs that were renamed across releases.
+
+The repo is written against the current jax surface (`jax.set_mesh`,
+`jax.shard_map`, `jax.sharding.get_abstract_mesh`, `jax.lax.pcast`,
+`pltpu.CompilerParams`); older jaxlibs (0.4.x) spell every one of these
+differently. Each alias resolves the NEW name first and falls back to the
+old one, so the rest of the codebase uses a single spelling and a toolchain
+bump deletes this module instead of touching call sites. Pure lookups — no
+behavior shims beyond name resolution (the one exception is `pcast`, which
+degrades to identity where rep-tracking doesn't exist, paired with
+`CHECK_REP` so shard_map callers relax the check only on toolchains that
+can't track varying values).
+"""
+
+from __future__ import annotations
+
+import jax
+
+# -- shard_map ---------------------------------------------------------------
+
+if hasattr(jax, "shard_map"):
+    _shard_map_impl = jax.shard_map
+else:  # jax < 0.5: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+try:
+    import inspect
+
+    _SHARD_MAP_REP_KW = (
+        "check_vma"
+        if "check_vma" in inspect.signature(_shard_map_impl).parameters
+        else "check_rep"
+    )
+except (TypeError, ValueError):  # signature unavailable: assume the old name
+    _SHARD_MAP_REP_KW = "check_rep"
+
+
+def shard_map(f, **kwargs):
+    """`shard_map` under one spelling of the replication-check kwarg: callers
+    pass `check_rep=`; the public `jax.shard_map` renamed it `check_vma`."""
+    if "check_rep" in kwargs and _SHARD_MAP_REP_KW != "check_rep":
+        kwargs[_SHARD_MAP_REP_KW] = kwargs.pop("check_rep")
+    return _shard_map_impl(f, **kwargs)
+
+# -- pcast / rep-checking ----------------------------------------------------
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+    CHECK_REP = True
+else:
+    # old shard_map has no varying-value tracking: marking is meaningless
+    # and the caller must pass check_rep=False for bodies that use
+    # axis_index (CHECK_REP advertises which world we are in)
+    CHECK_REP = False
+
+    def pcast(x, axes, to="varying"):  # noqa: ARG001 — signature parity
+        return x
+
+
+# -- ambient mesh ------------------------------------------------------------
+
+
+def set_mesh(mesh):
+    """Context manager installing `mesh` as the ambient mesh
+    (`jax.set_mesh` / `jax.sharding.use_mesh` / the legacy `with mesh:`
+    resource-env context, newest first)."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    if hasattr(jax.sharding, "use_mesh"):
+        return jax.sharding.use_mesh(mesh)
+    # jax 0.4.x: Mesh is itself the context manager for the resource env
+    return mesh
+
+
+def get_abstract_mesh():
+    """The ambient mesh, or None. New jax returns the AbstractMesh from
+    jax.sharding.get_abstract_mesh(); old jax exposes the physical mesh of
+    the active resource env (empty mesh -> None, matching the new API's
+    'nothing installed' contract closely enough for axis lookups)."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        m = jax.sharding.get_abstract_mesh()
+        if m is not None and not getattr(m, "axis_names", ()):  # empty mesh
+            return None
+        return m
+    try:
+        from jax._src import mesh as _mesh_lib
+
+        m = _mesh_lib.thread_resources.env.physical_mesh
+    except Exception:  # noqa: BLE001 — internals moved: behave as "no mesh"
+        return None
+    if m is None or not m.axis_names:
+        return None
+    return m
+
+
+# -- Pallas TPU compiler params ---------------------------------------------
+
+
+def tpu_compiler_params(**kwargs):
+    """`pltpu.CompilerParams(**kwargs)` under whichever name this jax ships
+    it (old: TPUCompilerParams). Imported lazily: the tpu pallas module is
+    not importable on every backend."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -97,6 +97,7 @@ def build(args, fault_plan=None, retry_policy=None):
         client_dropout=args.client_dropout,
         client_update_clip=args.client_update_clip,
         requeue_policy=args.requeue_policy,
+        sketch_path=args.sketch_path,
         split_compile=args.split_compile,
         client_chunk=args.client_chunk,
         on_nonfinite=args.on_nonfinite,

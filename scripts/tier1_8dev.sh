@@ -25,7 +25,7 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 python -m pytest tests/test_sharded_round.py tests/test_engine.py \
     tests/test_client_state_sharding.py tests/test_cohort_faults.py \
-    tests/test_serve.py tests/test_obs.py \
+    tests/test_serve.py tests/test_obs.py tests/test_layerwise.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 
 # bench mesh section must degrade to {"skipped": ...} on ONE device (the
@@ -35,6 +35,7 @@ BENCH_WORKERS=2 BENCH_COLS=1024 BENCH_TOPK=64 BENCH_BLOCKS=1 \
 BENCH_CHAIN_LEN=1 BENCH_CHAINS=1 BENCH_WARMUP=0 BENCH_MICRO_D=10000 \
 BENCH_MICRO_CHAIN=1 BENCH_PHASE_TIMING=0 BENCH_SERVER_SPLIT=0 \
 BENCH_BASELINE_BASIS=0 BENCH_SCALE_CHECK=0 BENCH_RUN_LOOP=0 \
+BENCH_SKETCH_PATH=0 \
 python - <<'EOF'
 import json, subprocess, sys
 out = subprocess.run([sys.executable, "bench.py"], capture_output=True,

@@ -1,0 +1,14 @@
+# graftlint: module=commefficient_tpu/federated/engine.py
+# G010 violating twin: an UNDECLARED ravel_pytree in the round-path compiled
+# scope — a casually-added flat [d] materialization that re-introduces the
+# HBM ceiling the layerwise sketch path exists to remove.
+from jax.flatten_util import ravel_pytree
+
+
+def make_round_step(cfg):
+    def round_step(state, batch):
+        grads = batch["grads"]  # per-leaf pytree off the backward pass
+        gflat, _ = ravel_pytree(grads)  # the dense [d] vector, undeclared
+        return state, gflat * 0.1
+
+    return round_step

@@ -76,6 +76,45 @@ def test_g007_import_traversal_stops_at_drain_point():
     assert "G007" not in found, found
 
 
+def test_g010_sketch_boundary_declares_the_ravel_path():
+    """The conforming twin's ravel site is legal ONLY because its def
+    carries `# graftlint: sketch-boundary` — strip the directive and the
+    same code must fire (the boundary is a declaration, not a loophole)."""
+    with open(os.path.join(FIXTURES, "g010_ok.py")) as f:
+        text = f.read()
+    stripped = text.replace(
+        "# graftlint: sketch-boundary — the ravel path IS the declared "
+        "flat boundary\n", "")
+    assert stripped != text, "fixture lost its sketch-boundary line"
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(stripped)
+        path = tmp.name
+    try:
+        assert "G010" in _codes(path)
+    finally:
+        os.unlink(path)
+
+
+def test_g010_import_alone_is_silent():
+    """`from jax.flatten_util import ravel_pytree` without a call moves no
+    bytes — only the call that materializes the flat vector fires."""
+    import tempfile
+
+    src = ("# graftlint: module=commefficient_tpu/modes/modes.py\n"
+           "from jax.flatten_util import ravel_pytree\n")
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        assert "G010" not in _codes(path)
+    finally:
+        os.unlink(path)
+
+
 def test_every_rule_has_fixture_pair():
     # adding a rule without fixtures should fail HERE, not in review
     for code in RULE_CODES:
