@@ -50,6 +50,14 @@ G010  flat-ravel-in-round-path                   the dense [d] gradient never
                                                  compiled scope only inside
                                                  functions declared
                                                  `# graftlint: sketch-boundary`
+G011  wire-bytes-in-compiled-scope               untrusted wire frame bytes
+                                                 (transport payload fields)
+                                                 reach compiled scope only
+                                                 through the one declared
+                                                 deserialization boundary,
+                                                 serve.ingest.validate_payload
+                                                 (`# graftlint:
+                                                 payload-boundary`)
 ====  =========================================  ================================
 
 Run it:
@@ -85,6 +93,7 @@ from .rules_obs import ObsCallInCompiledScope
 from .rules_parity import ReservedLeafAccess, UnorderedReduction
 from .rules_sketch import FlatRavelInRoundPath
 from .rules_sync import BlockingCallOnDispatchThread, HostSyncInRoundPath
+from .rules_wire import WireBytesInCompiledScope
 
 ALL_RULES: tuple[type[Rule], ...] = (
     HostSyncInRoundPath,
@@ -97,6 +106,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     UnvalidatedConfigRead,
     ObsCallInCompiledScope,
     FlatRavelInRoundPath,
+    WireBytesInCompiledScope,
 )
 
 RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)

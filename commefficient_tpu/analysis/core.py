@@ -57,6 +57,7 @@ class FunctionInfo:
     end: int
     drain_point: bool
     sketch_boundary: bool = False
+    payload_boundary: bool = False
 
 
 class SourceFile:
@@ -100,8 +101,10 @@ class SourceFile:
                     drain = bool(cand & self.directives.drain_linenos)
                     sketch = bool(
                         cand & self.directives.sketch_boundary_linenos)
+                    payload = bool(
+                        cand & self.directives.payload_boundary_linenos)
                     out.append(FunctionInfo(qual, start, child.lineno, end,
-                                            drain, sketch))
+                                            drain, sketch, payload))
                     visit(child, f"{qual}.")
                 elif isinstance(child, ast.ClassDef):
                     visit(child, f"{prefix}{child.name}.")
@@ -124,6 +127,12 @@ class SourceFile:
     def in_drain_point(self, lineno: int) -> bool:
         """True when any enclosing function is a declared drain point."""
         return any(f.drain_point for f in self.enclosing_functions(lineno))
+
+    def in_payload_boundary(self, lineno: int) -> bool:
+        """True when any enclosing function is the declared wire-payload
+        deserialization boundary (G011's sanctioned sites)."""
+        return any(f.payload_boundary
+                   for f in self.enclosing_functions(lineno))
 
     def in_sketch_boundary(self, lineno: int) -> bool:
         """True when any enclosing function is a declared flat/ravel

@@ -52,6 +52,9 @@ class Directives:
     # linenos carrying a sketch-boundary marker (G010's sanctioned ravel
     # sites — the declared flat boundary of the sketch path)
     sketch_boundary_linenos: set[int]
+    # linenos carrying a payload-boundary marker (G011's sanctioned wire
+    # deserialization sites — serve.ingest.validate_payload)
+    payload_boundary_linenos: set[int]
     # fixture impersonation path, or None
     module_override: str | None
     # (lineno, message) for malformed directives — surfaced as G000
@@ -105,7 +108,8 @@ def _comments(text: str) -> list[tuple[int, str]]:
 def parse(text: str, valid_codes: frozenset[str]) -> Directives:
     d = Directives(
         line_disables={}, file_disables=set(), drain_linenos=set(),
-        sketch_boundary_linenos=set(), module_override=None, errors=[],
+        sketch_boundary_linenos=set(), payload_boundary_linenos=set(),
+        module_override=None, errors=[],
     )
     for lineno, line in _comments(text):
         m = _DIRECTIVE_RE.search(line)
@@ -126,6 +130,8 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
             d.drain_linenos.add(lineno)
         elif verb == "sketch-boundary" and not has_eq:
             d.sketch_boundary_linenos.add(lineno)
+        elif verb == "payload-boundary" and not has_eq:
+            d.payload_boundary_linenos.add(lineno)
         elif verb == "module" and has_eq:
             d.module_override = arg.strip()
         elif not verb:
@@ -135,6 +141,6 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
                 lineno,
                 f"unknown graftlint directive {verb!r} "
                 "(expected disable/disable-file/drain-point/"
-                "sketch-boundary/module)",
+                "sketch-boundary/payload-boundary/module)",
             ))
     return d

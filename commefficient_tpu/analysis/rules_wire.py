@@ -1,0 +1,97 @@
+"""G011 wire-bytes-in-compiled-scope.
+
+The wire-payload round (serve/ ``--serve_payload sketch``) makes the merge
+path a consumer of UNTRUSTED input: every submission's frame — base64 data,
+length prefix, checksum, schema fields — arrives from a peer the server
+does not control. The repo's defense is a single choke point:
+``serve.ingest.validate_payload`` (declared with ``# graftlint:
+payload-boundary``) is the ONE place wire bytes are deserialized, screened
+(schema, dtype/shape, length, checksum, non-finite, sketch-space L2), and
+turned into a host ndarray the engine may consume. Any other route from
+frame bytes to the compiled round program silently reopens the injection
+classes the gauntlet exists to close: a crafted length prefix reading past
+a buffer, a stale-schema table misinterpreted shapewise, a NaN bomb
+reaching the merge.
+
+Detection, in the wire + compiled scope (serve/, sketch/, modes/,
+federated/):
+
+- any call resolving through the import table to the frame DECODING
+  primitives — ``base64.b64decode`` or ``np.frombuffer`` — outside a
+  function declared ``# graftlint: payload-boundary``. These two are how
+  frame bytes become arrays; everything downstream of the boundary works
+  on validated ndarrays and never needs them.
+- any call resolving into ``jax.*`` (the compiled scope's front door) with
+  an argument expression that reads a ``.payload`` attribute — the frame
+  as the transport carries it, flowing into compiled scope without the
+  gauntlet.
+
+The client-side ENCODER (sketch/payload.py encode_frame: b64encode,
+tobytes) is not flagged — serialization of bytes the process itself
+produced moves no untrusted data. The chaos injector
+(resilience/faults.py) decodes frames it is about to damage; it lives
+outside this rule's scope and feeds the transport, not the engine.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+# the wire + compiled scope: where frame bytes live (serve/) and where they
+# must never arrive unvalidated (the round-path compiled modules)
+_WIRE_SCOPE = (
+    f"{PACKAGE}/serve/",
+    f"{PACKAGE}/sketch/",
+    f"{PACKAGE}/modes/",
+    f"{PACKAGE}/federated/",
+)
+
+# frame bytes -> array primitives: the moves only the boundary may make
+_DECODERS = ("base64.b64decode", "numpy.frombuffer")
+
+
+class WireBytesInCompiledScope(Rule):
+    code = "G011"
+    name = "wire-bytes-in-compiled-scope"
+    fixit = ("route the frame through serve.ingest.validate_payload (the "
+             "declared `# graftlint: payload-boundary`) and consume the "
+             "validated ndarray it returns — never decode or forward raw "
+             "wire bytes yourself")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(_WIRE_SCOPE)
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = src.resolve_dotted(node.func)
+            if dotted is None:
+                continue
+            if dotted in _DECODERS:
+                if src.in_payload_boundary(node.lineno):
+                    continue
+                out.append(self.violation(
+                    src, node,
+                    f"{dotted}() deserializes wire frame bytes outside the "
+                    "declared payload boundary — validate_payload is the "
+                    "one sanctioned decode of untrusted transport input"))
+            elif dotted == "jax" or dotted.startswith("jax."):
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    if self._reads_payload(arg):
+                        out.append(self.violation(
+                            src, node,
+                            "a `.payload` frame field flows into compiled "
+                            "scope without passing the validation gauntlet "
+                            f"({ast.unparse(node.func)} call)"))
+                        break
+        return out
+
+    @staticmethod
+    def _reads_payload(expr: ast.expr) -> bool:
+        return any(isinstance(n, ast.Attribute) and n.attr == "payload"
+                   for n in ast.walk(expr))

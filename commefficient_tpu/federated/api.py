@@ -60,6 +60,11 @@ class PreparedRound:
     # rounds-waiting bookkeeping rides the same committed-snapshot
     # discipline as the queue itself
     requeue_ages: tuple = ()
+    # wire-payload serving (serve/, --serve_payload sketch): the round's
+    # WIRE-DECODED per-client tables + arrival mask + the client program's
+    # device-side aux (see FederatedSession.compute_client_tables). None =
+    # a normal batch round; dispatch_round routes on it.
+    payload: tuple | None = None
 
 
 @dataclasses.dataclass
@@ -125,6 +130,8 @@ class FederatedSession:
         client_update_clip: float = 0.0,
         requeue_policy: str = "fifo",
         sketch_path: str = "ravel",
+        quarantine_window: int = 1,
+        wire_payloads: bool = False,
     ):
         # client_shards: 0 = derive from the mesh (the default — on a >1-
         # device mesh with a mode in engine.supports_sharded_round's scope
@@ -147,10 +154,22 @@ class FederatedSession:
             # the flat [d] gradient never materializes; pinned
             # bit-identical to the default ravel path
             sketch_path=sketch_path,
+            # windowed quarantine baseline (1 = the pre-window running
+            # median, bit-identically) and the wire-payload round shape
+            # (per-client tables merged by ordered sum — serve/'s
+            # --serve_payload sketch; see EngineConfig for both)
+            quarantine_window=quarantine_window,
+            wire_payloads=wire_payloads,
             # CLI "halt" is a host-side policy on top of the compiled "skip"
             # guard (state stays clean either way; the CLI decides to stop)
             on_nonfinite="skip" if on_nonfinite == "halt" else on_nonfinite,
         )
+        if wire_payloads and split_compile:
+            raise ValueError(
+                "wire_payloads IS a two-program round (client tables + "
+                "table merge); --split_compile is redundant and would pick "
+                "a different program pair — drop one of the two"
+            )
         # cohort-degradation re-queue: client ids whose batch load failed (or
         # were fault-dropped) wait here and displace sampled ids in a later
         # round's cohort, so a dropped client's data is delayed, not lost.
@@ -303,7 +322,23 @@ class FederatedSession:
         # a multi-round scan over the fused step would reintroduce it, so
         # run_rounds falls back to per-round dispatch there
         self._split = split_compile
-        if split_compile:
+        self._payload_client = None
+        self._payload_merge = None
+        if wire_payloads:
+            # the wire-payload two-program round: client tables + table
+            # merge (engine.make_payload_round_steps). The batch simulator
+            # composes them; the serving layer calls them separately with
+            # the wire round-trip in between (compute_client_tables /
+            # dispatch_round on a payload-carrying PreparedRound).
+            client_p, merge_p = engine.make_payload_round_steps(
+                train_loss_fn, self.cfg,
+                self.mesh if self._spmd and self.mesh is not None else None)
+            self._payload_client = jax.jit(client_p)
+            self._payload_merge = jax.jit(
+                merge_p, donate_argnums=self._state_donation())
+            self._step = engine.compose_payload(
+                self._payload_client, self._payload_merge)
+        elif split_compile:
             # two XLA programs per round: the Pallas/Mosaic sketch server step
             # compiles separately from the big vmapped grad module (see
             # engine.make_split_round_step for why). On the SPMD path the
@@ -650,15 +685,115 @@ class FederatedSession:
         keys = rs.random_sample(len(queue)) ** (1.0 / ages)
         return [queue[i] for i in np.argsort(-keys, kind="stable")]
 
+    # -- wire-payload serving (serve/, --serve_payload sketch) ---------------
+
+    # graftlint: drain-point — payload rounds sync the client tables to the
+    # host BY DESIGN: the tables are the wire objects the serving layer
+    # serializes per client, so the round's host boundary moves here (the
+    # payload path trades pipeline overlap for a real untrusted wire)
+    def compute_client_tables(self, prep: PreparedRound):
+        """Run the payload round's CLIENT program for a prepared cohort and
+        fetch the per-client r x c tables to the host — the objects that
+        cross the wire, one row per invitee. Returns (tables_np [W, r, c],
+        aux); `aux` carries the device-side leftovers the merge dispatch
+        needs (the exact state tree the client program read, per-client
+        net-state/metric rows, the validity mask, the noise key)."""
+        if self._payload_client is None:
+            raise RuntimeError(
+                "compute_client_tables needs a wire_payloads=True session "
+                "(--serve_payload sketch)")
+        batch = prep.batch
+        if self.mesh is not None:
+            batch = meshlib.shard_client_batch(self.mesh, batch)
+        state = self._head_state if self._head_state is not None else self.state
+        with self._mesh_ctx():
+            tables, nstates, mvals, part, noise_rng = self._payload_client(
+                state, batch, prep.sub)
+        tables_np = np.asarray(jax.device_get(tables))
+        return tables_np, (state, nstates, mvals, part, noise_rng)
+
+    def quarantine_median_host(self) -> float:
+        """Host copy of the CURRENT quarantine threshold baseline (0.0 with
+        the quarantine off or unseeded) — the ingest validation gauntlet's
+        sketch-space L2 screen reads this. Payload rounds sync per round
+        anyway (compute_client_tables), so this fetch adds no new sync
+        class."""
+        if self.cfg.client_update_clip <= 0:
+            return 0.0
+        state = self._head_state if self._head_state is not None else self.state
+        # host-side by design: read at the payload round's host boundary
+        return float(jax.device_get(  # graftlint: disable=G001 — payload-boundary sync
+            state["quarantine"]["median"]))
+
+    def finish_served_payload(self, prep: PreparedRound, arrived,
+                              wire_tables, aux) -> PreparedRound:
+        """Post-close bookkeeping of a served payload round: every invitee
+        whose payload missed the merge (no-show, straggler, or a rejected
+        frame) gets the client_drop treatment — counted as masked and
+        re-queued for a later cohort — and the final PreparedRound carries
+        the WIRE-DECODED table stack + arrival mask for dispatch_round. The
+        RNG snapshot from assembly stays valid: nothing here consumes host
+        RNG."""
+        # host numpy by construction: the arrival mask comes from the
+        # assembler, the validity mask from the loader/fault sites
+        arrived = np.asarray(arrived, np.float32)  # graftlint: disable=G001
+        _, valid = engine.split_valid(prep.batch)
+        if valid is None:
+            valid = np.ones(len(prep.ids), np.float32)
+        eff = np.asarray(valid, np.float32) * arrived  # graftlint: disable=G001 — host mask
+        for p in np.flatnonzero(eff == 0.0):
+            cid = int(prep.ids[int(p)])
+            if cid not in self._requeue:
+                self._requeue.append(cid)
+                self._requeue_enqueued.setdefault(cid, prep.rnd)
+        masked = int(len(prep.ids) - eff.sum())
+        if masked:
+            obtrace.instant("federated", "cohort_degraded", round=prep.rnd,
+                            clients=masked)
+        return dataclasses.replace(
+            prep, masked=masked, requeue_depth=len(self._requeue),
+            requeue=tuple(self._requeue),
+            requeue_ages=tuple(self._requeue_enqueued.items()),
+            # the gauntlet's validated table stack is host numpy already
+            payload=(np.asarray(wire_tables, np.float32), arrived, aux),  # graftlint: disable=G001
+        )
+
+    def _dispatch_payload_merge(self, prep: PreparedRound,
+                                lr: float) -> InFlightRound:
+        """Dispatch the payload round's MERGE program over the wire-decoded
+        tables a served round collected (prep.payload). The merge consumes
+        the SAME state tree the client program read (carried in aux), so
+        the two programs see one consistent round."""
+        wire_tables, arrived, aux = prep.payload
+        state, nstates, mvals, part, noise_rng = aux
+        with self._mesh_ctx():
+            new_state, metrics = self._payload_merge(
+                state, jnp.asarray(wire_tables), nstates, mvals, part,
+                jnp.asarray(arrived, jnp.float32), jnp.float32(lr),
+                noise_rng)
+        self._head_state = new_state
+        self._inflight += 1
+        self._inflight_rounds += 1
+        return InFlightRound(new_state, None, metrics, [lr],
+                             prep.snapshot, stacked=False,
+                             masked=[prep.masked],
+                             requeue_depths=[prep.requeue_depth],
+                             requeue=prep.requeue,
+                             requeue_ages=prep.requeue_ages)
+
     def dispatch_round(self, prep: PreparedRound, lr: float) -> InFlightRound:
         """Enqueue one round on the device WITHOUT a host sync. Chains on the
         newest dispatched state (not the committed one), so back-to-back
         dispatches queue on the device while metrics stay device arrays until
-        commit_round. Caller must commit in dispatch order."""
+        commit_round. Caller must commit in dispatch order. A payload-
+        carrying prep (served wire-payload round) dispatches the table-merge
+        program over its wire-decoded tables instead."""
         if self.fault_plan is not None:
             # delivers a real SIGTERM that the runner's PreemptionHandler
             # turns into drain -> emergency checkpoint -> resumable exit
             self.fault_plan.preempt(prep.rnd)
+        if prep.payload is not None:
+            return self._dispatch_payload_merge(prep, lr)
         batch = prep.batch
         if self.mesh is not None:
             batch = meshlib.shard_client_batch(self.mesh, batch)
@@ -839,7 +974,10 @@ class FederatedSession:
         An active fault plan also forces per-round dispatch: injection sites
         are scheduled by round, which a K-round fused block cannot honor."""
         return (self.client_state is None and not self._split
-                and self.fault_plan is None)
+                and self.fault_plan is None
+                # payload rounds are per-round by construction: the wire
+                # crossing is the round boundary
+                and not self.cfg.wire_payloads)
 
     # -- a block of rounds in one dispatch (SURVEY.md §7 hard part (d)) ------
     def run_rounds(self, lrs) -> list[dict]:

@@ -113,6 +113,25 @@ class EngineConfig:
     # DP clip — after the clip every norm is <= dp_clip and screening is
     # vacuous. 0 = off: the compiled program is unchanged.
     client_update_clip: float = 0.0
+    # Quarantine baseline window (rounds): 1 (default) keeps the pre-window
+    # behavior BIT-identically — the threshold baseline is the last
+    # non-empty round's live-cohort median, in the exact same state tree.
+    # K > 1 keeps a [K] ring of recent per-round medians in server state and
+    # screens against the MEDIAN OVER THE WINDOW, so a model whose update
+    # norms drift fast (early training, lr pivots) doesn't quarantine
+    # healthy clients just because this round's norms moved: one outlier
+    # round perturbs one window slot, not the whole threshold. Fused round
+    # paths only (the split-compile program boundary threads a single
+    # scalar median).
+    quarantine_window: int = 1
+    # Wire-payload round (--serve_payload sketch): the round's aggregate is
+    # the ordered sum of PER-CLIENT Count-Sketch tables instead of the
+    # compress-once linearity shortcut — the arithmetic a serving layer
+    # that merges client-computed payloads actually performs. The batch
+    # simulator runs the identical two-program shape (client tables +
+    # table-merge server step), which is what pins a served round with
+    # real wire-crossed payloads bit-identical to the batch round.
+    wire_payloads: bool = False
 
     def __post_init__(self):
         if self.client_shards < 1:
@@ -159,6 +178,32 @@ class EngineConfig:
                     "num_blocks=1 (layerwise transients are O(leaf) anyway) "
                     "or hash_family='rotation'."
                 )
+        if self.quarantine_window < 1:
+            raise ValueError(
+                f"quarantine_window must be >= 1, got {self.quarantine_window}"
+            )
+        if self.wire_payloads:
+            if self.mode.mode != "sketch":
+                raise ValueError(
+                    "wire_payloads (serve_payload='sketch') merges per-client "
+                    "Count-Sketch tables, so it requires mode='sketch'; "
+                    f"mode={self.mode.mode!r} has no table wire"
+                )
+            if self.sketch_path != "ravel":
+                raise ValueError(
+                    "wire_payloads requires sketch_path='ravel': the client-"
+                    "side table is sketched from the client's flat gradient "
+                    "(the object that crosses the wire); layerwise "
+                    "accumulation is a server-memory optimization with no "
+                    "client wire to ship"
+                )
+            if self.client_dropout > 0:
+                raise ValueError(
+                    "wire_payloads with client_dropout is double-counting: "
+                    "on the payload path the ARRIVAL STREAM is the dropout — "
+                    "a client that doesn't submit is the straggler; use the "
+                    "serving layer's traffic model instead"
+                )
         if self.dp_noise > 0 and self.dp_clip <= 0:
             raise ValueError("dp_noise > 0 requires dp_clip > 0 (unbounded "
                              "sensitivity has no meaningful noise scale)")
@@ -201,6 +246,14 @@ def init_server_state(cfg: EngineConfig, params: Any, net_state: Any) -> dict:
         # threshold's baseline. 0 = "no baseline yet": the first round only
         # screens non-finite updates and then seeds the median.
         state["quarantine"] = {"median": jnp.zeros((), dtype=jnp.float32)}
+        if cfg.quarantine_window > 1:
+            # bounded ring of the last K non-empty rounds' cohort medians
+            # (newest last); "median" above stays the ACTIVE threshold (the
+            # median over the filled window slots). window=1 keeps the
+            # pre-window state tree so checkpoints stay shape-compatible.
+            state["quarantine"]["window"] = jnp.zeros(
+                (cfg.quarantine_window,), dtype=jnp.float32)
+            state["quarantine"]["count"] = jnp.zeros((), dtype=jnp.int32)
     return state
 
 
@@ -284,18 +337,83 @@ def _quarantine_mask(cfg: EngineConfig, norms: jnp.ndarray, qmed) -> jnp.ndarray
     return bad | ((qmed > 0) & (norms > cfg.client_update_clip * qmed))
 
 
-def _update_running_median(norms, part_eff, old_med):
-    """Next round's quarantine baseline: the median L2 norm over this round's
-    LIVE, non-quarantined clients (sort with dead rows pushed to +inf, then
-    index by the live count). Keeps the previous median when the whole cohort
-    dropped/quarantined — an empty round must not zero the threshold."""
+def _masked_median(values, live, n):
+    """Median over the `live` entries of `values` (sort with dead entries
+    pushed to +inf, then index by the live count `n`). Undefined (garbage)
+    when n == 0 — callers gate on n > 0."""
+    s = jnp.sort(jnp.where(live, values, jnp.inf))
+    lo = jnp.clip((n - 1) // 2, 0, values.shape[0] - 1)
+    hi = jnp.clip(n // 2, 0, values.shape[0] - 1)
+    return 0.5 * (s[lo] + s[hi])
+
+
+def _round_median(norms, part_eff):
+    """(median, live count) of this round's LIVE, non-quarantined client
+    norms — the per-round observation every quarantine baseline (windowed
+    or not) is built from."""
     live = (part_eff > 0) & jnp.isfinite(norms)
     n_live = live.sum()
-    s = jnp.sort(jnp.where(live, norms, jnp.inf))
-    lo = jnp.clip((n_live - 1) // 2, 0, norms.shape[0] - 1)
-    hi = jnp.clip(n_live // 2, 0, norms.shape[0] - 1)
-    med = 0.5 * (s[lo] + s[hi])
+    return _masked_median(norms, live, n_live), n_live
+
+
+def _update_running_median(norms, part_eff, old_med):
+    """Next round's quarantine baseline, window=1 semantics: the median L2
+    norm over this round's live clients, keeping the previous median when
+    the whole cohort dropped/quarantined — an empty round must not zero the
+    threshold."""
+    med, n_live = _round_median(norms, part_eff)
     return jnp.where(n_live > 0, med, old_med)
+
+
+def _advance_quarantine(cfg: EngineConfig, qstate: dict, norms, part_eff) -> dict:
+    """One round's update of the quarantine server state.
+
+    quarantine_window == 1 (default): {"median": <window=1 update>} — the
+    exact pre-window arithmetic AND state tree, so the default is
+    bit-identical to the running-median behavior it replaces.
+
+    quarantine_window K > 1: push this round's live-cohort median into a
+    [K] ring (empty rounds push nothing) and set the ACTIVE threshold
+    baseline to the median over the filled slots — a norm distribution that
+    drifts across rounds moves the threshold at window speed instead of
+    snapping to the newest round, so fast-drifting models don't quarantine
+    healthy clients (and one outlier round perturbs one slot, not the whole
+    baseline)."""
+    if cfg.quarantine_window <= 1:
+        return {"median": _update_running_median(
+            norms, part_eff, qstate["median"])}
+    K = cfg.quarantine_window
+    med, n_live = _round_median(norms, part_eff)
+    has = n_live > 0
+    window = jnp.where(
+        has, jnp.concatenate([qstate["window"][1:], med[None]]),
+        qstate["window"])
+    count = jnp.where(has, jnp.minimum(qstate["count"] + 1, K),
+                      qstate["count"])
+    # the ring fills from the tail: the newest `count` slots are live
+    filled = jnp.arange(K) >= (K - count)
+    wmed = _masked_median(window, filled, count)
+    return {
+        "median": jnp.where(count > 0, wmed, qstate["median"]),
+        "window": window,
+        "count": count,
+    }
+
+
+def _split_quarantine_scope_check(cfg: EngineConfig):
+    """The split-compile program boundary threads exactly one scalar
+    (metrics['quarantine_median']) between the client and server programs —
+    a K-slot window ring cannot cross it without widening the boundary for
+    every split caller. The windowed baseline is a fused-path feature
+    (make_round_step, make_sharded_round_step, the payload merge); reject
+    the combination at build time instead of silently running window=1."""
+    if cfg.client_update_clip > 0 and cfg.quarantine_window > 1:
+        raise ValueError(
+            "quarantine_window > 1 is fused-paths-only: the split-compile "
+            "program boundary threads a single scalar median "
+            f"(got quarantine_window={cfg.quarantine_window} with a split "
+            "round step); drop --split_compile or use quarantine_window=1"
+        )
 
 
 def _tree_finite(tree) -> jnp.ndarray:
@@ -821,11 +939,12 @@ def make_round_step(
             new_net_state = _merge_net_state(nstates, net_state, part_eff)
             out_metrics = _survivor_metrics(metrics, part_eff)
 
-        new_med = None
+        new_q = None
         if cfg.client_update_clip > 0:
             out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()
-            new_med = _update_running_median(norms, part_eff, qmed)
-            out_metrics["quarantine_median"] = new_med
+            new_q = _advance_quarantine(cfg, state["quarantine"], norms,
+                                        part_eff)
+            out_metrics["quarantine_median"] = new_q["median"]
         agg, new_net_state, new_rows, out_metrics, fin_ok = _guard_nonfinite(
             cfg, agg, new_net_state, net_state, new_rows, client_rows,
             out_metrics,
@@ -851,8 +970,8 @@ def make_round_step(
             "mode_state": mode_state,
             "round": state["round"] + 1,
         }
-        if new_med is not None:
-            new_state["quarantine"] = {"median": new_med}
+        if new_q is not None:
+            new_state["quarantine"] = new_q
         if mcfg.mode == "local_topk":
             # support of the actually-broadcast delta (SURVEY.md §6 row 4):
             # the union of client supports when momentum keeps nothing extra
@@ -952,12 +1071,11 @@ def _merged_sharded_tail(
                                  jnp.maximum(part_eff.sum(), 1.0))
     new_net_state, out_metrics = _merged_survivor_finalize(
         ns_sum, m_sum, part_eff, state["net_state"])
-    new_med = None
+    new_q = None
     if cfg.client_update_clip > 0:
-        qmed = state["quarantine"]["median"]
         out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()
-        new_med = _update_running_median(norms, part_eff, qmed)
-        out_metrics["quarantine_median"] = new_med
+        new_q = _advance_quarantine(cfg, state["quarantine"], norms, part_eff)
+        out_metrics["quarantine_median"] = new_q["median"]
     agg, new_net_state, _, out_metrics, fin_ok = _guard_nonfinite(
         cfg, agg, new_net_state, state["net_state"], {}, {}, out_metrics,
     )
@@ -975,8 +1093,8 @@ def _merged_sharded_tail(
         "mode_state": mode_state,
         "round": state["round"] + 1,
     }
-    if new_med is not None:
-        new_state["quarantine"] = {"median": new_med}
+    if new_q is not None:
+        new_state["quarantine"] = new_q
     return new_state, out_metrics
 
 
@@ -1249,6 +1367,7 @@ def make_sharded_split_round_step(
     axes = meshlib.client_axes(mesh)
 
     quarantine = cfg.client_update_clip > 0
+    _split_quarantine_scope_check(cfg)
 
     # As in the fused sharded step, ONLY the per-shard work + gathers live
     # inside shard_map; merges and the server algebra run at jit top level
@@ -1436,6 +1555,7 @@ def make_split_round_step(
                         else None)
 
     quarantine = cfg.client_update_clip > 0
+    _split_quarantine_scope_check(cfg)
 
     def client_step(state, batch, lr, rng):
         batch, valid = split_valid(batch)
@@ -1592,6 +1712,232 @@ def compose_split(client_step: Callable, server_step: Callable) -> Callable:
             state, weighted, net_state, metrics["participants"], lr,
             noise_rng, qmed=metrics.get("quarantine_median"),
         )
+        return new_state, client_rows, metrics
+
+    return step
+
+
+def _table_norms(tables: jnp.ndarray) -> jnp.ndarray:
+    """[W] sketch-space L2 norm of each client's r x c payload table (f32
+    accumulation) — the quarantine observable of the wire-payload round: the
+    table IS the only object the server sees, so the screen (and the running
+    median it feeds) lives in sketch space. By the Count Sketch's isometry-
+    in-expectation each row's squared norm estimates the update's, so the
+    magnitude screen keeps its meaning; non-finite updates propagate into
+    non-finite tables, so the non-finite screen is exact."""
+    t = tables.astype(jnp.float32)
+    return jnp.sqrt(jnp.sum(jnp.square(t), axis=(1, 2)))
+
+
+def make_payload_round_steps(
+    loss_fn: Callable, cfg: EngineConfig, mesh=None
+) -> tuple[Callable, Callable]:
+    """The wire-payload round (cfg.wire_payloads) as TWO jittable programs —
+    the shape a serving deployment actually has:
+
+        client_step(state, batch, rng) -> (tables[W, r, c], nstates, mvals,
+                                           part, noise_rng)
+        merge_step(state, tables, nstates, mvals, part, arrived, lr,
+                   noise_rng) -> (state', metrics)
+
+    The client program is "the clients": each sampled client's fwd/bwd, DP
+    clip, and its OWN Count-Sketch table (the same csvec path the engine
+    compresses with) — one [r, c] table per client, the object that crosses
+    the wire. The merge program is "the server": it consumes ONLY the
+    per-client tables plus tiny per-client masks/metric rows — an ordered
+    masked sum through the SAME merge entry point the sharded path uses
+    (modes.merge_partial_wires), survivor normalization in wire space,
+    sketch-space quarantine (window-capable), non-finite guard, and the
+    FetchSGD server algebra.
+
+    The batch simulator composes the two back-to-back with arrived = ones;
+    the serving layer runs the client program, round-trips each client's
+    table through the transport (serialize -> socket -> validate), and feeds
+    the WIRE-DECODED tables + the arrival mask to the merge program. float32
+    serialization is exact, both paths run these same two compiled programs,
+    and a rejected/missing payload is a zero row under a 0 mask (exact zeros
+    via mask_rows either way) — which is what pins a served round with real
+    wire-crossed payloads BIT-identical to the server-computed batch round
+    over the same surviving cohort, and a rejected payload bitwise equal to
+    a dropped client.
+
+    Unlike the announce path there is no compress-once linearity shortcut:
+    the aggregate is the ordered sum of W per-client tables (a different fp
+    association than sketching the summed update), so wire-payload params
+    are NOT bit-comparable to announce-path params — equal in exact
+    arithmetic only. That is why --serve_payload announce stays the default.
+
+    client_shards S > 1 runs the client phase as a lax.map over S groups of
+    W/S vmapped clients (bounding live per-client gradients to W/S — the
+    payload path's chunking mechanism); per-client tables make the cross-
+    group arithmetic per-client, so the merge is shard-count-invariant. With
+    a mesh the groups become shard_map shards and the tables all_gather."""
+    mcfg = cfg.mode
+    if not cfg.wire_payloads:
+        raise ValueError(
+            "make_payload_round_steps requires cfg.wire_payloads=True (the "
+            "announce path compiles make_round_step and friends)"
+        )
+    _sharded_scope_check(mcfg)
+    grad_client = _make_grad_client(loss_fn, cfg)
+    quarantine = cfg.client_update_clip > 0
+
+    def per_client_tables(params, pflat, net_state, cb, crngs):
+        """One group's client phase: per-client flat grads -> per-client
+        DP-clipped updates -> one Count-Sketch table PER CLIENT (vmapped
+        client_compress — the exact table a real client would transmit)."""
+        updates, nstates, metrics = jax.vmap(
+            lambda b, r: grad_client(params, pflat, net_state, b, r)
+        )(cb, crngs)
+        updates = _clip_updates(cfg, updates)
+        tables = jax.vmap(
+            lambda u: modes.client_compress(mcfg, u, {})[0]["table"]
+        )(updates)
+        return tables, nstates, metrics
+
+    if mesh is None:
+        S = max(cfg.client_shards, 1)
+
+        def client_step(state, batch, rng):
+            batch, valid = split_valid(batch)
+            params, net_state = state["params"], state["net_state"]
+            pflat, _ = _ravel_params(params)
+            W = jax.tree.leaves(batch)[0].shape[0]
+            client_rngs, part, noise_rng = _cohort_streams(cfg, rng, W)
+            if valid is not None:
+                part = part * valid
+            if S <= 1:
+                tables, nstates, metrics = per_client_tables(
+                    params, pflat, net_state, batch, client_rngs)
+            else:
+                if W % S:
+                    raise ValueError(
+                        f"sampled cohort ({W}) not divisible by "
+                        f"client_shards={S}")
+                wl = W // S
+                groups = (
+                    jax.tree.map(
+                        lambda a: a.reshape((S, wl) + a.shape[1:]), batch),
+                    client_rngs.reshape((S, wl) + client_rngs.shape[1:]),
+                )
+                stacked = jax.lax.map(
+                    lambda xs: per_client_tables(
+                        params, pflat, net_state, *xs),
+                    groups,
+                )
+                tables, nstates, metrics = jax.tree.map(
+                    lambda a: a.reshape((W,) + a.shape[2:]), stacked)
+            return tables, nstates, metrics, part, noise_rng
+
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        from ..utils.jax_compat import shard_map
+
+        from ..parallel import mesh as meshlib
+
+        S, axis_names = _mesh_shard_info(mesh)
+        batch_spec = P(meshlib.client_axes(mesh))
+
+        def body(state, batch_l, rng):
+            params, net_state = state["params"], state["net_state"]
+            batch_l, valid_l = split_valid(batch_l)
+            pflat, _ = _ravel_params(params)
+            wl = jax.tree.leaves(batch_l)[0].shape[0]
+            all_rngs, part, noise_rng = _cohort_streams(cfg, rng, wl * S)
+            lo = _shard_index(mesh, axis_names) * wl
+            rngs_l = jax.lax.dynamic_slice_in_dim(all_rngs, lo, wl)
+            part_l = jax.lax.dynamic_slice_in_dim(part, lo, wl)
+            if valid_l is not None:
+                part_l = part_l * valid_l
+            locals_ = per_client_tables(
+                params, pflat, net_state, batch_l, rngs_l) + (part_l,)
+            stacked = jax.tree.map(
+                lambda x: jax.lax.all_gather(x, axis_names, axis=0, tiled=True),
+                locals_,
+            )
+            return stacked + (noise_rng,)
+
+        mapped = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), batch_spec, P()),
+            out_specs=tuple(P() for _ in range(5)),
+            check_rep=False,
+        )
+
+        def client_step(state, batch, rng):
+            tables, nstates, metrics, part, noise_rng = mapped(
+                state, batch, rng)
+            return tables, nstates, metrics, part, noise_rng
+
+    def merge_step(state, tables, nstates, mvals, part, arrived, lr,
+                   noise_rng):
+        """The server side: ordered masked sum of the (wire-delivered)
+        per-client tables. `part` is the client program's validity mask,
+        `arrived` the serving layer's 0/1 admission mask (ones in the batch
+        simulator) — a rejected or missing payload is a zero row under a 0
+        mask, exactly a dropped client."""
+        part = part * arrived
+        part_eff = part
+        norms = None
+        qmed = state["quarantine"]["median"] if quarantine else None
+        if quarantine:
+            norms = _table_norms(tables)
+            bad = _quarantine_mask(cfg, norms, qmed)
+            part_eff = part * (1.0 - bad.astype(part.dtype))
+        # THE merge: masked per-client tables through the same ordered-sum
+        # entry point the sharded mesh round uses (client-index order)
+        masked = modes.mask_rows(part_eff, tables)
+        wire_sum = modes.merge_partial_wires(mcfg, {"table": masked})
+        agg = _normalize_merged_wire(mcfg, wire_sum,
+                                     jnp.maximum(part_eff.sum(), 1.0))
+        new_net_state, out_metrics = _merged_survivor_finalize(
+            jax.tree.map(lambda s: modes.mask_rows(part_eff, s).sum(0),
+                         nstates),
+            jax.tree.map(lambda m: modes.mask_rows(part_eff, m).sum(axis=0),
+                         mvals),
+            part_eff, state["net_state"])
+        new_q = None
+        if quarantine:
+            out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()
+            new_q = _advance_quarantine(cfg, state["quarantine"], norms,
+                                        part_eff)
+            out_metrics["quarantine_median"] = new_q["median"]
+        agg, new_net_state, _, out_metrics, _ = _guard_nonfinite(
+            cfg, agg, new_net_state, state["net_state"], {}, {}, out_metrics,
+        )
+        # dp_noise is unreachable here: EngineConfig rejects dp_noise with
+        # mode=sketch, and wire_payloads requires mode=sketch
+        delta, mode_state = modes.server_step_sparse(
+            mcfg, agg, state["mode_state"], lr)
+        pflat, unravel = _ravel_params(state["params"])
+        new_state = {
+            "params": unravel(modes.apply_delta(pflat, delta)),
+            "net_state": new_net_state,
+            "mode_state": mode_state,
+            "round": state["round"] + 1,
+        }
+        if new_q is not None:
+            new_state["quarantine"] = new_q
+        return new_state, out_metrics
+
+    return client_step, merge_step
+
+
+def compose_payload(client_step: Callable, merge_step: Callable) -> Callable:
+    """Adapt the payload two-program pair to the fused-step signature, the
+    batch simulator's wire_payloads execution: client tables flow straight
+    into the merge (device-to-device — float32 wire serialization is exact,
+    so this IS the served arithmetic) with every invitee 'arrived'.
+    client_rows pass through untouched (the payload scope has no client-
+    local state)."""
+
+    def step(state, batch, client_rows, lr, rng):
+        tables, nstates, mvals, part, noise_rng = client_step(
+            state, batch, rng)
+        new_state, metrics = merge_step(
+            state, tables, nstates, mvals, part, jnp.ones_like(part), lr,
+            noise_rng)
         return new_state, client_rows, metrics
 
     return step

@@ -42,6 +42,13 @@ entries, each `kind[@round,round,...][:key=val,...]`:
                                 gradient path — caught per-client by the
                                 sketch-space quarantine (--client_update_clip)
                                 instead of costing the whole round
+    wire_corrupt@2:clients=0    flip a byte of position 0's payload frame at
+                                the transport seam in round 2 (checksum must
+                                reject it MALFORMED — serving payload runs,
+                                --serve_payload sketch; likewise
+                                wire_truncate / wire_dup / conn_drop, and
+                                wire_delay@r:clients=I:secs=S which delays
+                                the frame into the straggler discipline)
     host_preempt@3:host=0       SIGTERM round 3 ONLY on the host whose
                                 jax.process_index() == host — the one-host
                                 preemption the cross-host barrier
@@ -91,6 +98,15 @@ KINDS = {
     "client_straggle": ("clients", "secs"),
     "client_poison": ("clients", "value"),
     "host_preempt": ("host",),
+    # transport-seam sites (wire payloads, serve/ --serve_payload sketch):
+    # damage a client's FRAME between compute and ingest — the validation
+    # gauntlet, duplicate detection, close discipline, and read deadlines
+    # are what must absorb them. Same clients= position targeting.
+    "wire_corrupt": ("clients",),    # flip a payload byte (checksum catches)
+    "wire_truncate": ("clients",),   # cut the frame short (length prefix)
+    "wire_dup": ("clients",),        # at-least-once double send (dedup)
+    "wire_delay": ("clients", "secs"),  # late frame (straggler discipline)
+    "conn_drop": ("clients",),       # connection dies mid-send (no-show)
 }
 
 # the client_* sites fire inside a round's preparation: scheduled at or past
@@ -98,6 +114,11 @@ KINDS = {
 # test failure mode this module exists to prevent. FaultPlan.validate_rounds
 # rejects them at launch (the run length isn't known at parse time).
 CLIENT_KINDS = ("client_drop", "client_straggle", "client_poison")
+
+# the wire_* sites fire at the serving transport seam as a round's payloads
+# ship; same dead-schedule validation as the client kinds
+WIRE_KINDS = ("wire_corrupt", "wire_truncate", "wire_dup", "wire_delay",
+              "conn_drop")
 
 
 class InjectedFault(RuntimeError):
@@ -246,7 +267,8 @@ class FaultPlan:
         never fire; reject it loudly instead of letting the chaos run pass
         vacuously."""
         for s in self.specs:
-            if (s.kind in CLIENT_KINDS or s.kind == "host_preempt") and s.rounds:
+            if (s.kind in CLIENT_KINDS + WIRE_KINDS
+                    or s.kind == "host_preempt") and s.rounds:
                 dead = [r for r in s.rounds if r >= total_rounds]
                 if dead:
                     raise ValueError(
@@ -264,6 +286,23 @@ class FaultPlan:
                         f"fire — this job has {jax.process_count()} "
                         "process(es) (host is a 0-based jax.process_index)"
                     )
+
+    def validate_wire_context(self, payload_path_armed: bool) -> None:
+        """Launch-time context validation for the wire_* kinds: they inject
+        at the serving payload seam (FaultPlan.wire_plan, called only by
+        the --serve_payload sketch round), so a plan naming them on any
+        other run — announce serving, the batch loop — would pass
+        vacuously with zero injections; reject it loudly, same contract as
+        validate_rounds."""
+        if payload_path_armed:
+            return
+        dead = sorted({s.kind for s in self.specs if s.kind in WIRE_KINDS})
+        if dead:
+            raise ValueError(
+                f"--fault_plan: {', '.join(dead)} can never fire — the "
+                "wire kinds damage payload frames at the serving transport "
+                "seam and need --serve inproc|socket with --serve_payload "
+                "sketch; on this run the chaos plan would pass vacuously")
 
     def _log(self, msg: str):
         print(f"fault-injection: {msg}", file=sys.stderr, flush=True)
@@ -462,6 +501,76 @@ class FaultPlan:
                       "re-queued)")
             self._mark("client_drop", rnd, clients=pos)
         return batch, valid, dropped
+
+    # ------------------------------------------------- transport-seam sites
+
+    def wire_plan(self, rnd: int, num_workers: int) -> dict[int, dict]:
+        """Per-position wire damage for round `rnd`'s payload shipments,
+        applied by the traffic layer at the transport seam (between a
+        client's table compute and the server's ingest): {position:
+        {"corrupt": bool, "truncate": bool, "dup": bool, "delay_s": float,
+        "drop": bool}}. One-shot per (kind, round, clients) like the other
+        cohort sites; every armed action lands an obs instant + the
+        resilience counter HERE (the seam is about to apply it), so a chaos
+        run's injected-faults count covers the wire."""
+        plan: dict[int, dict] = {}
+
+        def slot(p: int) -> dict:
+            return plan.setdefault(
+                int(p), {"corrupt": False, "truncate": False, "dup": False,
+                         "delay_s": 0.0, "drop": False})
+
+        for kind, field in (("wire_corrupt", "corrupt"),
+                            ("wire_truncate", "truncate"),
+                            ("wire_dup", "dup"),
+                            ("conn_drop", "drop")):
+            for s in self.specs_for(kind, rnd):
+                key = (kind, rnd, s.params.get("clients", (0,)))
+                if key in self._fired:
+                    continue
+                self._fired.add(key)
+                pos = list(self._positions(s, num_workers, rnd))
+                for p in pos:
+                    slot(p)[field] = True
+                self._log(f"{kind} on cohort positions {pos} (round {rnd})")
+                self._mark(kind, rnd, clients=pos)
+        for s in self.specs_for("wire_delay", rnd):
+            key = ("wire_delay", rnd, s.params.get("clients", (0,)))
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            pos = list(self._positions(s, num_workers, rnd))
+            secs = float(s.params.get("secs", 1.0))
+            for p in pos:
+                slot(p)["delay_s"] += secs
+            self._log(f"wire_delay {secs}s on cohort positions {pos} "
+                      f"(round {rnd})")
+            self._mark("wire_delay", rnd, clients=pos, secs=secs)
+        return plan
+
+    @staticmethod
+    def corrupt_frame(frame: dict) -> dict:
+        """One flipped payload byte: decode the frame's data, flip the
+        middle byte, re-encode — and leave the checksum STALE, which is the
+        attack the per-payload crc32 exists to catch (the gauntlet must
+        reject with MALFORMED)."""
+        import base64
+
+        raw = bytearray(base64.b64decode(frame["data"]))
+        if raw:
+            raw[len(raw) // 2] ^= 0xFF
+        return {**frame, "data": base64.b64encode(bytes(raw)).decode("ascii")}
+
+    @staticmethod
+    def truncate_frame(frame: dict) -> dict:
+        """Cut the frame's data short (half the bytes survive) while the
+        length-prefix claim stays intact — the decoded-length check must
+        reject with MALFORMED before anything parses the partial table."""
+        import base64
+
+        raw = base64.b64decode(frame["data"])
+        return {**frame,
+                "data": base64.b64encode(raw[:len(raw) // 2]).decode("ascii")}
 
     def corrupt_checkpoint(self, rnd: int, path: str):
         """Post-commit checkpoint damage (one-shot per kind+round):

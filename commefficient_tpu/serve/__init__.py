@@ -6,32 +6,46 @@ server-side merge of asynchronously-arriving updates cheap. This package is
 that inversion over the existing engine/runner machinery:
 
 - `ingest`    — bounded arrival queue with admission control (backpressure,
-  duplicate / out-of-round rejection, early-push buffering)
+  duplicate / out-of-round rejection, early-push buffering, load shedding)
+  plus the wire-payload validation gauntlet (`validate_payload` — THE
+  sanctioned deserialization boundary for untrusted frame bytes,
+  graftlint G011)
 - `transport` — in-process (tests/bench/parity) and loopback-socket
-  (JSON-lines wire realism) submission fronts
+  (JSON-lines wire realism) submission fronts, hardened against a hostile
+  peer: per-connection read deadlines, max-frame caps, force-closed
+  connections on stop; client helpers with bounded jittered retries
 - `assembler` — over-provisioned cohorts that close at W-of-N arrivals;
   stragglers and no-shows masked + re-queued via the PR 4 `_valid`/
   `_requeue` machinery, so a short cohort is bit-identical to the round
-  over its survivors
+  over its survivors; payload rounds collect the validated table stack
 - `clients`   — O(1)-per-participant client state: fold_in-derived per-
   client streams and device classes, no per-client table (10M-ID safe)
 - `traffic`   — trace-driven generator: diurnal load, bursts, device
-  classes with distinct straggle distributions (test harness + BENCH_SERVE)
+  classes with distinct straggle distributions (test harness + BENCH_SERVE);
+  payload rounds ship per-invitee tables with wire-fault injection at the
+  transport seam
 - `metrics`   — the ops surface: /metrics JSON endpoint (round, queue
-  depth, arrival rate, quarantine/requeue counters)
+  depth, arrival rate, quarantine/requeue/rejection/shed counters)
 - `service`   — `AggregationService` + `ServedSource`: the service drives
   `runner.run_loop(source=...)` instead of the loop pulling clients
 
 Both CLIs expose it as `--serve {inproc,socket}` (+ `--serve_quorum`,
-`--serve_deadline`, `--serve_trace`, `--serve_metrics_port`).
+`--serve_deadline`, `--serve_trace`, `--serve_metrics_port`,
+`--serve_payload {announce,sketch}`, `--serve_shed_watermark`).
 """
 
 from .assembler import ClosedRound, CohortAssembler
-from .ingest import IngestQueue, Submission
+from .ingest import IngestQueue, PayloadPolicy, Submission, validate_payload
 from .metrics import MetricsServer
 from .service import AggregationService, ServeConfig, ServedSource
 from .traffic import TraceConfig, TrafficGenerator
-from .transport import InProcessTransport, SocketTransport, submit_over_socket
+from .transport import (
+    InProcessTransport,
+    SocketTransport,
+    abort_over_socket,
+    submit_over_socket,
+    submit_with_retries,
+)
 
 __all__ = [
     "AggregationService",
@@ -40,11 +54,15 @@ __all__ = [
     "IngestQueue",
     "InProcessTransport",
     "MetricsServer",
+    "PayloadPolicy",
     "ServeConfig",
     "ServedSource",
     "SocketTransport",
     "Submission",
     "TraceConfig",
     "TrafficGenerator",
+    "abort_over_socket",
     "submit_over_socket",
+    "submit_with_retries",
+    "validate_payload",
 ]

@@ -47,6 +47,12 @@ class ClosedRound:
     # accepted) aligned with `invited` — the obs layer turns these into
     # submission-to-merge spans when the round's merge commits
     wall_ts: np.ndarray | None = None
+    # wire-payload rounds only: [N, r, c] float32 validated client tables
+    # aligned with `invited` — a zero row everywhere a payload missed the
+    # merge (no-show, straggler, rejected frame), so a rejected payload is
+    # BITWISE a dropped client before the merge even sees it. None on the
+    # announce path.
+    tables: np.ndarray | None = None
 
     @property
     def survivors(self) -> int:
@@ -54,12 +60,16 @@ class ClosedRound:
 
 
 class CohortAssembler:
-    def __init__(self, queue: IngestQueue, quorum: int, deadline_s: float):
+    def __init__(self, queue: IngestQueue, quorum: int, deadline_s: float,
+                 payload_shape: tuple | None = None):
         if quorum < 1:
             raise ValueError(f"quorum must be >= 1, got {quorum}")
         self.queue = queue
         self.quorum = quorum
         self.deadline_s = deadline_s
+        # (r, c) of the wire-payload tables; None = announce path (closed
+        # rounds carry no table stack)
+        self.payload_shape = payload_shape
         # cumulative close counters (metrics endpoint)
         self.rounds_closed = 0
         self.closed_by_quorum = 0
@@ -91,20 +101,30 @@ class CohortAssembler:
             closed_by = "deadline"
         arrived = (lat <= close).astype(np.float32)
         return self._finish(rnd, invited, arrived, lat, closed_by, close,
-                            walls)
+                            walls, self._collect_tables(pos, arrivals,
+                                                        arrived, len(invited)))
 
     def close_wall(self, rnd: int, invited) -> ClosedRound:
         """Close on real arrival order: wait for quorum-or-deadline on the
         queue, then cut at the quorum-th ARRIVAL (recv order). Latencies in
-        the result are the submitted ones (accounting only)."""
-        self.queue.wait_for(self.quorum, self.deadline_s)
+        the result are the submitted ones (accounting only).
+
+        The cut is decided on the SNAPSHOT wait_for returned — the admission
+        state at the instant the wait was satisfied. Under concurrent socket
+        connections more submissions can be ADMITTED between that instant
+        and close_round() draining the queue; those are recv-order
+        stragglers (they arrived after the wall-clock cut) and must not ride
+        in just because they beat the drain — deciding on the drained list
+        would also let a deadline-expired wait flip to closed_by="quorum"
+        when late arrivals pile in during the gap."""
+        cut = self.queue.wait_for(self.quorum, self.deadline_s)
         arrivals = self.queue.close_round()
         invited = np.asarray(invited, np.int64)
         pos = {int(c): i for i, c in enumerate(invited)}
         lat = np.full(len(invited), np.inf)
         walls = np.full(len(invited), np.inf)
         arrived = np.zeros(len(invited), np.float32)
-        made_cut = sorted(arrivals, key=lambda a: a.recv_order)[:self.quorum]
+        made_cut = sorted(cut, key=lambda a: a.recv_order)[:self.quorum]
         for a in arrivals:
             if int(a.client_id) in pos:
                 lat[pos[int(a.client_id)]] = a.latency_s
@@ -112,14 +132,31 @@ class CohortAssembler:
         for a in made_cut:
             if int(a.client_id) in pos:
                 arrived[pos[int(a.client_id)]] = 1.0
-        closed_by = "quorum" if len(arrivals) >= self.quorum else "deadline"
+        closed_by = "quorum" if len(cut) >= self.quorum else "deadline"
         close = (max((a.latency_s for a in made_cut), default=self.deadline_s)
                  if closed_by == "quorum" else self.deadline_s)
         return self._finish(rnd, invited, arrived, lat, closed_by, close,
-                            walls)
+                            walls, self._collect_tables(pos, arrivals,
+                                                        arrived, len(invited)))
+
+    def _collect_tables(self, pos, arrivals, arrived,
+                        n: int) -> np.ndarray | None:
+        """[N, r, c] validated-table stack for a payload round: each
+        invitee's table where its submission both PASSED the gauntlet and
+        made the close, an exact-zero row everywhere else (no-show,
+        straggler, rejected frame) — so downstream a rejected payload is
+        bitwise a dropped client. None on the announce path."""
+        if self.payload_shape is None:
+            return None
+        out = np.zeros((n,) + tuple(self.payload_shape), np.float32)
+        for a in arrivals:
+            p = pos.get(int(a.client_id))
+            if p is not None and arrived[p] == 1.0 and a.table is not None:
+                out[p] = a.table
+        return out
 
     def _finish(self, rnd, invited, arrived, lat, closed_by,
-                close, walls=None) -> ClosedRound:
+                close, walls=None, tables=None) -> ClosedRound:
         submitted = np.isfinite(lat)
         stragglers = int((submitted & (arrived == 0.0)).sum())
         no_shows = int((~submitted).sum())
@@ -138,6 +175,7 @@ class CohortAssembler:
             rnd=rnd, invited=invited, arrived=arrived, latencies=lat,
             closed_by=closed_by, close_latency_s=float(close),
             stragglers=stragglers, no_shows=no_shows, wall_ts=walls,
+            tables=tables,
         )
 
     def counters(self) -> dict[str, int]:

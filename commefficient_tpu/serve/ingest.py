@@ -12,29 +12,65 @@ off on).
 Admission rules, in check order:
 
 - ``CLOSED``       — the service is shutting down (or no round ever opened).
+- ``SHEDDING``     — load shedding: the queue is past its pressure watermark
+  and the submission is turned away BEFORE any expensive work (with a
+  retry-after hint on the socket wire), so overload degrades gracefully
+  instead of queuing unboundedly. One O(1) probe runs first: a retry of an
+  already-ADMITTED submission still hears DUPLICATE (== success) so an
+  at-least-once client never burns its retry budget on a submission the
+  merge will count.
 - ``QUEUE_FULL``   — the bounded queue is at capacity: backpressure.
 - ``OUT_OF_ROUND`` — the submission names a round that is not the open one.
   Late (already-closed round) is always rejected; EARLY (the round after the
   open one — or after the last CLOSED one while the server is mid-merge
   between rounds) is buffered in the bounded pending queue and admitted when
   that round opens — a pushing client does not resubmit just because the
-  server is mid-merge.
+  server is mid-merge. With a payload policy armed, early pushes are
+  rejected instead of buffered: a sketch payload is a function of the open
+  round's params, so a table "for the next round" cannot exist yet.
 - ``NOT_INVITED``  — the client is not in the open round's cohort.
 - ``DUPLICATE``    — the client already has an accepted submission this
   round (an at-least-once transport may retry; the merge must not double
   count a client).
 
+With a payload policy armed (the wire-payload round, ``--serve_payload
+sketch``), an otherwise-admissible submission then runs the VALIDATION
+GAUNTLET (`validate_payload` — the one sanctioned deserialization boundary,
+graftlint G011) before anything can reach compiled scope; its docstring has
+the exact first-failure-wins check order (structural MALFORMED, then
+STALE_SCHEMA, then layout MALFORMED, then QUARANTINED):
+
+- ``STALE_SCHEMA`` — the frame names a wire schema version this server does
+  not speak (refuse rather than guess at layout).
+- ``MALFORMED``    — missing payload, undecodable base64, dtype/shape
+  mismatch against the server's OWN sketch spec, length-prefix (nbytes)
+  mismatch, or a checksum failure (one flipped bit anywhere rejects).
+- ``QUARANTINED``  — the decoded table is non-finite, or its sketch-space
+  L2 norm exceeds the quarantine multiple of the running median (the PR 4
+  screen, applied at the wire): a poisoned payload is dropped BEFORE the
+  merge, bitwise equal to that client never submitting.
+
 All counters are cumulative over the service lifetime and feed the metrics
-endpoint (serve/metrics.py).
+endpoint (serve/metrics.py); the wire-facing rejections additionally bump
+process-wide resilience counters in the obs registry.
 """
 
 from __future__ import annotations
 
+import base64
+import binascii
 import dataclasses
+import sys
 import threading
 import time
+import zlib
+from typing import Any, Callable
 
+import numpy as np
+
+from ..obs import registry as obreg
 from ..obs import trace as obtrace
+from ..sketch.payload import SCHEMA_VERSION, WIRE_DTYPE
 
 # rejection reasons (wire-visible: the socket transport echoes them)
 ACCEPTED = "ACCEPTED"
@@ -44,6 +80,21 @@ OUT_OF_ROUND = "OUT_OF_ROUND"
 NOT_INVITED = "NOT_INVITED"
 DUPLICATE = "DUPLICATE"
 BUFFERED = "BUFFERED"  # early submission parked for the next round
+# wire-payload gauntlet + overload decisions (see module docstring)
+MALFORMED = "MALFORMED"
+STALE_SCHEMA = "STALE_SCHEMA"
+QUARANTINED = "QUARANTINED"
+SHEDDING = "SHEDDING"
+
+# obs-registry resilience counters per wire-facing rejection class: the
+# chaos acceptance reads these (every rejection = a decision + an obs
+# instant + a counter)
+_REJECTION_COUNTERS = {
+    MALFORMED: "serve_rejected_malformed_total",
+    STALE_SCHEMA: "serve_rejected_stale_schema_total",
+    QUARANTINED: "serve_rejected_quarantined_total",
+    SHEDDING: "serve_shed_total",
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,12 +103,17 @@ class Submission:
     to the round's invite (simulated by the traffic generator; a real client
     would stamp send time) — the assembler's VIRTUAL clock orders arrivals
     by it, so a served round is a pure function of the submission set.
-    `payload_bytes` sizes the (simulated) sketch blob for wire accounting."""
+    `payload_bytes` sizes the (simulated) sketch blob for wire accounting.
+    `payload` is the wire payload of a sketch-carrying submission
+    (--serve_payload sketch): a raw [r, c] float32 ndarray on the in-process
+    transport, a frame dict (sketch/payload.py encode_frame) off the socket
+    wire — None on the announce path."""
 
     client_id: int
     round: int
     latency_s: float = 0.0
     payload_bytes: int = 0
+    payload: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +126,122 @@ class Arrival:
     # host wall timestamp (perf_counter) of the ACCEPT: the start of the
     # submission-to-merge latency the obs layer resolves at commit
     wall_t: float = 0.0
+    # the VALIDATED [r, c] table of a payload-carrying submission (already
+    # through the gauntlet — the only route wire bytes take to the merge)
+    table: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class PayloadPolicy:
+    """What the server demands of a wire payload (--serve_payload sketch):
+    its OWN sketch spec's shape, and the PR 4 quarantine screen applied at
+    the wire. `quarantine_median` is a zero-arg callable returning the live
+    threshold baseline (FederatedSession.quarantine_median_host) so the
+    screen tracks the running median without re-arming the queue per round;
+    `clip_multiple` is --client_update_clip (0 = only the non-finite
+    screen)."""
+
+    rows: int
+    cols: int
+    clip_multiple: float = 0.0
+    quarantine_median: Callable[[], float] | None = None
+
+    @property
+    def nbytes(self) -> int:
+        return self.rows * self.cols * 4  # float32 wire dtype
+
+
+# graftlint: payload-boundary — THE sanctioned decode of untrusted wire
+# bytes; every transport payload passes through here before compiled scope
+def validate_payload(payload, policy: PayloadPolicy,
+                     median: float | None = None):
+    """THE deserialization boundary for untrusted wire bytes (graftlint
+    G011): every byte a transport hands the server passes through here
+    before anything can reach compiled scope. Returns (table, decision,
+    detail) — `table` is a validated host float32 [r, c] ndarray only when
+    decision == ACCEPTED, else None.
+
+    Check order (first failure wins — a frame with several defects reports
+    the EARLIEST stage, so an unknown-schema frame with a bad checksum is
+    STALE_SCHEMA, never MALFORMED):
+      MALFORMED     structural: missing payload / not a frame dict or array
+                    / missing or unparseable schema field
+      STALE_SCHEMA  the frame names a wire schema version this server does
+                    not speak — refused BEFORE any layout field is trusted
+                    (an unknown schema means the layout checks below would
+                    be guesses)
+      MALFORMED     layout, against the server's OWN spec: dtype / shape /
+                    undecodable base64 / length-prefix (nbytes) mismatch /
+                    checksum failure (one flipped bit anywhere rejects)
+      QUARANTINED   the decoded table is non-finite, or its sketch-space L2
+                    exceeds the quarantine multiple of the running median —
+                    a poisoned payload drops BEFORE the merge, bitwise equal
+                    to that client never submitting
+
+    The in-process transport passes raw ndarrays (no frame to decode — the
+    dtype/shape and quarantine screens still apply); the socket transport
+    passes the frame dict its wire carried."""
+    if payload is None:
+        return None, MALFORMED, "no payload on a sketch-payload round"
+    if isinstance(payload, np.ndarray):
+        t = payload
+        if t.dtype != np.float32:
+            return None, MALFORMED, f"dtype {t.dtype} != float32"
+        if t.shape != (policy.rows, policy.cols):
+            return None, MALFORMED, (
+                f"shape {t.shape} != ({policy.rows}, {policy.cols})")
+        return _screen_table(np.ascontiguousarray(t), policy, median)
+    if not isinstance(payload, dict):
+        return None, MALFORMED, f"payload is {type(payload).__name__}"
+    try:
+        schema = int(payload["schema"])
+    except (KeyError, TypeError, ValueError):
+        return None, MALFORMED, "missing/bad schema field"
+    if schema != SCHEMA_VERSION:
+        return None, STALE_SCHEMA, (
+            f"schema {schema}, server speaks {SCHEMA_VERSION}")
+    if payload.get("dtype") != WIRE_DTYPE:
+        return None, MALFORMED, f"dtype {payload.get('dtype')!r} != {WIRE_DTYPE}"
+    if list(payload.get("shape", ())) != [policy.rows, policy.cols]:
+        return None, MALFORMED, (
+            f"shape {payload.get('shape')} != [{policy.rows}, {policy.cols}]")
+    try:
+        nbytes = int(payload["nbytes"])
+        crc = int(payload["crc32"])
+        raw = base64.b64decode(payload["data"], validate=True)
+    except (KeyError, TypeError, ValueError, binascii.Error) as e:
+        return None, MALFORMED, f"undecodable frame ({type(e).__name__})"
+    if nbytes != policy.nbytes:
+        return None, MALFORMED, (
+            f"length prefix {nbytes} != spec {policy.nbytes}")
+    if len(raw) != nbytes:
+        return None, MALFORMED, (
+            f"decoded {len(raw)} bytes, length prefix says {nbytes}")
+    if (zlib.crc32(raw) & 0xFFFFFFFF) != crc:
+        return None, MALFORMED, "checksum mismatch"
+    t = np.frombuffer(raw, dtype=WIRE_DTYPE).astype(
+        np.float32).reshape(policy.rows, policy.cols)
+    return _screen_table(t, policy, median)
+
+
+def _screen_table(t: np.ndarray, policy: PayloadPolicy,
+                  median: float | None = None):
+    """The PR 4 quarantine screen in sketch space, applied at the wire: a
+    payload rejected here is bitwise a dropped client (zero row, zero mask)
+    — the merge also re-screens, so the wire screen is a cheap early drop,
+    never the only line."""
+    if not np.isfinite(t).all():
+        return None, QUARANTINED, "non-finite table"
+    if policy.clip_multiple > 0 and policy.quarantine_median is not None:
+        med = (float(policy.quarantine_median())
+               if median is None else float(median))
+        if med > 0:
+            norm = float(np.sqrt(np.square(t, dtype=np.float64).sum()))
+            if norm > policy.clip_multiple * med:
+                return None, QUARANTINED, (
+                    f"sketch L2 {norm:.3g} > {policy.clip_multiple:g} x "
+                    f"median {med:.3g}")
+    return t, ACCEPTED, ""
 
 
 class IngestQueue:
@@ -77,11 +249,33 @@ class IngestQueue:
     buffer of early submissions. Thread-safe: transports submit from their
     own threads; the assembler consumes under the same lock."""
 
-    def __init__(self, capacity: int = 1024, pending_capacity: int = 256):
+    def __init__(self, capacity: int = 1024, pending_capacity: int = 256,
+                 payload_policy: PayloadPolicy | None = None,
+                 shed_watermark: float = 0.0,
+                 shed_retry_after_s: float = 1.0):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if not 0.0 <= shed_watermark <= 1.0:
+            raise ValueError(
+                f"shed_watermark must be in [0, 1] (a fraction of total "
+                f"queue capacity; 0 = shedding off), got {shed_watermark}")
         self.capacity = capacity
         self.pending_capacity = max(pending_capacity, 0)
+        # wire-payload gauntlet (None = announce path: payloads ignored)
+        self.payload_policy = payload_policy
+        # load shedding: depth at/past this fraction of TOTAL capacity
+        # (arrivals + pending) turns submissions away BEFORE any other
+        # work, with a retry-after hint — overload degrades gracefully
+        # instead of queuing unboundedly. 0 = off (QUEUE_FULL only).
+        self._shed_depth = (
+            max(int(shed_watermark * (capacity + max(pending_capacity, 0))),
+                1)
+            if shed_watermark > 0 else 0)
+        self.shed_retry_after_s = shed_retry_after_s
+        # the open round's quarantine-median snapshot (taken at open_round,
+        # host float): every payload in a round screens against the same
+        # baseline, and no submission pays a device fetch under the lock
+        self._round_median = 0.0
         self._cv = threading.Condition()
         self._open_round: int | None = None
         # the round an early push may target while NO round is open (the
@@ -108,6 +302,20 @@ class IngestQueue:
         self.rejected_out_of_round = 0
         self.rejected_uninvited = 0
         self.rejected_closed = 0
+        # wire-facing rejections (payload gauntlet + overload)
+        self.rejected_malformed = 0
+        self.rejected_stale_schema = 0
+        self.rejected_quarantined = 0
+        self.shed = 0
+
+    def note_wire_malformed(self) -> None:
+        """Count a MALFORMED rejection the TRANSPORT decided (oversized
+        frame, unparseable line) — it never reaches submit(), but the
+        /metrics submissions block must still see it, or an operator
+        watching rejected_malformed concludes a byte-flood isn't
+        happening."""
+        with self._cv:
+            self.rejected_malformed += 1
 
     # -- round lifecycle (assembler side) ------------------------------------
 
@@ -116,7 +324,17 @@ class IngestQueue:
         from invited clients are admitted immediately (recv order preserved);
         pending entries from clients NOT in this cohort stay parked for the
         round after (they pushed for "whatever opens next")."""
+        # snapshot the quarantine median BEFORE taking the lock: the read
+        # may sync from device (quarantine_median_host), and the baseline
+        # is constant for the whole round anyway (server state only
+        # advances at the merge)
+        median = 0.0
+        p = self.payload_policy
+        if (p is not None and p.clip_multiple > 0
+                and p.quarantine_median is not None):
+            median = float(p.quarantine_median())
         with self._cv:
+            self._round_median = median
             if self._closed:
                 raise RuntimeError("IngestQueue is closed")
             self._open_round = rnd
@@ -175,6 +393,11 @@ class IngestQueue:
         Every decision is a trace instant on the serve-ingest track, linked
         to the later merge span by the `submission` id (r<round>/c<cid>)."""
         status = self._decide(sub)
+        counter = _REJECTION_COUNTERS.get(status)
+        if counter is not None:
+            # wire-facing rejection: a process-wide resilience counter the
+            # chaos acceptance reads, alongside the admission counter
+            obreg.default().counter(counter).inc()
         if obtrace.get().enabled:
             # guard BEFORE building args: this is the admission hot path
             # (the ingest bench pushes ~1e5 submissions/s through it), and
@@ -187,45 +410,120 @@ class IngestQueue:
         return status
 
     def _decide(self, sub: Submission) -> str:
+        cid = int(sub.client_id)
         with self._cv:
+            status = self._precheck(sub, cid)
+            if status is not None:
+                return status
+            if self.payload_policy is None:
+                # announce path: nothing left to validate — admit under the
+                # same lock hold (the 1e5/s ingest-bench hot path)
+                self._admit(cid, float(sub.latency_s))
+                self._cv.notify_all()
+                return ACCEPTED
+            median = self._round_median
+        # the validation gauntlet runs OUTSIDE the lock: base64 + crc32 +
+        # ndarray work over up-to-max-frame bytes is CPU-bound, and the
+        # per-connection threads must not serialize behind the one condvar
+        # the assembler's wait_for also lives on. The screen threshold is
+        # the round's SNAPSHOT median (taken at open_round): every payload
+        # in a round is judged against the same baseline no matter how its
+        # arrival races the merge — and no device fetch under the lock.
+        table, decision, detail = validate_payload(
+            sub.payload, self.payload_policy, median=median)
+        if decision != ACCEPTED:
+            with self._cv:
+                if decision == MALFORMED:
+                    self.rejected_malformed += 1
+                elif decision == STALE_SCHEMA:
+                    self.rejected_stale_schema += 1
+                else:
+                    self.rejected_quarantined += 1
+            print(f"serve: payload from client {cid} rejected "
+                  f"{decision} ({detail})", file=sys.stderr, flush=True)
+            return decision
+        with self._cv:
+            # re-check: the world may have moved while this thread decoded
+            # (round closed, a duplicate landed, capacity filled)
             if self._closed:
                 self.rejected_closed += 1
                 return CLOSED
-            cid = int(sub.client_id)
             if self._open_round is None or sub.round != self._open_round:
-                if (self._next_round is not None
-                        and sub.round == self._next_round):
-                    # early push for the next round: park it, bounded
-                    # (dup before full: a retry of an already-parked push is
-                    # a DUPLICATE even when the buffer has no room left)
-                    if any(c == cid for c, _ in self._pending):
-                        self.rejected_dup += 1
-                        return DUPLICATE
-                    if len(self._pending) >= self.pending_capacity:
-                        self.rejected_full += 1
-                        return QUEUE_FULL
-                    self._pending.append((cid, float(sub.latency_s)))
-                    self.buffered += 1
-                    return BUFFERED
                 self.rejected_out_of_round += 1
                 return OUT_OF_ROUND
-            if cid not in self._invited:
-                self.rejected_uninvited += 1
-                return NOT_INVITED
             if cid in self._seen:
                 self.rejected_dup += 1
                 return DUPLICATE
             if len(self._arrivals) >= self.capacity:
                 self.rejected_full += 1
                 return QUEUE_FULL
-            self._admit(cid, float(sub.latency_s))
+            self._admit(cid, float(sub.latency_s), table)
             self._cv.notify_all()
             return ACCEPTED
 
-    def _admit(self, cid: int, latency_s: float) -> None:
+    def _precheck(self, sub: Submission, cid: int) -> str | None:
+        """Everything before the payload gauntlet — cheap O(1) set/dict
+        probes, lock held. Returns a decision, or None when the submission
+        is admissible so far (the caller then runs the gauntlet, or admits
+        directly on the announce path)."""
+        if self._closed:
+            self.rejected_closed += 1
+            return CLOSED
+        if (self._shed_depth
+                and len(self._arrivals) + len(self._pending)
+                >= self._shed_depth):
+            if (self._open_round is not None
+                    and sub.round == self._open_round
+                    and cid in self._seen):
+                # at-least-once under overload: a retry of an ALREADY
+                # ADMITTED submission must hear DUPLICATE (== success, the
+                # reply was lost), not SHEDDING — otherwise the client
+                # burns its whole retry budget on a submission the merge
+                # will count. An O(1) probe, so the shed path stays
+                # flood-cheap.
+                self.rejected_dup += 1
+                return DUPLICATE
+            # overload: turn the submission away BEFORE any other work
+            # (no invite lookup, no payload decode — the whole point is
+            # bounding the per-rejection cost under a flood)
+            self.shed += 1
+            return SHEDDING
+        if self._open_round is None or sub.round != self._open_round:
+            if (self._next_round is not None
+                    and sub.round == self._next_round
+                    and self.payload_policy is None):
+                # early push for the next round: park it, bounded
+                # (dup before full: a retry of an already-parked push is
+                # a DUPLICATE even when the buffer has no room left)
+                if any(c == cid for c, _ in self._pending):
+                    self.rejected_dup += 1
+                    return DUPLICATE
+                if len(self._pending) >= self.pending_capacity:
+                    self.rejected_full += 1
+                    return QUEUE_FULL
+                self._pending.append((cid, float(sub.latency_s)))
+                self.buffered += 1
+                return BUFFERED
+            self.rejected_out_of_round += 1
+            return OUT_OF_ROUND
+        if cid not in self._invited:
+            self.rejected_uninvited += 1
+            return NOT_INVITED
+        if cid in self._seen:
+            self.rejected_dup += 1
+            return DUPLICATE
+        if len(self._arrivals) >= self.capacity:
+            self.rejected_full += 1
+            return QUEUE_FULL
+        # admissible so far: the payload path now runs the gauntlet (lock
+        # released) and re-checks; the announce path admits immediately
+        return None
+
+    def _admit(self, cid: int, latency_s: float, table=None) -> None:
         """Record an accepted arrival (lock held)."""
         self._arrivals.append(
-            Arrival(cid, latency_s, self._recv_counter, time.perf_counter()))
+            Arrival(cid, latency_s, self._recv_counter, time.perf_counter(),
+                    table))
         self._recv_counter += 1
         self._seen.add(cid)
         self.accepted += 1
@@ -260,4 +558,8 @@ class IngestQueue:
                 "rejected_out_of_round": self.rejected_out_of_round,
                 "rejected_uninvited": self.rejected_uninvited,
                 "rejected_closed": self.rejected_closed,
+                "rejected_malformed": self.rejected_malformed,
+                "rejected_stale_schema": self.rejected_stale_schema,
+                "rejected_quarantined": self.rejected_quarantined,
+                "shed": self.shed,
             }

@@ -7,7 +7,10 @@ a loopback operator surface, the moral equivalent of a /healthz):
     queue_depth            open-round arrivals + parked early submissions
     arrival_rate_per_s     accepted submissions/s (sliding 60 s window)
     submissions            cumulative admission counters (accepted, buffered,
-                           rejected_full/_dup/_out_of_round/_uninvited/_closed)
+                           rejected_full/_dup/_out_of_round/_uninvited/
+                           _closed, and the wire-facing gauntlet/overload
+                           counters: rejected_malformed/_stale_schema/
+                           _quarantined, shed)
     rounds                 assembler close counters (rounds_closed,
                            closed_by_quorum/_deadline, stragglers, no_shows)
     requeue_depth          dropped/no-show clients waiting for re-service

@@ -24,6 +24,16 @@ The device pipeline stays the runner's: dispatch/commit overlap, deferred
 metrics, checkpoint writer — the service only replaces WHERE cohorts come
 from.
 
+With ``--serve_payload sketch`` the round inverts into the wire-payload
+shape (`_serve_payload_round`): clients compute their r x c Count-Sketch
+tables FIRST (the session's payload client program), the tables cross the
+transport — framed/checksummed over the real loopback socket when that is
+the transport — through the ingest validation gauntlet, and the session's
+table-merge program consumes only the validated stack the close collected.
+A rejected frame (MALFORMED / STALE_SCHEMA / QUARANTINED) is bitwise a
+dropped client; under queue pressure submissions shed (SHEDDING + a
+retry-after hint) instead of queuing unboundedly.
+
 Checkpoint discipline: the early-submission buffer is snapshotted per round
 boundary (`_pending_by_round`) and published to checkpoints through
 `session.serve_meta` (utils/checkpoint.py writes it into meta.json); a
@@ -39,13 +49,20 @@ import sys
 import threading
 import time
 
+import numpy as np
+
 from ..obs import registry as obreg
 from ..obs import trace as obtrace
 from .assembler import ClosedRound, CohortAssembler
-from .ingest import IngestQueue
+from .ingest import IngestQueue, PayloadPolicy
 from .metrics import MetricsServer
 from .traffic import TraceConfig, TrafficGenerator
-from .transport import InProcessTransport, SocketTransport
+from .transport import (
+    InProcessTransport,
+    SocketTransport,
+    abort_over_socket,
+    submit_over_socket,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +76,16 @@ class ServeConfig:
     metrics_port: int = -1   # >= 0 starts the HTTP endpoint (0 = ephemeral)
     queue_capacity: int = 1024
     pending_capacity: int = 256
+    # "announce" (default): submissions are arrival announcements, the
+    # engine computes every update server-side. "sketch": submissions carry
+    # the client's REAL r x c Count-Sketch table through the validation
+    # gauntlet, and the server merely SUMS accepted tables (the linearity
+    # FetchSGD is servable on). Needs a wire_payloads=True session.
+    payload: str = "announce"
+    # load shedding: queue depth at/past this fraction of total capacity
+    # turns submissions away with SHEDDING + a retry-after hint (0 = off)
+    shed_watermark: float = 0.0
+    shed_retry_after_s: float = 1.0
 
     @classmethod
     def from_args(cls, args) -> "ServeConfig":
@@ -68,6 +95,8 @@ class ServeConfig:
             transport=args.serve,
             port=getattr(args, "serve_port", 0),
             metrics_port=getattr(args, "serve_metrics_port", -1),
+            payload=getattr(args, "serve_payload", "announce"),
+            shed_watermark=getattr(args, "serve_shed_watermark", 0.0),
         )
 
 
@@ -81,6 +110,9 @@ class AggregationService:
         if cfg.transport not in ("inproc", "socket"):
             raise ValueError(
                 f"serve transport must be inproc|socket, got {cfg.transport!r}")
+        if cfg.payload not in ("announce", "sketch"):
+            raise ValueError(
+                f"--serve_payload must be announce|sketch, got {cfg.payload!r}")
         quorum = cfg.quorum or session.num_workers
         if not 1 <= quorum <= session.num_workers:
             raise ValueError(
@@ -93,12 +125,30 @@ class AggregationService:
                 "zero submissions: every round would close at deadline "
                 "fully degraded (pass a TrafficGenerator, or use the "
                 "socket transport with external clients)")
+        payload_policy = payload_shape = None
+        if cfg.payload == "sketch":
+            ecfg = session.cfg
+            if not getattr(ecfg, "wire_payloads", False):
+                raise ValueError(
+                    "--serve_payload sketch needs a session built with "
+                    "wire_payloads=True (the CLIs arm it from the flag): the "
+                    "payload round is a different compiled program pair — "
+                    "client tables + table merge")
+            payload_shape = (ecfg.mode.num_rows, ecfg.mode.num_cols)
+            payload_policy = PayloadPolicy(
+                rows=payload_shape[0], cols=payload_shape[1],
+                clip_multiple=float(ecfg.client_update_clip),
+                quarantine_median=session.quarantine_median_host)
         self.session = session
         self.cfg = dataclasses.replace(cfg, quorum=quorum)
         self.traffic = traffic
         self.queue = IngestQueue(capacity=cfg.queue_capacity,
-                                 pending_capacity=cfg.pending_capacity)
-        self.assembler = CohortAssembler(self.queue, quorum, cfg.deadline_s)
+                                 pending_capacity=cfg.pending_capacity,
+                                 payload_policy=payload_policy,
+                                 shed_watermark=cfg.shed_watermark,
+                                 shed_retry_after_s=cfg.shed_retry_after_s)
+        self.assembler = CohortAssembler(self.queue, quorum, cfg.deadline_s,
+                                         payload_shape=payload_shape)
         self.transport = (
             SocketTransport(self.queue, port=cfg.port)
             if cfg.transport == "socket" else InProcessTransport(self.queue))
@@ -175,18 +225,58 @@ class AggregationService:
         ClosedRound)."""
         with obtrace.span("assembler", "serve_round", round=rnd):
             ids = self.session.sample_cohort(rnd)
-            self.queue.open_round(rnd, ids)
-            if self.traffic is not None:
-                self.traffic.respond_to_invites(
-                    rnd, ids, self.transport.submit, self.cfg.deadline_s)
-                closed = self.assembler.close_virtual(rnd, ids)
+            if self.cfg.payload == "sketch":
+                prep, closed = self._serve_payload_round(rnd, ids)
             else:
-                # external clients: wall-clock W-of-N (socket transport)
-                closed = self.assembler.close_wall(rnd, ids)
-            prep = self.session.prepare_served_round(rnd, ids, closed.arrived)
+                self.queue.open_round(rnd, ids)
+                if self.traffic is not None:
+                    self.traffic.respond_to_invites(
+                        rnd, ids, self.transport.submit, self.cfg.deadline_s)
+                    closed = self.assembler.close_virtual(rnd, ids)
+                else:
+                    # external clients: wall-clock W-of-N (socket transport)
+                    closed = self.assembler.close_wall(rnd, ids)
+                prep = self.session.prepare_served_round(
+                    rnd, ids, closed.arrived)
         with self._meta_lock:
             self._unmerged.append(closed)
         return prep, closed
+
+    def _serve_payload_round(self, rnd: int, ids):
+        """The wire-payload round (--serve_payload sketch): clients compute
+        BEFORE the close (a real client sketches locally, then ships), the
+        tables cross the transport — over the actual loopback socket when
+        that's the transport, so real serialization/framing is exercised —
+        the ingest gauntlet validates each frame, and the close hands the
+        merge only the validated table stack. Every invitee whose payload
+        missed the merge (no-show, straggler, rejected frame) is masked +
+        re-queued exactly like a dropped client."""
+        prep0 = self.session.prepare_served_round(
+            rnd, ids, np.ones(len(ids), np.float32))
+        tables, aux = self.session.compute_client_tables(prep0)
+        self.queue.open_round(rnd, ids)
+        if self.traffic is not None:
+            plan = self.session.fault_plan
+            wire = (plan.wire_plan(rnd, len(ids))
+                    if plan is not None else None)
+            if self.cfg.transport == "socket":
+                # the REAL wire: every submission round-trips the loopback
+                # socket (frame encode -> recv -> gauntlet decode), and a
+                # conn_drop is an actual mid-send connection death
+                addr = self.transport.address
+                submit = lambda sub: submit_over_socket(addr, sub)  # noqa: E731
+                abort = lambda sub: abort_over_socket(addr, sub)  # noqa: E731
+            else:
+                submit, abort = self.transport.submit, None
+            self.traffic.respond_to_invites(
+                rnd, ids, submit, self.cfg.deadline_s,
+                payloads=tables, wire=wire, abort=abort)
+            closed = self.assembler.close_virtual(rnd, ids)
+        else:
+            # external clients: wall-clock W-of-N (socket transport)
+            closed = self.assembler.close_wall(rnd, ids)
+        return self.session.finish_served_payload(
+            prep0, closed.arrived, closed.tables, aux), closed
 
     def record_merges(self, committed_round: int | None = None) -> int:
         """Resolve submission-to-merge latency for every closed round the
@@ -289,6 +379,7 @@ class AggregationService:
             "invited_per_round": s.num_workers,
             "deadline_s": self.cfg.deadline_s,
             "transport": self.cfg.transport,
+            "payload": self.cfg.payload,
         }
 
 
@@ -356,6 +447,7 @@ def service_from_args(args, session) -> AggregationService | None:
     print(
         f"serve: {service.cfg.transport} transport"
         + (f" on {addr[0]}:{addr[1]}" if addr else "")
+        + f", payload {service.cfg.payload}"
         + f", quorum {service.cfg.quorum}/{session.num_workers}, "
         + f"deadline {service.cfg.deadline_s}s, trace {trace}"
         + (f", metrics http://{maddr[0]}:{maddr[1]}/metrics" if maddr else ""),

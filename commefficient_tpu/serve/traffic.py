@@ -123,22 +123,66 @@ class TrafficGenerator:
             self.classes)
 
     def respond_to_invites(self, rnd: int, invited_ids, submit,
-                           deadline_s: float) -> int:
+                           deadline_s: float, payloads=None, wire=None,
+                           abort=None) -> int:
         """Simulate the invited cohort answering round `rnd`: every invitee
         whose derived latency is finite AND within `deadline_s` submits
         (latency-order, so wall-clock transports see a realistic arrival
         sequence). Returns the number of submissions pushed. `submit` is
         transport.submit — rejections (dup/late/full) are the transport's
-        business, counted by the ingest queue."""
+        business, counted by the ingest queue.
+
+        Payload rounds (--serve_payload sketch): `payloads` is the
+        per-invitee sequence of wire payloads ([r, c] ndarrays — the socket
+        helper frames them; inproc ships the array), and `wire` an optional
+        FaultPlan.wire_plan dict applying damage AT THIS SEAM — between the
+        client's compute and the server's ingest, the hop the validation
+        gauntlet exists for:
+
+        - corrupt/truncate damage the FRAME (the array is encoded first so
+          the damage hits real wire bytes, whatever the transport);
+        - dup re-sends the identical submission (at-least-once double send —
+          the server's duplicate detection must keep the merge single-count);
+        - delay_s adds to the submission latency (the straggler discipline
+          decides whether it still makes the close);
+        - drop kills the send: through `abort` (a mid-send connection death,
+          socket realism) when given, else the submission just never leaves
+          the client — either way the server sees a no-show."""
+        from ..resilience.faults import FaultPlan
+        from ..sketch.payload import encode_frame
         from .ingest import Submission
 
         lat = self.invite_latencies(rnd, invited_ids)
+        wire = wire or {}
+        if wire:
+            lat = np.array(lat, copy=True)
+            for p, actions in wire.items():
+                if actions.get("delay_s"):
+                    lat[p] += actions["delay_s"]
         order = np.argsort(lat, kind="stable")
         sent = 0
         for i in order:
             if not np.isfinite(lat[i]) or lat[i] > deadline_s:
                 break  # sorted: everything after is slower
-            submit(Submission(client_id=int(invited_ids[i]), round=rnd,
-                              latency_s=float(lat[i])))
+            payload = payloads[i] if payloads is not None else None
+            actions = wire.get(int(i), {})
+            sub = Submission(client_id=int(invited_ids[i]), round=rnd,
+                             latency_s=float(lat[i]), payload=payload)
+            if actions.get("drop"):
+                if abort is not None:
+                    abort(sub)  # the connection dies mid-send
+                continue
+            if actions.get("corrupt") or actions.get("truncate"):
+                frame = (payload if isinstance(payload, dict)
+                         else encode_frame(payload))
+                if actions.get("corrupt"):
+                    frame = FaultPlan.corrupt_frame(frame)
+                if actions.get("truncate"):
+                    frame = FaultPlan.truncate_frame(frame)
+                sub = Submission(client_id=int(invited_ids[i]), round=rnd,
+                                 latency_s=float(lat[i]), payload=frame)
+            submit(sub)
+            if actions.get("dup"):
+                submit(sub)  # identical at-least-once re-send
             sent += 1
         return sent

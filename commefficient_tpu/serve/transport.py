@@ -7,15 +7,31 @@ the traffic generator, and the tests are transport-agnostic.
 - `InProcessTransport`: a direct call into the ingest queue. Zero copies,
   zero threads; the default for tests, bench, and the parity pins (the
   decision path is identical to the socket's — admission control lives in
-  the queue, not the transport).
+  the queue, not the transport). Sketch payloads ride as raw ndarrays.
 - `SocketTransport`: newline-delimited JSON over a loopback TCP socket —
   the smallest wire that exercises real serialization, partial reads, and
   concurrent client connections. One accept-loop thread + one thread per
   connection (daemon; bounded by the OS backlog and the traffic shape —
   this is the realism transport, not the 10M-client path). Request
-  ``{"client_id": int, "round": int, "latency_s": float?, "payload": str?}``
-  is answered with ``{"status": "<admission decision>"}``; the client-side
-  helper `submit_over_socket` round-trips one submission.
+  ``{"client_id": int, "round": int, "latency_s": float?, "payload":
+  frame?}`` — `frame` is the length-prefixed/checksummed dict of
+  sketch/payload.py — is answered with ``{"status": "<admission
+  decision>"}`` (plus ``retry_after_s`` on SHEDDING); the client-side
+  helpers `submit_over_socket` / `submit_with_retries` round-trip one
+  submission.
+
+The server survives a hostile wire by construction:
+
+- **read deadline** per connection (`read_deadline_s`): a peer that opens a
+  connection and stops sending (slow-loris, a crashed client mid-frame) is
+  disconnected when the deadline lapses — its thread exits instead of
+  blocking in recv forever.
+- **max frame size** (`max_frame_bytes`): a newline-less byte flood is cut
+  off at the cap with a MALFORMED reply and a disconnect — per-connection
+  memory is bounded no matter what the peer sends.
+- **thread hygiene**: live connections are tracked and force-closed on
+  stop(), so every per-connection thread joins within the stop deadline —
+  including threads parked on a half-open connection.
 
 Blocking discipline: the accept/recv loops live on their own threads and
 block by design; the functions that do are declared `# graftlint:
@@ -30,8 +46,13 @@ import json
 import socket
 import sys
 import threading
+import time
 
-from .ingest import IngestQueue, Submission
+import numpy as np
+
+from ..obs import registry as obreg
+from ..obs import trace as obtrace
+from .ingest import SHEDDING, IngestQueue, Submission
 
 
 class InProcessTransport:
@@ -58,13 +79,28 @@ class SocketTransport:
     """Loopback-TCP ingest: a tiny always-on server in front of the queue."""
 
     def __init__(self, queue: IngestQueue, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, read_deadline_s: float = 30.0,
+                 max_frame_bytes: int = 1 << 20):
+        if read_deadline_s <= 0:
+            raise ValueError(
+                f"read_deadline_s must be > 0, got {read_deadline_s} — an "
+                "unbounded recv is exactly the slow-loris hole this knob "
+                "closes")
+        if max_frame_bytes < 1024:
+            raise ValueError(
+                f"max_frame_bytes must be >= 1024, got {max_frame_bytes}")
         self.queue = queue
         self._host = host
         self._port = port
+        self.read_deadline_s = read_deadline_s
+        self.max_frame_bytes = max_frame_bytes
         self._sock: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._conn_threads: list[threading.Thread] = []
+        # live connection sockets, force-closed on stop() so every handler
+        # thread (including ones parked on a half-open peer) joins promptly
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         self._stop = threading.Event()
 
     @property
@@ -79,22 +115,52 @@ class SocketTransport:
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind((self._host, self._port))
         s.listen(64)
+        # poll-style accept: close() does not reliably wake a thread
+        # blocked in accept() on all platforms, so the loop wakes every
+        # half-second to check the stop flag — stop() then joins within
+        # the deadline instead of hanging on a parked accept
+        s.settimeout(0.5)
         self._sock = s
+        self._stop.clear()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="serve-accept", daemon=True)
         self._accept_thread.start()
 
-    def stop(self) -> None:
+    def stop(self, join_deadline_s: float = 5.0) -> None:
+        """Stop accepting, force-close live connections, and join every
+        per-connection thread against one overall deadline — a peer that
+        never sends another byte cannot leak a thread past stop()."""
         self._stop.set()
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
+        with self._conns_lock:
+            live = list(self._conns)
+        for conn in live:
+            # a blocking recv on this socket raises immediately — the
+            # handler thread exits instead of waiting out its read deadline
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + join_deadline_s
         if self._accept_thread is not None:
-            self._accept_thread.join(timeout=5.0)
+            self._accept_thread.join(
+                timeout=max(deadline - time.monotonic(), 0.1))
         for t in self._conn_threads:
-            t.join(timeout=1.0)
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
+        leaked = [t.name for t in self._conn_threads if t.is_alive()]
+        if leaked:
+            print(f"serve: WARNING — {len(leaked)} connection thread(s) "
+                  f"still alive past the stop deadline: {leaked}",
+                  file=sys.stderr, flush=True)
+        self._conn_threads = [t for t in self._conn_threads if t.is_alive()]
         self._sock = None
 
     def submit(self, sub: Submission) -> str:
@@ -111,8 +177,11 @@ class SocketTransport:
         while not self._stop.is_set():
             try:
                 conn, _ = self._sock.accept()
+            except socket.timeout:  # poll tick: re-check the stop flag
+                continue
             except OSError:  # socket closed by stop()
                 return
+            conn.settimeout(None)  # per-conn deadline set in _serve_conn
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  name="serve-conn", daemon=True)
             t.start()
@@ -124,41 +193,91 @@ class SocketTransport:
 
     # graftlint: drain-point — per-connection recv loop, dedicated thread
     def _serve_conn(self, conn: socket.socket) -> None:
-        with conn:
-            buf = b""
-            while not self._stop.is_set():
-                try:
-                    chunk = conn.recv(65536)
-                except OSError:
-                    return
-                if not chunk:
-                    return
-                buf += chunk
-                while b"\n" in buf:
-                    line, buf = buf.split(b"\n", 1)
-                    if not line.strip():
-                        continue
-                    status = self._handle_line(line)
+        with self._conns_lock:
+            self._conns.add(conn)
+        try:
+            # the read deadline: a silent peer (slow-loris, a client that
+            # died mid-frame) times out of recv and the connection closes —
+            # the thread can never be parked forever
+            conn.settimeout(self.read_deadline_s)
+            with conn:
+                buf = b""
+                while not self._stop.is_set():
                     try:
-                        conn.sendall(
-                            json.dumps({"status": status}).encode() + b"\n")
+                        chunk = conn.recv(65536)
+                    except socket.timeout:
+                        obreg.default().counter(
+                            "serve_conn_deadline_total").inc()
+                        obtrace.instant("serve-ingest", "conn:deadline")
+                        return
                     except OSError:
                         return
+                    if not chunk:
+                        return
+                    buf += chunk
+                    if len(buf) > self.max_frame_bytes and b"\n" not in buf:
+                        # newline-less byte flood: cut it off at the cap —
+                        # per-connection memory stays bounded no matter
+                        # what the peer sends
+                        obreg.default().counter(
+                            "serve_rejected_malformed_total").inc()
+                        self.queue.note_wire_malformed()
+                        obtrace.instant("serve-ingest", "conn:frame_too_big",
+                                        bytes=len(buf))
+                        self._reply(conn, {"status": "MALFORMED",
+                                           "detail": "frame too large"})
+                        return
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        if not self._reply(conn, self._handle_line(line)):
+                            return
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
 
-    def _handle_line(self, line: bytes) -> str:
+    @staticmethod
+    def _reply(conn: socket.socket, reply: dict) -> bool:
+        try:
+            conn.sendall(json.dumps(reply).encode() + b"\n")
+            return True
+        except OSError:
+            return False
+
+    def _handle_line(self, line: bytes) -> dict:
+        if len(line) > self.max_frame_bytes:
+            obreg.default().counter("serve_rejected_malformed_total").inc()
+            self.queue.note_wire_malformed()
+            return {"status": "MALFORMED", "detail": "frame too large"}
         try:
             req = json.loads(line)
+            payload = req.get("payload")
             sub = Submission(
                 client_id=int(req["client_id"]),
                 round=int(req["round"]),
                 latency_s=float(req.get("latency_s", 0.0)),
-                payload_bytes=len(req.get("payload", "")),
+                payload_bytes=(int(payload.get("nbytes", 0))
+                               if isinstance(payload, dict)
+                               else len(payload or "")),
+                # the frame dict passes through UNPARSED: the ingest
+                # gauntlet (validate_payload) is the one place wire bytes
+                # are decoded — the transport only carries them
+                payload=payload,
             )
-        except (ValueError, KeyError, TypeError) as e:
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
             print(f"serve: malformed submission rejected "
                   f"({type(e).__name__}: {e})", file=sys.stderr, flush=True)
-            return "MALFORMED"
-        return self.queue.submit(sub)
+            obreg.default().counter("serve_rejected_malformed_total").inc()
+            self.queue.note_wire_malformed()
+            return {"status": "MALFORMED", "detail": type(e).__name__}
+        status = self.queue.submit(sub)
+        reply = {"status": status}
+        if status == SHEDDING:
+            # the overload contract: a shed client is TOLD when to come
+            # back, so a flood decays instead of hammering the queue
+            reply["retry_after_s"] = self.queue.shed_retry_after_s
+        return reply
 
 
 # graftlint: drain-point — client-side blocking round-trip (the traffic
@@ -168,16 +287,108 @@ def submit_over_socket(addr: tuple[str, int], sub: Submission,
     """One submission over a fresh connection; returns the admission
     decision (or raises on transport failure — the caller decides whether
     to retry; admission rejections are NOT exceptions)."""
+    return _roundtrip(addr, sub, timeout_s)["status"]
+
+
+def _wire_request(sub: Submission) -> dict:
+    """The request dict exactly as the wire carries it — shared by the real
+    round-trip and the chaos half-send so the two can never frame a payload
+    differently. A raw table is framed here (the inproc transport passes
+    arrays; the socket always ships frames); a pre-built frame dict or the
+    announce path's sized filler passes through."""
+    payload = {"client_id": sub.client_id, "round": sub.round,
+               "latency_s": sub.latency_s}
+    if sub.payload is not None:
+        p = sub.payload
+        if isinstance(p, np.ndarray):
+            from ..sketch.payload import encode_frame
+
+            p = encode_frame(p)
+        payload["payload"] = p
+    elif sub.payload_bytes:
+        payload["payload"] = "x" * sub.payload_bytes
+    return payload
+
+
+# graftlint: drain-point — client-side blocking round-trip (shared tail of
+# the submit helpers; always on a client/traffic thread, never the server's)
+def _roundtrip(addr: tuple[str, int], sub: Submission,
+               timeout_s: float = 5.0) -> dict:
     with socket.create_connection(addr, timeout=timeout_s) as s:
-        payload = {"client_id": sub.client_id, "round": sub.round,
-                   "latency_s": sub.latency_s}
-        if sub.payload_bytes:
-            payload["payload"] = "x" * sub.payload_bytes
-        s.sendall(json.dumps(payload).encode() + b"\n")
+        s.sendall(json.dumps(_wire_request(sub)).encode() + b"\n")
         buf = b""
         while b"\n" not in buf:
             chunk = s.recv(65536)
             if not chunk:
                 raise ConnectionError("serve: connection closed mid-reply")
             buf += chunk
-    return json.loads(buf.split(b"\n", 1)[0])["status"]
+    return json.loads(buf.split(b"\n", 1)[0])
+
+
+# graftlint: drain-point — client-side blocking half-send (chaos only)
+def abort_over_socket(addr: tuple[str, int], sub: Submission,
+                      timeout_s: float = 5.0) -> None:
+    """A connection that dies mid-send (conn_drop chaos): open, transmit
+    HALF the request line with no newline, and close. The server must treat
+    it as a no-show — the partial frame never parses, the handler thread
+    exits on the EOF instead of waiting out its read deadline, and nothing
+    is admitted."""
+    line = json.dumps(_wire_request(sub)).encode()
+    with socket.create_connection(addr, timeout=timeout_s) as s:
+        s.sendall(line[:max(len(line) // 2, 1)])
+    # closed without the newline: the server sees EOF on a partial frame
+
+
+# graftlint: drain-point — the client helper's backoff sleeps on the
+# CLIENT's thread (traffic generator / external client), never the server's
+def submit_with_retries(addr: tuple[str, int], sub: Submission,
+                        max_retries: int = 3, base_backoff_s: float = 0.05,
+                        max_backoff_s: float = 2.0,
+                        timeout_s: float = 5.0,
+                        sleep=time.sleep) -> str:
+    """At-least-once client helper: bounded retries with jittered
+    exponential backoff around the single-shot round-trip.
+
+    Retried conditions: transport failures (refused/reset/timeout — the
+    reply was lost, the submission may or may not have been admitted) and
+    SHEDDING (the server ASKED us to come back; its retry_after_s hint
+    floors the backoff). Everything else — ACCEPTED, DUPLICATE, the
+    rejection gauntlet — returns immediately: a DUPLICATE on a retry IS
+    success (the first attempt's admission survived the lost reply; the
+    server's duplicate detection is what makes at-least-once safe), and a
+    MALFORMED frame will be exactly as malformed the next time.
+
+    The jitter is deterministic per (client, round, attempt) — fold_in-
+    style, no shared RNG — so a retrying cohort decorrelates without a
+    global random source, and a test can replay the exact schedule."""
+    attempt = 0
+    while True:
+        try:
+            reply = _roundtrip(addr, sub, timeout_s)
+            status = reply["status"]
+        except (OSError, ValueError) as e:
+            status, reply = None, {}
+            err = f"{type(e).__name__}: {e}"
+        if status is not None and status != SHEDDING:
+            return status
+        if attempt >= max_retries:
+            # budget exhausted: report what we last saw (SHEDDING, or a
+            # transport error as CONN_FAILED — the caller's client is a
+            # no-show this round; duplicate detection keeps a half-landed
+            # submission from double counting)
+            return status if status is not None else "CONN_FAILED"
+        # exponential backoff with deterministic jitter in [0.5, 1.5)x,
+        # floored at the server's retry-after hint when it gave one
+        from .clients import uniform01
+
+        jitter = 0.5 + float(uniform01(
+            0xB0FF, int(sub.client_id), int(sub.round), attempt))
+        delay = min(base_backoff_s * (2 ** attempt), max_backoff_s) * jitter
+        delay = max(delay, float(reply.get("retry_after_s", 0.0)))
+        obreg.default().counter("serve_client_retries_total").inc()
+        obtrace.instant(
+            "serve-ingest", "client:retry", client=int(sub.client_id),
+            round=int(sub.round), attempt=attempt + 1,
+            why=(status or err), backoff_s=round(delay, 4))
+        sleep(delay)
+        attempt += 1

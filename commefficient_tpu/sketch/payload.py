@@ -1,0 +1,70 @@
+"""Client-side wire payload: frame a Count-Sketch table for transmission.
+
+The wire-payload round (EngineConfig.wire_payloads, serve/'s
+``--serve_payload sketch``) ships each client's partial r x c table to the
+aggregator. This module is the CLIENT half of that wire: compute the table
+(`client_table` — the same csvec path the engine compresses with, so a
+client-computed table is bit-identical to the engine's) and frame it for
+the socket transport (`encode_frame`).
+
+Frame format (schema version 1) — a JSON-able dict carried as the
+``payload`` field of a submission line:
+
+    schema   int      wire schema version (a server refuses unknown versions
+                      with STALE_SCHEMA rather than guessing at layout)
+    dtype    str      numpy dtype string, pinned "<f4" (little-endian f32 —
+                      the table's device dtype; endianness explicit so the
+                      frame means the same bytes on every host)
+    shape    [r, c]   table dims (the server validates against ITS spec)
+    nbytes   int      byte length of the decoded data (the length prefix:
+                      a decoded blob of any other size is MALFORMED before
+                      anything is parsed out of it)
+    crc32    int      zlib.crc32 of the raw little-endian bytes — per-payload
+                      integrity: one flipped bit anywhere rejects the frame
+    data     str      base64 of the raw table bytes
+
+The DECODING half deliberately does NOT live here: deserializing untrusted
+wire bytes is the server's validation gauntlet, and the one sanctioned
+entry is ``serve.ingest.validate_payload`` (the declared payload boundary
+graftlint G011 enforces).
+"""
+
+from __future__ import annotations
+
+import base64
+import zlib
+
+import numpy as np
+
+SCHEMA_VERSION = 1
+# the one wire dtype: little-endian float32, the table's device dtype
+WIRE_DTYPE = "<f4"
+
+
+# graftlint: drain-point — the table syncs to host BY DESIGN: it is the
+# wire object a client transmits, and framing happens on host bytes
+def client_table(spec, update) -> np.ndarray:
+    """One client's wire payload: the Count Sketch of its flat [d] update,
+    through the exact csvec path the engine uses (bit-identical to the
+    table the server-computed round would build for this client). Host
+    numpy out — this is the object that gets framed."""
+    from . import csvec
+
+    return np.asarray(csvec.sketch_vec(spec, update), np.float32)
+
+
+# graftlint: drain-point — framing serializes the host table to wire bytes
+def encode_frame(table: np.ndarray, schema: int = SCHEMA_VERSION) -> dict:
+    """Frame a client's r x c table for the wire (see module docstring)."""
+    t = np.ascontiguousarray(np.asarray(table, np.float32))
+    if t.ndim != 2:
+        raise ValueError(f"payload table must be 2-D [r, c], got {t.shape}")
+    raw = t.astype(WIRE_DTYPE, copy=False).tobytes()
+    return {
+        "schema": int(schema),
+        "dtype": WIRE_DTYPE,
+        "shape": [int(t.shape[0]), int(t.shape[1])],
+        "nbytes": len(raw),
+        "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+        "data": base64.b64encode(raw).decode("ascii"),
+    }

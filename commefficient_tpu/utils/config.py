@@ -104,6 +104,16 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "merge and removed from the renormalization, so one "
                         "poisoned update costs one client, not the round. "
                         "Counted per round as clients_quarantined. 0 = off")
+    p.add_argument("--quarantine_window", type=int, default=1,
+                   help="--client_update_clip threshold baseline: 1 "
+                        "(default) screens against the LAST non-empty "
+                        "round's live-cohort median (the pre-window "
+                        "behavior, bit-identical); K > 1 screens against "
+                        "the median over a ring of the last K rounds' "
+                        "medians, so models whose update norms drift fast "
+                        "don't quarantine healthy clients (one outlier "
+                        "round perturbs one window slot, not the whole "
+                        "threshold). Fused round paths only")
     p.add_argument("--requeue_policy", default="fifo",
                    choices=["fifo", "aged"],
                    help="serving order for the dropped-client re-queue: "
@@ -141,6 +151,27 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "diurnal_period_s/burst_rate/burst_size/seed "
                         "(serve.TraceConfig); unset = defaults with "
                         "population=num_clients and seed=--seed")
+    p.add_argument("--serve_payload", default="announce",
+                   choices=["announce", "sketch"],
+                   help="what a submission carries. announce (default): an "
+                        "arrival announcement — the engine computes every "
+                        "update server-side from the client's shard. "
+                        "sketch: the client's REAL r x c Count-Sketch table "
+                        "crosses the wire (length-prefixed, checksummed, "
+                        "schema-versioned frames on the socket transport), "
+                        "runs the server's validation gauntlet "
+                        "(MALFORMED/STALE_SCHEMA/QUARANTINED rejections), "
+                        "and the server merely SUMS accepted tables — the "
+                        "linearity FetchSGD is servable on. Requires "
+                        "--mode sketch; announce stays the default until "
+                        "the payload path soaks (see MIGRATION.md)")
+    p.add_argument("--serve_shed_watermark", type=float, default=0.0,
+                   help="load shedding: reject submissions with SHEDDING "
+                        "(+ a retry-after hint on the socket wire) once "
+                        "queue depth passes this fraction of total "
+                        "capacity, BEFORE any per-submission work — "
+                        "overload degrades gracefully instead of queuing "
+                        "unboundedly. 0 = off (hard QUEUE_FULL only)")
     p.add_argument("--serve_port", type=int, default=0,
                    help="--serve socket: loopback bind port (0 = ephemeral)")
     p.add_argument("--serve_metrics_port", type=int, default=-1,

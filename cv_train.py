@@ -96,8 +96,13 @@ def build(args, fault_plan=None, retry_policy=None):
         dp_noise=args.dp_noise,
         client_dropout=args.client_dropout,
         client_update_clip=args.client_update_clip,
+        quarantine_window=args.quarantine_window,
         requeue_policy=args.requeue_policy,
         sketch_path=args.sketch_path,
+        # --serve_payload sketch inverts the round into the two-program
+        # wire shape (client tables + table merge) the service round-trips
+        wire_payloads=(getattr(args, "serve", "off") != "off"
+                       and args.serve_payload == "sketch"),
         split_compile=args.split_compile,
         client_chunk=args.client_chunk,
         on_nonfinite=args.on_nonfinite,
@@ -129,8 +134,11 @@ def main(argv=None):
     total_rounds = args.num_rounds or int(args.num_epochs * rounds_per_epoch)
     if fault_plan is not None:
         # launch-time schedule check: a client_* site at round >=
-        # total_rounds could never fire (a vacuous chaos run)
+        # total_rounds could never fire (a vacuous chaos run); likewise a
+        # wire_* site on a run with no payload seam to inject at
         fault_plan.validate_rounds(total_rounds)
+        fault_plan.validate_wire_context(
+            args.serve != "off" and args.serve_payload == "sketch")
     schedule = triangular(args.lr_scale, args.pivot_epoch, args.num_epochs)
     opt = FedOptimizer(schedule, rounds_per_epoch)
     model = FedModel(session)

@@ -16,6 +16,7 @@
 #        scripts/chaos_smoke.sh cohort
 #        scripts/chaos_smoke.sh serve
 #        scripts/chaos_smoke.sh trace
+#        scripts/chaos_smoke.sh wire
 #
 # `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
 # restartPolicy would: it launches the tiny cv_train run with a fault plan
@@ -35,6 +36,13 @@
 # injected client_drop/client_straggle faults ride the service path —
 # asserting every round closed (quorum or deadline), the W-of-N masking
 # fired, and the no-show/dropped clients went through the re-queue. < 2 min.
+#
+# `wire` mode drives the UNTRUSTED-WIRE serving path (--serve_payload
+# sketch) over the loopback socket: client-computed framed sketch tables
+# with wire_corrupt + wire_dup + conn_drop + client_poison injected at the
+# transport seam — asserting every rejection fired as an admission counter
+# AND a resilience obs counter, and the committed params are bit-identical
+# to the batch wire-payload round over the surviving cohort. < 1 min CPU.
 #
 # `trace` mode drives the OBSERVABILITY layer (obs/) under chaos: a real
 # cv_train run with --fault_plan AND --trace, ending in an injected
@@ -376,6 +384,121 @@ assert any(e["name"] == "prepare" for e in spans)
 assert any(e["name"] == "drain" for e in spans)
 print(f"trace: PASS (fault/retry/preemption instants on their rounds; "
       f"{len(ev)} events, flushed through exit 75)")
+EOF
+fi
+
+if [[ "${1:-}" == "wire" ]]; then
+    shift
+    exec timeout -k 10 "${CHAOS_TIMEOUT_S:-120}" python - "$@" <<'EOF'
+# wire chaos child (< 1 min CPU): the UNTRUSTED-WIRE serving path end to
+# end — a --serve_payload sketch round over the loopback SOCKET transport,
+# where every submission carries the client's real framed Count-Sketch
+# table, with wire_corrupt (flipped byte -> checksum), wire_dup
+# (at-least-once double send -> dedup), conn_drop (connection dies
+# mid-send -> no-show), and client_poison (NaN table -> wire quarantine)
+# injected at the transport seam. Asserts every rejection class fired as an
+# admission counter AND a resilience obs counter, and that the committed
+# params are BIT-identical to the batch wire-payload round that drops the
+# same casualties.
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+from commefficient_tpu.federated.api import FederatedSession
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.obs import registry as obreg
+from commefficient_tpu.resilience import FaultPlan
+from commefficient_tpu.serve import (
+    AggregationService, ServeConfig, TraceConfig, TrafficGenerator)
+from commefficient_tpu.serve.clients import DeviceClass
+
+RELIABLE = (DeviceClass("lab", weight=1.0, latency_median_s=0.1,
+                        latency_sigma=0.1, no_show_prob=0.0),)
+
+
+def quad_loss(params, net_state, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    err = pred - jax.nn.one_hot(batch["y"], pred.shape[-1])
+    mask = batch["mask"]
+    per_ex = (err ** 2).sum(-1)
+    return (per_ex * mask).sum() / jnp.maximum(mask.sum(), 1.0), {
+        "net_state": net_state,
+        "metrics": {"loss_sum": (per_ex * mask).sum(), "count": mask.sum()}}
+
+
+def mk(fault_plan=None):
+    rs = np.random.RandomState(0)
+    x = rs.randn(96, 6).astype(np.float32)
+    w_true = rs.randn(6, 3).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int32)
+    train = FedDataset(x, y, shard_iid(len(x), 12, np.random.RandomState(1)))
+    params = {"w": jnp.asarray(rs.randn(6, 3).astype(np.float32) * 0.1),
+              "b": jnp.zeros(3)}
+    d = ravel_pytree(params)[0].size
+    return FederatedSession(
+        train_loss_fn=quad_loss, eval_loss_fn=quad_loss,
+        params=params, net_state={},
+        mode_cfg=ModeConfig(mode="sketch", d=d, k=4, num_rows=3, num_cols=8,
+                            momentum=0.9, momentum_type="virtual",
+                            error_type="virtual"),
+        train_set=train, num_workers=4, local_batch_size=4, seed=0,
+        wire_payloads=True, client_update_clip=3.0,
+        fault_plan=fault_plan, quarantine_window=4)
+
+
+faults_before = obreg.default().counter(
+    "resilience_faults_injected_total").value
+plan = FaultPlan.parse(
+    "wire_corrupt@1:clients=0;wire_dup@1:clients=1;"
+    "conn_drop@2:clients=2;client_poison@2:clients=3,value=nan")
+served = mk(fault_plan=plan)
+svc = AggregationService(
+    served, ServeConfig(quorum=4, deadline_s=30.0, transport="socket",
+                        payload="sketch"),
+    traffic=TrafficGenerator(TraceConfig(population=12, seed=3),
+                             classes=RELIABLE)).start()
+src = svc.source()
+drops = []
+try:
+    for _ in range(3):
+        prep = src.next()
+        arrived = prep.payload[1]
+        drops.append(sorted(int(p) for p in np.flatnonzero(arrived == 0.0)))
+        served.commit_round(served.dispatch_round(prep, 0.05))
+finally:
+    svc.close()
+
+c = svc.queue.counters()
+print("wire chaos admission counters:", {k: v for k, v in c.items() if v})
+assert c["rejected_malformed"] >= 1, c       # wire_corrupt -> checksum
+assert c["rejected_dup"] >= 1, c             # wire_dup -> dedup
+assert c["rejected_quarantined"] >= 1, c     # client_poison -> wire screen
+assert drops[1] and drops[2], drops          # casualties actually masked
+reg = obreg.default()
+for name in ("serve_rejected_malformed_total",
+             "serve_rejected_quarantined_total"):
+    assert reg.counter(name).value >= 1, name  # resilience obs counters
+assert reg.counter(
+    "resilience_faults_injected_total").value - faults_before >= 4
+
+# the batch twin: the wire-payload round that client_drops the casualties
+pl = ";".join(f"client_drop@{r}:clients=" + "+".join(map(str, pos))
+              for r, pos in enumerate(drops) if pos)
+batch = mk(fault_plan=FaultPlan.parse(pl))
+for _ in range(3):
+    batch.run_round(0.05)
+for a, b in zip(jax.tree.leaves(jax.device_get(served.state["params"])),
+                jax.tree.leaves(jax.device_get(batch.state["params"]))):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+flat = np.asarray(ravel_pytree(jax.device_get(served.state["params"]))[0])
+assert np.isfinite(flat).all()
+print(f"wire: PASS (3 socket payload rounds; rejections "
+      f"[malformed={c['rejected_malformed']} dup={c['rejected_dup']} "
+      f"quarantined={c['rejected_quarantined']}], casualties {drops}, "
+      f"committed params bit-identical to the batch round over survivors)")
 EOF
 fi
 

@@ -1,0 +1,19 @@
+# graftlint: module=commefficient_tpu/serve/ingest.py
+# G011 violating twin: wire frame bytes decoded OUTSIDE the declared
+# payload boundary, and a raw `.payload` field fed straight into compiled
+# scope — both reopen the injection classes the validation gauntlet closes.
+import base64
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def sneak_decode(frame):
+    # undeclared deserialization of untrusted transport input
+    raw = base64.b64decode(frame["data"])
+    return np.frombuffer(raw, dtype="<f4")
+
+
+def sneak_merge(state, sub):
+    # the frame field flows into compiled scope without the gauntlet
+    return state + jnp.asarray(sub.payload)

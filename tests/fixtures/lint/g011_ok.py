@@ -1,0 +1,24 @@
+# graftlint: module=commefficient_tpu/serve/ingest.py
+# G011 conforming twin: the declared payload boundary (the def carries
+# `# graftlint: payload-boundary`) is the one place frame bytes decode,
+# and compiled scope only ever sees the validated ndarray it returned.
+import base64
+
+import jax.numpy as jnp
+import numpy as np
+
+
+# graftlint: payload-boundary — the sanctioned decode of untrusted frames
+def validate_payload(frame, policy):
+    raw = base64.b64decode(frame["data"], validate=True)
+    if len(raw) != policy.nbytes:
+        return None, "MALFORMED"
+    table = np.frombuffer(raw, dtype="<f4").reshape(policy.rows, policy.cols)
+    if not np.isfinite(table).all():
+        return None, "QUARANTINED"
+    return table, "ACCEPTED"
+
+
+def merge(state, validated_table):
+    # downstream of the gauntlet: a host ndarray, not wire bytes
+    return state + jnp.asarray(validated_table)

@@ -407,6 +407,18 @@ def test_validate_rounds_rejects_unreachable_client_sites():
     FaultPlan.parse("client_poison:clients=0").validate_rounds(1)  # unscheduled
 
 
+def test_validate_wire_context_rejects_wire_kinds_without_payload_path():
+    # wire_* kinds inject at the serving payload seam only: a plan naming
+    # them on a run without --serve_payload sketch would pass vacuously
+    # (zero injections, chaos run green) — reject it at launch instead
+    plan = FaultPlan.parse("wire_corrupt@1:clients=0;conn_drop@2:clients=1")
+    with pytest.raises(ValueError, match="can never fire"):
+        plan.validate_wire_context(False)
+    plan.validate_wire_context(True)  # payload path armed: fine
+    # a plan with no wire kinds never cares about the payload path
+    FaultPlan.parse("client_drop@1:clients=0").validate_wire_context(False)
+
+
 def test_client_faults_apply_and_requeue_positions():
     plan = FaultPlan.parse(
         "client_drop@2:clients=0+3;client_poison@2:clients=1,value=nan")
@@ -455,3 +467,84 @@ def test_coordinated_preemption_max_reduces_across_hosts(monkeypatch):
     assert coordinated(False) is False and coordinated(True) is True
     monkeypatch.setattr(distributed, "all_hosts_max", lambda v: 1)
     assert coordinated(False) is True  # a peer host was signalled
+
+
+# ------------------------------------------------- windowed quarantine median
+
+
+def test_quarantine_window_default_keeps_state_tree_and_threshold():
+    """quarantine_window=1 (the default) is the pre-window behavior: the
+    server state carries ONLY {"median"} (so existing checkpoints stay
+    shape-compatible) and the active threshold after each round is exactly
+    that round's live-cohort median — which a window=K run must also agree
+    with while its ring is what the window median reduces to."""
+    W, K = 8, 4
+    params, cfg1 = _cfg(client_update_clip=10.0)
+    _, cfgK = _cfg(client_update_clip=10.0, quarantine_window=K)
+    lr = jnp.float32(0.1)
+    step1 = jax.jit(engine.make_round_step(quad_loss, cfg1))
+    stepK = jax.jit(engine.make_round_step(quad_loss, cfgK))
+    s1 = engine.init_server_state(cfg1, jax.tree.map(jnp.copy, params), {})
+    sK = engine.init_server_state(cfgK, jax.tree.map(jnp.copy, params), {})
+    assert set(s1["quarantine"]) == {"median"}
+    assert set(sK["quarantine"]) == {"median", "window", "count"}
+    assert sK["quarantine"]["window"].shape == (K,)
+
+    meds = []  # per-round live-cohort medians (window=1 active threshold)
+    for r in range(3):
+        b = _batch(jax.random.PRNGKey(40 + r), W)
+        s1, _, m1 = step1(s1, b, {}, lr, jax.random.PRNGKey(60 + r))
+        sK, _, mK = stepK(sK, b, {}, lr, jax.random.PRNGKey(60 + r))
+        meds.append(float(m1["quarantine_median"]))
+        # clean data: neither run quarantines, so the cohorts (and the
+        # per-round medians feeding both baselines) stay identical
+        assert float(m1["clients_quarantined"]) == 0.0
+        assert float(mK["clients_quarantined"]) == 0.0
+        # the window=K active threshold is the median over the filled ring
+        # slots — the window=1 run's per-round medians, reduced
+        np.testing.assert_allclose(
+            float(mK["quarantine_median"]), float(np.median(meds[-K:])),
+            rtol=1e-6)
+        assert int(sK["quarantine"]["count"]) == min(r + 1, K)
+    # params identical too: the window only changes the THRESHOLD, and the
+    # clean run never trips it
+    np.testing.assert_array_equal(_flat(s1), _flat(sK))
+
+
+def test_quarantine_window_tolerates_one_collapsed_round():
+    """The drift scenario the window exists for: one round whose cohort
+    update norms COLLAPSE (near-converged batch, lr pivot) drags the
+    window=1 threshold down with it, so the NEXT round's healthy clients
+    all screen as 'adversarially large' and quarantine; a window=4 baseline
+    moves at window speed — one outlier round perturbs one slot — and the
+    healthy cohort passes."""
+    W, K = 8, 4
+    params, cfg1 = _cfg(client_update_clip=10.0)
+    _, cfgK = _cfg(client_update_clip=10.0, quarantine_window=K)
+    lr = jnp.float32(0.1)
+    b_normal = [_batch(jax.random.PRNGKey(70 + r), W) for r in range(4)]
+    # the collapsed round: example masks scaled 1e-4 scale the whole loss
+    # (count floors at 1.0), so every client's update norm collapses with
+    # them — small but finite, the shape of a near-converged / lr-pivot
+    # round
+    b_tiny = {k: (v * 1e-4 if k == "mask" else v)
+              for k, v in _batch(jax.random.PRNGKey(80), W).items()}
+    schedule = [b_normal[0], b_normal[1], b_tiny, b_normal[2]]
+
+    for cfg, expect_quarantined in ((cfg1, W), (cfgK, 0)):
+        step = jax.jit(engine.make_round_step(quad_loss, cfg))
+        s = engine.init_server_state(cfg, jax.tree.map(jnp.copy, params), {})
+        last = None
+        for r, b in enumerate(schedule):
+            s, _, last = step(s, b, {}, lr, jax.random.PRNGKey(90 + r))
+        assert float(last["clients_quarantined"]) == expect_quarantined, (
+            cfg.quarantine_window, float(last["clients_quarantined"]))
+
+
+def test_quarantine_window_rejected_on_split_compile_paths():
+    """The split-compile program boundary threads ONE scalar median; a
+    K-slot ring cannot cross it — the combination must fail loudly at
+    build time, not silently run window=1."""
+    params, cfg = _cfg(client_update_clip=10.0, quarantine_window=4)
+    with pytest.raises(ValueError, match="fused-paths-only"):
+        engine.make_split_round_step(quad_loss, cfg)

@@ -616,3 +616,694 @@ def test_service_refuses_bad_configs():
     with pytest.raises(ValueError, match="transport"):
         AggregationService(a, ServeConfig(quorum=2, transport="carrier-pigeon"),
                            traffic=TrafficGenerator(TraceConfig()))
+
+
+# ------------------------------------------------------------- untrusted wire
+# ISSUE 9: client-computed sketch payloads, the server-side validation
+# gauntlet, transport chaos, and overload shedding.
+
+from commefficient_tpu.resilience.faults import FaultPlan as _FP  # noqa: E402
+from commefficient_tpu.serve import abort_over_socket  # noqa: E402
+from commefficient_tpu.serve import submit_with_retries  # noqa: E402
+from commefficient_tpu.serve.clients import DeviceClass  # noqa: E402
+from commefficient_tpu.serve.ingest import (  # noqa: E402
+    MALFORMED,
+    QUARANTINED,
+    SHEDDING,
+    STALE_SCHEMA,
+    PayloadPolicy,
+    validate_payload,
+)
+from commefficient_tpu.sketch.payload import (  # noqa: E402
+    SCHEMA_VERSION,
+    encode_frame,
+)
+
+# a device-class mix with no organic no-shows/straggle, so wire-chaos tests
+# target exactly the clients the fault plan names
+RELIABLE_CLASSES = (
+    DeviceClass("lab", weight=1.0, latency_median_s=0.1,
+                latency_sigma=0.1, no_show_prob=0.0),
+)
+
+_PAYLOAD_SHAPE = (3, 8)  # (num_rows, num_cols) of the tiny sketch sessions
+
+
+def _sketch_session(shards=0, seed=0, fault_plan=None, clip=0.0, window=1,
+                    num_clients=12, workers=4, din=6, dout=3):
+    """_tiny_session's sketch-mode twin: wire_payloads=True, so the round is
+    the two-program payload shape (client tables + table merge)."""
+    rs = np.random.RandomState(0)
+    x = rs.randn(96, din).astype(np.float32)
+    w_true = rs.randn(din, dout).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int32)
+    train = FedDataset(x, y, shard_iid(len(x), num_clients,
+                                       np.random.RandomState(1)))
+    params = {"w": jnp.asarray(rs.randn(din, dout).astype(np.float32) * 0.1),
+              "b": jnp.zeros(dout)}
+    d = ravel_pytree(params)[0].size
+    return FederatedSession(
+        train_loss_fn=_quad_loss, eval_loss_fn=_quad_loss,
+        params=params, net_state={},
+        mode_cfg=ModeConfig(mode="sketch", d=d, k=4,
+                            num_rows=_PAYLOAD_SHAPE[0],
+                            num_cols=_PAYLOAD_SHAPE[1],
+                            momentum=0.9, momentum_type="virtual",
+                            error_type="virtual"),
+        train_set=train, num_workers=workers, local_batch_size=4,
+        seed=seed, client_shards=shards, fault_plan=fault_plan,
+        wire_payloads=True, client_update_clip=clip,
+        quarantine_window=window,
+    )
+
+
+def _serve_payload_rounds(session, n, transport="inproc", quorum=2,
+                          deadline=5.0, trace_seed=5,
+                          classes=RELIABLE_CLASSES):
+    """Run n served wire-payload rounds; returns (service, per-round dropped
+    positions). The service is closed before returning."""
+    svc = AggregationService(
+        session,
+        ServeConfig(quorum=quorum, deadline_s=deadline, transport=transport,
+                    payload="sketch"),
+        traffic=TrafficGenerator(
+            TraceConfig(population=session.train_set.num_clients,
+                        seed=trace_seed), classes=classes),
+    ).start()
+    src = svc.source()
+    drops = []
+    try:
+        for _ in range(n):
+            prep = src.next()
+            arrived = prep.payload[1]
+            drops.append(sorted(
+                int(p) for p in np.flatnonzero(arrived == 0.0)))
+            session.commit_round(session.dispatch_round(prep, LR))
+    finally:
+        svc.close()
+    return svc, drops
+
+
+def _policy(clip=0.0, median=None):
+    return PayloadPolicy(rows=_PAYLOAD_SHAPE[0], cols=_PAYLOAD_SHAPE[1],
+                         clip_multiple=clip,
+                         quarantine_median=(None if median is None
+                                            else (lambda: median)))
+
+
+def _table(fill=0.5):
+    return np.full(_PAYLOAD_SHAPE, fill, np.float32)
+
+
+# ---------------------------------------------------- the validation gauntlet
+
+
+def test_validate_payload_accepts_clean_frame_and_raw_array():
+    t = _table()
+    for payload in (encode_frame(t), t):
+        out, decision, detail = validate_payload(payload, _policy())
+        assert decision == ACCEPTED, (decision, detail)
+        np.testing.assert_array_equal(out, t)
+        assert out.dtype == np.float32
+
+
+def test_validate_payload_rejects_checksum_flip():
+    frame = _FP.corrupt_frame(encode_frame(_table()))
+    out, decision, detail = validate_payload(frame, _policy())
+    assert (out, decision) == (None, MALFORMED)
+    assert "checksum" in detail
+
+
+def test_validate_payload_rejects_truncation_by_length_prefix():
+    frame = _FP.truncate_frame(encode_frame(_table()))
+    out, decision, detail = validate_payload(frame, _policy())
+    assert (out, decision) == (None, MALFORMED)
+    assert "length prefix" in detail or "decoded" in detail
+
+
+def test_validate_payload_rejects_stale_schema():
+    frame = encode_frame(_table(), schema=SCHEMA_VERSION + 1)
+    out, decision, detail = validate_payload(frame, _policy())
+    assert (out, decision) == (None, STALE_SCHEMA)
+
+
+def test_validate_payload_rejects_shape_dtype_and_garbage():
+    good = encode_frame(_table())
+    cases = [
+        None,                                    # no payload at all
+        "zzz",                                   # not a frame
+        {**good, "shape": [4, 8]},               # shape vs the SERVER's spec
+        {**good, "dtype": "<f8"},                # wrong wire dtype
+        {**good, "nbytes": 12},                  # lying length prefix
+        {**good, "data": "!!!notbase64!!!"},     # undecodable data
+        {k: v for k, v in good.items() if k != "schema"},  # missing field
+        np.zeros((4, 4), np.float32),            # raw array, wrong shape
+        np.zeros(_PAYLOAD_SHAPE, np.float64),    # raw array, wrong dtype
+    ]
+    for payload in cases:
+        out, decision, _ = validate_payload(payload, _policy())
+        assert (out, decision) == (None, MALFORMED), payload
+
+
+def test_validate_payload_quarantines_nonfinite_and_oversized():
+    bad = _table()
+    bad[1, 2] = np.nan
+    out, decision, detail = validate_payload(encode_frame(bad), _policy())
+    assert (out, decision) == (None, QUARANTINED)
+    assert "non-finite" in detail
+    # sketch-space L2 screen against the running median, at the wire
+    out, decision, detail = validate_payload(
+        encode_frame(_table(100.0)), _policy(clip=2.0, median=1.0))
+    assert (out, decision) == (None, QUARANTINED)
+    assert "median" in detail
+    # same table under a healthy median passes
+    out, decision, _ = validate_payload(
+        encode_frame(_table(100.0)), _policy(clip=2.0, median=1e3))
+    assert decision == ACCEPTED
+
+
+def test_payload_queue_runs_gauntlet_and_counts_rejections():
+    q = IngestQueue(capacity=8, payload_policy=_policy())
+    q.open_round(0, [1, 2, 3, 4])
+    ok = encode_frame(_table())
+    assert q.submit(Submission(1, 0, 0.1, payload=ok)) == ACCEPTED
+    assert q.submit(Submission(
+        2, 0, 0.1, payload=_FP.corrupt_frame(ok))) == MALFORMED
+    assert q.submit(Submission(
+        3, 0, 0.1, payload=encode_frame(_table(), schema=99))) == STALE_SCHEMA
+    assert q.submit(Submission(4, 0, 0.1, payload=None)) == MALFORMED
+    c = q.counters()
+    assert c["rejected_malformed"] == 2
+    assert c["rejected_stale_schema"] == 1
+    # a rejected client may retry with a GOOD frame: rejection != admission
+    assert q.submit(Submission(2, 0, 0.2, payload=ok)) == ACCEPTED
+    arr = q.arrivals()
+    assert sorted(a.client_id for a in arr) == [1, 2]
+    for a in arr:
+        np.testing.assert_array_equal(a.table, _table())
+
+
+def test_payload_round_rejects_early_push():
+    """A sketch payload is a function of the OPEN round's params — a table
+    'for the next round' cannot exist yet, so the pending buffer is closed
+    on the payload path."""
+    q = IngestQueue(capacity=8, payload_policy=_policy())
+    q.open_round(0, [1])
+    assert q.submit(Submission(
+        5, 1, 0.1, payload=encode_frame(_table()))) == OUT_OF_ROUND
+    assert q.counters()["buffered"] == 0
+
+
+# ------------------------------------------------------------- load shedding
+
+
+def test_shedding_turns_overload_away_before_other_work():
+    q = IngestQueue(capacity=4, pending_capacity=0, shed_watermark=0.5,
+                    shed_retry_after_s=2.5)
+    q.open_round(0, [1, 2, 3, 4, 5])
+    assert q.submit(_sub(1)) == ACCEPTED
+    assert q.submit(_sub(2)) == ACCEPTED  # depth 2 = watermark (0.5 * 4)
+    # sheds before the expensive work (invite lookup, payload decode) —
+    # a fresh or uninvited client costs only the depth comparison plus one
+    # O(1) set probe under a flood
+    assert q.submit(_sub(3)) == SHEDDING
+    assert q.submit(_sub(99)) == SHEDDING
+    # ...but a retry of an ALREADY-ADMITTED submission hears DUPLICATE
+    # (== success: the reply was lost, the merge will count it) — shedding
+    # must not make an at-least-once client burn its retry budget on a
+    # submission the server already took
+    assert q.submit(_sub(1)) == DUPLICATE
+    assert q.counters()["shed"] == 2
+    assert q.counters()["rejected_dup"] == 1
+    assert q.shed_retry_after_s == 2.5
+    assert q.depth() == 2  # bounded: nothing queued past the watermark
+
+
+def test_shedding_off_by_default_keeps_queue_full_semantics():
+    q = IngestQueue(capacity=2)
+    q.open_round(0, [1, 2, 3])
+    assert q.submit(_sub(1)) == ACCEPTED
+    assert q.submit(_sub(2)) == ACCEPTED
+    assert q.submit(_sub(3)) == QUEUE_FULL
+    assert q.counters()["shed"] == 0
+
+
+def test_socket_shed_reply_carries_retry_after_hint():
+    q = IngestQueue(capacity=4, pending_capacity=0, shed_watermark=0.25,
+                    shed_retry_after_s=1.5)
+    q.open_round(0, [1, 2])
+    t = SocketTransport(q)
+    t.start()
+    try:
+        assert submit_over_socket(t.address, _sub(1)) == ACCEPTED
+        from commefficient_tpu.serve.transport import _roundtrip
+
+        reply = _roundtrip(t.address, _sub(2))
+        assert reply["status"] == SHEDDING
+        assert reply["retry_after_s"] == 1.5
+    finally:
+        t.stop()
+
+
+# -------------------------------------------------------- client-side retries
+
+
+def test_submit_with_retries_backs_off_on_shedding_with_hint_floor():
+    from commefficient_tpu.serve import transport as tmod
+
+    replies = [{"status": SHEDDING, "retry_after_s": 0.8},
+               {"status": SHEDDING, "retry_after_s": 0.8},
+               {"status": ACCEPTED}]
+    calls, sleeps = [], []
+
+    def fake_roundtrip(addr, sub, timeout_s=5.0):
+        calls.append(sub)
+        return replies[len(calls) - 1]
+
+    orig = tmod._roundtrip
+    tmod._roundtrip = fake_roundtrip
+    try:
+        status = submit_with_retries(
+            ("h", 1), _sub(7), max_retries=3, base_backoff_s=0.05,
+            sleep=sleeps.append)
+    finally:
+        tmod._roundtrip = orig
+    assert status == ACCEPTED
+    assert len(calls) == 3
+    # every backoff is floored at the server's hint
+    assert all(s >= 0.8 for s in sleeps)
+
+
+def test_submit_with_retries_duplicate_is_success_and_returns_immediately():
+    from commefficient_tpu.serve import transport as tmod
+
+    def fake_roundtrip(addr, sub, timeout_s=5.0):
+        return {"status": DUPLICATE}
+
+    sleeps = []
+    orig = tmod._roundtrip
+    tmod._roundtrip = fake_roundtrip
+    try:
+        status = submit_with_retries(("h", 1), _sub(7), sleep=sleeps.append)
+    finally:
+        tmod._roundtrip = orig
+    # at-least-once: the first attempt's admission survived a lost reply —
+    # a DUPLICATE on retry IS success, and no backoff is spent on it
+    assert status == DUPLICATE
+    assert sleeps == []
+
+
+def test_submit_with_retries_bounded_budget_and_deterministic_jitter():
+    from commefficient_tpu.serve import transport as tmod
+
+    def fake_roundtrip(addr, sub, timeout_s=5.0):
+        raise ConnectionRefusedError("down")
+
+    schedules = []
+    for _ in range(2):
+        sleeps = []
+        orig = tmod._roundtrip
+        tmod._roundtrip = fake_roundtrip
+        try:
+            status = submit_with_retries(
+                ("h", 1), _sub(7, rnd=3), max_retries=3,
+                base_backoff_s=0.05, max_backoff_s=0.4, sleep=sleeps.append)
+        finally:
+            tmod._roundtrip = orig
+        assert status == "CONN_FAILED"
+        assert len(sleeps) == 3  # bounded: exactly max_retries backoffs
+        schedules.append(tuple(sleeps))
+    # jitter is a pure function of (client, round, attempt): replayable
+    assert schedules[0] == schedules[1]
+    # exponential growth with jitter in [0.5, 1.5)x, capped
+    assert all(0.5 * 0.05 * 2**i <= s <= 1.5 * min(0.05 * 2**i, 0.4)
+               for i, s in enumerate(schedules[0]))
+
+
+# -------------------------------------------- payload parity (acceptance pin)
+
+
+@pytest.mark.parametrize("shards", [0, 2], ids=["fused", "sharded"])
+def test_served_payload_round_bit_identical_to_batch_round(shards):
+    """THE wire acceptance pin: a served round whose submissions carry REAL
+    client-computed sketch tables — with wire_corrupt + wire_dup +
+    client_poison injected at the transport seam — commits params
+    BIT-identical to the batch wire-payload round that drops the same
+    casualties, fused AND sharded. Every rejection class fired as an
+    admission counter."""
+    plan = _FP.parse(
+        "wire_corrupt@1:clients=0;wire_dup@1:clients=1;"
+        "client_poison@2:clients=3,value=nan")
+    a = _sketch_session(shards=shards, fault_plan=plan, clip=3.0)
+    svc, drops = _serve_payload_rounds(a, 3, quorum=4, deadline=30.0)
+    c = svc.queue.counters()
+    assert c["rejected_malformed"] >= 1, c     # corrupt -> checksum
+    assert c["rejected_dup"] >= 1, c           # dup -> dedup, single-count
+    assert c["rejected_quarantined"] >= 1, c   # poison -> wire screen
+    assert drops[1] and drops[2], drops
+
+    pl = ";".join(f"client_drop@{r}:clients=" + "+".join(map(str, pos))
+                  for r, pos in enumerate(drops) if pos)
+    b = _sketch_session(shards=shards, fault_plan=_FP.parse(pl), clip=3.0)
+    for _ in range(3):
+        b.run_round(LR)
+    _assert_params_equal(a, b)
+    assert list(a._requeue) == list(b._requeue)
+
+
+def test_served_payload_round_over_socket_matches_inproc():
+    """The loopback socket (real frame serialization, checksums, concurrent
+    connections) and the in-process transport commit IDENTICAL params for
+    the same trace — float32 framing is exact, so the wire adds no
+    arithmetic."""
+    a = _sketch_session()
+    _serve_payload_rounds(a, 2, transport="inproc", quorum=4, deadline=30.0)
+    b = _sketch_session()
+    _serve_payload_rounds(b, 2, transport="socket", quorum=4, deadline=30.0)
+    _assert_params_equal(a, b)
+
+
+def test_payload_session_rejects_split_compile():
+    """wire_payloads IS a two-program round; stacking --split_compile on it
+    would silently pick a different program pair — reject at build."""
+    with pytest.raises(ValueError, match="two-program"):
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 6).astype(np.float32)
+        y = np.zeros(32, np.int32)
+        train = FedDataset(x, y, shard_iid(32, 4, np.random.RandomState(1)))
+        params = {"w": jnp.zeros((6, 3)), "b": jnp.zeros(3)}
+        FederatedSession(
+            train_loss_fn=_quad_loss, eval_loss_fn=_quad_loss,
+            params=params, net_state={},
+            mode_cfg=ModeConfig(mode="sketch", d=21, k=4, num_rows=3,
+                                num_cols=8),
+            train_set=train, num_workers=2, local_batch_size=4,
+            wire_payloads=True, split_compile=True)
+
+
+def test_serve_payload_mode_requires_wire_payload_session():
+    a = _tiny_session()  # announce-shaped session (wire_payloads off)
+    with pytest.raises(ValueError, match="wire_payloads"):
+        AggregationService(
+            a, ServeConfig(quorum=2, payload="sketch"),
+            traffic=TrafficGenerator(TraceConfig(population=12)))
+
+
+# ------------------------------------- single-damaged-frame property (bitwise)
+
+
+def _one_payload_round(session, mutate=None, target=2):
+    """One served-style payload round driven at queue level: every invitee
+    submits its real table, `mutate(frame)` damages the target position's
+    frame (None = clean). Returns committed params (flat)."""
+    ids = session.sample_cohort(0)
+    prep0 = session.prepare_served_round(
+        0, ids, np.ones(len(ids), np.float32))
+    tables, aux = session.compute_client_tables(prep0)
+    q = IngestQueue(capacity=16, payload_policy=_policy())
+    q.open_round(0, ids)
+    asm = CohortAssembler(q, quorum=len(ids), deadline_s=10.0,
+                          payload_shape=_PAYLOAD_SHAPE)
+    for i, cid in enumerate(ids):
+        payload = encode_frame(tables[i])
+        if i == target and mutate is not None:
+            sent = mutate(payload)
+            for p in sent if isinstance(sent, list) else [sent]:
+                if p is not None:
+                    q.submit(Submission(int(cid), 0, 0.1, payload=p))
+        else:
+            q.submit(Submission(int(cid), 0, 0.1, payload=payload))
+    closed = asm.close_virtual(0, ids)
+    prep = session.finish_served_payload(
+        prep0, closed.arrived, closed.tables, aux)
+    session.commit_round(session.dispatch_round(prep, LR))
+    return np.asarray(
+        ravel_pytree(jax.device_get(session.state["params"]))[0])
+
+
+DAMAGE = {
+    "corrupt": lambda f: _FP.corrupt_frame(f),
+    "truncate": lambda f: _FP.truncate_frame(f),
+    "stale_schema": lambda f: {**f, "schema": SCHEMA_VERSION + 7},
+    "wrong_shape": lambda f: {**f, "shape": [1, 1]},
+    "garbage": lambda f: "not a frame at all",
+    "dropped_mid_send": lambda f: None,  # the send never completes
+}
+
+
+@pytest.mark.parametrize("kind", sorted(DAMAGE))
+def test_single_damaged_frame_never_changes_committed_params(kind):
+    """The robustness property: ANY single corrupted / truncated / stale /
+    garbled / half-sent frame changes NOTHING about the committed params
+    relative to the round where that client simply never submitted —
+    rejection == drop, bitwise. (A duplicated frame is the other half:
+    == the round where it submitted once.)"""
+    damaged = _one_payload_round(_sketch_session(), mutate=DAMAGE[kind])
+    # the reference: the target client never submits at all
+    reference = _one_payload_round(
+        _sketch_session(), mutate=lambda f: None)
+    np.testing.assert_array_equal(damaged, reference)
+
+
+def test_duplicated_frame_is_counted_once_bitwise():
+    duplicated = _one_payload_round(
+        _sketch_session(), mutate=lambda f: [f, f])
+    clean = _one_payload_round(_sketch_session(), mutate=None)
+    np.testing.assert_array_equal(duplicated, clean)
+
+
+# --------------------------------------------- close_wall under concurrency
+
+
+def test_close_wall_cut_excludes_arrivals_racing_the_drain():
+    """Recv-order wall-clock cut: submissions ADMITTED between the wait's
+    satisfaction and close_round's drain are stragglers, not survivors —
+    the cut is decided on the snapshot the wait returned."""
+    q = IngestQueue(capacity=8)
+    q.open_round(0, [1, 2, 3, 4])
+    asm = CohortAssembler(q, quorum=2, deadline_s=0.05)
+    orig_wait = q.wait_for
+
+    def racy_wait(count, timeout_s):
+        q.submit(_sub(1))
+        q.submit(_sub(2))
+        snap = orig_wait(count, 0.0)
+        # these land AFTER the wall-clock cut, BEFORE the drain
+        q.submit(_sub(3))
+        q.submit(_sub(4))
+        return snap
+
+    q.wait_for = racy_wait
+    closed = asm.close_wall(0, [1, 2, 3, 4])
+    assert closed.closed_by == "quorum"
+    assert closed.arrived.tolist() == [1.0, 1.0, 0.0, 0.0]
+    assert closed.stragglers == 2  # submitted, admitted, but past the cut
+
+
+def test_close_wall_deadline_verdict_survives_racing_arrivals():
+    """A deadline-expired wait must stay closed_by='deadline' even when
+    late arrivals pile in during the wait->drain gap — they cannot
+    retroactively make the round a quorum close."""
+    q = IngestQueue(capacity=8)
+    q.open_round(0, [1, 2, 3])
+    asm = CohortAssembler(q, quorum=3, deadline_s=0.01)
+    orig_wait = q.wait_for
+
+    def racy_wait(count, timeout_s):
+        q.submit(_sub(1))
+        snap = orig_wait(count, 0.01)  # times out short of quorum
+        q.submit(_sub(2))
+        q.submit(_sub(3))
+        return snap
+
+    q.wait_for = racy_wait
+    closed = asm.close_wall(0, [1, 2, 3])
+    assert closed.closed_by == "deadline"
+    assert closed.arrived.tolist() == [1.0, 0.0, 0.0]
+
+
+def test_close_wall_under_socket_load_with_stragglers():
+    """Satellite: the recv-order wall-clock cut under REAL concurrent
+    socket connections carrying payload frames, with injected stragglers.
+    Exactly the first `quorum` admitted clients survive, every survivor's
+    validated table rides into the close, and the slow group never makes
+    the cut."""
+    import threading as th
+
+    ids = list(range(12))
+    q = IngestQueue(capacity=64, payload_policy=_policy())
+    q.open_round(0, ids)
+    t = SocketTransport(q)
+    t.start()
+    asm = CohortAssembler(q, quorum=6, deadline_s=10.0,
+                          payload_shape=_PAYLOAD_SHAPE)
+    import time as _time
+    fast, slow = set(range(8)), set(range(8, 12))
+
+    def client(cid):
+        _time.sleep(0.02 if cid in fast else 1.2)  # injected stragglers
+        try:
+            submit_over_socket(t.address, Submission(
+                cid, 0, latency_s=0.02, payload=_table(float(cid + 1))))
+        except OSError:
+            pass
+
+    threads = [th.Thread(target=client, args=(cid,)) for cid in ids]
+    try:
+        for x in threads:
+            x.start()
+        closed = asm.close_wall(0, ids)
+    finally:
+        for x in threads:
+            x.join()
+        t.stop()
+    assert closed.closed_by == "quorum"
+    assert closed.survivors == 6
+    survivors = {int(c) for c, a in zip(closed.invited, closed.arrived)
+                 if a == 1.0}
+    assert survivors <= fast, survivors  # recv order == the fast group
+    # every survivor's VALIDATED table (and nobody else's) is in the stack
+    for pos, cid in enumerate(closed.invited):
+        expect = (_table(float(cid + 1)) if closed.arrived[pos] == 1.0
+                  else np.zeros(_PAYLOAD_SHAPE, np.float32))
+        np.testing.assert_array_equal(closed.tables[pos], expect)
+
+
+# ------------------------------------------------------- transport hardening
+
+
+def test_socket_read_deadline_disconnects_silent_peer():
+    """Slow-loris defense: a peer that connects and never sends is
+    disconnected when the read deadline lapses — its handler thread exits
+    on its own, before any stop()."""
+    import socket as sk
+    import threading as th
+    import time as _time
+
+    q = IngestQueue(capacity=4)
+    q.open_round(0, [1])
+    t = SocketTransport(q, read_deadline_s=0.2)
+    t.start()
+    try:
+        conn = sk.create_connection(t.address)
+        deadline = _time.monotonic() + 3.0
+        while _time.monotonic() < deadline:
+            if not any(x.name == "serve-conn" and x.is_alive()
+                       for x in th.enumerate()):
+                break
+            _time.sleep(0.05)
+        else:
+            raise AssertionError("silent peer's thread outlived the "
+                                 "read deadline")
+        conn.close()
+    finally:
+        t.stop()
+
+
+def test_socket_max_frame_rejects_newline_less_flood():
+    """Memory-bomb defense: a newline-less byte flood is cut off at the
+    frame cap with a MALFORMED reply and a disconnect — per-connection
+    memory stays bounded no matter what the peer sends."""
+    import socket as sk
+
+    q = IngestQueue(capacity=4)
+    q.open_round(0, [1])
+    t = SocketTransport(q, max_frame_bytes=2048)
+    t.start()
+    try:
+        with sk.create_connection(t.address) as conn:
+            conn.sendall(b"x" * 8192)  # no newline ever
+            conn.settimeout(5.0)
+            reply = b""
+            while b"\n" not in reply:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                reply += chunk
+            assert b"MALFORMED" in reply, reply
+            assert conn.recv(4096) == b""  # server hung up
+    finally:
+        t.stop()
+    assert q.counters()["accepted"] == 0
+    # a transport-decided MALFORMED still shows in the queue's counters —
+    # the /metrics submissions block must see a byte-flood happening
+    assert q.counters()["rejected_malformed"] == 1
+
+
+def test_socket_stop_joins_half_open_and_mid_frame_connections():
+    """Thread hygiene satellite: stop() force-closes live connections and
+    joins EVERY per-connection thread within its deadline — including
+    threads parked on abandoned half-open peers and mid-frame senders."""
+    import socket as sk
+    import threading as th
+
+    q = IngestQueue(capacity=8)
+    q.open_round(0, [1, 2])
+    t = SocketTransport(q, read_deadline_s=30.0)  # deadline will NOT help
+    t.start()
+    conns = []
+    try:
+        for _ in range(3):
+            conns.append(sk.create_connection(t.address))  # half-open
+        conns[0].sendall(b'{"client_id": 1, ')  # mid-frame, never finished
+        # a completed submission keeps one healthy connection around too
+        assert submit_over_socket(
+            t.address, Submission(2, 0, latency_s=0.1)) == ACCEPTED
+    finally:
+        t.stop(join_deadline_s=5.0)
+        leaked = [x.name for x in th.enumerate()
+                  if x.name.startswith("serve-") and x.is_alive()]
+        assert not leaked, leaked
+        for c in conns:
+            c.close()
+
+
+def test_abort_over_socket_is_a_no_show():
+    """conn_drop realism: a connection that dies mid-send admits NOTHING —
+    the partial frame never parses and the handler thread moves on."""
+    q = IngestQueue(capacity=4, payload_policy=_policy())
+    q.open_round(0, [1])
+    t = SocketTransport(q)
+    t.start()
+    try:
+        abort_over_socket(t.address, Submission(
+            1, 0, latency_s=0.1, payload=_table()))
+        assert q.counters()["accepted"] == 0
+        # the same client can still submit for real afterwards
+        assert submit_over_socket(t.address, Submission(
+            1, 0, latency_s=0.2, payload=encode_frame(_table()))) == ACCEPTED
+    finally:
+        t.stop()
+
+
+# ------------------------------------------- checkpoint resume (payload path)
+
+
+@pytest.mark.chaos
+def test_cli_serve_payload_preempt_resume_bit_identical(tiny_cv, tmp_path):
+    """Checkpoint -> resume MID-SERVED-ROUND on the payload path: the
+    --serve_payload sketch CLI run preempted by an injected SIGTERM resumes
+    BIT-identical to the uninterrupted run — cohort stream, payload tables,
+    requeue state and all."""
+    flags = ("--serve", "inproc", "--serve_payload", "sketch",
+             "--mode", "sketch", "--k", "16", "--num_cols", "256",
+             "--num_rows", "3", "--serve_deadline", "2.0",
+             "--num_rounds", "4")
+    argv = [
+        "--dataset", "cifar10", "--num_clients", "8", "--num_workers", "2",
+        "--local_batch_size", "4", "--lr_scale", "0.05",
+        "--weight_decay", "0", "--data_root", "/nonexistent", *flags,
+    ]
+    sa = cv_train.main(list(argv))  # uninterrupted reference
+
+    ckdir = str(tmp_path / "ck")
+    chaos = ["--checkpoint_dir", ckdir, "--checkpoint_every", "2",
+             "--fault_plan", "preempt@2"]
+    with pytest.raises(SystemExit) as ei:
+        cv_train.main(list(argv) + chaos)
+    assert ei.value.code == EXIT_RESUMABLE
+    sc = cv_train.main(list(argv) + chaos + ["--resume"])
+    assert sc.round == 4
+    _assert_params_equal(sa, sc)
+    assert list(sa._requeue) == list(sc._requeue)
+    assert sa._requeue_enqueued == sc._requeue_enqueued
