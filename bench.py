@@ -343,6 +343,13 @@ SKETCH_PATH_BENCH = os.environ.get("BENCH_SKETCH_PATH", "1") == "1"
 SERVE_BENCH = os.environ.get("BENCH_SERVE", "1") == "1"
 SERVE_ROUNDS = int(os.environ.get("BENCH_SERVE_ROUNDS", 12))
 SERVE_POPULATION = int(os.environ.get("BENCH_SERVE_POPULATION", 10_000_000))
+# Byzantine-robustness section: final accuracy under each adversarial
+# client kind x {sum, trimmed, median} merge on the flagship task, plus the
+# merge-policy overhead in updates/s (the robust policies forfeit the
+# compress-once shortcut — this measures what the defense costs). 12 short
+# real runs; BENCH_BYZANTINE=0 disables, BENCH_BYZANTINE_ROUNDS sizes them.
+BYZANTINE_BENCH = os.environ.get("BENCH_BYZANTINE", "1") == "1"
+BYZANTINE_ROUNDS = int(os.environ.get("BENCH_BYZANTINE_ROUNDS", 20))
 # Mesh scaling section: time the SPMD sharded round (engine.
 # make_sharded_round_step — per-device partial sketch + one table merge)
 # at the same global cohort across 1, 2, 4, ... visible devices, and record
@@ -1023,7 +1030,8 @@ def _run_loop_bench(round_ms: float) -> dict:
         arm(sync=True, rounds=min(2, RUN_LOOP_ROUNDS))  # compile + warm
         nonfinite = 0
         cohort = {"clients_dropped": 0, "clients_quarantined": 0,
-                  "degraded_rounds": 0, "requeue_depth_max": 0}
+                  "degraded_rounds": 0, "requeue_depth_max": 0,
+                  "attacks_injected": 0}
         for label, sync in (("sync", True), ("async", False)):
             stats = arm(sync, RUN_LOOP_ROUNDS)
             wall_round_ms = stats.wall_s * 1e3 / max(stats.rounds, 1)
@@ -1031,6 +1039,7 @@ def _run_loop_bench(round_ms: float) -> dict:
             cohort["clients_dropped"] += stats.clients_dropped
             cohort["clients_quarantined"] += stats.clients_quarantined
             cohort["degraded_rounds"] += stats.degraded_rounds
+            cohort["attacks_injected"] += stats.attacks_injected
             cohort["requeue_depth_max"] = max(
                 cohort["requeue_depth_max"], stats.requeue_depth_max)
             out[label] = {
@@ -1240,6 +1249,130 @@ def _sketch_path_bench(round_ms: float) -> dict:
             out["layerwise_vs_ravel_round_ms_ratio"] = round(
                 out["layerwise"]["wall_round_ms"]
                 / max(out["ravel"]["wall_round_ms"], 1e-9), 3)
+    except Exception as e:  # noqa: BLE001 — the stanza IS the result
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _byzantine_bench() -> dict:
+    """Final-accuracy under each adversarial client kind x merge policy on
+    the flagship (ResNet-9, separable synthetic CIFAR so accuracy moves in
+    few rounds), plus the merge-policy overhead in updates/s on a clean
+    run — the price of forfeiting the compress-once linearity shortcut.
+    Never raises; partial arms still report."""
+    import time as _time
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+    from commefficient_tpu.federated.api import FederatedSession
+    from commefficient_tpu.modes.config import ModeConfig
+    from commefficient_tpu.resilience import FaultPlan
+
+    rounds = BYZANTINE_ROUNDS
+    out: dict = {"rounds_per_arm": rounds}
+    try:
+        params, net_state, _, loss_fn, _, sketch_kw, workers = _resnet9_workload()
+        from jax.flatten_util import ravel_pytree
+
+        d = ravel_pytree(params)[0].size
+        rng = np.random.RandomState(0)
+        n_examples = max(512, workers * LOCAL_BATCH * 4)
+        # separable synthetic CIFAR (class prototypes + noise): accuracy
+        # responds within BYZANTINE_ROUNDS, so attack damage is visible
+        protos = rng.randn(10, 32, 32, 3).astype(np.float32)
+        y = rng.randint(0, 10, size=n_examples).astype(np.int32)
+        x = (protos[y]
+             + 0.5 * rng.randn(n_examples, 32, 32, 3)).astype(np.float32)
+
+        # a one-client sign-flipper, a 20x model-replacement scaler, and a
+        # seeded ~12% colluding-clone minority — each on every round
+        all_rounds = ",".join(str(r) for r in range(rounds))
+        trim = max(1, int(np.ceil(0.12 * workers)))
+        attacks = {
+            "none": None,
+            "signflip": f"client_signflip@{all_rounds}:clients=0",
+            "scale": f"client_scale@{all_rounds}:clients=0,factor=20",
+            "collude": f"client_collude@{all_rounds}:frac=0.12",
+        }
+        # the sum arms run wire_payloads=True so EVERY cell of the grid —
+        # clean included — executes the per-client-table round: the
+        # attacked-vs-clean deltas are attack damage, never the documented
+        # fp-association gap between the table and compress-once shapes
+        policies = {"sum": {"wire_payloads": True},
+                    "trimmed": {"merge_trim": trim}, "median": {}}
+        out["merge_trim"] = trim
+
+        def make_session(policy, plan_text, **kw):
+            return FederatedSession(
+                train_loss_fn=loss_fn, eval_loss_fn=loss_fn,
+                params=jax.tree.map(jnp.copy, params),
+                net_state=jax.tree.map(jnp.copy, net_state),
+                mode_cfg=ModeConfig(
+                    mode="sketch", d=d, momentum_type="virtual",
+                    error_type="virtual",
+                    topk_impl=os.environ.get("BENCH_TOPK_IMPL", "approx"),
+                    topk_recall=float(
+                        os.environ.get("BENCH_TOPK_RECALL", 0.99)),
+                    **sketch_kw),
+                train_set=FedDataset(
+                    x, y, shard_iid(n_examples, max(2 * workers, 8),
+                                    np.random.RandomState(1))),
+                num_workers=workers, local_batch_size=LOCAL_BATCH,
+                weight_decay=5e-4, seed=0, merge_policy=policy,
+                fault_plan=FaultPlan.parse(plan_text), **kw)
+
+        acc = {}
+        # assigned BEFORE the grid runs (and mutated in place), so a
+        # mid-grid failure still reports every completed arm
+        out["accuracy"] = acc
+        for aname, plan_text in attacks.items():
+            acc[aname] = {}
+            for pname, pkw in policies.items():
+                s = make_session(pname, plan_text, **pkw)
+                t0 = _time.perf_counter()
+                ms = [s.run_round(0.02) for _ in range(rounds)]
+                wall = _time.perf_counter() - t0
+                tail = ms[max(0, rounds - 3):]
+                correct = sum(m.get("correct", 0.0) for m in tail)
+                count = max(sum(m.get("count", 0.0) for m in tail), 1.0)
+                arm = {"final_train_acc": round(correct / count, 4),
+                       "final_train_loss": round(
+                           tail[-1].get("loss_sum", float("nan"))
+                           / max(tail[-1].get("count", 0.0), 1.0), 4)}
+                if aname == "none":
+                    # clean arms double as the merge-policy overhead probe
+                    # (wall includes the compile; report post-warm rate too)
+                    t1 = _time.perf_counter()
+                    extra = max(2, rounds // 4)
+                    for _ in range(extra):
+                        s.run_round(0.02)
+                    warm = _time.perf_counter() - t1
+                    arm["updates_per_sec_warm"] = round(
+                        workers * extra / max(warm, 1e-9), 2)
+                    arm["wall_s_incl_compile"] = round(wall, 2)
+                acc[aname][pname] = arm
+                _stage(f"byzantine {aname} x {pname}: {arm}")
+        clean = acc.get("none", {})
+        if all("updates_per_sec_warm" in clean.get(p, {})
+               for p in ("sum", "trimmed", "median")):
+            base = clean["sum"]["updates_per_sec_warm"]
+            out["merge_policy_overhead"] = {
+                p: {"updates_per_sec_warm":
+                        clean[p]["updates_per_sec_warm"],
+                    "vs_sum": round(
+                        clean[p]["updates_per_sec_warm"] / max(base, 1e-9),
+                        3)}
+                for p in ("sum", "trimmed", "median")}
+        out["note"] = (
+            "accuracy = train accuracy over the last 3 rounds; attacks ride "
+            "the per-client-table round (sum arms included, so damage is "
+            "attack-caused, not shape-caused); overhead vs_sum < 1 is the "
+            "robust policies' cost — the compress-once shortcut forfeited "
+            "plus the per-coordinate order statistics")
     except Exception as e:  # noqa: BLE001 — the stanza IS the result
         out["error"] = f"{type(e).__name__}: {e}"
     return out
@@ -1725,6 +1858,22 @@ def run_bench(platform: str) -> dict:
             result["serve"] = {
                 "skipped": "serve section measures the flagship resnet9 "
                            "workload (BENCH_MODEL=resnet9)"}
+    if BYZANTINE_BENCH:
+        if BENCH_MODEL == "resnet9":
+            _stage("byzantine (attack kind x merge policy accuracy + "
+                   "merge-policy overhead) ...")
+            result["byzantine"] = _byzantine_bench()
+            _stage(f"byzantine: {result['byzantine']}")
+        else:
+            result["byzantine"] = {
+                "skipped": "byzantine section measures the flagship resnet9 "
+                           "workload (BENCH_MODEL=resnet9)"}
+    else:
+        result["byzantine"] = {
+            "skipped": "gated off (BENCH_BYZANTINE=0, or the CPU fallback's "
+                       "default — 12 arms x two compiles each); set "
+                       "BENCH_BYZANTINE=1 [+ BENCH_BYZANTINE_ROUNDS] to run "
+                       "the attack-kind x merge-policy grid"}
 
     # chaos runs are benchmarkable: what the resilience layer absorbed while
     # this process produced the numbers above (nonzero only under
@@ -1743,6 +1892,7 @@ def run_bench(platform: str) -> dict:
         "clients_quarantined": rl_cohort.get("clients_quarantined", 0),
         "degraded_rounds": rl_cohort.get("degraded_rounds", 0),
         "requeue_depth_max": rl_cohort.get("requeue_depth_max", 0),
+        "attacks_injected": rl_cohort.get("attacks_injected", 0),
         **({"fault_plan": BENCH_FAULT_PLAN} if BENCH_FAULT_PLAN else {}),
     }
     return result
@@ -1756,7 +1906,8 @@ def _shrink_for_cpu():
                         ("WARMUP_ROUNDS", 1), ("MICROBENCH_D", 2_000_000),
                         ("MICRO_CHAIN", 3), ("SKETCH_COLS", 65_536),
                         ("TOPK", 8_192), ("PHASE_CHAIN", 2),
-                        ("RUN_LOOP_ROUNDS", 6), ("SERVE_ROUNDS", 4)]:
+                        ("RUN_LOOP_ROUNDS", 6), ("SERVE_ROUNDS", 4),
+                    ("BYZANTINE_ROUNDS", 6)]:
         env_name = {"NUM_WORKERS": "BENCH_WORKERS", "CHAIN_LEN": "BENCH_CHAIN_LEN",
                     "NUM_CHAINS": "BENCH_CHAINS", "WARMUP_ROUNDS": "BENCH_WARMUP",
                     "MICROBENCH_D": "BENCH_MICRO_D",
@@ -1764,7 +1915,8 @@ def _shrink_for_cpu():
                     "SKETCH_COLS": "BENCH_COLS", "TOPK": "BENCH_TOPK",
                     "PHASE_CHAIN": "BENCH_PHASE_CHAIN",
                     "RUN_LOOP_ROUNDS": "BENCH_RUN_LOOP_ROUNDS",
-                    "SERVE_ROUNDS": "BENCH_SERVE_ROUNDS"}[name]
+                    "SERVE_ROUNDS": "BENCH_SERVE_ROUNDS",
+                    "BYZANTINE_ROUNDS": "BENCH_BYZANTINE_ROUNDS"}[name]
         if env_name not in os.environ:
             g[name] = small
     if "BENCH_SCALE_CHECK" not in os.environ:
@@ -1777,6 +1929,11 @@ def _shrink_for_cpu():
         g["PHASE_TIMING"] = False
     if "BENCH_SERVER_SPLIT" not in os.environ:
         g["SERVER_SPLIT"] = False  # four more chains; on-chip question only
+    if "BENCH_BYZANTINE" not in os.environ:
+        # 12 arms x two compiled programs each — tens of minutes on the CPU
+        # fallback; set BENCH_BYZANTINE=1 (+ BENCH_BYZANTINE_ROUNDS) to
+        # opt in there, on-chip it runs by default
+        g["BYZANTINE_BENCH"] = False
 
 
 def main():

@@ -58,6 +58,13 @@ G011  wire-bytes-in-compiled-scope               untrusted wire frame bytes
                                                  serve.ingest.validate_payload
                                                  (`# graftlint:
                                                  payload-boundary`)
+G012  robust-order-sensitivity                   order statistics (sort/
+                                                 median/percentile) over
+                                                 client wires in parity scope
+                                                 only inside the ONE declared
+                                                 robust-merge boundary,
+                                                 modes._robust_table_merge
+                                                 (`# graftlint: robust-merge`)
 ====  =========================================  ================================
 
 Run it:
@@ -91,6 +98,7 @@ from .rules_dataflow import DonationAfterUse, RngKeyReuse
 from .rules_io import RawCheckpointWrite
 from .rules_obs import ObsCallInCompiledScope
 from .rules_parity import ReservedLeafAccess, UnorderedReduction
+from .rules_robust import RobustOrderSensitivity
 from .rules_sketch import FlatRavelInRoundPath
 from .rules_sync import BlockingCallOnDispatchThread, HostSyncInRoundPath
 from .rules_wire import WireBytesInCompiledScope
@@ -107,6 +115,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     ObsCallInCompiledScope,
     FlatRavelInRoundPath,
     WireBytesInCompiledScope,
+    RobustOrderSensitivity,
 )
 
 RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)

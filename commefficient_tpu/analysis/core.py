@@ -58,6 +58,7 @@ class FunctionInfo:
     drain_point: bool
     sketch_boundary: bool = False
     payload_boundary: bool = False
+    robust_merge: bool = False
 
 
 class SourceFile:
@@ -103,8 +104,10 @@ class SourceFile:
                         cand & self.directives.sketch_boundary_linenos)
                     payload = bool(
                         cand & self.directives.payload_boundary_linenos)
+                    robust = bool(
+                        cand & self.directives.robust_merge_linenos)
                     out.append(FunctionInfo(qual, start, child.lineno, end,
-                                            drain, sketch, payload))
+                                            drain, sketch, payload, robust))
                     visit(child, f"{qual}.")
                 elif isinstance(child, ast.ClassDef):
                     visit(child, f"{prefix}{child.name}.")
@@ -138,6 +141,12 @@ class SourceFile:
         """True when any enclosing function is a declared flat/ravel
         boundary of the sketch path (G010's sanctioned sites)."""
         return any(f.sketch_boundary
+                   for f in self.enclosing_functions(lineno))
+
+    def in_robust_merge(self, lineno: int) -> bool:
+        """True when any enclosing function is the declared robust-merge
+        boundary (G012's sanctioned order-statistics site)."""
+        return any(f.robust_merge
                    for f in self.enclosing_functions(lineno))
 
     # -- import index --------------------------------------------------------

@@ -13,6 +13,11 @@ Three forms, all line-anchored comments:
                                          sketch path (G010 exempt) — the
                                          ravel-path code that concatenates the
                                          gradient ON PURPOSE
+    # graftlint: robust-merge            on/above a `def`: this function IS
+                                         the declared robust-merge boundary
+                                         (G012 exempt) — the ONE place order
+                                         statistics may run over
+                                         client-stacked wires in parity scope
     # graftlint: module=<relpath>        fixture support: analyze this file as
                                          if it lived at <relpath> (scoped rules
                                          fire on test snippets)
@@ -55,6 +60,9 @@ class Directives:
     # linenos carrying a payload-boundary marker (G011's sanctioned wire
     # deserialization sites — serve.ingest.validate_payload)
     payload_boundary_linenos: set[int]
+    # linenos carrying a robust-merge marker (G012's sanctioned order-
+    # statistics site — modes._robust_table_merge)
+    robust_merge_linenos: set[int]
     # fixture impersonation path, or None
     module_override: str | None
     # (lineno, message) for malformed directives — surfaced as G000
@@ -109,7 +117,7 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
     d = Directives(
         line_disables={}, file_disables=set(), drain_linenos=set(),
         sketch_boundary_linenos=set(), payload_boundary_linenos=set(),
-        module_override=None, errors=[],
+        robust_merge_linenos=set(), module_override=None, errors=[],
     )
     for lineno, line in _comments(text):
         m = _DIRECTIVE_RE.search(line)
@@ -132,6 +140,8 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
             d.sketch_boundary_linenos.add(lineno)
         elif verb == "payload-boundary" and not has_eq:
             d.payload_boundary_linenos.add(lineno)
+        elif verb == "robust-merge" and not has_eq:
+            d.robust_merge_linenos.add(lineno)
         elif verb == "module" and has_eq:
             d.module_override = arg.strip()
         elif not verb:
@@ -141,6 +151,6 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
                 lineno,
                 f"unknown graftlint directive {verb!r} "
                 "(expected disable/disable-file/drain-point/"
-                "sketch-boundary/payload-boundary/module)",
+                "sketch-boundary/payload-boundary/robust-merge/module)",
             ))
     return d

@@ -1,0 +1,114 @@
+"""G012 robust-order-sensitivity.
+
+The repo's aggregation contract is LINEAR: client wires merge by the
+ordered sum (csvec.merge_tables / modes.merge_partial_wires), and every
+bit-parity pin — mesh == single-device, served == batch, split == fused —
+rests on that one fp association. The Byzantine-robust merge
+(--merge_policy trimmed|median) deliberately breaks linearity with order
+statistics over the client-stacked tables, and it does so in exactly ONE
+declared place: ``modes._robust_table_merge``, marked ``# graftlint:
+robust-merge``. A sort/median/percentile over client data anywhere else in
+parity scope is either a second, undeclared aggregation semantics (two
+robust merges that disagree about tie-breaks silently un-pin the
+mesh-shape invariance) or an accidental reassociation of the parity-pinned
+reduce.
+
+Detection, in the parity scope (modes/ + federated/engine.py):
+
+- any call resolving through the import table to an order-statistics
+  primitive — ``jnp.sort/argsort/partition/median/percentile/quantile/
+  nanmedian``, ``lax.sort``, or their host-numpy twins — outside a
+  function declared ``# graftlint: robust-merge``.
+- any robust-merge declaration OUTSIDE ``modes/modes.py``: the boundary
+  lives in exactly one sanctioned file, so a declaration elsewhere in
+  parity scope (and the exemption it would grant) is itself a violation —
+  which is also what catches the cross-file second-boundary case a
+  per-file rule could not see.
+- a SECOND robust-merge declaration in the same file: the boundary is "the
+  one declared function"; a second declared sort site is a second
+  aggregation semantics hiding under the first's exemption.
+
+The quarantine's norm-median helpers (engine._masked_median) sort [W] norm
+VECTORS — screening thresholds, not merged values; the one such site
+carries an inline justification. sketch/ is deliberately out of scope: the
+Count-Sketch estimator's per-row median (csvec) sorts over the r hash-row
+axis, the estimator's own definition, not a client axis.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+# the parity-pinned merge scope: where client wires are reduced
+_PARITY_SCOPE = (
+    f"{PACKAGE}/modes/",
+    f"{PACKAGE}/federated/engine.py",
+)
+
+# the ONE file the robust-merge boundary may be declared in
+_BOUNDARY_FILE = f"{PACKAGE}/modes/modes.py"
+
+# order-statistics primitives (import-resolved): the moves only the
+# declared boundary may make over client-stacked data
+_ORDER_STATS = frozenset({
+    "jax.numpy.sort", "jax.numpy.argsort", "jax.numpy.partition",
+    "jax.numpy.argpartition", "jax.numpy.median", "jax.numpy.nanmedian",
+    "jax.numpy.percentile", "jax.numpy.nanpercentile",
+    "jax.numpy.quantile", "jax.numpy.nanquantile",
+    "jax.lax.sort", "jax.lax.sort_key_val",
+    "numpy.sort", "numpy.argsort", "numpy.partition", "numpy.median",
+    "numpy.nanmedian", "numpy.percentile", "numpy.quantile",
+})
+
+
+class RobustOrderSensitivity(Rule):
+    code = "G012"
+    name = "robust-order-sensitivity"
+    fixit = ("route order statistics over client wires through the ONE "
+             "declared `# graftlint: robust-merge` boundary "
+             "(modes._robust_table_merge) — or, for a screening median "
+             "over norm vectors, justify the site inline with "
+             "`# graftlint: disable=G012 — why`")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(_PARITY_SCOPE)
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        declared = [f for f in src.functions if f.robust_merge]
+        in_boundary_file = src.rel == _BOUNDARY_FILE
+        # the exemption is only honored where the boundary is sanctioned to
+        # live; any declaration elsewhere is itself a violation (the
+        # cross-file second-boundary case a per-file rule can't count)
+        illegal = declared if not in_boundary_file else declared[1:]
+        for extra in illegal:
+            out.append(Violation(
+                code=self.code, name=self.name, rel=src.rel,
+                lineno=extra.def_lineno, col=0,
+                message=(
+                    f"robust-merge boundary declared at {extra.qualname} — "
+                    f"the robust merge is ONE declared function in "
+                    f"{_BOUNDARY_FILE}; another declaration is a second "
+                    f"aggregation semantics hiding under the exemption"),
+                fixit=("fold the order statistics into the existing "
+                       "declared boundary (modes._robust_table_merge)"),
+                line_text=src.line(extra.def_lineno),
+                symbol=extra.qualname,
+            ))
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = src.resolve_dotted(node.func)
+            if dotted is None or dotted not in _ORDER_STATS:
+                continue
+            if in_boundary_file and src.in_robust_merge(node.lineno):
+                continue
+            out.append(self.violation(
+                src, node,
+                f"{dotted}() is an order statistic in parity scope outside "
+                "the declared robust-merge boundary — sorting client data "
+                "here either adds an undeclared aggregation semantics or "
+                "reassociates the parity-pinned ordered sum"))
+        return out

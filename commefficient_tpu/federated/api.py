@@ -132,6 +132,9 @@ class FederatedSession:
         sketch_path: str = "ravel",
         quarantine_window: int = 1,
         wire_payloads: bool = False,
+        merge_policy: str = "sum",
+        merge_trim: int = 0,
+        quarantine_scope: str = "cohort",
     ):
         # client_shards: 0 = derive from the mesh (the default — on a >1-
         # device mesh with a mode in engine.supports_sharded_round's scope
@@ -160,6 +163,11 @@ class FederatedSession:
             # --serve_payload sketch; see EngineConfig for both)
             quarantine_window=quarantine_window,
             wire_payloads=wire_payloads,
+            # Byzantine-robust table merge (--merge_policy) + quarantine
+            # screen granularity (--quarantine_scope) — see EngineConfig
+            merge_policy=merge_policy,
+            merge_trim=merge_trim,
+            quarantine_scope=quarantine_scope,
             # CLI "halt" is a host-side policy on top of the compiled "skip"
             # guard (state stays clean either way; the CLI decides to stop)
             on_nonfinite="skip" if on_nonfinite == "halt" else on_nonfinite,
@@ -170,6 +178,40 @@ class FederatedSession:
                 "table merge); --split_compile is redundant and would pick "
                 "a different program pair — drop one of the two"
             )
+        # The per-client-TABLE round shape (engine.make_payload_round_steps)
+        # serves three masters: a real wire (--serve_payload sketch), a
+        # robust merge policy (order statistics need individual client
+        # tables), and the adversarial attack faults (client_signflip /
+        # client_scale / client_collude transform the per-client WIRE — the
+        # object that only exists on the table round). Any of the three
+        # routes the session through the two-program table round.
+        adv_faults = (fault_plan is not None
+                      and getattr(fault_plan, "has_adversarial",
+                                  lambda: False)())
+        self._table_round = bool(
+            engine.uses_table_round(self.cfg) or adv_faults)
+        if self._table_round and not wire_payloads:
+            why = ("merge_policy=" + repr(merge_policy)
+                   if engine.robust_policy(self.cfg) is not None
+                   else "adversarial fault kinds (client_signflip/"
+                        "client_scale/client_collude)")
+            if mode_cfg.mode != "sketch":
+                raise ValueError(
+                    f"{why} need(s) the per-client-table round, which "
+                    f"requires mode='sketch'; got mode={mode_cfg.mode!r}"
+                )
+            if sketch_path != "ravel":
+                raise ValueError(
+                    f"{why} need(s) the per-client-table round "
+                    "(sketch_path='ravel'); layerwise accumulation has no "
+                    "per-client wire to screen or attack"
+                )
+            if split_compile:
+                raise ValueError(
+                    f"{why} route(s) the round through the table-round "
+                    "program pair; --split_compile would pick a different "
+                    "pair — drop one of the two"
+                )
         # cohort-degradation re-queue: client ids whose batch load failed (or
         # were fault-dropped) wait here and displace sampled ids in a later
         # round's cohort, so a dropped client's data is delayed, not lost.
@@ -324,15 +366,18 @@ class FederatedSession:
         self._split = split_compile
         self._payload_client = None
         self._payload_merge = None
-        if wire_payloads:
-            # the wire-payload two-program round: client tables + table
+        if self._table_round:
+            # the per-client-table two-program round: client tables + table
             # merge (engine.make_payload_round_steps). The batch simulator
-            # composes them; the serving layer calls them separately with
-            # the wire round-trip in between (compute_client_tables /
-            # dispatch_round on a payload-carrying PreparedRound).
+            # composes them (robust merge / adversarial chaos runs ride the
+            # same shape without any wire); the serving layer calls them
+            # separately with the wire round-trip in between
+            # (compute_client_tables / dispatch_round on a payload-carrying
+            # PreparedRound).
             client_p, merge_p = engine.make_payload_round_steps(
                 train_loss_fn, self.cfg,
-                self.mesh if self._spmd and self.mesh is not None else None)
+                self.mesh if self._spmd and self.mesh is not None else None,
+                allow_batch_tables=True)
             self._payload_client = jax.jit(client_p)
             self._payload_merge = jax.jit(
                 merge_p, donate_argnums=self._state_donation())
@@ -618,6 +663,16 @@ class FederatedSession:
         batch[engine.VALID_KEY] = (
             valid if valid is not None
             else np.ones(len(ids), np.float32))
+        if (self._table_round and self.fault_plan is not None
+                and self.fault_plan.has_adversarial()):
+            # adversarial wire transform (signflip / scale / collude): the
+            # reserved leaves ride EVERY round of a plan that names the
+            # kinds (identity defaults off-schedule) so the compiled table
+            # round's shape is constant from round 0 — same discipline as
+            # the validity mask above
+            scale, src = self.fault_plan.adversarial_plan(rnd, len(ids))
+            batch[engine.ADV_SCALE_KEY] = scale
+            batch[engine.ADV_SRC_KEY] = src
         self._rng_key, sub = jax.random.split(self._rng_key)
         return PreparedRound(
             rnd, ids, batch, sub, (self.rng.get_state(), self._rng_key),
@@ -707,17 +762,27 @@ class FederatedSession:
             batch = meshlib.shard_client_batch(self.mesh, batch)
         state = self._head_state if self._head_state is not None else self.state
         with self._mesh_ctx():
-            tables, nstates, mvals, part, noise_rng = self._payload_client(
-                state, batch, prep.sub)
+            (tables, nstates, mvals, part, noise_rng,
+             lnorms) = self._payload_client(state, batch, prep.sub)
         tables_np = np.asarray(jax.device_get(tables))
-        return tables_np, (state, nstates, mvals, part, noise_rng)
+        return tables_np, (state, nstates, mvals, part, noise_rng, lnorms)
 
     def quarantine_median_host(self) -> float:
         """Host copy of the CURRENT quarantine threshold baseline (0.0 with
         the quarantine off or unseeded) — the ingest validation gauntlet's
         sketch-space L2 screen reads this. Payload rounds sync per round
         anyway (compute_client_tables), so this fetch adds no new sync
-        class."""
+        class.
+
+        The scalar "median" key IS the table-space ring the payload merge
+        advances (windowed when --quarantine_window > 1, co-resident with
+        the per-leaf rings under --quarantine_scope layer), so the wire
+        screen and the in-merge table-norm screen always read the same
+        baseline: a payload the gauntlet rejects QUARANTINED is exactly a
+        payload the merge would have quarantined — and either way the
+        round is bitwise the round without that client (pinned in
+        tests/test_byzantine.py). The per-leaf rings never reach the wire:
+        the gauntlet sees only the table, which superimposes all layers."""
         if self.cfg.client_update_clip <= 0:
             return 0.0
         state = self._head_state if self._head_state is not None else self.state
@@ -765,12 +830,12 @@ class FederatedSession:
         the SAME state tree the client program read (carried in aux), so
         the two programs see one consistent round."""
         wire_tables, arrived, aux = prep.payload
-        state, nstates, mvals, part, noise_rng = aux
+        state, nstates, mvals, part, noise_rng, lnorms = aux
         with self._mesh_ctx():
             new_state, metrics = self._payload_merge(
                 state, jnp.asarray(wire_tables), nstates, mvals, part,
                 jnp.asarray(arrived, jnp.float32), jnp.float32(lr),
-                noise_rng)
+                noise_rng, lnorms)
         self._head_state = new_state
         self._inflight += 1
         self._inflight_rounds += 1
@@ -975,9 +1040,10 @@ class FederatedSession:
         are scheduled by round, which a K-round fused block cannot honor."""
         return (self.client_state is None and not self._split
                 and self.fault_plan is None
-                # payload rounds are per-round by construction: the wire
-                # crossing is the round boundary
-                and not self.cfg.wire_payloads)
+                # table rounds (wire payloads / robust merge / adversarial
+                # chaos) are per-round by construction: the wire crossing —
+                # or its batch-simulated twin — is the round boundary
+                and not self._table_round)
 
     # -- a block of rounds in one dispatch (SURVEY.md §7 hard part (d)) ------
     def run_rounds(self, lrs) -> list[dict]:

@@ -132,6 +132,49 @@ class EngineConfig:
     # table-merge server step), which is what pins a served round with
     # real wire-crossed payloads bit-identical to the batch round.
     wire_payloads: bool = False
+    # Byzantine-robust table merge (--merge_policy): how the per-client
+    # r x c tables combine. "sum" (pinned default) is the linear ordered
+    # sum — FetchSGD's merge, and exactly what a colluding minority
+    # exploits (linearity means any admitted table moves the aggregate by
+    # its full mass). "trimmed" drops the merge_trim highest and lowest
+    # LIVE contributions per table coordinate before the ordered sum
+    # (coordinate-wise trimmed mean, deterministic tie-break by client
+    # index — mesh-shape-invariant over the gathered [W, r, c] stack);
+    # "median" is the coordinate-wise median. Robust policies need
+    # per-client tables, so they run the wire-payload round SHAPE even in
+    # the batch simulator (the linearity shortcut is forfeited — that IS
+    # the defense's price) and require mode=sketch + sketch_path="ravel".
+    # "trimmed" with merge_trim=0 compiles the EXACT "sum" program
+    # (trimming nothing is the sum), so the k=0 bit-identity pin holds by
+    # construction. Caveat: robust merges break the error-feedback
+    # telescoping exactly where they help (the retained error no longer
+    # equals the untransmitted mass of the true cohort mean) — see the
+    # README threat-model section.
+    merge_policy: str = "sum"
+    merge_trim: int = 0
+    # Quarantine screen granularity (--quarantine_scope): "cohort"
+    # (default) keeps the PR 4 scalar screen — one L2 norm per client vs
+    # the running cohort median. "layer" ADDS per-LAYER screens on top:
+    # each client's update is sliced into per-leaf blocks (the exact
+    # (offset, size) segments PR 8's BlockPlan is built from, so screen
+    # and sketch can never disagree about layer boundaries), each leaf's
+    # L2 is screened against that leaf's own running median ring
+    # (--quarantine_window semantics preserved per leaf), and a client
+    # quarantined in ANY layer is dropped — bitwise the same drop as the
+    # scalar screen's. A targeted attack that hides inside the flat norm
+    # (all its mass in one layer, e.g. an embedding-row replacement) moves
+    # one leaf's norm by sqrt(d/d_leaf) more than the flat norm moves, so
+    # the per-leaf screen catches what the scalar screen dilutes away.
+    # On the UPDATE-norm rounds (fused/sharded announce, where the scalar
+    # screen reads the flat update norm) a single-leaf model's per-leaf
+    # norm IS the flat norm — same reduction — so window=1 layer scope is
+    # bit-identical to the scalar screen there. On the per-client-TABLE
+    # rounds the scalar screen is sketch-space (table norms) while the
+    # per-leaf screens are update-space, so layer scope genuinely ADDS a
+    # second statistic even single-leaf (by design: the table superimposes
+    # all layers and cannot be screened per leaf). Fused round paths only
+    # (the split program boundary threads one scalar median).
+    quarantine_scope: str = "cohort"
 
     def __post_init__(self):
         if self.client_shards < 1:
@@ -204,6 +247,48 @@ class EngineConfig:
                     "a client that doesn't submit is the straggler; use the "
                     "serving layer's traffic model instead"
                 )
+        if self.merge_policy not in ("sum", "trimmed", "median"):
+            raise ValueError(
+                f"merge_policy must be 'sum', 'trimmed' or 'median', got "
+                f"{self.merge_policy!r}"
+            )
+        if self.merge_trim < 0:
+            raise ValueError(
+                f"merge_trim must be >= 0, got {self.merge_trim}"
+            )
+        if self.merge_trim > 0 and self.merge_policy != "trimmed":
+            raise ValueError(
+                f"merge_trim={self.merge_trim} names the trimmed policy's "
+                f"per-coordinate drop count; merge_policy="
+                f"{self.merge_policy!r} has no use for it"
+            )
+        if robust_policy(self):
+            if self.mode.mode != "sketch":
+                raise ValueError(
+                    f"merge_policy={self.merge_policy!r} is the robust "
+                    "TABLE merge over per-client Count-Sketch tables, so it "
+                    f"requires mode='sketch'; mode={self.mode.mode!r} has "
+                    "no table wire"
+                )
+            if self.sketch_path != "ravel":
+                raise ValueError(
+                    "robust merge policies run the per-client-table round "
+                    "(each client's table is sketched from its flat "
+                    "update); sketch_path='layerwise' is a server-memory "
+                    "optimization of the compress-once shortcut the robust "
+                    "merge forfeits — use sketch_path='ravel'"
+                )
+        if self.quarantine_scope not in ("cohort", "layer"):
+            raise ValueError(
+                f"quarantine_scope must be 'cohort' or 'layer', got "
+                f"{self.quarantine_scope!r}"
+            )
+        if self.quarantine_scope == "layer" and self.client_update_clip <= 0:
+            raise ValueError(
+                "quarantine_scope='layer' refines the --client_update_clip "
+                "screen; with the clip at 0 there is no quarantine to scope "
+                "— set client_update_clip > 0"
+            )
         if self.dp_noise > 0 and self.dp_clip <= 0:
             raise ValueError("dp_noise > 0 requires dp_clip > 0 (unbounded "
                              "sensitivity has no meaningful noise scale)")
@@ -225,6 +310,36 @@ class EngineConfig:
                 "a dense-wire mode (uncompressed/true_topk/fedavg/localSGD) or "
                 "local_topk without local state."
             )
+
+
+def robust_policy(cfg: EngineConfig) -> str | None:
+    """The EFFECTIVE robust merge policy, or None for the linear ordered
+    sum. "trimmed" with merge_trim=0 IS the sum (dropping zero values per
+    coordinate trims nothing), so it resolves to None here and the engine
+    compiles the exact sum program — the k=0 bit-identity contract holds
+    by construction, not by fp luck."""
+    if cfg.merge_policy == "median":
+        return "median"
+    if cfg.merge_policy == "trimmed" and cfg.merge_trim > 0:
+        return "trimmed"
+    return None
+
+
+def uses_table_round(cfg: EngineConfig) -> bool:
+    """Whether the round must produce PER-CLIENT tables (the wire-payload
+    two-program shape): a real wire (wire_payloads) or a robust merge —
+    order statistics need the individual contributions the compress-once
+    linearity shortcut never materializes."""
+    return cfg.wire_payloads or robust_policy(cfg) is not None
+
+
+def _leaf_segments(params) -> tuple[tuple[int, int], ...]:
+    """Static (offset, size) per non-empty params leaf in ravel order — the
+    per-layer quarantine's block boundaries, shared with the sketch block
+    plan (sketch/layerwise.py) so the two can never disagree."""
+    from ..sketch import layerwise as sketch_layerwise
+
+    return sketch_layerwise.leaf_segments(params)
 
 
 def init_server_state(cfg: EngineConfig, params: Any, net_state: Any) -> dict:
@@ -254,6 +369,20 @@ def init_server_state(cfg: EngineConfig, params: Any, net_state: Any) -> dict:
             state["quarantine"]["window"] = jnp.zeros(
                 (cfg.quarantine_window,), dtype=jnp.float32)
             state["quarantine"]["count"] = jnp.zeros((), dtype=jnp.int32)
+        if cfg.quarantine_scope == "layer":
+            # per-LEAF median rings beside the scalar one (the scalar screen
+            # stays armed — layer scope tightens it, it never replaces it).
+            # One ring per non-empty params leaf, same window semantics.
+            # NOTE this widens the checkpoint state tree: a cohort-scope
+            # checkpoint cannot restore into a layer-scope run (MIGRATION).
+            L = len(_leaf_segments(params))
+            state["quarantine"]["layer_median"] = jnp.zeros(
+                (L,), dtype=jnp.float32)
+            if cfg.quarantine_window > 1:
+                state["quarantine"]["layer_window"] = jnp.zeros(
+                    (L, cfg.quarantine_window), dtype=jnp.float32)
+                state["quarantine"]["layer_count"] = jnp.zeros(
+                    (L,), dtype=jnp.int32)
     return state
 
 
@@ -341,6 +470,10 @@ def _masked_median(values, live, n):
     """Median over the `live` entries of `values` (sort with dead entries
     pushed to +inf, then index by the live count `n`). Undefined (garbage)
     when n == 0 — callers gate on n > 0."""
+    # the quarantine's screening median over [W] NORM vectors (a threshold,
+    # never merged values); the robust MERGE's order statistics live in
+    # modes._robust_table_merge alone
+    # graftlint: disable=G012 — screening median over norms, not a merge
     s = jnp.sort(jnp.where(live, values, jnp.inf))
     lo = jnp.clip((n - 1) // 2, 0, values.shape[0] - 1)
     hi = jnp.clip(n // 2, 0, values.shape[0] - 1)
@@ -400,19 +533,104 @@ def _advance_quarantine(cfg: EngineConfig, qstate: dict, norms, part_eff) -> dic
     }
 
 
+def _client_layer_norms(updates: jnp.ndarray, segments) -> jnp.ndarray:
+    """[W, L] per-leaf L2 norms of each client's FLAT update, sliced by the
+    block plan's static (offset, size) ranges (f32 accumulation, like
+    `_client_norms`). On a single-leaf model the one column is the full-
+    width slice — the identical reduction `_client_norms` runs, which is
+    what makes single-leaf layer scope bit-identical to the scalar screen."""
+    u = updates.astype(jnp.float32)
+    cols = [
+        jnp.sqrt(jnp.sum(jnp.square(
+            jax.lax.slice_in_dim(u, off, off + n, axis=1)), axis=1))
+        for off, n in segments
+    ]
+    return jnp.stack(cols, axis=1)
+
+
+def _client_layer_norms_tree(updates_tree) -> jnp.ndarray:
+    """[W, L] per-leaf norms from a PYTREE of [W, ...] leaves — the
+    layerwise-path twin of `_client_layer_norms` (leaf order == ravel
+    order, so column l is the same layer on both sketch paths)."""
+    cols = [
+        jnp.sqrt(jnp.sum(jnp.square(leaf.astype(jnp.float32)),
+                         axis=tuple(range(1, leaf.ndim))))
+        for leaf in jax.tree.leaves(updates_tree) if leaf.size
+    ]
+    return jnp.stack(cols, axis=1)
+
+
+def _quarantine_layer_mask(cfg: EngineConfig, lnorms: jnp.ndarray,
+                           lmed: jnp.ndarray) -> jnp.ndarray:
+    """[W] bool: client rejected by ANY per-leaf screen — a non-finite leaf
+    norm, or a leaf norm past the clip multiple of THAT leaf's running
+    median (each leaf's screen arms independently once its median seeds,
+    exactly the scalar screen's arming rule per ring)."""
+    bad = ~jnp.isfinite(lnorms)
+    bad = bad | ((lmed[None, :] > 0)
+                 & (lnorms > cfg.client_update_clip * lmed[None, :]))
+    return bad.any(axis=1)
+
+
+def _advance_quarantine_layers(cfg: EngineConfig, qstate: dict,
+                               lnorms: jnp.ndarray, part_eff) -> dict:
+    """One round's update of the per-leaf median rings: the scalar
+    `_advance_quarantine` vmapped over the leaf axis (each leaf keeps its
+    own ring with the exact window semantics — an empty round advances no
+    ring, a leaf whose norms went non-finite cohort-wide keeps its old
+    median, same as the scalar rule)."""
+    sub = {"median": qstate["layer_median"]}
+    if cfg.quarantine_window > 1:
+        sub["window"] = qstate["layer_window"]
+        sub["count"] = qstate["layer_count"]
+    out = jax.vmap(
+        lambda st, nl: _advance_quarantine(cfg, st, nl, part_eff),
+        in_axes=(0, 1),
+    )(sub, lnorms)
+    new = {"layer_median": out["median"]}
+    if cfg.quarantine_window > 1:
+        new["layer_window"] = out["window"]
+        new["layer_count"] = out["count"]
+    return new
+
+
 def _split_quarantine_scope_check(cfg: EngineConfig):
     """The split-compile program boundary threads exactly one scalar
     (metrics['quarantine_median']) between the client and server programs —
     a K-slot window ring cannot cross it without widening the boundary for
-    every split caller. The windowed baseline is a fused-path feature
-    (make_round_step, make_sharded_round_step, the payload merge); reject
-    the combination at build time instead of silently running window=1."""
+    every split caller. The windowed baseline and the per-layer rings are
+    fused-path features (make_round_step, make_sharded_round_step, the
+    payload merge); reject the combination at build time instead of
+    silently running window=1 / cohort scope."""
     if cfg.client_update_clip > 0 and cfg.quarantine_window > 1:
         raise ValueError(
             "quarantine_window > 1 is fused-paths-only: the split-compile "
             "program boundary threads a single scalar median "
             f"(got quarantine_window={cfg.quarantine_window} with a split "
             "round step); drop --split_compile or use quarantine_window=1"
+        )
+    if cfg.client_update_clip > 0 and cfg.quarantine_scope == "layer":
+        raise ValueError(
+            "quarantine_scope='layer' is fused-paths-only: the split-"
+            "compile program boundary threads a single scalar median and "
+            "the per-leaf rings cannot cross it; drop --split_compile or "
+            "use quarantine_scope=cohort"
+        )
+
+
+def _robust_scope_check(cfg: EngineConfig):
+    """Robust merge policies need per-client tables: the linear round
+    builders (fused / sharded / split — all built on the compress-once or
+    per-shard-partial shortcut) cannot apply them. The session routes
+    robust-policy configs through make_payload_round_steps; a direct
+    caller reaching a linear builder with one armed gets a loud error
+    instead of a silently-linear merge."""
+    if robust_policy(cfg) is not None:
+        raise ValueError(
+            f"merge_policy={cfg.merge_policy!r} (trim={cfg.merge_trim}) "
+            "needs the per-client-table round: use make_payload_round_steps"
+            " (FederatedSession routes this automatically); the linear "
+            "round builders merge by the ordered sum only"
         )
 
 
@@ -485,6 +703,18 @@ def _skip_metrics(ok, out_metrics) -> dict:
     return out_metrics
 
 
+def _advance_quarantine_full(cfg: EngineConfig, qstate: dict, norms, lnorms,
+                             part_eff) -> dict:
+    """Scalar ring + (layer scope) per-leaf rings, one round's advance —
+    the single entry every fused path uses so the state tree cannot drift
+    between the batch, sharded, and payload rounds."""
+    new_q = _advance_quarantine(cfg, qstate, norms, part_eff)
+    if lnorms is not None:
+        new_q.update(_advance_quarantine_layers(cfg, qstate, lnorms,
+                                                part_eff))
+    return new_q
+
+
 def _merge_net_state(nstates, net_state, part) -> Any:
     """Mutable model collections (BN stats): average the SURVIVING clients'
     results; with no survivors, keep the previous stats. mask_rows keeps a
@@ -510,15 +740,18 @@ def _survivor_metrics(metrics, part) -> dict:
 def _weighted_client_reduce(
     cfg: EngineConfig, grad_client: Callable,
     params, pflat, net_state, batch, client_rngs, part,
-    *, qmed=None, nan_safe: bool = False,
+    *, qmed=None, nan_safe: bool = False, lmed=None, segments=None,
 ):
     """Participation-weighted SUMS over the sampled clients of (clipped)
     updates, mutable-collection contributions, and metric values — the whole
     client phase of a linear-mode round before normalization. Returns
-    (wsum, ns_sum, m_sum, part_eff, norms): `part_eff` is the [W] mask of
-    clients that actually contributed (the input mask minus any quarantined
-    clients), `norms` the [W] per-client update L2 norms (None with the
-    quarantine off).
+    (wsum, ns_sum, m_sum, part_eff, norms, lnorms): `part_eff` is the [W]
+    mask of clients that actually contributed (the input mask minus any
+    quarantined clients), `norms` the [W] per-client update L2 norms (None
+    with the quarantine off), `lnorms` the [W, L] per-leaf norms
+    (quarantine_scope="layer" only — `lmed`/`segments` carry that scope's
+    per-leaf medians and static leaf ranges; a client over ANY leaf's
+    screen is quarantined exactly like a scalar-screen rejection).
 
     One vmap when cfg.client_chunk is 0; otherwise a lax.scan over chunks of
     client_chunk clients (each chunk vmapped), accumulating additively, so at
@@ -539,10 +772,13 @@ def _weighted_client_reduce(
         updates, nstates, metrics = jax.vmap(
             lambda b, r: grad_client(params, pflat, net_state, b, r)
         )(cb, crngs)
-        norms_c = None
+        norms_c = lnorms_c = None
         if cfg.client_update_clip > 0:
             norms_c = _client_norms(updates)
             bad = _quarantine_mask(cfg, norms_c, qmed)
+            if lmed is not None:
+                lnorms_c = _client_layer_norms(updates, segments)
+                bad = bad | _quarantine_layer_mask(cfg, lnorms_c, lmed)
             cpart = cpart * (1.0 - bad.astype(cpart.dtype))
         updates = _clip_updates(cfg, updates)
         if nan_safe:
@@ -557,7 +793,7 @@ def _weighted_client_reduce(
                 lambda s: (s * modes.bcast(cpart, s)).sum(0), nstates)
             m_sum = jax.tree.map(
                 lambda m: jnp.sum(m * modes.bcast(cpart, m), axis=0), metrics)
-        return wsum, ns_sum, m_sum, cpart, norms_c
+        return wsum, ns_sum, m_sum, cpart, norms_c, lnorms_c
 
     W = part.shape[0]
     C = cfg.client_chunk
@@ -575,15 +811,17 @@ def _weighted_client_reduce(
     init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes[:3])
 
     def body(carry, x):
-        wsum, ns_sum, m_sum, cpart_eff, norms_c = chunk(*x)
+        wsum, ns_sum, m_sum, cpart_eff, norms_c, lnorms_c = chunk(*x)
         carry = jax.tree.map(jnp.add, carry, (wsum, ns_sum, m_sum))
-        return carry, (cpart_eff, norms_c)
+        return carry, (cpart_eff, norms_c, lnorms_c)
 
-    acc, (pe, norms) = jax.lax.scan(body, init, xs)
+    acc, (pe, norms, lnorms) = jax.lax.scan(body, init, xs)
     part_eff = pe.reshape(W)
     if norms is not None:
         norms = norms.reshape(W)
-    return acc + (part_eff, norms)
+    if lnorms is not None:
+        lnorms = lnorms.reshape(W, -1)
+    return acc + (part_eff, norms, lnorms)
 
 
 def _client_norms_tree(updates_tree) -> jnp.ndarray:
@@ -615,7 +853,7 @@ def _clip_updates_tree(cfg: EngineConfig, updates_tree):
 def _weighted_client_reduce_tree(
     cfg: EngineConfig, grad_client_tree: Callable,
     params, net_state, batch, client_rngs, part,
-    *, qmed=None, nan_safe: bool = False,
+    *, qmed=None, nan_safe: bool = False, lmed=None, segments=None,
 ):
     """The layerwise (`sketch_path="layerwise"`) mirror of
     `_weighted_client_reduce`: identical participation weighting, validity
@@ -625,19 +863,25 @@ def _weighted_client_reduce_tree(
     [W, d]/[chunk, d] stacks) never materializes. Per coordinate the
     client-axis sums are the same ordered fp reduction as the flat path's,
     which is what keeps the downstream sketch bit-identical. Returns
-    (wsum_tree, ns_sum, m_sum, part_eff, norms). Kept as a deliberate
-    structural mirror rather than a shared polymorphic body: the ravel
-    path's compiled program must stay byte-for-byte the seed's."""
+    (wsum_tree, ns_sum, m_sum, part_eff, norms, lnorms) — lnorms as in the
+    flat reduce (layer scope only; `segments` is unused here, the tree IS
+    the segmentation). Kept as a deliberate structural mirror rather than
+    a shared polymorphic body: the ravel path's compiled program must stay
+    byte-for-byte the seed's."""
+    del segments  # the pytree carries its own leaf boundaries
     nan_safe = nan_safe or cfg.client_update_clip > 0
 
     def chunk(cb, crngs, cpart):
         updates, nstates, metrics = jax.vmap(
             lambda b, r: grad_client_tree(params, net_state, b, r)
         )(cb, crngs)
-        norms_c = None
+        norms_c = lnorms_c = None
         if cfg.client_update_clip > 0:
             norms_c = _client_norms_tree(updates)
             bad = _quarantine_mask(cfg, norms_c, qmed)
+            if lmed is not None:
+                lnorms_c = _client_layer_norms_tree(updates)
+                bad = bad | _quarantine_layer_mask(cfg, lnorms_c, lmed)
             cpart = cpart * (1.0 - bad.astype(cpart.dtype))
         updates = _clip_updates_tree(cfg, updates)
         if nan_safe:
@@ -654,7 +898,7 @@ def _weighted_client_reduce_tree(
                 lambda s: (s * modes.bcast(cpart, s)).sum(0), nstates)
             m_sum = jax.tree.map(
                 lambda m: jnp.sum(m * modes.bcast(cpart, m), axis=0), metrics)
-        return wsum, ns_sum, m_sum, cpart, norms_c
+        return wsum, ns_sum, m_sum, cpart, norms_c, lnorms_c
 
     W = part.shape[0]
     C = cfg.client_chunk
@@ -672,15 +916,17 @@ def _weighted_client_reduce_tree(
     init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes[:3])
 
     def body(carry, x):
-        wsum, ns_sum, m_sum, cpart_eff, norms_c = chunk(*x)
+        wsum, ns_sum, m_sum, cpart_eff, norms_c, lnorms_c = chunk(*x)
         carry = jax.tree.map(jnp.add, carry, (wsum, ns_sum, m_sum))
-        return carry, (cpart_eff, norms_c)
+        return carry, (cpart_eff, norms_c, lnorms_c)
 
-    acc, (pe, norms) = jax.lax.scan(body, init, xs)
+    acc, (pe, norms, lnorms) = jax.lax.scan(body, init, xs)
     part_eff = pe.reshape(W)
     if norms is not None:
         norms = norms.reshape(W)
-    return acc + (part_eff, norms)
+    if lnorms is not None:
+        lnorms = lnorms.reshape(W, -1)
+    return acc + (part_eff, norms, lnorms)
 
 
 def _finalize_client_reduce(mcfg: ModeConfig, wsum, ns_sum, m_sum, net_state, part):
@@ -802,8 +1048,11 @@ def make_round_step(
     - metrics are summed over clients (and local iters); caller normalises.
     """
     mcfg = cfg.mode
+    _robust_scope_check(cfg)
     grad_client = _make_grad_client(loss_fn, cfg)
     layerwise = cfg.sketch_path == "layerwise"
+    layer_q = (cfg.client_update_clip > 0
+               and cfg.quarantine_scope == "layer")
     grad_client_tree = (_make_grad_client_tree(loss_fn, cfg) if layerwise
                         else None)
 
@@ -858,7 +1107,9 @@ def make_round_step(
             part = part * valid
         qmed = (state["quarantine"]["median"]
                 if cfg.client_update_clip > 0 else None)
-        norms = None
+        lmed = state["quarantine"]["layer_median"] if layer_q else None
+        segments = _leaf_segments(params) if layer_q else None
+        norms = lnorms = None
 
         if (modes.is_linear(mcfg) and not mcfg.needs_local_state
                 and not mcfg.uses_weight_delta):
@@ -873,11 +1124,11 @@ def make_round_step(
                 # and fold straight into the running r x c table — the flat
                 # [d] gradient never materializes (bit-identical to the
                 # ravel branch below, see EngineConfig.sketch_path)
-                wsum, ns_sum, m_sum, part_eff, norms = (
+                wsum, ns_sum, m_sum, part_eff, norms, lnorms = (
                     _weighted_client_reduce_tree(
                         cfg, grad_client_tree, params, net_state, batch,
                         client_rngs, part, qmed=qmed,
-                        nan_safe=valid is not None,
+                        nan_safe=valid is not None, lmed=lmed,
                     ))
                 weighted = _layerwise_normalize(
                     mcfg, wsum, jnp.maximum(part_eff.sum(), 1.0))
@@ -885,9 +1136,11 @@ def make_round_step(
                     ns_sum, m_sum, part_eff, net_state)
                 agg = _layerwise_compress(mcfg, weighted, plan)
             else:
-                wsum, ns_sum, m_sum, part_eff, norms = _weighted_client_reduce(
+                (wsum, ns_sum, m_sum, part_eff, norms,
+                 lnorms) = _weighted_client_reduce(
                     cfg, grad_client, params, pflat, net_state, batch,
                     client_rngs, part, qmed=qmed, nan_safe=valid is not None,
+                    lmed=lmed, segments=segments,
                 )
                 weighted, new_net_state, out_metrics = _finalize_client_reduce(
                     mcfg, wsum, ns_sum, m_sum, net_state, part_eff
@@ -907,6 +1160,9 @@ def make_round_step(
             if cfg.client_update_clip > 0:
                 norms = _client_norms(updates)
                 bad = _quarantine_mask(cfg, norms, qmed)
+                if layer_q:
+                    lnorms = _client_layer_norms(updates, segments)
+                    bad = bad | _quarantine_layer_mask(cfg, lnorms, lmed)
                 part_eff = part * (1.0 - bad.astype(part.dtype))
                 # hard-zero the rejected updates so downstream per-client
                 # transforms (top-k, local error rows) never see the poison
@@ -942,8 +1198,8 @@ def make_round_step(
         new_q = None
         if cfg.client_update_clip > 0:
             out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()
-            new_q = _advance_quarantine(cfg, state["quarantine"], norms,
-                                        part_eff)
+            new_q = _advance_quarantine_full(cfg, state["quarantine"], norms,
+                                             lnorms, part_eff)
             out_metrics["quarantine_median"] = new_q["median"]
         agg, new_net_state, new_rows, out_metrics, fin_ok = _guard_nonfinite(
             cfg, agg, new_net_state, net_state, new_rows, client_rows,
@@ -1048,7 +1304,7 @@ def _normalize_merged_wire(mcfg: ModeConfig, wire_sum: dict, n_live) -> dict:
 
 def _merged_sharded_tail(
     cfg: EngineConfig, state, stacked_wire, stacked_ns, stacked_m, part_eff,
-    lr, noise_rng, part=None, norms=None,
+    lr, noise_rng, part=None, norms=None, lnorms=None,
 ):
     """Everything after the per-shard client phase, shared verbatim by the
     mesh execution and the single-device reference so they cannot drift:
@@ -1074,7 +1330,8 @@ def _merged_sharded_tail(
     new_q = None
     if cfg.client_update_clip > 0:
         out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()
-        new_q = _advance_quarantine(cfg, state["quarantine"], norms, part_eff)
+        new_q = _advance_quarantine_full(cfg, state["quarantine"], norms,
+                                         lnorms, part_eff)
         out_metrics["quarantine_median"] = new_q["median"]
     agg, new_net_state, _, out_metrics, fin_ok = _guard_nonfinite(
         cfg, agg, new_net_state, state["net_state"], {}, {}, out_metrics,
@@ -1147,6 +1404,7 @@ def make_sharded_round_step(
     (client_rows pass through untouched — the scope has no local state)."""
     mcfg = cfg.mode
     _sharded_scope_check(mcfg)
+    _robust_scope_check(cfg)
     if mesh is not None:
         S, axis_names = _mesh_shard_info(mesh)
         if cfg.client_shards > 1 and cfg.client_shards != S:
@@ -1166,31 +1424,42 @@ def make_sharded_round_step(
     grad_client_tree = (_make_grad_client_tree(loss_fn, cfg) if layerwise
                         else None)
     quarantine = cfg.client_update_clip > 0
+    layer_q = quarantine and cfg.quarantine_scope == "layer"
 
-    def local_phase(params, pflat, net_state, qmed, batch_l, rngs_l, part_l):
+    def local_phase(params, pflat, net_state, qmed, lmed, batch_l, rngs_l,
+                    part_l):
         """One shard's client phase. Returns (wire, ns_sum, m_sum, part_eff)
-        plus, with the quarantine armed, (part_valid, norms) — the per-shard
-        slices the merged tail reassembles into cohort-order [W] vectors.
-        On the layerwise path the shard's partial Count Sketch accumulates
-        straight from the per-leaf weighted sums — the shard's dense [d]
-        partial never exists either (pflat is None there)."""
+        plus, with the quarantine armed, (part_valid, norms[, lnorms]) — the
+        per-shard slices the merged tail reassembles into cohort-order [W]
+        vectors (lnorms only under layer scope: the per-leaf screens run
+        per shard against the replicated per-leaf medians, exactly like the
+        scalar screen). On the layerwise path the shard's partial Count
+        Sketch accumulates straight from the per-leaf weighted sums — the
+        shard's dense [d] partial never exists either (pflat is None
+        there)."""
         batch_l, valid_l = split_valid(batch_l)
         if valid_l is not None:
             part_l = part_l * valid_l
+        segments = _leaf_segments(params) if layer_q else None
         if layerwise:
-            wsum, ns_sum, m_sum, part_eff_l, norms_l = (
+            wsum, ns_sum, m_sum, part_eff_l, norms_l, lnorms_l = (
                 _weighted_client_reduce_tree(
                     cfg, grad_client_tree, params, net_state, batch_l,
                     rngs_l, part_l, qmed=qmed, nan_safe=valid_l is not None,
+                    lmed=lmed,
                 ))
             wire = _layerwise_compress(mcfg, wsum,
                                        _layerwise_plan(mcfg, params))
         else:
-            wsum, ns_sum, m_sum, part_eff_l, norms_l = _weighted_client_reduce(
+            (wsum, ns_sum, m_sum, part_eff_l, norms_l,
+             lnorms_l) = _weighted_client_reduce(
                 cfg, grad_client, params, pflat, net_state, batch_l, rngs_l,
                 part_l, qmed=qmed, nan_safe=valid_l is not None,
+                lmed=lmed, segments=segments,
             )
             wire, _ = modes.client_compress(mcfg, wsum, {})
+        if layer_q:
+            return wire, ns_sum, m_sum, part_eff_l, part_l, norms_l, lnorms_l
         if quarantine:
             return wire, ns_sum, m_sum, part_eff_l, part_l, norms_l
         return wire, ns_sum, m_sum, part_eff_l
@@ -1198,6 +1467,12 @@ def make_sharded_round_step(
     def _tail(cfg_state, stacked, lr, noise_rng):
         """Unpack the per-shard stacks ([S, wl] leaves, shard-index order =
         cohort order row-major) and run the shared merged tail."""
+        if layer_q:
+            wire_s, ns_s, m_s, pe_s, pv_s, norms_s, lnorms_s = stacked
+            return _merged_sharded_tail(
+                cfg, cfg_state, wire_s, ns_s, m_s, pe_s.reshape(-1), lr,
+                noise_rng, part=pv_s.reshape(-1), norms=norms_s.reshape(-1),
+                lnorms=lnorms_s.reshape((-1,) + lnorms_s.shape[2:]))
         if quarantine:
             wire_s, ns_s, m_s, pe_s, pv_s, norms_s = stacked
             return _merged_sharded_tail(
@@ -1221,6 +1496,7 @@ def make_sharded_round_step(
             wl = W // S
             all_rngs, part, noise_rng = _cohort_streams(cfg, rng, W)
             qmed = state["quarantine"]["median"] if quarantine else None
+            lmed = state["quarantine"]["layer_median"] if layer_q else None
             shards = (
                 jax.tree.map(
                     lambda a: a.reshape((S, wl) + a.shape[1:]), batch),
@@ -1239,7 +1515,8 @@ def make_sharded_round_step(
             # (unrolled, length-1 map, top-level tail) removes it for
             # every mode at once, it only moves which ops carry the ulp.
             stacked = jax.lax.map(
-                lambda xs: local_phase(params, pflat, net_state, qmed, *xs),
+                lambda xs: local_phase(params, pflat, net_state, qmed, lmed,
+                                       *xs),
                 shards,
             )
             new_state, out_metrics = _tail(state, stacked, lr, noise_rng)
@@ -1263,7 +1540,7 @@ def make_sharded_round_step(
     # fusion (fma contraction) can differ from the reference's at the last
     # bit (observed: ~6 table entries at 1e-9 after one momentum round),
     # which would break the bit-identity pin on the server state.
-    n_local_outs = 6 if quarantine else 4
+    n_local_outs = 7 if layer_q else (6 if quarantine else 4)
 
     def body(state, batch_l, lr, rng):
         params, net_state = state["params"], state["net_state"]
@@ -1274,11 +1551,12 @@ def make_sharded_round_step(
         # streams are mesh-shape-invariant (see _cohort_streams)
         all_rngs, part, noise_rng = _cohort_streams(cfg, rng, wl * S)
         qmed = state["quarantine"]["median"] if quarantine else None
+        lmed = state["quarantine"]["layer_median"] if layer_q else None
         lo = _shard_index(mesh, axis_names) * wl
         rngs_l = jax.lax.dynamic_slice_in_dim(all_rngs, lo, wl)
         part_l = jax.lax.dynamic_slice_in_dim(part, lo, wl)
         locals_ = local_phase(
-            params, pflat, net_state, qmed, batch_l, rngs_l, part_l)
+            params, pflat, net_state, qmed, lmed, batch_l, rngs_l, part_l)
         # THE cross-device move: gather the [S] partial wires (plus the tiny
         # per-shard effective-mask/norm rows) in shard order; the ordered
         # reduce happens outside, shared with the reference (merged tail)
@@ -1339,6 +1617,7 @@ def make_sharded_split_round_step(
     """
     mcfg = cfg.mode
     _sharded_scope_check(mcfg)
+    _robust_scope_check(cfg)
     if mesh is None:
         raise ValueError(
             "sharded split round needs a mesh; the single-device reference "
@@ -1386,7 +1665,9 @@ def make_sharded_split_round_step(
         if valid_l is not None:
             part_l = part_l * valid_l
         if layerwise:
-            wsum_l, ns_l, m_l, pe_l, norms_l = _weighted_client_reduce_tree(
+            # layer scope is split-rejected (_split_quarantine_scope_check):
+            # the trailing lnorms slot is always None here
+            wsum_l, ns_l, m_l, pe_l, norms_l, _ = _weighted_client_reduce_tree(
                 cfg, grad_client_tree, params, net_state, batch_l, rngs_l,
                 part_l, qmed=qmed, nan_safe=valid_l is not None,
             )
@@ -1398,7 +1679,7 @@ def make_sharded_split_round_step(
             wire_out = jax.lax.all_gather(table_l, axis_names, axis=0)
             fin_l = jnp.isfinite(table_l).all()[None]
         else:
-            wsum_l, ns_l, m_l, pe_l, norms_l = _weighted_client_reduce(
+            wsum_l, ns_l, m_l, pe_l, norms_l, _ = _weighted_client_reduce(
                 cfg, grad_client, params, pflat, net_state, batch_l, rngs_l,
                 part_l, qmed=qmed, nan_safe=valid_l is not None,
             )
@@ -1541,6 +1822,7 @@ def make_split_round_step(
     wire table instead of the dense [d] reduced update.
     """
     mcfg = cfg.mode
+    _robust_scope_check(cfg)
     if not (modes.is_linear(mcfg) and not mcfg.needs_local_state
             and not mcfg.uses_weight_delta):
         raise ValueError(
@@ -1572,7 +1854,8 @@ def make_split_round_step(
         qmed = state["quarantine"]["median"] if quarantine else None
 
         if layerwise:
-            wsum, ns_sum, m_sum, part_eff, norms = (
+            # layer scope is split-rejected: lnorms is always None here
+            wsum, ns_sum, m_sum, part_eff, norms, _ = (
                 _weighted_client_reduce_tree(
                     cfg, grad_client_tree, params, net_state, batch,
                     client_rngs, part, qmed=qmed, nan_safe=valid is not None,
@@ -1585,7 +1868,7 @@ def make_split_round_step(
             new_net_state, out_metrics = _merged_survivor_finalize(
                 ns_sum, m_sum, part_eff, net_state)
         else:
-            wsum, ns_sum, m_sum, part_eff, norms = _weighted_client_reduce(
+            wsum, ns_sum, m_sum, part_eff, norms, _ = _weighted_client_reduce(
                 cfg, grad_client, params, pflat, net_state, batch, client_rngs,
                 part, qmed=qmed, nan_safe=valid is not None,
             )
@@ -1729,8 +2012,46 @@ def _table_norms(tables: jnp.ndarray) -> jnp.ndarray:
     return jnp.sqrt(jnp.sum(jnp.square(t), axis=(1, 2)))
 
 
+# Reserved per-client batch leaves: the ADVERSARIAL transform of the table
+# round (resilience/faults.py client_signflip / client_scale /
+# client_collude). `_adv_scale` is a [W] float multiplier applied to each
+# client's transmitted table (sketch linearity makes scaling the table
+# EXACTLY scaling the update: sketch(a*u) == a*sketch(u) coordinate-wise);
+# `_adv_src` is a [W] int source position — a colluding client transmits a
+# (scaled) CLONE of the source's table instead of its own. Identity
+# defaults (src=arange, scale=1) keep the program's shapes constant from
+# round 0, so the first attack never triggers a mid-run recompile. The
+# leaves ride the batch pytree like `_valid` and are popped before the
+# client fwd/bwd ever sees them.
+ADV_SCALE_KEY = "_adv_scale"
+ADV_SRC_KEY = "_adv_src"
+
+
+def split_adv(batch):
+    """Pop the reserved adversarial-transform leaves off a round batch.
+    Returns (batch_without_them, (scale, src) or None)."""
+    if isinstance(batch, dict) and ADV_SCALE_KEY in batch:
+        batch = dict(batch)
+        return batch, (batch.pop(ADV_SCALE_KEY), batch.pop(ADV_SRC_KEY))
+    return batch, None
+
+
+def _apply_adv(tables: jnp.ndarray, adv) -> jnp.ndarray:
+    """Apply the adversarial wire transform to the replicated [W, r, c]
+    table stack (AFTER any cross-shard gather, so the crafted table is
+    mesh-shape-invariant): row i becomes scale[i] * tables[src[i]]. With
+    the identity defaults this is a gather of every row in order times
+    1.0 — the same values bit-for-bit."""
+    if adv is None:
+        return tables
+    scale, src = adv
+    cloned = jnp.take(tables, src.astype(jnp.int32), axis=0)
+    return cloned * scale.astype(tables.dtype)[:, None, None]
+
+
 def make_payload_round_steps(
-    loss_fn: Callable, cfg: EngineConfig, mesh=None
+    loss_fn: Callable, cfg: EngineConfig, mesh=None, *,
+    allow_batch_tables: bool = False,
 ) -> tuple[Callable, Callable]:
     """The wire-payload round (cfg.wire_payloads) as TWO jittable programs —
     the shape a serving deployment actually has:
@@ -1771,34 +2092,62 @@ def make_payload_round_steps(
     W/S vmapped clients (bounding live per-client gradients to W/S — the
     payload path's chunking mechanism); per-client tables make the cross-
     group arithmetic per-client, so the merge is shard-count-invariant. With
-    a mesh the groups become shard_map shards and the tables all_gather."""
+    a mesh the groups become shard_map shards and the tables all_gather.
+
+    Byzantine defenses live here, on both sides of the wire: the client
+    program applies the adversarial transform of any armed attack faults
+    (split_adv/_apply_adv — a sign-flipped, scaled, or colluding-clone
+    table is EXACTLY what a malicious client would transmit, by sketch
+    linearity), and the merge applies cfg.merge_policy — "sum" keeps the
+    ordered masked sum; "trimmed"/"median" run the coordinate-wise robust
+    statistic over the live [W, r, c] stack (modes._robust_table_merge,
+    the declared G012 boundary) and rescale by the live count for
+    agg_op="sum". Robust policies are why this round shape also serves
+    the BATCH simulator (allow_batch_tables / robust_policy(cfg)): order
+    statistics need the per-client tables the linearity shortcut never
+    materializes."""
     mcfg = cfg.mode
-    if not cfg.wire_payloads:
+    if not (uses_table_round(cfg) or allow_batch_tables):
         raise ValueError(
-            "make_payload_round_steps requires cfg.wire_payloads=True (the "
-            "announce path compiles make_round_step and friends)"
+            "make_payload_round_steps requires cfg.wire_payloads=True, a "
+            "robust merge_policy, or allow_batch_tables=True (the announce "
+            "path compiles make_round_step and friends)"
         )
     _sharded_scope_check(mcfg)
+    if mcfg.mode != "sketch":
+        raise ValueError(
+            f"the per-client-table round requires mode='sketch'; "
+            f"mode={mcfg.mode!r} has no table wire"
+        )
     grad_client = _make_grad_client(loss_fn, cfg)
     quarantine = cfg.client_update_clip > 0
+    layer_q = quarantine and cfg.quarantine_scope == "layer"
 
     def per_client_tables(params, pflat, net_state, cb, crngs):
         """One group's client phase: per-client flat grads -> per-client
         DP-clipped updates -> one Count-Sketch table PER CLIENT (vmapped
-        client_compress — the exact table a real client would transmit)."""
+        client_compress — the exact table a real client would transmit).
+        Layer scope appends the [*, L] per-leaf update norms (pre-clip,
+        like the scalar screen's norms) for the merge's per-leaf rings."""
         updates, nstates, metrics = jax.vmap(
             lambda b, r: grad_client(params, pflat, net_state, b, r)
         )(cb, crngs)
+        lnorms = None
+        if layer_q:
+            lnorms = _client_layer_norms(updates, _leaf_segments(params))
         updates = _clip_updates(cfg, updates)
         tables = jax.vmap(
             lambda u: modes.client_compress(mcfg, u, {})[0]["table"]
         )(updates)
+        if layer_q:
+            return tables, nstates, metrics, lnorms
         return tables, nstates, metrics
 
     if mesh is None:
         S = max(cfg.client_shards, 1)
 
         def client_step(state, batch, rng):
+            batch, adv = split_adv(batch)
             batch, valid = split_valid(batch)
             params, net_state = state["params"], state["net_state"]
             pflat, _ = _ravel_params(params)
@@ -1807,7 +2156,7 @@ def make_payload_round_steps(
             if valid is not None:
                 part = part * valid
             if S <= 1:
-                tables, nstates, metrics = per_client_tables(
+                outs = per_client_tables(
                     params, pflat, net_state, batch, client_rngs)
             else:
                 if W % S:
@@ -1825,9 +2174,12 @@ def make_payload_round_steps(
                         params, pflat, net_state, *xs),
                     groups,
                 )
-                tables, nstates, metrics = jax.tree.map(
+                outs = jax.tree.map(
                     lambda a: a.reshape((W,) + a.shape[2:]), stacked)
-            return tables, nstates, metrics, part, noise_rng
+            tables, nstates, metrics = outs[:3]
+            lnorms = outs[3] if layer_q else None
+            tables = _apply_adv(tables, adv)
+            return tables, nstates, metrics, part, noise_rng, lnorms
 
     else:
         from jax.sharding import PartitionSpec as P
@@ -1838,6 +2190,7 @@ def make_payload_round_steps(
 
         S, axis_names = _mesh_shard_info(mesh)
         batch_spec = P(meshlib.client_axes(mesh))
+        n_gathered = 5 if layer_q else 4  # tables, ns, metrics[, lnorms], part
 
         def body(state, batch_l, rng):
             params, net_state = state["params"], state["net_state"]
@@ -1861,22 +2214,32 @@ def make_payload_round_steps(
         mapped = shard_map(
             body, mesh=mesh,
             in_specs=(P(), batch_spec, P()),
-            out_specs=tuple(P() for _ in range(5)),
+            out_specs=tuple(P() for _ in range(n_gathered + 1)),
             check_rep=False,
         )
 
         def client_step(state, batch, rng):
-            tables, nstates, metrics, part, noise_rng = mapped(
-                state, batch, rng)
-            return tables, nstates, metrics, part, noise_rng
+            # the adversarial transform applies to the REPLICATED gathered
+            # stack at jit top level (outside shard_map), so a colluding
+            # clone of any source position is mesh-shape-invariant
+            batch, adv = split_adv(batch)
+            outs = mapped(state, batch, rng)
+            tables, nstates, metrics = outs[:3]
+            lnorms = outs[3] if layer_q else None
+            part, noise_rng = outs[-2], outs[-1]
+            tables = _apply_adv(tables, adv)
+            return tables, nstates, metrics, part, noise_rng, lnorms
 
     def merge_step(state, tables, nstates, mvals, part, arrived, lr,
-                   noise_rng):
-        """The server side: ordered masked sum of the (wire-delivered)
-        per-client tables. `part` is the client program's validity mask,
-        `arrived` the serving layer's 0/1 admission mask (ones in the batch
-        simulator) — a rejected or missing payload is a zero row under a 0
-        mask, exactly a dropped client."""
+                   noise_rng, lnorms=None):
+        """The server side: the cfg.merge_policy reduction of the
+        (wire-delivered) per-client tables. `part` is the client program's
+        validity mask, `arrived` the serving layer's 0/1 admission mask
+        (ones in the batch simulator) — a rejected or missing payload is a
+        zero row under a 0 mask, exactly a dropped client. `lnorms` is the
+        client program's [W, L] per-leaf norm stack (layer scope only):
+        the per-leaf screens run beside the table-norm screen, and a
+        client over ANY of them drops from the merge bitwise."""
         part = part * arrived
         part_eff = part
         norms = None
@@ -1884,13 +2247,46 @@ def make_payload_round_steps(
         if quarantine:
             norms = _table_norms(tables)
             bad = _quarantine_mask(cfg, norms, qmed)
+            if layer_q:
+                bad = bad | _quarantine_layer_mask(
+                    cfg, lnorms, state["quarantine"]["layer_median"])
             part_eff = part * (1.0 - bad.astype(part.dtype))
-        # THE merge: masked per-client tables through the same ordered-sum
-        # entry point the sharded mesh round uses (client-index order)
-        masked = modes.mask_rows(part_eff, tables)
-        wire_sum = modes.merge_partial_wires(mcfg, {"table": masked})
-        agg = _normalize_merged_wire(mcfg, wire_sum,
-                                     jnp.maximum(part_eff.sum(), 1.0))
+        pol = robust_policy(cfg)
+        if pol is not None:
+            # a non-finite table can never enter the order statistics
+            # (modes._robust_table_merge screens it out internally) — so
+            # it must leave the ROUND the same way: masked out of the
+            # survivor count, the agg_op="sum" rescale, the metrics/
+            # net-state folds, and the median rings. Without this, a NaN
+            # table under a robust policy with the quarantine unarmed
+            # would commit a round rescaled by the wrong live count while
+            # the sum policy's non-finite guard skips it cleanly. With
+            # the quarantine armed the screen above already zeroed these
+            # rows and this mask is value-transparent.
+            finite = jnp.isfinite(tables).reshape(
+                tables.shape[0], -1).all(axis=1)
+            part_eff = part_eff * finite.astype(part_eff.dtype)
+        if pol is None:
+            # THE merge: masked per-client tables through the same ordered-
+            # sum entry point the sharded mesh round uses (client-index
+            # order). merge_policy="trimmed" with trim=0 compiles THIS
+            # branch — the k=0 == sum bit-identity by construction.
+            masked = modes.mask_rows(part_eff, tables)
+            wire_sum = modes.merge_partial_wires(mcfg, {"table": masked})
+            agg = _normalize_merged_wire(mcfg, wire_sum,
+                                         jnp.maximum(part_eff.sum(), 1.0))
+        else:
+            # Byzantine-robust merge: coordinate-wise trimmed mean / median
+            # over the LIVE client tables (dead rows excluded from the
+            # order statistics, not counted as zeros). The boundary returns
+            # the robust MEAN; agg_op="sum" rescales by the live count so
+            # the FetchSGD lr translation (sum@lr == mean@lr*W) survives.
+            robust = modes.merge_partial_wires(
+                mcfg, {"table": tables}, policy=pol, live=part_eff,
+                trim=cfg.merge_trim)
+            agg = (robust if mcfg.agg_op != "sum" else {
+                k: v * jnp.maximum(part_eff.sum(), 1.0)
+                for k, v in robust.items()})
         new_net_state, out_metrics = _merged_survivor_finalize(
             jax.tree.map(lambda s: modes.mask_rows(part_eff, s).sum(0),
                          nstates),
@@ -1900,8 +2296,9 @@ def make_payload_round_steps(
         new_q = None
         if quarantine:
             out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()
-            new_q = _advance_quarantine(cfg, state["quarantine"], norms,
-                                        part_eff)
+            new_q = _advance_quarantine_full(
+                cfg, state["quarantine"], norms,
+                lnorms if layer_q else None, part_eff)
             out_metrics["quarantine_median"] = new_q["median"]
         agg, new_net_state, _, out_metrics, _ = _guard_nonfinite(
             cfg, agg, new_net_state, state["net_state"], {}, {}, out_metrics,
@@ -1933,11 +2330,11 @@ def compose_payload(client_step: Callable, merge_step: Callable) -> Callable:
     local state)."""
 
     def step(state, batch, client_rows, lr, rng):
-        tables, nstates, mvals, part, noise_rng = client_step(
+        tables, nstates, mvals, part, noise_rng, lnorms = client_step(
             state, batch, rng)
         new_state, metrics = merge_step(
             state, tables, nstates, mvals, part, jnp.ones_like(part), lr,
-            noise_rng)
+            noise_rng, lnorms)
         return new_state, client_rows, metrics
 
     return step

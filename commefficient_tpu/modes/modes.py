@@ -198,7 +198,60 @@ def aggregate(cfg: ModeConfig, wires: dict, weights=None) -> dict:
     return {"dense": op(wires["dense"])}
 
 
-def merge_partial_wires(cfg: ModeConfig, stacked: dict) -> dict:
+# graftlint: robust-merge — THE declared robust-order-sensitivity boundary
+# (G012): the one place order statistics run over client-stacked wires.
+# Everything else in parity scope merges by the ORDERED SUM; a sort/median
+# over a client axis anywhere else silently changes the aggregation
+# semantics the parity pins rest on.
+def _robust_table_merge(stacked, live, policy: str, trim: int):
+    """Coordinate-wise Byzantine-robust location estimate over the [W, ...]
+    stacked client wires, dead rows (live == 0) excluded. Returns the
+    robust MEAN-scale array (the caller rescales for agg_op="sum").
+
+    - "median": per coordinate, the median over the live rows — the same
+      lo/hi even-count convention as the quarantine's `_masked_median`
+      (dead rows are keyed to +inf and indexed past).
+    - "trimmed": per coordinate, rank the live rows (stable argsort —
+      ties break by CLIENT INDEX, so the verdict is deterministic and,
+      over the gathered full-cohort stack, mesh-shape-invariant), drop the
+      `trim` lowest and `trim` highest LIVE values, and take the ordered
+      masked sum of the survivors IN CLIENT-INDEX ORDER (the same fp
+      association as the plain merge) divided by the survivor count.
+
+    A cohort degraded below 2*trim+1 live clients keeps nothing — the
+    aggregate is zero, the fully-dropped-round semantics. A live row
+    carrying ANY non-finite value is excluded exactly like a dead row —
+    from the order statistics AND from the live count — so a NaN table
+    can neither poison the estimate nor burn a slot of the trim budget
+    (an adversary pairing one NaN client with `trim` oversized clients
+    must not smuggle an outlier past the trimmed window). With the
+    quarantine armed, non-finite clients are already masked upstream and
+    this screen is value-transparent."""
+    W = stacked.shape[0]
+    finite = jnp.isfinite(stacked).reshape(W, -1).all(axis=1)
+    live = live * finite.astype(live.dtype)
+    expand = live.reshape((-1,) + (1,) * (stacked.ndim - 1))
+    keyed = jnp.where(expand > 0, stacked, jnp.inf)
+    n = live.sum().astype(jnp.int32)
+    if policy == "median":
+        s = jnp.sort(keyed, axis=0)
+        lo = jnp.clip((n - 1) // 2, 0, W - 1)
+        hi = jnp.clip(n // 2, 0, W - 1)
+        med = 0.5 * (jnp.take(s, lo, axis=0) + jnp.take(s, hi, axis=0))
+        return jnp.where(n > 0, med, jnp.zeros_like(med))
+    if policy != "trimmed":
+        raise ValueError(f"unknown robust merge policy {policy!r}")
+    order = jnp.argsort(keyed, axis=0, stable=True)
+    ranks = jnp.argsort(order, axis=0, stable=True)  # inverse permutation
+    keep = (ranks >= trim) & (ranks < n - trim) & (expand > 0)
+    kept = jnp.where(keep, stacked, jnp.zeros_like(stacked))
+    denom = jnp.maximum((n - 2 * trim).astype(stacked.dtype), 1.0)
+    return kept.sum(axis=0) / denom
+
+
+def merge_partial_wires(cfg: ModeConfig, stacked: dict, *,
+                        policy: str = "sum", live=None,
+                        trim: int = 0) -> dict:
     """Merge S per-shard partial wires (leaves stacked on a leading [S] axis,
     in shard-index order) into one wire — the cross-device reduction of the
     data-parallel round. Linear modes only: the partial wires are compressions
@@ -208,13 +261,44 @@ def merge_partial_wires(cfg: ModeConfig, stacked: dict) -> dict:
     Sketch tables route through `csvec.merge_tables` (the documented merge
     entry point); dense wires are the same ordered sum. The ordered reduce —
     not a psum — is what lets the mesh execution and the single-device
-    reference of the sharded round stay bit-identical (see merge_tables)."""
+    reference of the sharded round stay bit-identical (see merge_tables).
+
+    `policy` != "sum" is the Byzantine-robust table merge (--merge_policy):
+    the stacked leaves must then be PER-CLIENT [W, r, c] tables (mode=sketch
+    — the wire-payload round shape; robust statistics over per-shard
+    partial SUMS would screen shards, not clients), `live` the [W] 0/1 mask
+    of clients in the merge, and the returned table is the coordinate-wise
+    robust MEAN (see `_robust_table_merge`) — the caller rescales by the
+    live count for agg_op="sum" instead of normalizing. "trimmed" with
+    trim=0 never reaches here: the engine compiles it as "sum" by
+    construction (trimming nothing IS the sum — that is the bit-identity
+    contract, not an fp coincidence)."""
     if not is_linear(cfg):
         raise ValueError(
             f"mode={cfg.mode!r} is nonlinear: partial per-shard wires cannot "
             "be merged by addition (per-client top-k does not commute with "
             "the cross-shard sum)"
         )
+    if policy != "sum":
+        if cfg.mode != "sketch":
+            raise ValueError(
+                f"robust merge policy {policy!r} operates on per-client "
+                f"Count-Sketch tables; mode={cfg.mode!r} has no table wire"
+            )
+        if live is None:
+            raise ValueError(
+                "robust merge needs the [W] live-client mask: dead rows "
+                "must be excluded from the order statistics, not counted "
+                "as zero-valued contributions"
+            )
+        W = stacked["table"].shape[0]
+        if policy == "trimmed" and 2 * trim >= W:
+            raise ValueError(
+                f"merge_trim={trim} would trim the whole cohort "
+                f"(2*{trim} >= W={W}); need 2*trim < num_workers"
+            )
+        return {"table": _robust_table_merge(
+            stacked["table"], live, policy, trim)}
     if cfg.mode == "sketch":
         return {"table": csvec.merge_tables(cfg.sketch_spec, stacked["table"])}
     return {"dense": stacked["dense"].sum(axis=0)}

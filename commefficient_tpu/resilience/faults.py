@@ -49,6 +49,31 @@ entries, each `kind[@round,round,...][:key=val,...]`:
                                 wire_truncate / wire_dup / conn_drop, and
                                 wire_delay@r:clients=I:secs=S which delays
                                 the frame into the straggler discipline)
+    client_signflip@2:clients=0 position 0 transmits the NEGATED table in
+                                round 2 — a Byzantine client that passes
+                                every norm screen (|-u| == |u|) and is
+                                answerable only by a robust merge
+                                (--merge_policy trimmed|median). Table
+                                rounds only (the attack is on the WIRE):
+                                the session routes adversarial plans
+                                through the per-client-table round.
+    client_scale@2:clients=1,factor=50
+                                position 1 transmits its table scaled by
+                                the factor (model replacement, Bhagoji et
+                                al.) — caught by the sketch-space L2
+                                quarantine when armed, and by the robust
+                                merge regardless
+    client_collude@3:frac=0.25  a seeded ceil(frac*W)-client minority in
+                                round 3 each transmits the NEGATED CLONE of
+                                one honest client's table: every clone
+                                individually passes the L2 median screen
+                                (same norm as an honest table), but their
+                                identical mass pulls the linear sum toward
+                                gradient ASCENT — the inner-product attack
+                                the trimmed/median merge exists for.
+                                Colluder positions draw from the plan's
+                                seed (finally consumed), pinned to
+                                (seed, round)
     host_preempt@3:host=0       SIGTERM round 3 ONLY on the host whose
                                 jax.process_index() == host — the one-host
                                 preemption the cross-host barrier
@@ -107,6 +132,16 @@ KINDS = {
     "wire_dup": ("clients",),        # at-least-once double send (dedup)
     "wire_delay": ("clients", "secs"),  # late frame (straggler discipline)
     "conn_drop": ("clients",),       # connection dies mid-send (no-show)
+    # adversarial (Byzantine) clients: transform the per-client sketch
+    # TABLE a client transmits — in-screen attacks the robust merge
+    # (--merge_policy trimmed|median) exists for. Table rounds only; the
+    # session refuses a plan naming them on a run with no per-client wire.
+    "client_signflip": ("clients",),          # transmit -table (norm-
+    #                                           invariant: screens pass)
+    "client_scale": ("clients", "factor"),    # transmit factor*table
+    #                                           (model replacement)
+    "client_collude": ("frac",),              # seeded minority clones one
+    #                                           crafted (negated) table
 }
 
 # the client_* sites fire inside a round's preparation: scheduled at or past
@@ -119,6 +154,12 @@ CLIENT_KINDS = ("client_drop", "client_straggle", "client_poison")
 # ship; same dead-schedule validation as the client kinds
 WIRE_KINDS = ("wire_corrupt", "wire_truncate", "wire_dup", "wire_delay",
               "conn_drop")
+
+# the adversarial kinds fire in the table round's client program (the
+# reserved _adv_* batch leaves the engine consumes); same dead-schedule
+# validation, and the SESSION enforces the table-round context at build
+# (a plan naming them with no per-client wire would inject nothing)
+ADVERSARIAL_KINDS = ("client_signflip", "client_scale", "client_collude")
 
 
 class InjectedFault(RuntimeError):
@@ -194,6 +235,27 @@ def _parse_entry(entry: str) -> FaultSpec:
                         raise ValueError(
                             "expected '+'-separated non-negative positions")
                     params[k] = pos
+                elif k == "factor":
+                    f = float(v)
+                    if not np.isfinite(f) or f == 0.0:
+                        # a zero/NaN factor is a dropped client / poison in
+                        # disguise — use client_drop / client_poison, so the
+                        # chaos run asserts the defense it actually means
+                        raise ValueError(
+                            "expected a finite nonzero float (zero is a "
+                            "drop, use client_drop)")
+                    params[k] = f
+                elif k == "frac":
+                    f = float(v)
+                    if not 0.0 < f <= 0.5:
+                        # a colluding MAJORITY defeats any order statistic
+                        # by definition; a plan asking for one is testing
+                        # nothing the merge could ever pass
+                        raise ValueError(
+                            "expected a fraction in (0, 0.5] (a colluding "
+                            "majority defeats every robust merge by "
+                            "definition)")
+                    params[k] = f
                 elif k == "value":
                     allowed = (("nan", "inf", "big") if kind == "client_poison"
                                else ("nan", "inf"))
@@ -267,7 +329,7 @@ class FaultPlan:
         never fire; reject it loudly instead of letting the chaos run pass
         vacuously."""
         for s in self.specs:
-            if (s.kind in CLIENT_KINDS + WIRE_KINDS
+            if (s.kind in CLIENT_KINDS + WIRE_KINDS + ADVERSARIAL_KINDS
                     or s.kind == "host_preempt") and s.rounds:
                 dead = [r for r in s.rounds if r >= total_rounds]
                 if dead:
@@ -501,6 +563,103 @@ class FaultPlan:
                       "re-queued)")
             self._mark("client_drop", rnd, clients=pos)
         return batch, valid, dropped
+
+    # ------------------------------------------------ adversarial clients
+
+    def has_adversarial(self) -> bool:
+        """Whether the plan names any Byzantine client kind — the session
+        routes such plans through the per-client-table round (the attacks
+        transform the per-client WIRE, which only exists there)."""
+        return any(s.kind in ADVERSARIAL_KINDS for s in self.specs)
+
+    def adversarial_plan(self, rnd: int,
+                         num_workers: int) -> tuple[np.ndarray, np.ndarray]:
+        """Round `rnd`'s adversarial wire transform as the engine's reserved
+        batch leaves: (scale [W] float32, src [W] int32) — client i
+        transmits scale[i] * table[src[i]]. Identity (ones, arange) when
+        nothing is scheduled, so the leaves ride every round of an armed
+        plan without changing the compiled program's shapes. One-shot per
+        (kind, round, params) like the other cohort sites; every armed
+        attack lands an obs instant, the injected-faults counter, AND a
+        per-kind attack counter (the chaos acceptance reads them).
+
+        client_collude draws its ceil(frac*W) colluder positions from the
+        PLAN SEED pinned to (seed, round) — deterministic and replayable;
+        the crafted table is the NEGATED clone of the lowest-indexed honest
+        client's table: every clone individually passes the L2 median
+        screen (norm identical to an honest table's), while the identical
+        mass pulls the linear sum toward ascent."""
+        scale = np.ones(num_workers, np.float32)
+        src = np.arange(num_workers, dtype=np.int32)
+
+        def attack_mark(kind, **args):
+            self._mark(kind, rnd, **args)
+            obreg.default().counter(
+                f"resilience_attack_{kind[len('client_'):]}_total").inc()
+
+        for s in self.specs_for("client_signflip", rnd):
+            key = ("client_signflip", rnd, s.params.get("clients", (0,)))
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            pos = list(self._positions(s, num_workers, rnd))
+            scale[pos] *= -1.0
+            self._log(f"client_signflip on positions {pos} (round {rnd})")
+            attack_mark("client_signflip", clients=pos)
+        for s in self.specs_for("client_scale", rnd):
+            key = ("client_scale", rnd, s.params.get("clients", (0,)))
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            pos = list(self._positions(s, num_workers, rnd))
+            factor = float(s.params.get("factor", 10.0))
+            scale[pos] *= factor
+            self._log(f"client_scale x{factor:g} on positions {pos} "
+                      f"(round {rnd})")
+            attack_mark("client_scale", clients=pos, factor=factor)
+        for s in self.specs_for("client_collude", rnd):
+            frac = float(s.params.get("frac", 0.25))
+            key = ("client_collude", rnd, frac)
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            if num_workers < 2:
+                # a collusion needs an honest source to clone AND a
+                # colluder — with one worker neither exists. Loud no-op
+                # (the poison() int-batch precedent): a chaos run must
+                # never believe an attack fired that could not
+                self._log(
+                    f"client_collude@{rnd}: num_workers={num_workers} "
+                    "leaves no honest source to clone; injection is a "
+                    "NO-OP (collusion needs a cohort of >= 2)")
+                continue
+            n = min(int(np.ceil(frac * num_workers)), num_workers - 1)
+            n = max(n, 1)
+            rs = np.random.RandomState(
+                (self.seed * 1_000_003 + rnd) % (2 ** 32))
+            colluders = sorted(
+                int(p) for p in rs.choice(num_workers, size=n, replace=False))
+            # the clone source must be HONEST — not a colluder, and not a
+            # client a co-scheduled signflip/scale already attacked this
+            # round (cloning an attacked wire would amplify that attack
+            # instead of staging the documented clone-of-an-honest-table)
+            honest = [p for p in range(num_workers)
+                      if p not in colluders
+                      and scale[p] == 1.0 and src[p] == p]
+            if not honest:
+                self._log(
+                    f"client_collude@{rnd}: every non-colluding position "
+                    "is already attacked this round; injection is a NO-OP "
+                    "(no honest table to clone)")
+                continue
+            source = honest[0]
+            src[colluders] = source
+            scale[colluders] = -1.0
+            self._log(f"client_collude: positions {colluders} clone "
+                      f"-table[{source}] (frac={frac:g}, round {rnd})")
+            attack_mark("client_collude", clients=colluders, source=source,
+                        frac=frac)
+        return scale, src
 
     # ------------------------------------------------- transport-seam sites
 

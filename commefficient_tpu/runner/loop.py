@@ -192,6 +192,11 @@ class RunStats:
     clients_quarantined: int = 0
     degraded_rounds: int = 0
     requeue_depth_max: int = 0
+    # Byzantine attacks the fault plan injected while this loop ran
+    # (client_signflip / client_scale / client_collude firings — the
+    # resilience_attack_*_total counters' per-run deltas summed): a chaos
+    # run's stats say how much adversarial pressure the merge absorbed
+    attacks_injected: int = 0
 
 
 def make_save_ckpt(session: FederatedSession, checkpoint_dir: str):
@@ -635,6 +640,12 @@ def run_loop(
     stats.clients_quarantined = int(
         mark.delta("cohort_clients_quarantined_total"))
     stats.degraded_rounds = int(mark.delta("cohort_degraded_rounds_total"))
+    from ..resilience.faults import ADVERSARIAL_KINDS
+
+    stats.attacks_injected = sum(
+        int(mark.delta(
+            f"resilience_attack_{kind[len('client_'):]}_total"))
+        for kind in ADVERSARIAL_KINDS)
     stats.max_inflight_used = eff_inflight if async_mode else 0
     reg.gauge("runner_rtt_ms").set(rtt_ms)
     reg.gauge("runner_max_inflight").set(stats.max_inflight_used)

@@ -50,6 +50,15 @@ STALE_SCHEMA, then layout MALFORMED, then QUARANTINED):
   screen, applied at the wire): a poisoned payload is dropped BEFORE the
   merge, bitwise equal to that client never submitting.
 
+The gauntlet screens what a TABLE can reveal — structure, schema,
+magnitude. An in-screen Byzantine payload (a sign-flipped table, a
+colluding clone at median norm) is norm-invariant and sails through BY
+DESIGN; the defense against those is downstream, in the merge itself
+(``--merge_policy trimmed|median`` — see the README threat model). The
+gauntlet's scalar median snapshot is the same table-space ring the merge
+advances, so a payload rejected QUARANTINED here is bitwise the payload
+the merge would have quarantined (pinned in tests/test_byzantine.py).
+
 All counters are cumulative over the service lifetime and feed the metrics
 endpoint (serve/metrics.py); the wire-facing rejections additionally bump
 process-wide resilience counters in the obs registry.

@@ -380,6 +380,12 @@ class AggregationService:
             "deadline_s": self.cfg.deadline_s,
             "transport": self.cfg.transport,
             "payload": self.cfg.payload,
+            # the armed Byzantine defense posture, so an operator can see
+            # at a glance whether this aggregator's merge is the linear sum
+            # or a robust statistic (and how wide the quarantine screens)
+            "merge_policy": getattr(s.cfg, "merge_policy", "sum"),
+            "merge_trim": int(getattr(s.cfg, "merge_trim", 0)),
+            "quarantine_scope": getattr(s.cfg, "quarantine_scope", "cohort"),
         }
 
 

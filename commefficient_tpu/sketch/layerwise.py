@@ -100,16 +100,30 @@ def _leaf_block(spec: CSVecSpec, offset: int, n: int) -> LeafBlock:
                      front=offset - s0 * spec.c)
 
 
-def make_block_plan(spec: CSVecSpec, tree) -> BlockPlan:
-    """Build the plan from a params/grads pytree (or its eval_shape)."""
-    blocks: list[LeafBlock] = []
+def leaf_segments(tree) -> tuple[tuple[int, int], ...]:
+    """(offset, size) of every non-empty leaf in ravel order — the
+    spec-independent core of the block plan. `make_block_plan` derives the
+    slab geometry from exactly these offsets, and the per-layer quarantine
+    (engine quarantine_scope="layer") slices per-client flat updates into
+    per-leaf blocks with them, so the screen's layer boundaries and the
+    sketch's block boundaries can never disagree."""
+    segs: list[tuple[int, int]] = []
     off = 0
     for leaf in jax.tree.leaves(tree):
         n = int(jnp.size(leaf)) if not hasattr(leaf, "size") else int(leaf.size)
         if n == 0:
             continue
-        blocks.append(_leaf_block(spec, off, n))
+        segs.append((off, n))
         off += n
+    return tuple(segs)
+
+
+def make_block_plan(spec: CSVecSpec, tree) -> BlockPlan:
+    """Build the plan from a params/grads pytree (or its eval_shape)."""
+    blocks: list[LeafBlock] = [
+        _leaf_block(spec, off, n) for off, n in leaf_segments(tree)
+    ]
+    off = blocks[-1].offset + blocks[-1].size if blocks else 0
     if off != spec.d:
         raise ValueError(
             f"block plan covers {off} coordinates but the sketch spec has "

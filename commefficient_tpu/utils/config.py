@@ -104,6 +104,47 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "merge and removed from the renormalization, so one "
                         "poisoned update costs one client, not the round. "
                         "Counted per round as clients_quarantined. 0 = off")
+    p.add_argument("--merge_policy", default="sum",
+                   choices=["sum", "trimmed", "median"],
+                   help="how per-client Count-Sketch tables combine into "
+                        "the round aggregate. sum (pinned default): the "
+                        "linear ordered sum — FetchSGD's merge, maximally "
+                        "accurate and exactly what a Byzantine minority "
+                        "exploits. trimmed: per table coordinate, drop the "
+                        "--merge_trim highest and lowest live "
+                        "contributions before the ordered sum (trimmed "
+                        "mean; deterministic tie-break by client index, "
+                        "mesh-shape-invariant; trim=0 is BIT-identical to "
+                        "sum by construction). median: coordinate-wise "
+                        "median. Robust policies need per-client tables, "
+                        "so they forfeit the compress-once linearity "
+                        "shortcut (the round runs the wire-payload shape "
+                        "even unserved) and require --mode sketch with "
+                        "--sketch_path ravel; they also weaken error-"
+                        "feedback exactness (see README threat model)")
+    p.add_argument("--merge_trim", type=int, default=0,
+                   help="--merge_policy trimmed: contributions dropped per "
+                        "coordinate from EACH end (defends up to this many "
+                        "colluders; needs 2*trim < --num_workers). 0 = "
+                        "trim nothing = the sum program, bit-identically")
+    p.add_argument("--quarantine_scope", default="cohort",
+                   choices=["cohort", "layer"],
+                   help="--client_update_clip screen granularity. cohort "
+                        "(default): one L2 norm per client vs the running "
+                        "cohort median (the original screen, unchanged). "
+                        "layer: ADDITIONALLY screen each client's update "
+                        "per LAYER — per-leaf L2 vs that leaf's own "
+                        "running median ring (--quarantine_window applies "
+                        "per leaf), a client over ANY leaf's screen is "
+                        "dropped — so an attack hiding inside the flat "
+                        "norm (all its mass in one layer) still trips. "
+                        "Single-leaf models are bit-identical to cohort "
+                        "scope on the update-norm (announce) rounds; "
+                        "table rounds (--serve_payload sketch / robust "
+                        "--merge_policy) add the update-space per-leaf "
+                        "screen beside the table-space one even "
+                        "single-leaf. Fused round paths only (widens the "
+                        "quarantine state tree — see MIGRATION.md)")
     p.add_argument("--quarantine_window", type=int, default=1,
                    help="--client_update_clip threshold baseline: 1 "
                         "(default) screens against the LAST non-empty "
@@ -113,7 +154,9 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "medians, so models whose update norms drift fast "
                         "don't quarantine healthy clients (one outlier "
                         "round perturbs one window slot, not the whole "
-                        "threshold). Fused round paths only")
+                        "threshold). Works on the fused, sharded, and "
+                        "payload rounds; --split_compile rejects it loudly "
+                        "(the split boundary threads one scalar median)")
     p.add_argument("--requeue_policy", default="fifo",
                    choices=["fifo", "aged"],
                    help="serving order for the dropped-client re-queue: "
@@ -251,7 +294,12 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "big (cohort-level: mask/stall/poison individual "
                         "clients inside the round), host_preempt:host=K "
                         "(SIGTERM one simulated host; the cross-host "
-                        "barrier carries it to all), seed=N. "
+                        "barrier carries it to all), client_signflip:"
+                        "clients=I / client_scale:clients=I,factor=F / "
+                        "client_collude:frac=P (Byzantine wire attacks on "
+                        "the per-client sketch table — mode=sketch table "
+                        "rounds; answered by --merge_policy and the "
+                        "quarantine), seed=N. "
                         "Unset = zero injection, zero behavior change")
     p.add_argument("--on_nonfinite", default="skip",
                    choices=["off", "skip", "halt"],

@@ -97,6 +97,11 @@ def build(args, fault_plan=None, retry_policy=None):
         client_dropout=args.client_dropout,
         client_update_clip=args.client_update_clip,
         quarantine_window=args.quarantine_window,
+        quarantine_scope=args.quarantine_scope,
+        # Byzantine-robust table merge (trimmed/median run the per-client-
+        # table round; trim=0 trimmed IS sum, bit-identically)
+        merge_policy=args.merge_policy,
+        merge_trim=args.merge_trim,
         requeue_policy=args.requeue_policy,
         sketch_path=args.sketch_path,
         # --serve_payload sketch inverts the round into the two-program

@@ -17,6 +17,7 @@
 #        scripts/chaos_smoke.sh serve
 #        scripts/chaos_smoke.sh trace
 #        scripts/chaos_smoke.sh wire
+#        scripts/chaos_smoke.sh byzantine
 #
 # `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
 # restartPolicy would: it launches the tiny cv_train run with a fault plan
@@ -49,6 +50,13 @@
 # preemption (exit 75) — asserting the exported Chrome trace contains the
 # fault/retry/preemption instants with their correct round numbers, and
 # that the trace still flushed on the resumable exit path. < 1 min CPU.
+#
+# `byzantine` mode drives the ROBUST MERGE end-to-end through the real
+# cv_train CLI: a sketch-mode run under --merge_policy trimmed with
+# client_signflip + client_collude attacks in the fault plan — asserting
+# the per-kind attack counters fired, the run finished every round with
+# finite params, and the logged train loss FELL under attack (the trimmed
+# merge absorbing what would poison the linear sum). < 1 min CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -499,6 +507,91 @@ print(f"wire: PASS (3 socket payload rounds; rejections "
       f"[malformed={c['rejected_malformed']} dup={c['rejected_dup']} "
       f"quarantined={c['rejected_quarantined']}], casualties {drops}, "
       f"committed params bit-identical to the batch round over survivors)")
+EOF
+fi
+
+if [[ "${1:-}" == "byzantine" ]]; then
+    shift
+    exec timeout -k 10 "${CHAOS_TIMEOUT_S:-120}" python - "$@" <<'EOF'
+# byzantine chaos child (< 1 min CPU): the real cv_train.main CLI path
+# (tiny-model substitution, sketch mode) under --merge_policy trimmed,
+# with a sign-flipping client and a seeded colluding-clone minority in the
+# fault plan. Asserts the attack counters fired, every round completed
+# with finite params, and the logged train loss is finite and FALLING —
+# the robust merge holding the trajectory an ordered sum would forfeit.
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import flax.linen as nn
+
+import commefficient_tpu.data.cifar as cifar
+import cv_train
+from commefficient_tpu.obs import registry as obreg
+
+
+class _TinyNet(nn.Module):
+    num_classes: int = 10
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+_orig = cifar.load_cifar_fed
+
+
+def _tiny(*a, **kw):
+    kw.update(synthetic_train=64, synthetic_test=32)
+    return _orig(*a, **kw)
+
+
+cv_train.ResNet9 = _TinyNet
+cv_train.load_cifar_fed = _tiny
+
+reg = obreg.default()
+before = {k: reg.counter(f"resilience_attack_{k}_total").value
+          for k in ("signflip", "collude")}
+
+# the 8-device CPU mesh makes the cohort 8-wide (num_workers must divide
+# it): one sign-flipper + a ceil(0.12*8)=1-clone collusion = at most 2
+# poisoned tables per round, inside trim=2's per-coordinate budget
+rows_path = os.path.join(tempfile.mkdtemp(), "rows.jsonl")
+session = cv_train.main([
+    "--dataset", "cifar10", "--mode", "sketch",
+    "--k", "2048", "--num_rows", "3", "--num_cols", "8192",
+    "--num_clients", "16", "--num_workers", "8", "--local_batch_size", "4",
+    "--lr_scale", "0.02", "--weight_decay", "0",
+    "--data_root", "/nonexistent", "--num_rounds", "12",
+    "--eval_every", "3", "--merge_policy", "trimmed", "--merge_trim", "2",
+    "--client_update_clip", "10", "--log_jsonl", rows_path,
+    "--fault_plan", "client_signflip@2,3,4,5,6,7,8,9,10,11:clients=0;"
+    "client_collude@4,5,6,7,8,9,10,11:frac=0.12",
+])
+assert session.round == 12, session.round
+
+for kind in ("signflip", "collude"):
+    fired = reg.counter(f"resilience_attack_{kind}_total").value - before[kind]
+    assert fired >= 1, f"attack counter resilience_attack_{kind}_total never fired"
+
+import jax
+from jax.flatten_util import ravel_pytree
+
+flat = np.asarray(ravel_pytree(jax.device_get(session.state["params"]))[0])
+assert np.isfinite(flat).all(), "params went non-finite under attack"
+
+rows = [json.loads(l) for l in open(rows_path) if l.strip()]
+losses = [r["train_loss"] for r in rows]
+assert all(np.isfinite(losses)), losses
+assert losses[-1] < losses[0], (
+    f"train loss did not fall under attack: {losses}")
+print(f"byzantine: PASS (signflip+collude under trimmed merge; "
+      f"loss {losses[0]:.4f} -> {losses[-1]:.4f}, 12 rounds, params finite)")
 EOF
 fi
 

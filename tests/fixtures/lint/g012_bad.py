@@ -1,0 +1,18 @@
+# graftlint: module=commefficient_tpu/modes/modes.py
+# G012 violating twin: order statistics over the client-stacked tables
+# OUTSIDE the declared robust-merge boundary — an undeclared second
+# aggregation semantics (its tie-breaks and fp association are pinned
+# nowhere), plus a screening percentile in parity scope.
+import jax.numpy as jnp
+
+
+def sneaky_median_merge(tables, live):
+    # undeclared coordinate-wise median over the [W, r, c] client stack
+    keyed = jnp.where(live[:, None, None] > 0, tables, jnp.inf)
+    return jnp.sort(keyed, axis=0)[tables.shape[0] // 2]
+
+
+def sneaky_trim(tables):
+    # undeclared trimming via percentile thresholds
+    hi = jnp.percentile(tables, 90.0, axis=0)
+    return jnp.where(tables > hi[None], 0.0, tables).sum(axis=0)

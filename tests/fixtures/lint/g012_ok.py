@@ -1,0 +1,22 @@
+# graftlint: module=commefficient_tpu/modes/modes.py
+# G012 conforming twin: the ONE declared robust-merge boundary owns every
+# order statistic; the caller dispatches into it and otherwise merges by
+# the ordered sum (the parity-pinned association).
+import jax.numpy as jnp
+
+
+# graftlint: robust-merge — the declared order-statistics site
+def _robust_table_merge(stacked, live, policy, trim):
+    keyed = jnp.where(live.reshape((-1, 1, 1)) > 0, stacked, jnp.inf)
+    order = jnp.argsort(keyed, axis=0, stable=True)
+    ranks = jnp.argsort(order, axis=0, stable=True)
+    n = live.sum().astype(jnp.int32)
+    keep = (ranks >= trim) & (ranks < n - trim)
+    return jnp.where(keep, stacked, 0.0).sum(axis=0)
+
+
+def merge_partial_wires(stacked, live=None, policy="sum", trim=0):
+    if policy != "sum":
+        return _robust_table_merge(stacked, live, policy, trim)
+    # the linear ordered sum: no order statistics anywhere near it
+    return stacked.sum(axis=0)

@@ -115,6 +115,106 @@ def test_g010_import_alone_is_silent():
         os.unlink(path)
 
 
+def test_g012_robust_merge_is_a_declaration_not_a_loophole():
+    """Strip the conforming twin's `# graftlint: robust-merge` marker and
+    the same sorts must fire — the boundary is declared, never inferred."""
+    with open(os.path.join(FIXTURES, "g012_ok.py")) as f:
+        text = f.read()
+    stripped = text.replace(
+        "# graftlint: robust-merge — the declared order-statistics site\n",
+        "")
+    assert stripped != text, "fixture lost its robust-merge line"
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(stripped)
+        path = tmp.name
+    try:
+        assert "G012" in _codes(path)
+    finally:
+        os.unlink(path)
+
+
+def test_g012_second_declared_boundary_fires():
+    """THE robust-merge boundary is one function: a second declaration in
+    parity scope is a second aggregation semantics hiding under the
+    first's exemption, and must itself be a violation."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/modes/modes.py\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "# graftlint: robust-merge\n"
+        "def first(stacked):\n"
+        "    return jnp.sort(stacked, axis=0)\n"
+        "\n"
+        "\n"
+        "# graftlint: robust-merge\n"
+        "def second(stacked):\n"
+        "    return jnp.median(stacked, axis=0)\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        found = _codes(path)
+        assert found.count("G012") == 1, found  # the SECOND def, only
+    finally:
+        os.unlink(path)
+
+
+def test_g012_boundary_outside_modes_fires_cross_file():
+    """The boundary lives in ONE sanctioned file: declaring robust-merge in
+    engine.py (also parity scope) must fire even for a lone declaration —
+    that is how a cross-file second boundary is caught without cross-file
+    rule state."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/federated/engine.py\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "# graftlint: robust-merge\n"
+        "def rogue(stacked):\n"
+        "    return jnp.sort(stacked, axis=0)\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        found = _codes(path)
+        # the illegal declaration AND the unexempted sort both fire
+        assert found.count("G012") == 2, found
+    finally:
+        os.unlink(path)
+
+
+def test_g012_sketch_row_median_out_of_scope():
+    """csvec's per-row median estimator (sketch/) sorts over the r hash-row
+    axis — the Count-Sketch definition, not a client merge; the rule's
+    scope deliberately excludes sketch/."""
+    import tempfile
+
+    src = ("# graftlint: module=commefficient_tpu/sketch/csvec.py\n"
+           "import jax.numpy as jnp\n"
+           "def estimate(per_row, r):\n"
+           "    return jnp.sort(per_row, axis=0)[(r - 1) // 2]\n")
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        assert "G012" not in _codes(path)
+    finally:
+        os.unlink(path)
+
+
 def test_every_rule_has_fixture_pair():
     # adding a rule without fixtures should fail HERE, not in review
     for code in RULE_CODES:
