@@ -98,7 +98,8 @@ from .rules_dataflow import DonationAfterUse, RngKeyReuse
 from .rules_io import RawCheckpointWrite
 from .rules_obs import ObsCallInCompiledScope
 from .rules_parity import ReservedLeafAccess, UnorderedReduction
-from .rules_robust import RobustOrderSensitivity
+from .rules_robust import (RobustOrderSensitivity,
+                           StalenessFoldBoundary)
 from .rules_sketch import FlatRavelInRoundPath
 from .rules_sync import BlockingCallOnDispatchThread, HostSyncInRoundPath
 from .rules_wire import WireBytesInCompiledScope
@@ -116,6 +117,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     FlatRavelInRoundPath,
     WireBytesInCompiledScope,
     RobustOrderSensitivity,
+    StalenessFoldBoundary,
 )
 
 RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)

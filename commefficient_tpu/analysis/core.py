@@ -59,6 +59,7 @@ class FunctionInfo:
     sketch_boundary: bool = False
     payload_boundary: bool = False
     robust_merge: bool = False
+    staleness_fold: bool = False
 
 
 class SourceFile:
@@ -106,8 +107,11 @@ class SourceFile:
                         cand & self.directives.payload_boundary_linenos)
                     robust = bool(
                         cand & self.directives.robust_merge_linenos)
+                    stale = bool(
+                        cand & self.directives.staleness_fold_linenos)
                     out.append(FunctionInfo(qual, start, child.lineno, end,
-                                            drain, sketch, payload, robust))
+                                            drain, sketch, payload, robust,
+                                            stale))
                     visit(child, f"{qual}.")
                 elif isinstance(child, ast.ClassDef):
                     visit(child, f"{prefix}{child.name}.")
@@ -147,6 +151,12 @@ class SourceFile:
         """True when any enclosing function is the declared robust-merge
         boundary (G012's sanctioned order-statistics site)."""
         return any(f.robust_merge
+                   for f in self.enclosing_functions(lineno))
+
+    def in_staleness_fold(self, lineno: int) -> bool:
+        """True when any enclosing function is the declared staleness-fold
+        boundary (G013's sanctioned stale-wire arithmetic site)."""
+        return any(f.staleness_fold
                    for f in self.enclosing_functions(lineno))
 
     # -- import index --------------------------------------------------------

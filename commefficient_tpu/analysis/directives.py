@@ -63,6 +63,9 @@ class Directives:
     # linenos carrying a robust-merge marker (G012's sanctioned order-
     # statistics site — modes._robust_table_merge)
     robust_merge_linenos: set[int]
+    # linenos carrying a staleness-fold marker (G013's sanctioned
+    # staleness-weighted fold site — engine._stale_fold)
+    staleness_fold_linenos: set[int]
     # fixture impersonation path, or None
     module_override: str | None
     # (lineno, message) for malformed directives — surfaced as G000
@@ -117,7 +120,8 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
     d = Directives(
         line_disables={}, file_disables=set(), drain_linenos=set(),
         sketch_boundary_linenos=set(), payload_boundary_linenos=set(),
-        robust_merge_linenos=set(), module_override=None, errors=[],
+        robust_merge_linenos=set(), staleness_fold_linenos=set(),
+        module_override=None, errors=[],
     )
     for lineno, line in _comments(text):
         m = _DIRECTIVE_RE.search(line)
@@ -142,6 +146,8 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
             d.payload_boundary_linenos.add(lineno)
         elif verb == "robust-merge" and not has_eq:
             d.robust_merge_linenos.add(lineno)
+        elif verb == "staleness-fold" and not has_eq:
+            d.staleness_fold_linenos.add(lineno)
         elif verb == "module" and has_eq:
             d.module_override = arg.strip()
         elif not verb:
@@ -151,6 +157,7 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
                 lineno,
                 f"unknown graftlint directive {verb!r} "
                 "(expected disable/disable-file/drain-point/"
-                "sketch-boundary/payload-boundary/robust-merge/module)",
+                "sketch-boundary/payload-boundary/robust-merge/"
+                "staleness-fold/module)",
             ))
     return d

@@ -1,4 +1,4 @@
-"""G012 robust-order-sensitivity.
+"""G012 robust-order-sensitivity + G013 staleness-fold-boundary.
 
 The repo's aggregation contract is LINEAR: client wires merge by the
 ordered sum (csvec.merge_tables / modes.merge_partial_wires), and every
@@ -33,6 +33,19 @@ VECTORS — screening thresholds, not merged values; the one such site
 carries an inline justification. sketch/ is deliberately out of scope: the
 Count-Sketch estimator's per-row median (csvec) sorts over the r hash-row
 axis, the estimator's own definition, not a client axis.
+
+G013 is the same shape of contract for the buffered-ASYNC merge
+(--serve_async): stale wire tables fold into the server table in exactly
+ONE declared place — ``engine._stale_fold``, marked ``# graftlint:
+staleness-fold`` — whose slot-ordered lax.scan IS the async mode's whole
+numerical contract (fold order = slot order = a pure function of the
+submission set; weights join the survivor normalization). Arithmetic over
+``stale_*``-named values anywhere else in parity scope is a second,
+undeclared fold site: two sites that disagree about order or weight
+handling silently un-pin the async==sync bit-identity. Bare argument
+FORWARDING (``_stale_fold(tbl, live, stale_tables, stale_weights)``) is
+legal — the merge program has to hand the stack to the boundary; touching
+the values outside it is not.
 """
 
 from __future__ import annotations
@@ -111,4 +124,75 @@ class RobustOrderSensitivity(Rule):
                 "the declared robust-merge boundary — sorting client data "
                 "here either adds an undeclared aggregation semantics or "
                 "reassociates the parity-pinned ordered sum"))
+        return out
+
+
+# the ONE file the staleness-fold boundary may be declared in
+_STALE_BOUNDARY_FILE = f"{PACKAGE}/federated/engine.py"
+# the async merge's stale-wire value names (the merge signature's stack
+# args) — config scalars (stale_slots) and derived host metrics are not
+# wire values and stay legal outside the boundary
+_STALE_NAMES = frozenset({"stale_tables", "stale_weights"})
+
+
+class StalenessFoldBoundary(Rule):
+    code = "G013"
+    name = "staleness-fold-boundary"
+    fixit = ("route every piece of arithmetic over stale_* wire values "
+             "through the ONE declared `# graftlint: staleness-fold` "
+             "boundary (engine._stale_fold) — callers may only FORWARD "
+             "the stack to it")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(_PARITY_SCOPE)
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        declared = [f for f in src.functions if f.staleness_fold]
+        in_boundary_file = src.rel == _STALE_BOUNDARY_FILE
+        illegal = declared if not in_boundary_file else declared[1:]
+        for extra in illegal:
+            out.append(Violation(
+                code=self.code, name=self.name, rel=src.rel,
+                lineno=extra.def_lineno, col=0,
+                message=(
+                    f"staleness-fold boundary declared at {extra.qualname} "
+                    f"— the stale fold is ONE declared function in "
+                    f"{_STALE_BOUNDARY_FILE}; another declaration is a "
+                    f"second fold semantics hiding under the exemption"),
+                fixit=("fold the stale arithmetic into the existing "
+                       "declared boundary (engine._stale_fold)"),
+                line_text=src.line(extra.def_lineno),
+                symbol=extra.qualname,
+            ))
+        # Name uses of stale_* values are legal in exactly two shapes:
+        # inside the declared boundary, or as a bare argument being
+        # FORWARDED to a plain function call (the merge handing the stack
+        # to the boundary). Anything else — a BinOp, a compare, a method
+        # call, an index — is stale arithmetic outside the boundary.
+        forwarded: set[int] = set()
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                for a in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(a, ast.Name):
+                        forwarded.add(id(a))
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Name):
+                continue
+            if node.id not in _STALE_NAMES:
+                continue
+            if isinstance(node.ctx, ast.Store):
+                continue  # binding the incoming stack is not arithmetic
+            if id(node) in forwarded:
+                continue
+            if in_boundary_file and src.in_staleness_fold(node.lineno):
+                continue
+            out.append(self.violation(
+                src, node,
+                f"`{node.id}` used outside the declared staleness-fold "
+                "boundary — stale wire values may only be FORWARDED to "
+                "engine._stale_fold; arithmetic on them here is a second, "
+                "undeclared fold site (its order and weight handling are "
+                "pinned nowhere)"))
         return out

@@ -50,7 +50,16 @@ class HostSyncInRoundPath(Rule):
         f"{PACKAGE}/modes/",
         f"{PACKAGE}/sketch/",
     )
-    EXACT = (f"{PACKAGE}/runner/loop.py",)
+    # the always-on pipeline seams joined the round path in PR 11: the
+    # two-open-rounds ingest buffer sits on the admission hot path, and
+    # the pipeline worker runs the serve cycle that feeds every dispatch —
+    # a hidden host sync in either stalls the always-on promise exactly
+    # like one in the loop would
+    EXACT = (
+        f"{PACKAGE}/runner/loop.py",
+        f"{PACKAGE}/serve/ingest.py",
+        f"{PACKAGE}/serve/pipeline.py",
+    )
 
     def applies(self, rel: str) -> bool:
         return rel.startswith(self.SCOPE) or rel in self.EXACT

@@ -135,6 +135,7 @@ class FederatedSession:
         merge_policy: str = "sum",
         merge_trim: int = 0,
         quarantine_scope: str = "cohort",
+        stale_slots: int = 0,
     ):
         # client_shards: 0 = derive from the mesh (the default — on a >1-
         # device mesh with a mode in engine.supports_sharded_round's scope
@@ -168,6 +169,9 @@ class FederatedSession:
             merge_policy=merge_policy,
             merge_trim=merge_trim,
             quarantine_scope=quarantine_scope,
+            # buffered-async serving (--serve_async): slot count of the
+            # stale-fold merge variant; 0 keeps the sync programs only
+            stale_slots=stale_slots,
             # CLI "halt" is a host-side policy on top of the compiled "skip"
             # guard (state stays clean either way; the CLI decides to stop)
             on_nonfinite="skip" if on_nonfinite == "halt" else on_nonfinite,
@@ -366,6 +370,7 @@ class FederatedSession:
         self._split = split_compile
         self._payload_client = None
         self._payload_merge = None
+        self._payload_merge_stale = None
         if self._table_round:
             # the per-client-table two-program round: client tables + table
             # merge (engine.make_payload_round_steps). The batch simulator
@@ -381,6 +386,23 @@ class FederatedSession:
             self._payload_client = jax.jit(client_p)
             self._payload_merge = jax.jit(
                 merge_p, donate_argnums=self._state_donation())
+            if self.cfg.stale_slots > 0:
+                # the buffered-async merge variant: the SAME merge with a
+                # stale-fold slot stack appended. Kept beside — never
+                # instead of — the plain program: a round with zero stale
+                # entries dispatches the plain one, which is what pins
+                # async-with-everyone-on-time bitwise == sync. jit is
+                # lazy, so the variant costs nothing until the first
+                # straggler actually folds (one extra compile then —
+                # documented in MIGRATION.md).
+                _, merge_s = engine.make_payload_round_steps(
+                    train_loss_fn, self.cfg,
+                    self.mesh if self._spmd and self.mesh is not None
+                    else None,
+                    allow_batch_tables=True,
+                    stale_slots=self.cfg.stale_slots)
+                self._payload_merge_stale = jax.jit(
+                    merge_s, donate_argnums=self._state_donation())
             self._step = engine.compose_payload(
                 self._payload_client, self._payload_merge)
         elif split_compile:
@@ -791,14 +813,21 @@ class FederatedSession:
             state["quarantine"]["median"]))
 
     def finish_served_payload(self, prep: PreparedRound, arrived,
-                              wire_tables, aux) -> PreparedRound:
+                              wire_tables, aux,
+                              stale=None) -> PreparedRound:
         """Post-close bookkeeping of a served payload round: every invitee
         whose payload missed the merge (no-show, straggler, or a rejected
         frame) gets the client_drop treatment — counted as masked and
         re-queued for a later cohort — and the final PreparedRound carries
         the WIRE-DECODED table stack + arrival mask for dispatch_round. The
         RNG snapshot from assembly stays valid: nothing here consumes host
-        RNG."""
+        RNG.
+
+        `stale` (buffered-async serving): a ([stale_slots, r, c] table
+        stack, [stale_slots] weight vector) host pair of LATE tables the
+        service wants staleness-folded into THIS round's merge — requires
+        a stale_slots > 0 session; None (and all sync paths) dispatches
+        the plain merge program."""
         # host numpy by construction: the arrival mask comes from the
         # assembler, the validity mask from the loader/fault sites
         arrived = np.asarray(arrived, np.float32)  # graftlint: disable=G001
@@ -815,12 +844,18 @@ class FederatedSession:
         if masked:
             obtrace.instant("federated", "cohort_degraded", round=prep.rnd,
                             clients=masked)
+        if stale is not None and self._payload_merge_stale is None:
+            raise ValueError(
+                "finish_served_payload got a stale-fold stack but the "
+                "session was built with stale_slots=0 — arm stale_slots "
+                "(--serve_async wires it) or drop the stale entries")
         return dataclasses.replace(
             prep, masked=masked, requeue_depth=len(self._requeue),
             requeue=tuple(self._requeue),
             requeue_ages=tuple(self._requeue_enqueued.items()),
             # the gauntlet's validated table stack is host numpy already
-            payload=(np.asarray(wire_tables, np.float32), arrived, aux),  # graftlint: disable=G001
+            payload=(np.asarray(wire_tables, np.float32), arrived, aux,  # graftlint: disable=G001
+                     stale),
         )
 
     def _dispatch_payload_merge(self, prep: PreparedRound,
@@ -828,14 +863,25 @@ class FederatedSession:
         """Dispatch the payload round's MERGE program over the wire-decoded
         tables a served round collected (prep.payload). The merge consumes
         the SAME state tree the client program read (carried in aux), so
-        the two programs see one consistent round."""
-        wire_tables, arrived, aux = prep.payload
+        the two programs see one consistent round. A prep carrying a
+        stale-fold stack (buffered-async serving) dispatches the
+        stale-slots merge variant; every other round — including every
+        round of an async run where nobody was late — dispatches the plain
+        program, the async==sync bit-identity's load-bearing routing."""
+        wire_tables, arrived, aux, stale = (
+            prep.payload if len(prep.payload) == 4
+            else (*prep.payload, None))
         state, nstates, mvals, part, noise_rng, lnorms = aux
+        merge, extra = self._payload_merge, ()
+        if stale is not None:
+            merge = self._payload_merge_stale
+            extra = (jnp.asarray(stale[0], jnp.float32),
+                     jnp.asarray(stale[1], jnp.float32))
         with self._mesh_ctx():
-            new_state, metrics = self._payload_merge(
+            new_state, metrics = merge(
                 state, jnp.asarray(wire_tables), nstates, mvals, part,
                 jnp.asarray(arrived, jnp.float32), jnp.float32(lr),
-                noise_rng, lnorms)
+                noise_rng, lnorms, *extra)
         self._head_state = new_state
         self._inflight += 1
         self._inflight_rounds += 1

@@ -175,6 +175,20 @@ class EngineConfig:
     # all layers and cannot be screened per leaf). Fused round paths only
     # (the split program boundary threads one scalar median).
     quarantine_scope: str = "cohort"
+    # Buffered-ASYNC serving (--serve_async, FedBuff-shaped): > 0 sizes the
+    # stale-fold slot stack of the payload MERGE program — late tables
+    # (submissions answering an already-closed round) fold into the merged
+    # wire as an ordered staleness-weighted sum AFTER the live cohort's
+    # ordered sum, inside the ONE declared staleness-fold boundary
+    # (engine._stale_fold, graftlint G013). Count-Sketch linearity makes
+    # the staged fold exact; the weights ((1+lag)^-alpha, computed by the
+    # serving layer as a pure function of round lag) down-weight staleness
+    # FedBuff-style. The parity contract: the session keeps the PLAIN merge
+    # program compiled alongside and dispatches it whenever a round has
+    # ZERO stale entries, so async-with-everyone-on-time runs the exact
+    # sync program — bit-identity by construction, not fp luck. 0 = off
+    # (the stale program is never built).
+    stale_slots: int = 0
 
     def __post_init__(self):
         if self.client_shards < 1:
@@ -289,6 +303,27 @@ class EngineConfig:
                 "screen; with the clip at 0 there is no quarantine to scope "
                 "— set client_update_clip > 0"
             )
+        if self.stale_slots < 0:
+            raise ValueError(
+                f"stale_slots must be >= 0, got {self.stale_slots}"
+            )
+        if self.stale_slots > 0:
+            if not self.wire_payloads:
+                raise ValueError(
+                    "stale_slots (--serve_async) folds LATE WIRE TABLES "
+                    "into the payload merge; without wire_payloads there is "
+                    "no per-client table wire to arrive late — arm "
+                    "--serve_payload sketch"
+                )
+            if robust_policy(self) is not None:
+                raise ValueError(
+                    f"stale_slots with merge_policy={self.merge_policy!r} "
+                    "is unsupported: the robust order statistics run over "
+                    "ONE round's cohort stack, and a staleness-weighted "
+                    "extra fold would bypass them — the two defenses "
+                    "compose at different trust boundaries (see the README "
+                    "always-on section); pick one"
+                )
         if self.dp_noise > 0 and self.dp_clip <= 0:
             raise ValueError("dp_noise > 0 requires dp_clip > 0 (unbounded "
                              "sensitivity has no meaningful noise scale)")
@@ -2049,9 +2084,43 @@ def _apply_adv(tables: jnp.ndarray, adv) -> jnp.ndarray:
     return cloned * scale.astype(tables.dtype)[:, None, None]
 
 
+# graftlint: staleness-fold — THE one sanctioned staleness-weighted fold:
+# late tables join the merged wire HERE and nowhere else (rule G013). A
+# second fold site would be a second, undeclared aggregation semantics —
+# two places that disagree about fold order or weight handling silently
+# un-pin the async==sync bit-identity contract.
+def _stale_fold(table, live_weight, stale_tables, stale_weights):
+    """Ordered staleness-weighted fold of late client tables into a merged
+    wire table (the buffered-async mode's FedBuff-shaped update): slot i
+    adds `stale_weights[i] * stale_tables[i]` in SLOT ORDER — an explicit
+    lax.scan left fold, so the fp association is a pure function of the
+    slot assignment (the serving layer fills slots in (source round asc,
+    cohort position asc, admission order) — deterministic and replayable,
+    never wall-clock). Empty slots carry weight 0 and a zero table.
+    Returns (folded table, live_weight + total stale weight, metrics) —
+    the weight total feeds the same survivor normalization the live
+    cohort uses, so agg_op="mean" becomes the staleness-weighted mean.
+    EVERY piece of arithmetic over the stale stack lives in this one
+    function: a second touch point would be a second, undeclared
+    aggregation semantics (rule G013's whole argument)."""
+
+    def body(carry, xs):
+        tbl, wsum = carry
+        t, w = xs
+        return (tbl + w * t, wsum + w), None
+
+    (folded, total), _ = jax.lax.scan(
+        body, (table, live_weight), (stale_tables, stale_weights))
+    metrics = {
+        "stale_folded": (stale_weights > 0).sum(),
+        "stale_weight": stale_weights.sum(),
+    }
+    return folded, total, metrics
+
+
 def make_payload_round_steps(
     loss_fn: Callable, cfg: EngineConfig, mesh=None, *,
-    allow_batch_tables: bool = False,
+    allow_batch_tables: bool = False, stale_slots: int = 0,
 ) -> tuple[Callable, Callable]:
     """The wire-payload round (cfg.wire_payloads) as TWO jittable programs —
     the shape a serving deployment actually has:
@@ -2112,6 +2181,12 @@ def make_payload_round_steps(
             "make_payload_round_steps requires cfg.wire_payloads=True, a "
             "robust merge_policy, or allow_batch_tables=True (the announce "
             "path compiles make_round_step and friends)"
+        )
+    if stale_slots and robust_policy(cfg) is not None:
+        raise ValueError(
+            "stale_slots composes with the linear sum only (the robust "
+            "order statistics run over one round's cohort stack; "
+            "EngineConfig rejects the combination too)"
         )
     _sharded_scope_check(mcfg)
     if mcfg.mode != "sketch":
@@ -2231,7 +2306,8 @@ def make_payload_round_steps(
             return tables, nstates, metrics, part, noise_rng, lnorms
 
     def merge_step(state, tables, nstates, mvals, part, arrived, lr,
-                   noise_rng, lnorms=None):
+                   noise_rng, lnorms=None, stale_tables=None,
+                   stale_weights=None):
         """The server side: the cfg.merge_policy reduction of the
         (wire-delivered) per-client tables. `part` is the client program's
         validity mask, `arrived` the serving layer's 0/1 admission mask
@@ -2239,7 +2315,20 @@ def make_payload_round_steps(
         zero row under a 0 mask, exactly a dropped client. `lnorms` is the
         client program's [W, L] per-leaf norm stack (layer scope only):
         the per-leaf screens run beside the table-norm screen, and a
-        client over ANY of them drops from the merge bitwise."""
+        client over ANY of them drops from the merge bitwise.
+
+        Compiled with stale_slots > 0 (the buffered-async variant) the
+        signature grows `stale_tables [stale_slots, r, c]` and
+        `stale_weights [stale_slots]`: late tables fold into the merged
+        wire staleness-weighted through engine._stale_fold (the declared
+        G013 boundary), their weight total joining the survivor
+        normalization. The session dispatches THIS program only on rounds
+        that actually have stale entries; zero-stale rounds run the plain
+        program, which is what pins async-with-everyone-on-time bitwise
+        equal to sync. Stale rows were screened at the wire (their source
+        round's gauntlet); they carry no net-state/metric rows — a stale
+        fold contributes its gradient sketch, nothing else (documented in
+        the README always-on section)."""
         part = part * arrived
         part_eff = part
         norms = None
@@ -2266,6 +2355,7 @@ def make_payload_round_steps(
             finite = jnp.isfinite(tables).reshape(
                 tables.shape[0], -1).all(axis=1)
             part_eff = part_eff * finite.astype(part_eff.dtype)
+        stale_metrics = {}
         if pol is None:
             # THE merge: masked per-client tables through the same ordered-
             # sum entry point the sharded mesh round uses (client-index
@@ -2273,8 +2363,17 @@ def make_payload_round_steps(
             # branch — the k=0 == sum bit-identity by construction.
             masked = modes.mask_rows(part_eff, tables)
             wire_sum = modes.merge_partial_wires(mcfg, {"table": masked})
+            total_w = part_eff.sum()
+            if stale_slots:
+                # buffered-async: the late tables' ordered weighted fold
+                # joins AFTER the live cohort's ordered sum (linearity
+                # makes the staging exact), and their weight mass joins
+                # the survivor normalization
+                folded, total_w, stale_metrics = _stale_fold(
+                    wire_sum["table"], total_w, stale_tables, stale_weights)
+                wire_sum = {"table": folded}
             agg = _normalize_merged_wire(mcfg, wire_sum,
-                                         jnp.maximum(part_eff.sum(), 1.0))
+                                         jnp.maximum(total_w, 1.0))
         else:
             # Byzantine-robust merge: coordinate-wise trimmed mean / median
             # over the LIVE client tables (dead rows excluded from the
@@ -2293,6 +2392,7 @@ def make_payload_round_steps(
             jax.tree.map(lambda m: modes.mask_rows(part_eff, m).sum(axis=0),
                          mvals),
             part_eff, state["net_state"])
+        out_metrics.update(stale_metrics)
         new_q = None
         if quarantine:
             out_metrics["clients_quarantined"] = part.sum() - part_eff.sum()

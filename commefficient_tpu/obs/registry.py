@@ -255,6 +255,14 @@ class Registry:
 # desync the endpoint from the loop
 RUNNER_PHASES = ("prepare", "dispatch", "drain", "commit")
 
+# the serving pipeline's per-round stage histograms
+# (serve_stage_<name>_ms): invite = cohort sample + window open, compute =
+# the payload client program + table fetch (payload rounds only), collect =
+# traffic/arrivals + the W-of-N (or buffer-trigger) close, prep = round
+# preparation / payload finish. Shared writer/reader list like
+# RUNNER_PHASES, for the same cannot-silently-desync reason.
+SERVE_STAGES = ("invite", "compute", "collect", "prep")
+
 
 _DEFAULT = Registry()
 

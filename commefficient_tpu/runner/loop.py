@@ -197,6 +197,14 @@ class RunStats:
     # resilience_attack_*_total counters' per-run deltas summed): a chaos
     # run's stats say how much adversarial pressure the merge absorbed
     attacks_injected: int = 0
+    # always-on serving acceptance: the mean/max wall gap between a drain's
+    # commit and the NEXT dispatch — the server idle the pipelined serving
+    # mode exists to close (a pipelined source has the next round prepared
+    # when the drain ends, so the gap collapses to the dispatch call
+    # itself). Also published as the `server_idle_ms` registry gauge (last
+    # observed gap) + `runner_idle_ms` histogram.
+    server_idle_ms: float = 0.0
+    server_idle_ms_max: float = 0.0
 
 
 def make_save_ckpt(session: FederatedSession, checkpoint_dir: str):
@@ -346,6 +354,33 @@ def run_loop(
 
     pending: collections.deque = collections.deque()  # in-flight dispatches
     pending_rounds = 0
+    # serving-layer hook: a pipelined ServedSource gates the NEXT round's
+    # payload client compute on the previous merge being dispatched (the
+    # head-state chaining the bit-parity rests on) — resolved once so the
+    # batch-simulator sources pay one getattr, not one per dispatch
+    on_dispatched = getattr(src, "on_dispatched", None)
+    # server-idle accounting (always-on serving acceptance): the gap from a
+    # drain's commit to the next dispatch — ≈0 when the source has the next
+    # round ready (pipelined), the whole invite/collect/close cycle when it
+    # doesn't (serial served source)
+    idle_hist = reg.histogram("runner_idle_ms")
+    idle_gauge = reg.gauge("server_idle_ms")
+    idle_mark: list = [None]  # [perf_counter at drain end] | [None]
+    idle_acc = [0.0, 0, 0.0]  # sum_ms, n, max_ms
+
+    def note_idle():
+        """Called at each dispatch site BEFORE the dispatch: resolves the
+        commit-to-dispatch gap the last drain opened (first dispatch after
+        a drain only)."""
+        if idle_mark[0] is None:
+            return
+        ms = (time.perf_counter() - idle_mark[0]) * 1e3
+        idle_mark[0] = None
+        idle_hist.observe(ms)
+        idle_gauge.set(ms)
+        idle_acc[0] += ms
+        idle_acc[1] += 1
+        idle_acc[2] = max(idle_acc[2], ms)
     # per-dispatch (trace timestamp, first round, round count): the
     # deferred device-phase spans — resolved at the drain that commits
     # them, never by a mid-round sync (the deferred-metrics discipline)
@@ -437,6 +472,7 @@ def run_loop(
             # the commit that published their round's merged update
             on_committed(session.round)
         now = time.perf_counter()
+        idle_mark[0] = now  # the idle window the next dispatch closes
         per_round = (now - last_drain_t) * 1e3 / max(committed, 1)
         last_drain_t = now
         if first_drain:
@@ -489,6 +525,7 @@ def run_loop(
                             preps = [src.next() for _ in lrs]
                         phase_hist["prepare"].observe(
                             (time.perf_counter() - t_p0) * 1e3)
+                        note_idle()
                         t_d0 = time.perf_counter()
                         t_mark = tracer.now_us()
                         with tracer.span("runner", "dispatch", round=rnd,
@@ -498,6 +535,8 @@ def run_loop(
                         # raising dispatch must not leave a stale mark the
                         # next drain would resolve into a phantom span
                         dispatch_marks.append((t_mark, rnd, len(lrs)))
+                        if on_dispatched is not None:
+                            on_dispatched(rnd + len(lrs) - 1)
                         phase_hist["dispatch"].observe(
                             (time.perf_counter() - t_d0) * 1e3)
                         if len(pending) > 1:
@@ -520,6 +559,7 @@ def run_loop(
                                 prep = src.next()
                             phase_hist["prepare"].observe(
                                 (time.perf_counter() - t_p0) * 1e3)
+                            note_idle()
                             t_d0 = time.perf_counter()
                             t_mark = tracer.now_us()
                             with tracer.span("runner", "dispatch",
@@ -528,6 +568,8 @@ def run_loop(
                                     session.dispatch_round(prep, lr)
                                 )
                             dispatch_marks.append((t_mark, rnd + j, 1))
+                            if on_dispatched is not None:
+                                on_dispatched(rnd + j)
                             phase_hist["dispatch"].observe(
                                 (time.perf_counter() - t_d0) * 1e3)
                             if len(pending) > 1:
@@ -647,6 +689,8 @@ def run_loop(
             f"resilience_attack_{kind[len('client_'):]}_total"))
         for kind in ADVERSARIAL_KINDS)
     stats.max_inflight_used = eff_inflight if async_mode else 0
+    stats.server_idle_ms = idle_acc[0] / max(idle_acc[1], 1)
+    stats.server_idle_ms_max = idle_acc[2]
     reg.gauge("runner_rtt_ms").set(rtt_ms)
     reg.gauge("runner_max_inflight").set(stats.max_inflight_used)
     stats.wall_s = time.perf_counter() - t0

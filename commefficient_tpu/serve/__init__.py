@@ -25,18 +25,28 @@ that inversion over the existing engine/runner machinery:
   payload rounds ship per-invitee tables with wire-fault injection at the
   transport seam
 - `metrics`   — the ops surface: /metrics JSON endpoint (round, queue
-  depth, arrival rate, quarantine/requeue/rejection/shed counters)
+  depth, arrival rate, quarantine/requeue/rejection/shed counters, stage
+  histograms, the server_idle_ms always-on gauge)
+- `pipeline`  — the ALWAYS-ON worker (`--serve_pipeline`): the serve
+  cycle runs one-plus rounds ahead on a double-buffered thread, so round
+  r+1's ingest overlaps round r's merge and the commit-to-dispatch gap
+  collapses; bit-identical to the serial source by construction
 - `service`   — `AggregationService` + `ServedSource`: the service drives
-  `runner.run_loop(source=...)` instead of the loop pulling clients
+  `runner.run_loop(source=...)` instead of the loop pulling clients;
+  `--serve_async` is the buffered FedBuff-shaped mode (buffer-size
+  trigger closes, staleness-weighted folds of late tables)
 
 Both CLIs expose it as `--serve {inproc,socket}` (+ `--serve_quorum`,
 `--serve_deadline`, `--serve_trace`, `--serve_metrics_port`,
-`--serve_payload {announce,sketch}`, `--serve_shed_watermark`).
+`--serve_payload {announce,sketch}`, `--serve_shed_watermark`,
+`--serve_pipeline`, `--serve_async` + `--serve_buffer` /
+`--serve_staleness` / `--serve_stale_rounds`).
 """
 
 from .assembler import ClosedRound, CohortAssembler
 from .ingest import IngestQueue, PayloadPolicy, Submission, validate_payload
 from .metrics import MetricsServer
+from .pipeline import RoundPipeline
 from .service import AggregationService, ServeConfig, ServedSource
 from .traffic import TraceConfig, TrafficGenerator
 from .transport import (
@@ -55,6 +65,7 @@ __all__ = [
     "InProcessTransport",
     "MetricsServer",
     "PayloadPolicy",
+    "RoundPipeline",
     "ServeConfig",
     "ServedSource",
     "SocketTransport",

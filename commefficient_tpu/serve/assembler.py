@@ -18,6 +18,17 @@ Two close disciplines:
 - **wall** (socket transport): block on the ingest queue's condition for
   quorum-or-timeout; arrival ORDER (recv_order) decides the cut. Realistic,
   used by the socket demo path.
+
+Both close forms take the ROUND they close (the ingest queue holds up to
+two concurrently-open windows since the pipelined serving mode landed), so
+a close of round r never disturbs round r+1's still-collecting window.
+
+Buffered-async mode (--serve_async) reuses the same machinery with the
+quorum reinterpreted as the BUFFER-SIZE trigger (`trigger_label="buffer"`
+relabels the close counters) and `collect_stragglers=True`: a payload
+round's stragglers — validated tables that arrived but missed the cut —
+are carried on the ClosedRound so the serving layer can fold them into a
+LATER merge with a staleness weight instead of discarding the work.
 """
 
 from __future__ import annotations
@@ -53,6 +64,12 @@ class ClosedRound:
     # BITWISE a dropped client before the merge even sees it. None on the
     # announce path.
     tables: np.ndarray | None = None
+    # buffered-async mode only (collect_stragglers=True): the validated
+    # tables of invitees who ARRIVED but missed the close cut, as
+    # (cohort_position, client_id, table) in cohort-position order — the
+    # deterministic fold order of the staleness-weighted merge they join
+    # one-or-more rounds later. () on sync paths.
+    straggler_tables: tuple = ()
 
     @property
     def survivors(self) -> int:
@@ -61,7 +78,9 @@ class ClosedRound:
 
 class CohortAssembler:
     def __init__(self, queue: IngestQueue, quorum: int, deadline_s: float,
-                 payload_shape: tuple | None = None):
+                 payload_shape: tuple | None = None,
+                 trigger_label: str = "quorum",
+                 collect_stragglers: bool = False):
         if quorum < 1:
             raise ValueError(f"quorum must be >= 1, got {quorum}")
         self.queue = queue
@@ -70,6 +89,11 @@ class CohortAssembler:
         # (r, c) of the wire-payload tables; None = announce path (closed
         # rounds carry no table stack)
         self.payload_shape = payload_shape
+        # what a count-triggered close is CALLED: "quorum" (W-of-N sync
+        # close) or "buffer" (the async buffer-size trigger) — same cut
+        # arithmetic, different operational meaning in the counters
+        self.trigger_label = trigger_label
+        self.collect_stragglers = collect_stragglers
         # cumulative close counters (metrics endpoint)
         self.rounds_closed = 0
         self.closed_by_quorum = 0
@@ -81,7 +105,7 @@ class CohortAssembler:
         """Close on simulated latencies (see module docstring). The queue's
         accepted arrivals are ranked by (latency, client_id); the quorum-th
         latency — capped at the deadline — is the close."""
-        arrivals = self.queue.close_round()
+        arrivals = self.queue.close_round(rnd)
         invited = np.asarray(invited, np.int64)
         pos = {int(c): i for i, c in enumerate(invited)}
         lat = np.full(len(invited), np.inf)
@@ -95,14 +119,15 @@ class CohortAssembler:
         n_in_time = int(in_time.sum())
         if n_in_time >= self.quorum:
             close = float(lat[order][self.quorum - 1])
-            closed_by = "quorum"
+            closed_by = self.trigger_label
         else:
             close = self.deadline_s
             closed_by = "deadline"
         arrived = (lat <= close).astype(np.float32)
         return self._finish(rnd, invited, arrived, lat, closed_by, close,
                             walls, self._collect_tables(pos, arrivals,
-                                                        arrived, len(invited)))
+                                                        arrived, len(invited)),
+                            self._collect_stragglers(pos, arrivals, arrived))
 
     def close_wall(self, rnd: int, invited) -> ClosedRound:
         """Close on real arrival order: wait for quorum-or-deadline on the
@@ -117,8 +142,8 @@ class CohortAssembler:
         in just because they beat the drain — deciding on the drained list
         would also let a deadline-expired wait flip to closed_by="quorum"
         when late arrivals pile in during the gap."""
-        cut = self.queue.wait_for(self.quorum, self.deadline_s)
-        arrivals = self.queue.close_round()
+        cut = self.queue.wait_for(self.quorum, self.deadline_s, rnd=rnd)
+        arrivals = self.queue.close_round(rnd)
         invited = np.asarray(invited, np.int64)
         pos = {int(c): i for i, c in enumerate(invited)}
         lat = np.full(len(invited), np.inf)
@@ -132,12 +157,14 @@ class CohortAssembler:
         for a in made_cut:
             if int(a.client_id) in pos:
                 arrived[pos[int(a.client_id)]] = 1.0
-        closed_by = "quorum" if len(cut) >= self.quorum else "deadline"
+        closed_by = (self.trigger_label if len(cut) >= self.quorum
+                     else "deadline")
         close = (max((a.latency_s for a in made_cut), default=self.deadline_s)
-                 if closed_by == "quorum" else self.deadline_s)
+                 if closed_by != "deadline" else self.deadline_s)
         return self._finish(rnd, invited, arrived, lat, closed_by, close,
                             walls, self._collect_tables(pos, arrivals,
-                                                        arrived, len(invited)))
+                                                        arrived, len(invited)),
+                            self._collect_stragglers(pos, arrivals, arrived))
 
     def _collect_tables(self, pos, arrivals, arrived,
                         n: int) -> np.ndarray | None:
@@ -155,13 +182,29 @@ class CohortAssembler:
                 out[p] = a.table
         return out
 
+    def _collect_stragglers(self, pos, arrivals, arrived) -> tuple:
+        """Validated tables of invitees who arrived but missed the cut, as
+        (position, client_id, table) in cohort-position order — the
+        buffered-async mode's stale-fold candidates (their compute is not
+        discarded, it folds into a later merge staleness-weighted). ()
+        unless collect_stragglers."""
+        if not self.collect_stragglers or self.payload_shape is None:
+            return ()
+        out = []
+        for a in arrivals:
+            p = pos.get(int(a.client_id))
+            if p is not None and arrived[p] == 0.0 and a.table is not None:
+                out.append((int(p), int(a.client_id), a.table))
+        return tuple(sorted(out, key=lambda e: e[0]))
+
     def _finish(self, rnd, invited, arrived, lat, closed_by,
-                close, walls=None, tables=None) -> ClosedRound:
+                close, walls=None, tables=None,
+                straggler_tables: tuple = ()) -> ClosedRound:
         submitted = np.isfinite(lat)
         stragglers = int((submitted & (arrived == 0.0)).sum())
         no_shows = int((~submitted).sum())
         self.rounds_closed += 1
-        if closed_by == "quorum":
+        if closed_by != "deadline":
             self.closed_by_quorum += 1
         else:
             self.closed_by_deadline += 1
@@ -175,7 +218,7 @@ class CohortAssembler:
             rnd=rnd, invited=invited, arrived=arrived, latencies=lat,
             closed_by=closed_by, close_latency_s=float(close),
             stragglers=stragglers, no_shows=no_shows, wall_ts=walls,
-            tables=tables,
+            tables=tables, straggler_tables=straggler_tables,
         )
 
     def counters(self) -> dict[str, int]:

@@ -5,9 +5,17 @@ updates at an always-on aggregator; the linearity of the Count Sketch makes
 the server-side merge of asynchronously-arriving updates cheap. This module
 is the front door of that inversion: a bounded, thread-safe arrival queue
 with explicit admission decisions — every submission is either ACCEPTED into
-the open round or rejected with a reason the transport echoes back to the
+an open round or rejected with a reason the transport echoes back to the
 client (`QUEUE_FULL` is the backpressure signal a well-behaved client backs
 off on).
+
+Since the always-on pipeline landed the queue holds PER-ROUND WINDOWS (up
+to `max_open_rounds` concurrently open — the pipelined serving mode keeps
+round r+1's invite window open while round r merges), each with its own
+invite list, arrival list, dedup set, and — payload rounds — its own
+quarantine-median snapshot taken when the window opened, so an early
+payload push for round r+1 validates against round r+1's state, never
+round r's.
 
 Admission rules, in check order:
 
@@ -20,16 +28,23 @@ Admission rules, in check order:
   at-least-once client never burns its retry budget on a submission the
   merge will count.
 - ``QUEUE_FULL``   — the bounded queue is at capacity: backpressure.
-- ``OUT_OF_ROUND`` — the submission names a round that is not the open one.
-  Late (already-closed round) is always rejected; EARLY (the round after the
-  open one — or after the last CLOSED one while the server is mid-merge
-  between rounds) is buffered in the bounded pending queue and admitted when
-  that round opens — a pushing client does not resubmit just because the
-  server is mid-merge. With a payload policy armed, early pushes are
-  rejected instead of buffered: a sketch payload is a function of the open
-  round's params, so a table "for the next round" cannot exist yet.
-- ``NOT_INVITED``  — the client is not in the open round's cohort.
-- ``DUPLICATE``    — the client already has an accepted submission this
+- ``OUT_OF_ROUND`` — the submission names a round with no open window.
+  Late (already-closed round) is rejected — unless the queue runs in the
+  buffered-ASYNC band (`stale_rounds > 0`), where a payload submission for
+  a recently-closed round is admitted ``ACCEPTED_STALE`` into the stale
+  buffer (validated against ITS round's retained median snapshot) and
+  folds into a later merge with a staleness weight. EARLY (the round after
+  the newest window ever opened — open or mid-merge) is buffered in the
+  bounded pending queue and admitted when that round opens — a pushing
+  client does not resubmit just because the server is mid-merge. With a
+  payload policy armed, early pushes beyond any OPEN window are rejected
+  instead of buffered: a sketch payload is a function of its round's
+  params, so a table for a round whose window never opened cannot exist
+  yet. (A push for an OPEN round r+1 while r is still merging is not
+  "early" at all — it routes to r+1's window and validates against r+1's
+  median snapshot. That is the pipelined-invite path.)
+- ``NOT_INVITED``  — the client is not in the target round's cohort.
+- ``DUPLICATE``    — the client already has an accepted submission for that
   round (an at-least-once transport may retry; the merge must not double
   count a client).
 
@@ -44,7 +59,11 @@ STALE_SCHEMA, then layout MALFORMED, then QUARANTINED):
   not speak (refuse rather than guess at layout).
 - ``MALFORMED``    — missing payload, undecodable base64, dtype/shape
   mismatch against the server's OWN sketch spec, length-prefix (nbytes)
-  mismatch, or a checksum failure (one flipped bit anywhere rejects).
+  mismatch, a checksum failure (one flipped bit anywhere rejects), or a
+  broken CHUNK SEQUENCE: a table too big for one frame crosses the wire as
+  length-prefixed continuation frames (sketch/payload.py), and the
+  reassembly happens HERE, inside the same boundary — a partial, reordered,
+  or duplicated sequence is MALFORMED, never a guess.
 - ``QUARANTINED``  — the decoded table is non-finite, or its sketch-space
   L2 norm exceeds the quarantine multiple of the running median (the PR 4
   screen, applied at the wire): a poisoned payload is dropped BEFORE the
@@ -79,7 +98,7 @@ import numpy as np
 
 from ..obs import registry as obreg
 from ..obs import trace as obtrace
-from ..sketch.payload import SCHEMA_VERSION, WIRE_DTYPE
+from ..sketch.payload import MAX_CHUNKS, SCHEMA_VERSION, WIRE_DTYPE
 
 # rejection reasons (wire-visible: the socket transport echoes them)
 ACCEPTED = "ACCEPTED"
@@ -89,6 +108,9 @@ OUT_OF_ROUND = "OUT_OF_ROUND"
 NOT_INVITED = "NOT_INVITED"
 DUPLICATE = "DUPLICATE"
 BUFFERED = "BUFFERED"  # early submission parked for the next round
+# buffered-async mode: a late payload for a recently-closed round, admitted
+# into the stale buffer for a staleness-weighted fold (FedBuff-shaped)
+ACCEPTED_STALE = "ACCEPTED_STALE"
 # wire-payload gauntlet + overload decisions (see module docstring)
 MALFORMED = "MALFORMED"
 STALE_SCHEMA = "STALE_SCHEMA"
@@ -103,6 +125,7 @@ _REJECTION_COUNTERS = {
     STALE_SCHEMA: "serve_rejected_stale_schema_total",
     QUARANTINED: "serve_rejected_quarantined_total",
     SHEDDING: "serve_shed_total",
+    ACCEPTED_STALE: "serve_stale_admitted_total",
 }
 
 
@@ -115,8 +138,9 @@ class Submission:
     `payload_bytes` sizes the (simulated) sketch blob for wire accounting.
     `payload` is the wire payload of a sketch-carrying submission
     (--serve_payload sketch): a raw [r, c] float32 ndarray on the in-process
-    transport, a frame dict (sketch/payload.py encode_frame) off the socket
-    wire — None on the announce path."""
+    transport, a frame dict (sketch/payload.py encode_frame) — or a LIST of
+    continuation frames for a chunked table — off the socket wire; None on
+    the announce path."""
 
     client_id: int
     round: int
@@ -141,6 +165,20 @@ class Arrival:
 
 
 @dataclasses.dataclass(frozen=True)
+class StaleArrival:
+    """A late-but-admitted payload submission (buffered-async band): the
+    validated table plus the SOURCE round it answered — the staleness
+    weight at fold time is a pure function of (merge round - round)."""
+
+    round: int
+    client_id: int
+    latency_s: float
+    recv_order: int
+    wall_t: float
+    table: Any
+
+
+@dataclasses.dataclass(frozen=True)
 class PayloadPolicy:
     """What the server demands of a wire payload (--serve_payload sketch):
     its OWN sketch spec's shape, and the PR 4 quarantine screen applied at
@@ -160,6 +198,47 @@ class PayloadPolicy:
         return self.rows * self.cols * 4  # float32 wire dtype
 
 
+def _reassemble_chunks(payload):
+    """Chunk-sequence reassembly — part of the G011 boundary, first stage of
+    validate_payload for a list payload. Returns (frame_dict, None, None) on
+    success — a synthetic single frame carrying the header fields of chunk 0
+    and the concatenated data — or (None, MALFORMED, detail): a partial,
+    reordered, duplicated, oversized, or schema-mixed sequence never
+    reaches the layout checks."""
+    if len(payload) == 0:
+        return None, MALFORMED, "empty chunk sequence"
+    if len(payload) > MAX_CHUNKS:
+        return None, MALFORMED, (
+            f"{len(payload)} chunks > MAX_CHUNKS {MAX_CHUNKS}")
+    if not all(isinstance(f, dict) for f in payload):
+        return None, MALFORMED, "chunk sequence with a non-frame entry"
+    head = payload[0]
+    try:
+        total = int(head["total"])
+        seqs = [int(f["seq"]) for f in payload]
+        schemas = {int(f["schema"]) for f in payload}
+    except (KeyError, TypeError, ValueError):
+        return None, MALFORMED, "chunk missing/bad seq/total/schema field"
+    if len(schemas) != 1:
+        return None, MALFORMED, "chunk sequence mixes schema versions"
+    if total != len(payload):
+        return None, MALFORMED, (
+            f"partial chunk sequence: {len(payload)} of {total} frames")
+    if seqs != list(range(total)):
+        return None, MALFORMED, (
+            f"chunk sequence out of order or duplicated: {seqs}")
+    if any(int(f.get("total", total)) != total for f in payload):
+        return None, MALFORMED, "chunk frames disagree about total"
+    try:
+        data = "".join(str(f["data"]) for f in payload)
+    except (KeyError, TypeError):
+        return None, MALFORMED, "chunk missing data field"
+    merged = dict(head)
+    merged["data"] = data
+    merged["seq"], merged["total"] = 0, 1
+    return merged, None, None
+
+
 # graftlint: payload-boundary — THE sanctioned decode of untrusted wire
 # bytes; every transport payload passes through here before compiled scope
 def validate_payload(payload, policy: PayloadPolicy,
@@ -173,8 +252,11 @@ def validate_payload(payload, policy: PayloadPolicy,
     Check order (first failure wins — a frame with several defects reports
     the EARLIEST stage, so an unknown-schema frame with a bad checksum is
     STALE_SCHEMA, never MALFORMED):
-      MALFORMED     structural: missing payload / not a frame dict or array
-                    / missing or unparseable schema field
+      MALFORMED     structural: missing payload / not a frame dict, chunk
+                    list, or array / missing or unparseable schema field /
+                    a broken chunk sequence (partial, reordered,
+                    duplicated, schema-mixed — reassembly happens HERE,
+                    inside the boundary, never in the transport)
       STALE_SCHEMA  the frame names a wire schema version this server does
                     not speak — refused BEFORE any layout field is trusted
                     (an unknown schema means the layout checks below would
@@ -189,7 +271,8 @@ def validate_payload(payload, policy: PayloadPolicy,
 
     The in-process transport passes raw ndarrays (no frame to decode — the
     dtype/shape and quarantine screens still apply); the socket transport
-    passes the frame dict its wire carried."""
+    passes the frame dict its wire carried, or the LIST of continuation
+    frames of a chunked table (schema >= 2) in receive order."""
     if payload is None:
         return None, MALFORMED, "no payload on a sketch-payload round"
     if isinstance(payload, np.ndarray):
@@ -200,6 +283,10 @@ def validate_payload(payload, policy: PayloadPolicy,
             return None, MALFORMED, (
                 f"shape {t.shape} != ({policy.rows}, {policy.cols})")
         return _screen_table(np.ascontiguousarray(t), policy, median)
+    if isinstance(payload, (list, tuple)):
+        payload, decision, detail = _reassemble_chunks(list(payload))
+        if decision is not None:
+            return None, decision, detail
     if not isinstance(payload, dict):
         return None, MALFORMED, f"payload is {type(payload).__name__}"
     try:
@@ -209,6 +296,15 @@ def validate_payload(payload, policy: PayloadPolicy,
     if schema != SCHEMA_VERSION:
         return None, STALE_SCHEMA, (
             f"schema {schema}, server speaks {SCHEMA_VERSION}")
+    try:
+        if int(payload.get("total", 1)) != 1 or int(payload.get("seq", 0)):
+            # a single-frame submission claiming to be mid-sequence: the
+            # transport failed to collect its siblings
+            return None, MALFORMED, (
+                f"partial chunk sequence: frame {payload.get('seq')} of "
+                f"{payload.get('total')}")
+    except (TypeError, ValueError):
+        return None, MALFORMED, "bad seq/total field"
     if payload.get("dtype") != WIRE_DTYPE:
         return None, MALFORMED, f"dtype {payload.get('dtype')!r} != {WIRE_DTYPE}"
     if list(payload.get("shape", ())) != [policy.rows, policy.cols]:
@@ -253,50 +349,93 @@ def _screen_table(t: np.ndarray, policy: PayloadPolicy,
     return t, ACCEPTED, ""
 
 
+class _Window:
+    """One round's open invite window: invite map, arrivals, dedup set, and
+    the round's quarantine-median snapshot (payload rounds) — per-ROUND so
+    two concurrently-open rounds never screen against each other's
+    baseline."""
+
+    __slots__ = ("invited", "arrivals", "seen", "median")
+
+    def __init__(self, invited: dict[int, int], median: float):
+        self.invited = invited
+        self.arrivals: list[Arrival] = []
+        self.seen: set[int] = set()
+        self.median = median
+
+
 class IngestQueue:
-    """Bounded arrival queue for ONE open round plus a bounded pending
-    buffer of early submissions. Thread-safe: transports submit from their
-    own threads; the assembler consumes under the same lock."""
+    """Bounded arrival queue over up to `max_open_rounds` concurrently-open
+    per-round windows, plus a bounded pending buffer of early submissions
+    (and, in buffered-async mode, a bounded stale buffer of late payload
+    submissions). Thread-safe: transports submit from their own threads;
+    the assembler consumes under the same lock.
+
+    `stale_rounds > 0` arms the ASYNC admission band: a payload submission
+    for a closed round at most `stale_rounds` behind the newest window is
+    ACCEPTED_STALE into the stale buffer — validated against ITS OWN
+    round's retained median snapshot and invite list — instead of bouncing
+    OUT_OF_ROUND; the serving layer drains the buffer into staleness-
+    weighted merge folds. 0 (default) keeps the synchronous behavior
+    bit-for-bit."""
 
     def __init__(self, capacity: int = 1024, pending_capacity: int = 256,
                  payload_policy: PayloadPolicy | None = None,
                  shed_watermark: float = 0.0,
-                 shed_retry_after_s: float = 1.0):
+                 shed_retry_after_s: float = 1.0,
+                 max_open_rounds: int = 2,
+                 stale_rounds: int = 0,
+                 stale_capacity: int = 256):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if max_open_rounds < 1:
+            raise ValueError(
+                f"max_open_rounds must be >= 1, got {max_open_rounds}")
+        if stale_rounds < 0:
+            raise ValueError(
+                f"stale_rounds must be >= 0, got {stale_rounds}")
         if not 0.0 <= shed_watermark <= 1.0:
             raise ValueError(
                 f"shed_watermark must be in [0, 1] (a fraction of total "
                 f"queue capacity; 0 = shedding off), got {shed_watermark}")
         self.capacity = capacity
         self.pending_capacity = max(pending_capacity, 0)
+        self.max_open_rounds = max_open_rounds
         # wire-payload gauntlet (None = announce path: payloads ignored)
         self.payload_policy = payload_policy
-        # load shedding: depth at/past this fraction of TOTAL capacity
-        # (arrivals + pending) turns submissions away BEFORE any other
-        # work, with a retry-after hint — overload degrades gracefully
-        # instead of queuing unboundedly. 0 = off (QUEUE_FULL only).
+        # buffered-async admission band (see class docstring); the stale
+        # capacity exists only when the band does, so a sync queue's depth
+        # arithmetic (and shed watermark) is unchanged by the knob's default
+        self.stale_rounds = stale_rounds
+        self.stale_capacity = max(stale_capacity, 0) if stale_rounds else 0
+        # load shedding: depth at/past this fraction of TOTAL capacity —
+        # everything depth() counts: one window's arrivals + pending +
+        # (async) the stale band — turns submissions away BEFORE any other
+        # work, with a retry-after hint, so overload degrades gracefully
+        # instead of queuing unboundedly. 0 = off (QUEUE_FULL only). With
+        # two windows open (pipelined invites) the combined arrivals can
+        # reach the watermark sooner — the deliberately conservative side
+        # for a pressure valve.
         self._shed_depth = (
-            max(int(shed_watermark * (capacity + max(pending_capacity, 0))),
-                1)
+            max(int(shed_watermark * (capacity + self.pending_capacity
+                                      + self.stale_capacity)), 1)
             if shed_watermark > 0 else 0)
         self.shed_retry_after_s = shed_retry_after_s
-        # the open round's quarantine-median snapshot (taken at open_round,
-        # host float): every payload in a round screens against the same
-        # baseline, and no submission pays a device fetch under the lock
-        self._round_median = 0.0
         self._cv = threading.Condition()
-        self._open_round: int | None = None
-        # the round an early push may target while NO round is open (the
-        # server is mid-merge between close_round(r) and open_round(r+1)):
-        # a client must not have to resubmit just because it raced the merge
-        self._next_round: int | None = None
-        self._invited: dict[int, int] = {}  # client_id -> cohort position
-        self._arrivals: list[Arrival] = []
-        self._seen: set[int] = set()
+        # open windows, keyed by round (at most max_open_rounds entries)
+        self._windows: dict[int, _Window] = {}
+        # the newest round ever opened; the pending buffer targets
+        # _newest + 1 (the round a client may push early for — whether the
+        # newest window is still open or the server is mid-merge)
+        self._newest: int | None = None
+        # recently-CLOSED rounds' (median, invited, seen) retained for the
+        # stale band: a late payload validates against the state its round
+        # actually had. Pruned to the band on every open.
+        self._recent: dict[int, tuple[float, dict[int, int], set[int]]] = {}
+        self._stale: list[StaleArrival] = []
         self._closed = False
-        # early submissions for round open+1: (client_id, latency_s) in
-        # arrival order, deduped; drained into arrivals at the next open
+        # early submissions for round _newest + 1: (client_id, latency_s)
+        # in arrival order, deduped; drained into the window at its open
         self._pending: list[tuple[int, float]] = []
         self._recv_counter = 0
         # optional accept hook (the service feeds its arrival-rate window);
@@ -306,6 +445,7 @@ class IngestQueue:
         # cumulative admission counters (metrics endpoint)
         self.accepted = 0
         self.buffered = 0
+        self.accepted_stale = 0
         self.rejected_full = 0
         self.rejected_dup = 0
         self.rejected_out_of_round = 0
@@ -319,75 +459,118 @@ class IngestQueue:
 
     def note_wire_malformed(self) -> None:
         """Count a MALFORMED rejection the TRANSPORT decided (oversized
-        frame, unparseable line) — it never reaches submit(), but the
-        /metrics submissions block must still see it, or an operator
-        watching rejected_malformed concludes a byte-flood isn't
-        happening."""
+        frame, unparseable line, a chunk sequence cut off by a dead
+        connection) — it never reaches submit(), but the /metrics
+        submissions block must still see it, or an operator watching
+        rejected_malformed concludes a byte-flood isn't happening."""
         with self._cv:
             self.rejected_malformed += 1
 
     # -- round lifecycle (assembler side) ------------------------------------
 
     def open_round(self, rnd: int, invited_ids) -> None:
-        """Open round `rnd` for the given cohort. Pending early submissions
-        from invited clients are admitted immediately (recv order preserved);
-        pending entries from clients NOT in this cohort stay parked for the
-        round after (they pushed for "whatever opens next")."""
+        """Open round `rnd`'s window for the given cohort — alongside any
+        window already open, up to `max_open_rounds` (the pipelined serving
+        mode opens r+1 while r is still merging; a third concurrent window
+        is a caller bug and raises). Pending early submissions from invited
+        clients are admitted immediately (recv order preserved); pending
+        entries from clients NOT in this cohort stay parked for the round
+        after (they pushed for "whatever opens next")."""
         # snapshot the quarantine median BEFORE taking the lock: the read
         # may sync from device (quarantine_median_host), and the baseline
         # is constant for the whole round anyway (server state only
-        # advances at the merge)
+        # advances at the merge) — per-ROUND: each window keeps its own
         median = 0.0
         p = self.payload_policy
         if (p is not None and p.clip_multiple > 0
                 and p.quarantine_median is not None):
             median = float(p.quarantine_median())
         with self._cv:
-            self._round_median = median
             if self._closed:
                 raise RuntimeError("IngestQueue is closed")
-            self._open_round = rnd
-            self._next_round = rnd + 1
-            self._invited = {int(c): i for i, c in enumerate(invited_ids)}
-            self._arrivals = []
-            self._seen = set()
+            if rnd in self._windows:
+                raise RuntimeError(f"round {rnd} is already open")
+            if len(self._windows) >= self.max_open_rounds:
+                raise RuntimeError(
+                    f"open_round({rnd}): {len(self._windows)} window(s) "
+                    f"already open ({sorted(self._windows)}), "
+                    f"max_open_rounds={self.max_open_rounds} — close one "
+                    "first (the pipeline depth is bounded by design)")
+            win = _Window({int(c): i for i, c in enumerate(invited_ids)},
+                          median)
+            self._windows[rnd] = win
+            self._newest = rnd if self._newest is None else max(
+                self._newest, rnd)
+            # the stale band moves with the newest window: prune retained
+            # closed-round state (and parked stale entries can no longer
+            # grow for pruned rounds; already-parked ones are drained by
+            # the service's fold cadence, which enforces the same band)
+            if self.stale_rounds:
+                low = self._newest - self.stale_rounds
+                for r in [r for r in self._recent if r < low]:
+                    del self._recent[r]
+            else:
+                self._recent.clear()
             still_pending: list[tuple[int, float]] = []
             for cid, latency in self._pending:
-                if cid in self._invited and cid not in self._seen:
-                    self._admit(cid, latency)
+                if cid in win.invited and cid not in win.seen:
+                    self._admit(win, cid, latency)
                 else:
                     still_pending.append((cid, latency))
             self._pending = still_pending
             self._cv.notify_all()
 
-    def close_round(self) -> list[Arrival]:
-        """Close the open round and return its arrivals (submission-order).
-        Subsequent submissions naming the closed round are OUT_OF_ROUND."""
+    def close_round(self, rnd: int | None = None) -> list[Arrival]:
+        """Close one open window — `rnd` names it; None closes the OLDEST
+        open round (the single-window callers' historical behavior) — and
+        return its arrivals (submission order). Subsequent submissions
+        naming the closed round are OUT_OF_ROUND (or ACCEPTED_STALE inside
+        the async band)."""
         with self._cv:
-            out = list(self._arrivals)
-            self._open_round = None
-            self._invited = {}
-            self._arrivals = []
-            self._seen = set()
-            return out
+            if rnd is None:
+                if not self._windows:
+                    return []
+                rnd = min(self._windows)
+            win = self._windows.pop(rnd, None)
+            if win is None:
+                return []
+            if self.stale_rounds:
+                # retain the round's screen state for the stale band: a
+                # late payload validates against ITS round's median, and
+                # NOT_INVITED / DUPLICATE still mean what they meant
+                self._recent[rnd] = (win.median, win.invited, win.seen)
+            return list(win.arrivals)
 
-    def arrivals(self) -> list[Arrival]:
-        """Snapshot of the open round's arrivals so far."""
+    def arrivals(self, rnd: int | None = None) -> list[Arrival]:
+        """Snapshot of an open round's arrivals so far (None = oldest)."""
         with self._cv:
-            return list(self._arrivals)
+            win = self._window(rnd)
+            return list(win.arrivals) if win is not None else []
+
+    def _window(self, rnd: int | None) -> _Window | None:
+        if rnd is not None:
+            return self._windows.get(rnd)
+        if not self._windows:
+            return None
+        return self._windows[min(self._windows)]
 
     # graftlint: drain-point — the serving queue's sanctioned wait: the
     # assembler blocks HERE (wall-clock transports) for quorum or deadline
-    def wait_for(self, count: int, timeout_s: float) -> list[Arrival]:
-        """Block until >= `count` arrivals or `timeout_s` elapses; return
-        the arrival snapshot. Wall-clock close for the socket transport —
-        the in-process path closes on virtual latencies instead."""
+    def wait_for(self, count: int, timeout_s: float,
+                 rnd: int | None = None) -> list[Arrival]:
+        """Block until >= `count` arrivals in round `rnd`'s window (None =
+        oldest open) or `timeout_s` elapses; return the arrival snapshot.
+        Wall-clock close for the socket transport — the in-process path
+        closes on virtual latencies instead."""
         with self._cv:
-            self._cv.wait_for(
-                lambda: len(self._arrivals) >= count or self._closed,
-                timeout=timeout_s,
-            )
-            return list(self._arrivals)
+            def ready():
+                win = self._window(rnd)
+                return (self._closed
+                        or (win is not None and len(win.arrivals) >= count))
+
+            self._cv.wait_for(ready, timeout=timeout_s)
+            win = self._window(rnd)
+            return list(win.arrivals) if win is not None else []
 
     def shutdown(self) -> None:
         with self._cv:
@@ -398,14 +581,16 @@ class IngestQueue:
 
     def submit(self, sub: Submission) -> str:
         """Admission decision for one submission (see module docstring for
-        the rule order). Returns ACCEPTED/BUFFERED or a rejection reason.
-        Every decision is a trace instant on the serve-ingest track, linked
-        to the later merge span by the `submission` id (r<round>/c<cid>)."""
+        the rule order). Returns ACCEPTED/BUFFERED/ACCEPTED_STALE or a
+        rejection reason. Every decision is a trace instant on the
+        serve-ingest track, linked to the later merge span by the
+        `submission` id (r<round>/c<cid>)."""
         status = self._decide(sub)
         counter = _REJECTION_COUNTERS.get(status)
         if counter is not None:
-            # wire-facing rejection: a process-wide resilience counter the
-            # chaos acceptance reads, alongside the admission counter
+            # wire-facing rejection (or stale admission): a process-wide
+            # resilience counter the chaos acceptance reads, alongside the
+            # admission counter
             obreg.default().counter(counter).inc()
         if obtrace.get().enabled:
             # guard BEFORE building args: this is the admission hot path
@@ -421,23 +606,25 @@ class IngestQueue:
     def _decide(self, sub: Submission) -> str:
         cid = int(sub.client_id)
         with self._cv:
-            status = self._precheck(sub, cid)
+            status, stale_median = self._precheck(sub, cid)
             if status is not None:
                 return status
+            win = self._windows.get(sub.round)
             if self.payload_policy is None:
                 # announce path: nothing left to validate — admit under the
                 # same lock hold (the 1e5/s ingest-bench hot path)
-                self._admit(cid, float(sub.latency_s))
+                self._admit(win, cid, float(sub.latency_s))
                 self._cv.notify_all()
                 return ACCEPTED
-            median = self._round_median
+            median = win.median if win is not None else stale_median
         # the validation gauntlet runs OUTSIDE the lock: base64 + crc32 +
         # ndarray work over up-to-max-frame bytes is CPU-bound, and the
         # per-connection threads must not serialize behind the one condvar
         # the assembler's wait_for also lives on. The screen threshold is
-        # the round's SNAPSHOT median (taken at open_round): every payload
-        # in a round is judged against the same baseline no matter how its
-        # arrival races the merge — and no device fetch under the lock.
+        # the TARGET ROUND's snapshot median (taken at its open_round):
+        # every payload answering a round is judged against that round's
+        # baseline no matter how its arrival races the merge — and no
+        # device fetch under the lock.
         table, decision, detail = validate_payload(
             sub.payload, self.payload_policy, median=median)
         if decision != ACCEPTED:
@@ -457,95 +644,185 @@ class IngestQueue:
             if self._closed:
                 self.rejected_closed += 1
                 return CLOSED
-            if self._open_round is None or sub.round != self._open_round:
-                self.rejected_out_of_round += 1
-                return OUT_OF_ROUND
-            if cid in self._seen:
+            win = self._windows.get(sub.round)
+            if win is None:
+                # the window closed mid-decode: the stale band may still
+                # take it (the same re-check _precheck ran, post-decode)
+                return self._admit_stale(sub, cid, table)
+            if cid in win.seen:
                 self.rejected_dup += 1
                 return DUPLICATE
-            if len(self._arrivals) >= self.capacity:
+            if len(win.arrivals) >= self.capacity:
                 self.rejected_full += 1
                 return QUEUE_FULL
-            self._admit(cid, float(sub.latency_s), table)
+            self._admit(win, cid, float(sub.latency_s), table)
             self._cv.notify_all()
             return ACCEPTED
 
-    def _precheck(self, sub: Submission, cid: int) -> str | None:
+    def _precheck(self, sub: Submission,
+                  cid: int) -> tuple[str | None, float]:
         """Everything before the payload gauntlet — cheap O(1) set/dict
-        probes, lock held. Returns a decision, or None when the submission
-        is admissible so far (the caller then runs the gauntlet, or admits
-        directly on the announce path)."""
+        probes, lock held. Returns (decision, stale_median): decision None
+        when the submission is admissible so far (the caller then runs the
+        gauntlet, or admits directly on the announce path); stale_median is
+        the target round's retained screen baseline when the submission is
+        a stale-band candidate (its window already closed)."""
         if self._closed:
             self.rejected_closed += 1
-            return CLOSED
-        if (self._shed_depth
-                and len(self._arrivals) + len(self._pending)
-                >= self._shed_depth):
-            if (self._open_round is not None
-                    and sub.round == self._open_round
-                    and cid in self._seen):
+            return CLOSED, 0.0
+        if (self._shed_depth and self.depth_locked() >= self._shed_depth):
+            win = self._windows.get(sub.round)
+            recent = self._recent.get(sub.round)
+            if ((win is not None and cid in win.seen)
+                    or (recent is not None and cid in recent[2])):
                 # at-least-once under overload: a retry of an ALREADY
-                # ADMITTED submission must hear DUPLICATE (== success, the
-                # reply was lost), not SHEDDING — otherwise the client
-                # burns its whole retry budget on a submission the merge
-                # will count. An O(1) probe, so the shed path stays
-                # flood-cheap.
+                # ADMITTED submission — into the open window OR the stale
+                # band — must hear DUPLICATE (== success, the reply was
+                # lost), not SHEDDING — otherwise the client burns its
+                # whole retry budget on a submission the merge will
+                # count. O(1) probes, so the shed path stays flood-cheap.
                 self.rejected_dup += 1
-                return DUPLICATE
+                return DUPLICATE, 0.0
             # overload: turn the submission away BEFORE any other work
             # (no invite lookup, no payload decode — the whole point is
             # bounding the per-rejection cost under a flood)
             self.shed += 1
-            return SHEDDING
-        if self._open_round is None or sub.round != self._open_round:
-            if (self._next_round is not None
-                    and sub.round == self._next_round
+            return SHEDDING, 0.0
+        win = self._windows.get(sub.round)
+        if win is None:
+            if (self._newest is not None and sub.round == self._newest + 1
                     and self.payload_policy is None):
-                # early push for the next round: park it, bounded
-                # (dup before full: a retry of an already-parked push is
-                # a DUPLICATE even when the buffer has no room left)
+                # early push for the round after the newest window (open
+                # or mid-merge): park it, bounded (dup before full: a
+                # retry of an already-parked push is a DUPLICATE even
+                # when the buffer has no room left)
                 if any(c == cid for c, _ in self._pending):
                     self.rejected_dup += 1
-                    return DUPLICATE
+                    return DUPLICATE, 0.0
                 if len(self._pending) >= self.pending_capacity:
                     self.rejected_full += 1
-                    return QUEUE_FULL
+                    return QUEUE_FULL, 0.0
                 self._pending.append((cid, float(sub.latency_s)))
                 self.buffered += 1
-                return BUFFERED
+                return BUFFERED, 0.0
+            # LATE: the async band admits a payload for a recently-closed
+            # round into the stale buffer (invite/dedup checked against
+            # that round's retained state); everything else bounces
+            recent = (self._recent.get(sub.round)
+                      if self.payload_policy is not None else None)
+            if recent is not None:
+                _, invited, seen = recent
+                if cid not in invited:
+                    self.rejected_uninvited += 1
+                    return NOT_INVITED, 0.0
+                if cid in seen:
+                    self.rejected_dup += 1
+                    return DUPLICATE, 0.0
+                if len(self._stale) >= self.stale_capacity:
+                    self.rejected_full += 1
+                    return QUEUE_FULL, 0.0
+                # admissible into the stale band: gauntlet next, against
+                # the round's retained median
+                return None, recent[0]
             self.rejected_out_of_round += 1
-            return OUT_OF_ROUND
-        if cid not in self._invited:
+            return OUT_OF_ROUND, 0.0
+        if cid not in win.invited:
             self.rejected_uninvited += 1
-            return NOT_INVITED
-        if cid in self._seen:
+            return NOT_INVITED, 0.0
+        if cid in win.seen:
             self.rejected_dup += 1
-            return DUPLICATE
-        if len(self._arrivals) >= self.capacity:
+            return DUPLICATE, 0.0
+        if len(win.arrivals) >= self.capacity:
             self.rejected_full += 1
-            return QUEUE_FULL
+            return QUEUE_FULL, 0.0
         # admissible so far: the payload path now runs the gauntlet (lock
         # released) and re-checks; the announce path admits immediately
-        return None
+        return None, 0.0
 
-    def _admit(self, cid: int, latency_s: float, table=None) -> None:
-        """Record an accepted arrival (lock held)."""
-        self._arrivals.append(
+    def _admit_stale(self, sub: Submission, cid: int, table) -> str:
+        """Post-gauntlet admission into the stale buffer (lock held) — the
+        same re-checks _precheck ran, because the world may have moved
+        while this thread decoded."""
+        recent = self._recent.get(sub.round)
+        if recent is None:
+            self.rejected_out_of_round += 1
+            return OUT_OF_ROUND
+        _, invited, seen = recent
+        if cid not in invited:
+            self.rejected_uninvited += 1
+            return NOT_INVITED
+        if cid in seen:
+            self.rejected_dup += 1
+            return DUPLICATE
+        if len(self._stale) >= self.stale_capacity:
+            self.rejected_full += 1
+            return QUEUE_FULL
+        seen.add(cid)
+        self._stale.append(StaleArrival(
+            int(sub.round), cid, float(sub.latency_s), self._recv_counter,
+            time.perf_counter(), table))
+        self._recv_counter += 1
+        self.accepted_stale += 1
+        self._cv.notify_all()
+        return ACCEPTED_STALE
+
+    def _admit(self, win: _Window, cid: int, latency_s: float,
+               table=None) -> None:
+        """Record an accepted arrival into a window (lock held)."""
+        win.arrivals.append(
             Arrival(cid, latency_s, self._recv_counter, time.perf_counter(),
                     table))
         self._recv_counter += 1
-        self._seen.add(cid)
+        win.seen.add(cid)
         self.accepted += 1
         if self.on_accept is not None:
             self.on_accept(1)
 
+    def drain_stale(self) -> list[StaleArrival]:
+        """Hand the parked stale submissions to the serving layer (which
+        folds them into the next merge with their staleness weights) and
+        clear the buffer."""
+        with self._cv:
+            out = self._stale
+            self._stale = []
+            return out
+
+    def prune_stale(self, rnd: int) -> int:
+        """Drop parked stale entries AND retained closed-round band state
+        for rounds >= `rnd` — the rewind discipline's queue half: a round
+        the runner never committed will be RE-served, and its pre-rewind
+        stale arrivals (or its stale dedup/median state) must not survive
+        into the replay, or the same client's table could merge twice.
+        The early-push high-water mark rewinds with it, so the replayed
+        timeline's BUFFERED/OUT_OF_ROUND verdicts (and the stale band's
+        lower edge) match the original run's round for round. Returns how
+        many parked entries were dropped."""
+        with self._cv:
+            before = len(self._stale)
+            self._stale = [s for s in self._stale if s.round < rnd]
+            for r in [r for r in self._recent if r >= rnd]:
+                del self._recent[r]
+            if self._newest is not None and self._newest >= rnd:
+                self._newest = rnd - 1 if rnd > 0 else None
+            return before - len(self._stale)
+
     # -- introspection --------------------------------------------------------
 
+    def depth_locked(self) -> int:
+        return (sum(len(w.arrivals) for w in self._windows.values())
+                + len(self._pending) + len(self._stale))
+
     def depth(self) -> int:
-        """Open-round arrivals + parked early submissions (the 'queue
-        depth' the metrics endpoint reports)."""
+        """Arrivals across every open window + parked early submissions +
+        parked stale submissions (the 'queue depth' the metrics endpoint
+        reports)."""
         with self._cv:
-            return len(self._arrivals) + len(self._pending)
+            return self.depth_locked()
+
+    def open_rounds(self) -> list[int]:
+        """The rounds with an open window, oldest first."""
+        with self._cv:
+            return sorted(self._windows)
 
     def pending_snapshot(self) -> list[tuple[int, float]]:
         """Checkpointable view of the early-submission buffer."""
@@ -562,6 +839,7 @@ class IngestQueue:
             return {
                 "accepted": self.accepted,
                 "buffered": self.buffered,
+                "accepted_stale": self.accepted_stale,
                 "rejected_full": self.rejected_full,
                 "rejected_dup": self.rejected_dup,
                 "rejected_out_of_round": self.rejected_out_of_round,

@@ -23,6 +23,17 @@ a loopback operator surface, the moral equivalent of a /healthz):
     round_phase_ms         per-phase round wall-clock {p50, p99, count} for
                            prepare/dispatch/drain/commit (obs registry
                            `runner_phase_*_ms` histograms)
+    serve_stage_ms         the serving pipeline's own stages — invite /
+                           compute / collect / prep (obs registry
+                           `serve_stage_*_ms`; written by serve_round,
+                           on the always-on worker when --serve_pipeline)
+    server_idle_ms         last commit-to-next-dispatch gap the runner
+                           measured (the always-on acceptance gauge:
+                           ~0 pipelined, the whole serve cycle serial)
+    pipeline / async       which always-on modes are armed
+    stale                  buffered-async posture + counters (trigger
+                           size, staleness alpha, band width; admitted /
+                           folded / dropped stale tables) — null in sync
 
 The rate/latency/phase numbers all come from the obs registry — the
 process-wide single source of truth the runner and serving layers write to

@@ -1,0 +1,185 @@
+"""The always-on serving pipeline: round preparation runs AHEAD of the merge.
+
+The serial served loop leaves the server idle between a round's commit and
+the next dispatch: `ServedSource.next()` runs the whole
+invite -> collect -> close -> prep cycle inline on the dispatch thread, so
+the device waits out every virtual close, every socket deadline, every
+batch assembly. At millions of clients that dead time — not compute —
+bounds sustained merged-submissions/s (the ROADMAP's always-on item).
+
+`RoundPipeline` closes the gap: ONE worker thread runs the identical
+serve-cycle call sequence the serial source runs — `service.serve_round(s)`
+for s = start, start+1, ... — and parks the finished
+(PreparedRound, ClosedRound) pairs in a bounded hand-off buffer (depth 2 =
+double buffering: one round buffered, one in flight on the worker). The
+runner's `next()` pops a ready round instead of computing one, so the
+commit-to-dispatch gap collapses to a queue pop (`server_idle_ms` ≈ 0 in
+the bench `serve` section), and round r+1's ingest/close overlaps round
+r's merge on the device — the double-buffered assembler/merge pipeline,
+visible as overlapping `serve-pipeline` vs `runner`/`device` spans in a
+--trace capture.
+
+Why it stays bit-identical to the serial source (pinned in
+tests/test_pipeline_serve.py):
+
+- **Same producer order.** The worker is the ONE thread calling
+  sample_cohort / prepare_served_round / finish_served_payload, strictly in
+  round order — exactly the single-producer discipline RoundPrefetcher
+  established for the host RNG and re-queue streams. Nothing about the
+  draws, the requeue, or the fault sites changes; only WHEN they run does.
+- **The dispatch gate.** A payload round's client program reads the newest
+  DISPATCHED server state (the head-state chain). The worker therefore
+  blocks before round s's table compute until the runner reports round
+  s-1's merge dispatched (`on_dispatched`, wired through run_loop) — the
+  same state the serial source would have read, never an earlier one.
+  Announce rounds read no server state at preparation and skip the gate.
+- **Committed-snapshot discipline.** The worker records each pending-buffer
+  round boundary right after its serve_round (the sequence point the
+  serial source recorded it at), and `stop()` JOINS the worker before the
+  runner's exit rewind — prepared-but-never-committed rounds unwind
+  through the existing RNG/requeue/pending rewinds, so a resumed or reused
+  session replays bit-identically.
+
+The worker's blocking points (the hand-off buffer when the runner lags,
+the dispatch gate, the close waits inside serve_round) are waits on
+bounded conditions, declared drain-points where they live; the dispatch
+thread itself only ever blocks popping a READY round.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from ..obs import trace as obtrace
+
+
+class RoundPipeline:
+    """See module docstring. `depth` counts rounds the worker may run ahead
+    of the consumer: 1 buffered + 1 in flight = the default double
+    buffering (a deeper pipeline buys nothing — the merge consumes rounds
+    one at a time — and widens the preemption rewind)."""
+
+    def __init__(self, service, start_round: int, depth: int = 2):
+        if depth < 2:
+            raise ValueError(f"pipeline depth must be >= 2, got {depth}")
+        self.service = service
+        self._cv = threading.Condition()
+        self._buf: collections.deque = collections.deque()
+        self._buffered = max(depth - 1, 1)  # beyond the one in flight
+        self._start = start_round
+        # newest round the runner has DISPATCHED (the gate's watermark);
+        # start-1 = nothing yet, round `start` computes against the
+        # committed state like the serial source would
+        self._dispatched = start_round - 1
+        self._stop = False
+        self._err: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run, name="serve-pipeline", daemon=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "RoundPipeline":
+        # the gate hooks the service's payload compute (a no-op attribute
+        # on announce paths — prepare reads no server state there)
+        self.service._compute_gate = self._gate
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Halt the worker and JOIN it — callers rely on the join: the
+        runner's exit rewind (host RNG, requeue, pending buffer) must not
+        race a worker mid-preparation. The worker's longest legitimate
+        park is a wall-clock close (the queue wait's own timeout bounds
+        it), so the join budget scales with the service deadline; a worker
+        that somehow outlives it is announced loudly — and its residual
+        effects are bounded anyway: every hand-off/boundary mutation
+        re-checks the stop flag first, and the caller's
+        rewind_to_committed prunes anything an orphaned round left."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        deadline = 30.0 + float(getattr(self.service.cfg, "deadline_s", 0.0))
+        self._thread.join(timeout=deadline)
+        if self._thread.is_alive():
+            import sys
+
+            print("serve: WARNING — pipeline worker still alive past the "
+                  f"{deadline:.0f}s stop deadline", file=sys.stderr,
+                  flush=True)
+        self.service._compute_gate = None
+
+    # -- runner side ----------------------------------------------------------
+
+    # graftlint: drain-point — the dispatch thread's sanctioned wait: pops
+    # a READY round (the pipeline's whole point is that this never waits
+    # out an invite window)
+    def next(self):
+        """The next (PreparedRound, ClosedRound) in round order; blocks only
+        when the worker has genuinely not finished the round yet. Re-raises
+        a worker error at the consuming point, like the prefetcher."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._buf or self._err is not None or self._stop)
+            if self._buf:
+                item = self._buf.popleft()
+                self._cv.notify_all()
+                return item
+            if self._err is not None:
+                raise self._err
+            raise RuntimeError("RoundPipeline stopped while a consumer "
+                               "was waiting for the next round")
+
+    def on_dispatched(self, rnd: int) -> None:
+        """run_loop hook: round `rnd`'s merge has been dispatched — the
+        worker may now compute round rnd+1's client tables against the
+        head state that dispatch chained."""
+        with self._cv:
+            if rnd > self._dispatched:
+                self._dispatched = rnd
+                self._cv.notify_all()
+
+    # -- worker side ----------------------------------------------------------
+
+    # graftlint: drain-point — the WORKER thread's gate: payload table
+    # compute for round s waits for merge s-1's dispatch by design (the
+    # head-state chain is the bit-parity contract); never the dispatch
+    # thread
+    def _gate(self, rnd: int) -> None:
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._dispatched >= rnd - 1 or self._stop)
+
+    def _run(self) -> None:
+        s = self._start
+        try:
+            while True:
+                with self._cv:
+                    self._cv.wait_for(
+                        lambda: len(self._buf) < self._buffered
+                        or self._stop)
+                    if self._stop:
+                        return
+                with obtrace.span("serve-pipeline", "serve_round", round=s):
+                    prep, closed = self.service.serve_round(s)
+                with self._cv:
+                    if self._stop:
+                        # stopped mid-round: deliver NOTHING and touch no
+                        # more shared state — the caller's rewind owns the
+                        # cleanup from here
+                        return
+                # the pending-buffer boundary snapshot lands at the same
+                # SEQUENCE point the serial source records it (right after
+                # round s's open drained the buffer) — wall-clock moved,
+                # the committed-snapshot discipline didn't
+                self.service._record_boundary(s + 1)
+                with self._cv:
+                    if self._stop:
+                        return
+                    self._buf.append((prep, closed))
+                    self._cv.notify_all()
+                s += 1
+        except BaseException as e:  # noqa: BLE001 — parked for the consumer
+            with self._cv:
+                self._err = e
+                self._cv.notify_all()

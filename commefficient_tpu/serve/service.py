@@ -44,10 +44,12 @@ committed-snapshot discipline the host RNG and the re-queue ride.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import sys
 import threading
 import time
+from typing import Any
 
 import numpy as np
 
@@ -86,6 +88,27 @@ class ServeConfig:
     # turns submissions away with SHEDDING + a retry-after hint (0 = off)
     shed_watermark: float = 0.0
     shed_retry_after_s: float = 1.0
+    # --serve_pipeline: run the serve cycle on the always-on worker
+    # (serve/pipeline.py) — round r+1's invite/collect/close overlaps
+    # round r's merge, and the runner's commit-to-dispatch gap collapses
+    # to a queue pop (server_idle_ms ≈ 0). Bit-identical to the serial
+    # source by construction (same producer call order, dispatch-gated
+    # payload compute) — pinned in tests/test_pipeline_serve.py.
+    pipeline: bool = False
+    # --serve_async: buffered asynchronous aggregation (FedBuff-shaped).
+    # The W-of-N quorum becomes a BUFFER-SIZE trigger (`buffer_size`, 0 =
+    # the quorum value), and late tables — stragglers that missed the
+    # trigger, or post-close pushes inside the `stale_rounds` band — fold
+    # into a later merge with weight (1 + round_lag) ** -staleness_alpha
+    # instead of being discarded. Requires payload="sketch" and a session
+    # built with stale_slots > 0. Synchronous mode stays the parity
+    # reference: an async run where every submission answers the open
+    # round dispatches the plain merge program every round and is pinned
+    # bitwise == sync.
+    async_mode: bool = False
+    buffer_size: int = 0
+    staleness_alpha: float = 0.5
+    stale_rounds: int = 1
 
     @classmethod
     def from_args(cls, args) -> "ServeConfig":
@@ -97,6 +120,11 @@ class ServeConfig:
             metrics_port=getattr(args, "serve_metrics_port", -1),
             payload=getattr(args, "serve_payload", "announce"),
             shed_watermark=getattr(args, "serve_shed_watermark", 0.0),
+            pipeline=bool(getattr(args, "serve_pipeline", False)),
+            async_mode=bool(getattr(args, "serve_async", False)),
+            buffer_size=getattr(args, "serve_buffer", 0),
+            staleness_alpha=getattr(args, "serve_staleness", 0.5),
+            stale_rounds=getattr(args, "serve_stale_rounds", 1),
         )
 
 
@@ -125,6 +153,30 @@ class AggregationService:
                 "zero submissions: every round would close at deadline "
                 "fully degraded (pass a TrafficGenerator, or use the "
                 "socket transport with external clients)")
+        if cfg.async_mode:
+            if cfg.payload != "sketch":
+                raise ValueError(
+                    "--serve_async merges client tables as they arrive; "
+                    "the announce path has no client-computed table to "
+                    "merge — arm --serve_payload sketch")
+            if getattr(session.cfg, "stale_slots", 0) <= 0:
+                raise ValueError(
+                    "--serve_async needs a session built with "
+                    "stale_slots > 0 (the CLIs arm it from the flag): the "
+                    "staleness-weighted fold is a compiled merge variant")
+            if cfg.stale_rounds < 1:
+                raise ValueError(
+                    f"--serve_stale_rounds must be >= 1 in async mode, got "
+                    f"{cfg.stale_rounds} (0 stale rounds IS sync)")
+            if cfg.staleness_alpha < 0:
+                raise ValueError(
+                    f"--serve_staleness must be >= 0, got "
+                    f"{cfg.staleness_alpha} (0 = unweighted folds)")
+        elif cfg.buffer_size:
+            raise ValueError(
+                "--serve_buffer is the ASYNC buffer-size trigger; without "
+                "--serve_async the close discipline is the W-of-N quorum "
+                "(--serve_quorum)")
         payload_policy = payload_shape = None
         if cfg.payload == "sketch":
             ecfg = session.cfg
@@ -140,15 +192,40 @@ class AggregationService:
                 clip_multiple=float(ecfg.client_update_clip),
                 quarantine_median=session.quarantine_median_host)
         self.session = session
-        self.cfg = dataclasses.replace(cfg, quorum=quorum)
+        # async: the W-of-N quorum becomes the buffer-size trigger (the
+        # round's merge fires when `trigger` validated tables are in, not
+        # when a cohort quorum is); sync keeps trigger == quorum
+        trigger = (min(cfg.buffer_size or quorum, session.num_workers)
+                   if cfg.async_mode else quorum)
+        if trigger < 1:
+            raise ValueError(f"--serve_buffer must be >= 1, got {trigger}")
+        self.cfg = dataclasses.replace(cfg, quorum=quorum,
+                                       buffer_size=trigger)
         self.traffic = traffic
-        self.queue = IngestQueue(capacity=cfg.queue_capacity,
-                                 pending_capacity=cfg.pending_capacity,
-                                 payload_policy=payload_policy,
-                                 shed_watermark=cfg.shed_watermark,
-                                 shed_retry_after_s=cfg.shed_retry_after_s)
-        self.assembler = CohortAssembler(self.queue, quorum, cfg.deadline_s,
-                                         payload_shape=payload_shape)
+        self.queue = IngestQueue(
+            capacity=cfg.queue_capacity,
+            pending_capacity=cfg.pending_capacity,
+            payload_policy=payload_policy,
+            shed_watermark=cfg.shed_watermark,
+            shed_retry_after_s=cfg.shed_retry_after_s,
+            # the async admission band: late payloads for recently-closed
+            # rounds park for the staleness fold instead of bouncing
+            stale_rounds=cfg.stale_rounds if cfg.async_mode else 0,
+            stale_capacity=getattr(session.cfg, "stale_slots", 0))
+        self.assembler = CohortAssembler(
+            self.queue, trigger, cfg.deadline_s,
+            payload_shape=payload_shape,
+            trigger_label="buffer" if cfg.async_mode else "quorum",
+            collect_stragglers=cfg.async_mode)
+        # buffered-async stale stash: (source_round, cohort_position,
+        # client_id, table) entries awaiting their staleness-weighted fold
+        # — filled from each closed round's stragglers and the queue's
+        # late-admission band, drained into merge folds in deterministic
+        # (source round, position) order
+        self._stale_stash: list[tuple[int, int, int, Any]] = []
+        # the pipelined worker's payload-compute gate (serve/pipeline.py
+        # installs it; None = serial source, compute runs inline)
+        self._compute_gate = None
         self.transport = (
             SocketTransport(self.queue, port=cfg.port)
             if cfg.transport == "socket" else InProcessTransport(self.queue))
@@ -215,34 +292,55 @@ class AggregationService:
     # -- the round source -----------------------------------------------------
 
     def source(self, start_round: int | None = None) -> "ServedSource":
-        """The runner-facing round source (run_loop(source=...))."""
+        """The runner-facing round source (run_loop(source=...)) —
+        pipelined when the config says so."""
         return ServedSource(
-            self, self.session.round if start_round is None else start_round)
+            self, self.session.round if start_round is None else start_round,
+            pipelined=self.cfg.pipeline)
+
+    @contextlib.contextmanager
+    def _stage(self, name: str, rnd: int):
+        """One serving-pipeline stage: a span on the serve-pipeline track
+        (overlap with the runner/device tracks is the double-buffered
+        pipeline made visible) + the serve_stage_<name>_ms histogram the
+        /metrics endpoint and bench read."""
+        t0 = time.perf_counter()
+        with obtrace.span("serve-pipeline", f"stage:{name}", round=rnd):
+            yield
+        self.registry.histogram(f"serve_stage_{name}_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
 
     def serve_round(self, rnd: int):
         """One full served round preparation: invite, collect, close at
-        W-of-N, mask + re-queue the casualties. Returns (PreparedRound,
-        ClosedRound)."""
+        W-of-N (or the async buffer trigger), mask + re-queue the
+        casualties. Returns (PreparedRound, ClosedRound). Runs inline on
+        the dispatch thread for a serial source, on the always-on worker
+        for a pipelined one — same call sequence either way (that is the
+        parity pin)."""
         with obtrace.span("assembler", "serve_round", round=rnd):
-            ids = self.session.sample_cohort(rnd)
             if self.cfg.payload == "sketch":
-                prep, closed = self._serve_payload_round(rnd, ids)
+                prep, closed = self._serve_payload_round(rnd)
             else:
-                self.queue.open_round(rnd, ids)
-                if self.traffic is not None:
-                    self.traffic.respond_to_invites(
-                        rnd, ids, self.transport.submit, self.cfg.deadline_s)
-                    closed = self.assembler.close_virtual(rnd, ids)
-                else:
-                    # external clients: wall-clock W-of-N (socket transport)
-                    closed = self.assembler.close_wall(rnd, ids)
-                prep = self.session.prepare_served_round(
-                    rnd, ids, closed.arrived)
+                with self._stage("invite", rnd):
+                    ids = self.session.sample_cohort(rnd)
+                    self.queue.open_round(rnd, ids)
+                with self._stage("collect", rnd):
+                    if self.traffic is not None:
+                        self.traffic.respond_to_invites(
+                            rnd, ids, self.transport.submit,
+                            self.cfg.deadline_s)
+                        closed = self.assembler.close_virtual(rnd, ids)
+                    else:
+                        # external clients: wall-clock W-of-N (socket)
+                        closed = self.assembler.close_wall(rnd, ids)
+                with self._stage("prep", rnd):
+                    prep = self.session.prepare_served_round(
+                        rnd, ids, closed.arrived)
         with self._meta_lock:
             self._unmerged.append(closed)
         return prep, closed
 
-    def _serve_payload_round(self, rnd: int, ids):
+    def _serve_payload_round(self, rnd: int):
         """The wire-payload round (--serve_payload sketch): clients compute
         BEFORE the close (a real client sketches locally, then ships), the
         tables cross the transport — over the actual loopback socket when
@@ -250,33 +348,131 @@ class AggregationService:
         the ingest gauntlet validates each frame, and the close hands the
         merge only the validated table stack. Every invitee whose payload
         missed the merge (no-show, straggler, rejected frame) is masked +
-        re-queued exactly like a dropped client."""
-        prep0 = self.session.prepare_served_round(
-            rnd, ids, np.ones(len(ids), np.float32))
-        tables, aux = self.session.compute_client_tables(prep0)
-        self.queue.open_round(rnd, ids)
-        if self.traffic is not None:
-            plan = self.session.fault_plan
-            wire = (plan.wire_plan(rnd, len(ids))
-                    if plan is not None else None)
-            if self.cfg.transport == "socket":
-                # the REAL wire: every submission round-trips the loopback
-                # socket (frame encode -> recv -> gauntlet decode), and a
-                # conn_drop is an actual mid-send connection death
-                addr = self.transport.address
-                submit = lambda sub: submit_over_socket(addr, sub)  # noqa: E731
-                abort = lambda sub: abort_over_socket(addr, sub)  # noqa: E731
+        re-queued exactly like a dropped client.
+
+        Pipelined, the compute stage first waits on the dispatch gate:
+        round rnd's client program must read the state round rnd-1's merge
+        dispatch chained — the serial source got that ordering for free,
+        the worker waits for it (serve/pipeline.py). Async additionally
+        stashes the close's stragglers and drains the queue's late band
+        into a staleness-weighted fold stack for THIS merge."""
+        with self._stage("prep", rnd):
+            ids = self.session.sample_cohort(rnd)
+            prep0 = self.session.prepare_served_round(
+                rnd, ids, np.ones(len(ids), np.float32))
+        with self._stage("compute", rnd):
+            gate = self._compute_gate
+            if gate is not None:
+                gate(rnd)
+            tables, aux = self.session.compute_client_tables(prep0)
+        with self._stage("invite", rnd):
+            self.queue.open_round(rnd, ids)
+        with self._stage("collect", rnd):
+            if self.traffic is not None:
+                plan = self.session.fault_plan
+                wire = (plan.wire_plan(rnd, len(ids))
+                        if plan is not None else None)
+                if self.cfg.transport == "socket":
+                    # the REAL wire: every submission round-trips the
+                    # loopback socket (frame encode -> recv -> gauntlet
+                    # decode), and a conn_drop is an actual mid-send
+                    # connection death
+                    addr = self.transport.address
+                    submit = lambda sub: submit_over_socket(addr, sub)  # noqa: E731
+                    abort = lambda sub: abort_over_socket(addr, sub)  # noqa: E731
+                else:
+                    submit, abort = self.transport.submit, None
+                self.traffic.respond_to_invites(
+                    rnd, ids, submit, self.cfg.deadline_s,
+                    payloads=tables, wire=wire, abort=abort)
+                closed = self.assembler.close_virtual(rnd, ids)
             else:
-                submit, abort = self.transport.submit, None
-            self.traffic.respond_to_invites(
-                rnd, ids, submit, self.cfg.deadline_s,
-                payloads=tables, wire=wire, abort=abort)
-            closed = self.assembler.close_virtual(rnd, ids)
-        else:
-            # external clients: wall-clock W-of-N (socket transport)
-            closed = self.assembler.close_wall(rnd, ids)
-        return self.session.finish_served_payload(
-            prep0, closed.arrived, closed.tables, aux), closed
+                # external clients: wall-clock W-of-N (socket transport)
+                closed = self.assembler.close_wall(rnd, ids)
+        with self._stage("prep", rnd):
+            stale = None
+            if self.cfg.async_mode:
+                stale = self._build_stale_fold(rnd)
+                self._stash_stragglers(closed)
+            prep = self.session.finish_served_payload(
+                prep0, closed.arrived, closed.tables, aux, stale=stale)
+        return prep, closed
+
+    # -- buffered-async staleness folds ---------------------------------------
+
+    def _stash_stragglers(self, closed) -> None:
+        """Park a closed round's validated-but-late tables (they arrived,
+        the buffer trigger had already fired) for a later merge's
+        staleness-weighted fold — the work is not discarded, it is
+        down-weighted. The client was ALSO masked + re-queued by the close
+        (it missed THIS round); the fold and the re-service are different
+        things: one salvages the computed update, the other restores the
+        client's sampling fairness."""
+        for pos, cid, table in closed.straggler_tables:
+            self._stale_stash.append((int(closed.rnd), pos, cid, table))
+
+    def _build_stale_fold(self, rnd: int):
+        """The staleness-weighted fold stack for round `rnd`'s merge:
+        stashed stragglers + the queue's late-band admissions, each
+        weighted (1 + lag) ** -alpha with lag = rnd - source_round.
+        Entries older than the stale_rounds band are dropped (counted);
+        overflow past the session's stale_slots DEFERS to the next
+        round's fold (it either merges then or ages out of the band and
+        is counted dropped at that point — never both).
+        Slot order — the fold's fp association — is (source round asc,
+        cohort position asc, then late-band admission order): a pure
+        function of the submission set, never wall-clock. Returns None
+        when nothing is pending (the round then dispatches the PLAIN merge
+        program — the async==sync bit-identity's routing)."""
+        for a in self.queue.drain_stale():
+            # queue recv_order preserves the late band's admission order;
+            # position -1 sorts wire-band entries after same-round
+            # stragglers deterministically via the admission counter
+            self._stale_stash.append(
+                (int(a.round), self.session.num_workers + int(a.recv_order),
+                 int(a.client_id), a.table))
+        if not self._stale_stash:
+            return None
+        keep, dropped = [], 0
+        for sr, pos, cid, table in self._stale_stash:
+            lag = rnd - sr
+            if 1 <= lag <= self.cfg.stale_rounds:
+                keep.append((sr, pos, cid, table))
+            elif lag > self.cfg.stale_rounds:
+                dropped += 1  # aged out of the band: the update is too
+                # stale to be worth its estimator noise
+            else:
+                keep.append((sr, pos, cid, table))  # not yet foldable
+        keep.sort(key=lambda e: (e[0], e[1]))
+        slots = int(getattr(self.session.cfg, "stale_slots", 0))
+        ready = [e for e in keep if rnd - e[0] >= 1]
+        # slot overflow DEFERS (stays stashed for the next fold) rather
+        # than dropping: a deferred entry either merges next round or
+        # ages out of the band then — counting it dropped here would
+        # double-book it against the admitted/folded/dropped triad an
+        # operator reconciles in /metrics
+        ready = ready[:slots]
+        # entries not folded this round stay stashed for the next
+        folded_ids = {(sr, cid) for sr, _, cid, _ in ready}
+        self._stale_stash = [
+            e for e in keep if (e[0], e[2]) not in folded_ids]
+        if dropped:
+            self.registry.counter("serve_stale_dropped_total").inc(dropped)
+            print(f"serve: dropped {dropped} stale table(s) aged past the "
+                  f"{self.cfg.stale_rounds}-round band",
+                  file=sys.stderr, flush=True)
+        if not ready:
+            return None
+        r, c = self.assembler.payload_shape
+        stale_tables = np.zeros((slots, r, c), np.float32)
+        stale_weights = np.zeros(slots, np.float32)
+        for i, (sr, _, cid, table) in enumerate(ready):
+            stale_tables[i] = table
+            stale_weights[i] = (1.0 + (rnd - sr)) ** -self.cfg.staleness_alpha
+            obtrace.instant("serve-ingest", "stale_fold", round=int(rnd),
+                            source_round=int(sr), client=int(cid))
+        self.registry.counter("serve_stale_folded_total").inc(len(ready))
+        return stale_tables, stale_weights
 
     def record_merges(self, committed_round: int | None = None) -> int:
         """Resolve submission-to-merge latency for every closed round the
@@ -343,11 +539,25 @@ class AggregationService:
         serve-side twin of run_loop's host-RNG rewind, so a session (and
         service) reused after an interrupted loop replays identically.
         Served-but-never-committed rounds also drop out of the unmerged
-        list: their submissions never merged, so no latency resolves."""
+        list (their submissions never merged, so no latency resolves), any
+        window a halted pipelined worker left open closes, and stale-fold
+        entries sourced from uncommitted rounds unwind (the rounds will be
+        re-served; their stragglers re-stash then)."""
+        committed = self.session.round
+        for r in self.queue.open_rounds():
+            if r >= committed:
+                self.queue.close_round(r)
+        # the queue half of the same discipline: parked stale arrivals and
+        # retained band state for rounds >= committed must not survive the
+        # replay (the re-served round's live submission would otherwise
+        # merge beside its own pre-rewind stale twin)
+        self.queue.prune_stale(committed)
         with self._meta_lock:
-            pending = self._pending_by_round.get(self.session.round)
+            pending = self._pending_by_round.get(committed)
             self._unmerged = [c for c in self._unmerged
-                              if c.rnd < self.session.round]
+                              if c.rnd < committed]
+            self._stale_stash = [e for e in self._stale_stash
+                                 if e[0] < committed]
         if pending is not None:
             self.queue.restore_pending(pending)
 
@@ -375,6 +585,31 @@ class AggregationService:
                 ph: self.registry.histogram(f"runner_phase_{ph}_ms").summary()
                 for ph in obreg.RUNNER_PHASES
             },
+            # the serving pipeline's own stages (service-written) + the
+            # always-on acceptance gauge: commit-to-next-dispatch gap
+            # (runner-written; ≈0 pipelined, the whole serve cycle serial)
+            "serve_stage_ms": {
+                st: self.registry.histogram(f"serve_stage_{st}_ms").summary()
+                for st in obreg.SERVE_STAGES
+            },
+            "server_idle_ms": round(
+                self.registry.gauge("server_idle_ms").value, 3),
+            "pipeline": bool(self.cfg.pipeline),
+            "async": bool(self.cfg.async_mode),
+            # buffered-async posture: trigger size, staleness discipline,
+            # and the stale-fold counters (admitted at the wire band,
+            # folded into merges, dropped past the band/slot budget)
+            "stale": {
+                "buffer_size": int(self.cfg.buffer_size),
+                "staleness_alpha": float(self.cfg.staleness_alpha),
+                "stale_rounds": int(self.cfg.stale_rounds),
+                "admitted": int(self.registry.counter(
+                    "serve_stale_admitted_total").value),
+                "folded": int(self.registry.counter(
+                    "serve_stale_folded_total").value),
+                "dropped": int(self.registry.counter(
+                    "serve_stale_dropped_total").value),
+            } if self.cfg.async_mode else None,
             "quorum": self.cfg.quorum,
             "invited_per_round": s.num_workers,
             "deadline_s": self.cfg.deadline_s,
@@ -393,27 +628,47 @@ class ServedSource:
     """run_loop round source backed by the service (the PreparedSource
     protocol: next() -> PreparedRound in strict round order, stop()).
 
-    next() runs the whole invite->collect->close cycle synchronously on the
-    dispatch thread — the device pipeline still overlaps (dispatch N+1
-    queues while N computes), and in virtual-latency mode the close never
-    sleeps. The per-round ClosedRound is kept on `last_closed` for the
-    loop's observers (chaos smoke, bench)."""
+    Serial (default): next() runs the whole invite->collect->close cycle
+    synchronously on the dispatch thread — the device pipeline still
+    overlaps (dispatch N+1 queues while N computes), and in virtual-latency
+    mode the close never sleeps. Pipelined (--serve_pipeline): the cycle
+    runs AHEAD on the always-on worker (serve/pipeline.py) and next() pops
+    a ready round — the commit-to-dispatch gap collapses, round r+1's
+    ingest overlaps round r's merge. The per-round ClosedRound is kept on
+    `last_closed` for the loop's observers (chaos smoke, bench)."""
 
-    def __init__(self, service: AggregationService, start_round: int):
+    def __init__(self, service: AggregationService, start_round: int,
+                 pipelined: bool = False):
         self.service = service
         self._next = start_round
         self.last_closed: ClosedRound | None = None
         self.closed_rounds: list[ClosedRound] = []
         service._record_boundary(start_round)
+        self._pipeline = None
+        if pipelined:
+            from .pipeline import RoundPipeline
+
+            self._pipeline = RoundPipeline(service, start_round).start()
 
     def next(self):
         rnd = self._next
-        prep, closed = self.service.serve_round(rnd)
+        if self._pipeline is not None:
+            # the worker already served this round (and recorded its
+            # pending-buffer boundary at the same sequence point)
+            prep, closed = self._pipeline.next()
+        else:
+            prep, closed = self.service.serve_round(rnd)
+            self.service._record_boundary(rnd + 1)
         self.last_closed = closed
         self.closed_rounds.append(closed)
         self._next = rnd + 1
-        self.service._record_boundary(rnd + 1)
         return prep
+
+    def on_dispatched(self, rnd: int):
+        """runner dispatch hook: releases the pipelined worker's payload
+        compute gate for round rnd+1 (the head-state chain)."""
+        if self._pipeline is not None:
+            self._pipeline.on_dispatched(rnd)
 
     def on_committed(self, committed_round: int):
         """runner drain hook: submission-to-merge latencies resolve at the
@@ -421,6 +676,10 @@ class ServedSource:
         self.service.record_merges(committed_round)
 
     def stop(self):
+        # join the worker FIRST: the loop's exit rewind (host RNG, requeue,
+        # pending buffer) must not race a preparation in flight
+        if self._pipeline is not None:
+            self._pipeline.stop()
         # the loop may have served rounds that never commit (preemption,
         # early exit): rewind the pending buffer with the host RNG
         self.service.rewind_to_committed()
@@ -450,11 +709,18 @@ def service_from_args(args, session) -> AggregationService | None:
     addr = service.transport.address
     maddr = (service.metrics_server.address
              if service.metrics_server is not None else None)
+    close = (f"buffer {service.cfg.buffer_size}"
+             if service.cfg.async_mode
+             else f"quorum {service.cfg.quorum}")
     print(
         f"serve: {service.cfg.transport} transport"
         + (f" on {addr[0]}:{addr[1]}" if addr else "")
         + f", payload {service.cfg.payload}"
-        + f", quorum {service.cfg.quorum}/{session.num_workers}, "
+        + (", pipelined" if service.cfg.pipeline else "")
+        + (f", async (alpha={service.cfg.staleness_alpha:g}, "
+           f"band={service.cfg.stale_rounds})"
+           if service.cfg.async_mode else "")
+        + f", {close}/{session.num_workers}, "
         + f"deadline {service.cfg.deadline_s}s, trace {trace}"
         + (f", metrics http://{maddr[0]}:{maddr[1]}/metrics" if maddr else ""),
         flush=True,

@@ -20,6 +20,15 @@ the traffic generator, and the tests are transport-agnostic.
   helpers `submit_over_socket` / `submit_with_retries` round-trip one
   submission.
 
+  A table too big for one frame line (GPT-2-scale r x c at
+  `max_frame_bytes`) crosses as CHUNKED continuation lines ``{"client_id",
+  "round", "latency_s", "chunk": frame_i}`` (sketch/payload.py schema 2):
+  the per-connection handler COLLECTS the sequence — it never decodes it —
+  and hands the complete frame list to the ingest gauntlet, where
+  reassembly and every integrity check live (G011). One reply per
+  submission, sent when the final chunk lands; a connection that dies
+  mid-sequence counts the partial sequence MALFORMED and admits nothing.
+
 The server survives a hostile wire by construction:
 
 - **read deadline** per connection (`read_deadline_s`): a peer that opens a
@@ -52,7 +61,16 @@ import numpy as np
 
 from ..obs import registry as obreg
 from ..obs import trace as obtrace
+from ..sketch.payload import MAX_CHUNKS
 from .ingest import SHEDDING, IngestQueue, Submission
+
+# the socket transport's default per-line byte cap — also the chunking
+# threshold the client helpers frame against (one knob, both sides)
+DEFAULT_MAX_FRAME_BYTES = 1 << 20
+# concurrent in-flight chunk sequences one connection may hold open: a
+# client submits one table at a time (a retry is a new connection), so a
+# peer spraying sequence keys is hostile — bounded, MALFORMED past it
+_MAX_SEQUENCES_PER_CONN = 4
 
 
 class InProcessTransport:
@@ -80,7 +98,7 @@ class SocketTransport:
 
     def __init__(self, queue: IngestQueue, host: str = "127.0.0.1",
                  port: int = 0, read_deadline_s: float = 30.0,
-                 max_frame_bytes: int = 1 << 20):
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
         if read_deadline_s <= 0:
             raise ValueError(
                 f"read_deadline_s must be > 0, got {read_deadline_s} — an "
@@ -195,6 +213,11 @@ class SocketTransport:
     def _serve_conn(self, conn: socket.socket) -> None:
         with self._conns_lock:
             self._conns.add(conn)
+        # in-flight chunk sequences on THIS connection: (client_id, round)
+        # -> list of frame dicts in receive order. The handler only
+        # COLLECTS — reassembly and every integrity verdict live in the
+        # ingest gauntlet (the G011 boundary).
+        sequences: dict[tuple[int, int], list] = {}
         try:
             # the read deadline: a silent peer (slow-loris, a client that
             # died mid-frame) times out of recv and the connection closes —
@@ -231,9 +254,23 @@ class SocketTransport:
                         line, buf = buf.split(b"\n", 1)
                         if not line.strip():
                             continue
-                        if not self._reply(conn, self._handle_line(line)):
+                        reply = self._handle_line(line, sequences,
+                                                  len(line))
+                        if reply is None:
+                            continue  # mid-sequence chunk: reply at the end
+                        if not self._reply(conn, reply):
                             return
         finally:
+            if sequences:
+                # the peer died (EOF / deadline / force-close) with chunk
+                # sequences still open: each partial sequence is a
+                # MALFORMED submission that admitted nothing
+                for _ in sequences:
+                    obreg.default().counter(
+                        "serve_rejected_malformed_total").inc()
+                    self.queue.note_wire_malformed()
+                obtrace.instant("serve-ingest", "conn:partial_sequence",
+                                sequences=len(sequences))
             with self._conns_lock:
                 self._conns.discard(conn)
 
@@ -245,13 +282,21 @@ class SocketTransport:
         except OSError:
             return False
 
-    def _handle_line(self, line: bytes) -> dict:
+    def _handle_line(self, line: bytes, sequences: dict | None = None,
+                     line_bytes: int | None = None) -> dict | None:
         if len(line) > self.max_frame_bytes:
             obreg.default().counter("serve_rejected_malformed_total").inc()
             self.queue.note_wire_malformed()
             return {"status": "MALFORMED", "detail": "frame too large"}
         try:
             req = json.loads(line)
+            if "chunk" in req:
+                # chunked payload: collect the sequence; submit when the
+                # declared total is in. None = no reply yet (the client
+                # sends all chunks, then reads ONE reply).
+                return self._handle_chunk(
+                    req, sequences if sequences is not None else {},
+                    len(line) if line_bytes is None else line_bytes)
             payload = req.get("payload")
             sub = Submission(
                 client_id=int(req["client_id"]),
@@ -271,6 +316,75 @@ class SocketTransport:
             obreg.default().counter("serve_rejected_malformed_total").inc()
             self.queue.note_wire_malformed()
             return {"status": "MALFORMED", "detail": type(e).__name__}
+        return self._submit_reply(sub)
+
+    def _sequence_byte_budget(self) -> int:
+        """Upper bound on the base64 bytes one chunk sequence may buffer:
+        the server KNOWS the payload size it expects (the queue's payload
+        policy), so a sequence is cut off a little past the encoded size
+        of one legitimate table — without this, a hostile peer could park
+        MAX_CHUNKS frame-cap-sized chunks per sequence (GiBs) before any
+        admission or shedding check ever runs. Announce servers expect no
+        payload at all, so chunk traffic there gets one frame's worth."""
+        p = self.queue.payload_policy
+        if p is None:
+            return self.max_frame_bytes
+        # base64 inflates 4/3; one extra frame of slack for envelope split
+        return p.nbytes * 4 // 3 + self.max_frame_bytes
+
+    def _handle_chunk(self, req: dict, sequences: dict,
+                      line_bytes: int) -> dict | None:
+        """Collect one chunk line. The transport enforces only what IT must
+        to stay bounded (sequence count per connection, chunk count AND
+        cumulative WIRE bytes per sequence — the whole line, not just the
+        data field, so padding any other frame field buys an attacker
+        nothing — sized to the payload the server actually expects); every
+        content verdict — order, totals, checksum — is the gauntlet's
+        (validate_payload reassembles the list)."""
+        try:
+            key = (int(req["client_id"]), int(req["round"]))
+            frame = req["chunk"]
+            total = int(frame["total"])
+            latency = float(req.get("latency_s", 0.0))
+        except (ValueError, KeyError, TypeError):
+            obreg.default().counter("serve_rejected_malformed_total").inc()
+            self.queue.note_wire_malformed()
+            return {"status": "MALFORMED", "detail": "bad chunk line"}
+        if not 1 <= total <= MAX_CHUNKS:
+            obreg.default().counter("serve_rejected_malformed_total").inc()
+            self.queue.note_wire_malformed()
+            return {"status": "MALFORMED",
+                    "detail": f"chunk total {total} out of bounds"}
+        if key not in sequences and len(sequences) >= _MAX_SEQUENCES_PER_CONN:
+            obreg.default().counter("serve_rejected_malformed_total").inc()
+            self.queue.note_wire_malformed()
+            return {"status": "MALFORMED",
+                    "detail": "too many concurrent chunk sequences"}
+        seq = sequences.setdefault(key, {"frames": [], "bytes": 0})
+        seq["frames"].append(frame)
+        seq["bytes"] += line_bytes
+        if seq["bytes"] > self._sequence_byte_budget():
+            # more wire bytes than any legitimate payload's lines carry:
+            # cut the sequence off NOW (the overload design says unbounded
+            # memory never waits for a complete submission)
+            buffered = seq["bytes"]
+            del sequences[key]
+            obreg.default().counter("serve_rejected_malformed_total").inc()
+            self.queue.note_wire_malformed()
+            return {"status": "MALFORMED",
+                    "detail": f"chunk sequence exceeds {buffered} bytes"}
+        if len(seq["frames"]) < total:
+            return None  # mid-sequence: the reply comes with the last chunk
+        frames = sequences.pop(key)["frames"]
+        return self._submit_reply(Submission(
+            client_id=key[0], round=key[1], latency_s=latency,
+            payload_bytes=sum(len(str(f.get("data", ""))) for f in frames),
+            # the frame LIST passes through unparsed — reassembly is the
+            # gauntlet's (a reordered/duplicated sequence is ITS verdict)
+            payload=frames,
+        ))
+
+    def _submit_reply(self, sub: Submission) -> dict:
         status = self.queue.submit(sub)
         reply = {"status": status}
         if status == SHEDDING:
@@ -283,39 +397,55 @@ class SocketTransport:
 # graftlint: drain-point — client-side blocking round-trip (the traffic
 # generator's submitting thread, never the dispatch thread)
 def submit_over_socket(addr: tuple[str, int], sub: Submission,
-                       timeout_s: float = 5.0) -> str:
+                       timeout_s: float = 5.0,
+                       max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES) -> str:
     """One submission over a fresh connection; returns the admission
     decision (or raises on transport failure — the caller decides whether
-    to retry; admission rejections are NOT exceptions)."""
-    return _roundtrip(addr, sub, timeout_s)["status"]
+    to retry; admission rejections are NOT exceptions). A table bigger
+    than `max_frame_bytes` ships as chunked continuation lines (ONE reply,
+    after the last chunk)."""
+    return _roundtrip(addr, sub, timeout_s, max_frame_bytes)["status"]
 
 
-def _wire_request(sub: Submission) -> dict:
-    """The request dict exactly as the wire carries it — shared by the real
-    round-trip and the chaos half-send so the two can never frame a payload
-    differently. A raw table is framed here (the inproc transport passes
-    arrays; the socket always ships frames); a pre-built frame dict or the
-    announce path's sized filler passes through."""
-    payload = {"client_id": sub.client_id, "round": sub.round,
-               "latency_s": sub.latency_s}
-    if sub.payload is not None:
-        p = sub.payload
-        if isinstance(p, np.ndarray):
-            from ..sketch.payload import encode_frame
+def _wire_bytes(sub: Submission, max_frame_bytes: int) -> bytes:
+    """The exact byte stream a submission crosses the wire as (newline-
+    terminated JSON lines, chunked past the frame cap) — shared by the
+    real round-trip and the chaos half-send so the two can never frame a
+    payload differently: a mid-send death on a chunked table exercises
+    the server's partial-SEQUENCE cleanup, not an artificial oversized
+    single line."""
+    return b"".join(json.dumps(ln).encode() + b"\n"
+                    for ln in _wire_lines(sub, max_frame_bytes))
 
-            p = encode_frame(p)
-        payload["payload"] = p
-    elif sub.payload_bytes:
-        payload["payload"] = "x" * sub.payload_bytes
-    return payload
+
+def _wire_lines(sub: Submission, max_frame_bytes: int) -> list[dict]:
+    """The request line dicts a submission crosses the wire as: one
+    `payload` line for a table that fits the frame cap (or any non-table
+    payload), `total` `chunk` lines for one that doesn't (sketch/payload.py
+    schema-2 chunking). max_frame_bytes=0 never chunks."""
+    head = {"client_id": sub.client_id, "round": sub.round,
+            "latency_s": sub.latency_s}
+    if sub.payload is None:
+        if sub.payload_bytes:
+            return [{**head, "payload": "x" * sub.payload_bytes}]
+        return [head]
+    p = sub.payload
+    if isinstance(p, np.ndarray):
+        from ..sketch.payload import encode_frame
+
+        p = encode_frame(p, max_frame_bytes=max_frame_bytes)
+    if isinstance(p, list):
+        return [{**head, "chunk": f} for f in p]
+    return [{**head, "payload": p}]
 
 
 # graftlint: drain-point — client-side blocking round-trip (shared tail of
 # the submit helpers; always on a client/traffic thread, never the server's)
 def _roundtrip(addr: tuple[str, int], sub: Submission,
-               timeout_s: float = 5.0) -> dict:
+               timeout_s: float = 5.0,
+               max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES) -> dict:
     with socket.create_connection(addr, timeout=timeout_s) as s:
-        s.sendall(json.dumps(_wire_request(sub)).encode() + b"\n")
+        s.sendall(_wire_bytes(sub, max_frame_bytes))
         buf = b""
         while b"\n" not in buf:
             chunk = s.recv(65536)
@@ -327,16 +457,18 @@ def _roundtrip(addr: tuple[str, int], sub: Submission,
 
 # graftlint: drain-point — client-side blocking half-send (chaos only)
 def abort_over_socket(addr: tuple[str, int], sub: Submission,
-                      timeout_s: float = 5.0) -> None:
+                      timeout_s: float = 5.0,
+                      max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES) -> None:
     """A connection that dies mid-send (conn_drop chaos): open, transmit
-    HALF the request line with no newline, and close. The server must treat
-    it as a no-show — the partial frame never parses, the handler thread
-    exits on the EOF instead of waiting out its read deadline, and nothing
-    is admitted."""
-    line = json.dumps(_wire_request(sub)).encode()
+    HALF the byte stream the real submission would send — mid-line for a
+    single-frame payload, mid-SEQUENCE for a chunked one — and close. The
+    server must treat it as a no-show: the partial frame/sequence never
+    admits, the handler thread exits on the EOF instead of waiting out its
+    read deadline, and the partial-sequence cleanup counts MALFORMED."""
+    data = _wire_bytes(sub, max_frame_bytes)
     with socket.create_connection(addr, timeout=timeout_s) as s:
-        s.sendall(line[:max(len(line) // 2, 1)])
-    # closed without the newline: the server sees EOF on a partial frame
+        s.sendall(data[:max(len(data) // 2, 1)])
+    # closed mid-stream: the server sees EOF on a partial frame/sequence
 
 
 # graftlint: drain-point — the client helper's backoff sleeps on the

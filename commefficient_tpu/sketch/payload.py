@@ -7,7 +7,7 @@ aggregator. This module is the CLIENT half of that wire: compute the table
 client-computed table is bit-identical to the engine's) and frame it for
 the socket transport (`encode_frame`).
 
-Frame format (schema version 1) — a JSON-able dict carried as the
+Frame format (schema version 2) — a JSON-able dict carried as the
 ``payload`` field of a submission line:
 
     schema   int      wire schema version (a server refuses unknown versions
@@ -16,17 +16,31 @@ Frame format (schema version 1) — a JSON-able dict carried as the
                       the table's device dtype; endianness explicit so the
                       frame means the same bytes on every host)
     shape    [r, c]   table dims (the server validates against ITS spec)
-    nbytes   int      byte length of the decoded data (the length prefix:
-                      a decoded blob of any other size is MALFORMED before
-                      anything is parsed out of it)
-    crc32    int      zlib.crc32 of the raw little-endian bytes — per-payload
-                      integrity: one flipped bit anywhere rejects the frame
-    data     str      base64 of the raw table bytes
+    nbytes   int      byte length of the WHOLE decoded payload (the length
+                      prefix: a decoded blob of any other size is MALFORMED
+                      before anything is parsed out of it)
+    crc32    int      zlib.crc32 of the whole raw little-endian byte string
+                      — per-payload integrity: one flipped bit anywhere in
+                      any chunk rejects the reassembled payload
+    seq      int      this frame's position in the chunk sequence (0-based)
+    total    int      how many frames the payload spans (1 = unchunked)
+    data     str      base64 of this frame's slice of the raw table bytes
+
+Schema 2 adds CHUNKING (the v1 -> v2 bump): a table bigger than a
+transport's ``max_frame_bytes`` is split across `total` length-prefixed
+continuation frames — frame 0 carries the full header (dtype/shape/nbytes/
+crc32 over the WHOLE payload), continuation frames repeat schema/seq/total
+with their data slice. GPT-2-scale tables (num_cols in the millions) do
+not fit one JSON line; chunked frames are also the shape the C1M
+transport's zero-copy reassembly needs. The chunk budget is sized so the
+base64-encoded frame (plus JSON envelope) stays under the byte cap.
 
 The DECODING half deliberately does NOT live here: deserializing untrusted
-wire bytes is the server's validation gauntlet, and the one sanctioned
-entry is ``serve.ingest.validate_payload`` (the declared payload boundary
-graftlint G011 enforces).
+wire bytes — INCLUDING chunk-sequence reassembly, where a partial,
+reordered, or duplicated sequence is MALFORMED — is the server's
+validation gauntlet, and the one sanctioned entry is
+``serve.ingest.validate_payload`` (the declared payload boundary graftlint
+G011 enforces).
 """
 
 from __future__ import annotations
@@ -36,9 +50,17 @@ import zlib
 
 import numpy as np
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 # the one wire dtype: little-endian float32, the table's device dtype
 WIRE_DTYPE = "<f4"
+# hard cap on frames per payload: bounds what a server must buffer for one
+# submission no matter what `total` a hostile frame claims (4096 chunks of
+# a 1 MiB budget covers a 3 GiB table — far past any real geometry)
+MAX_CHUNKS = 4096
+# bytes the JSON envelope (keys, ints, quoting) may add around the data
+# field — the chunk budget subtracts it so an encoded LINE stays under the
+# transport's frame cap
+_ENVELOPE_SLACK = 512
 
 
 # graftlint: drain-point — the table syncs to host BY DESIGN: it is the
@@ -53,18 +75,53 @@ def client_table(spec, update) -> np.ndarray:
     return np.asarray(csvec.sketch_vec(spec, update), np.float32)
 
 
+def _chunk_raw_budget(max_frame_bytes: int) -> int:
+    """Raw (pre-base64) bytes per chunk so the encoded frame line fits the
+    cap: base64 inflates 4/3, the envelope adds slack, and the budget is
+    floored to a MULTIPLE OF 3 (a base64 group) — a non-multiple budget
+    would put '=' padding mid-stream in every chunk, and the reassembled
+    concatenation would fail strict decoding at the gauntlet (rejecting
+    every legitimate chunked submission)."""
+    budget = max((max_frame_bytes - _ENVELOPE_SLACK) * 3 // 4, 3)
+    return budget - budget % 3
+
+
 # graftlint: drain-point — framing serializes the host table to wire bytes
-def encode_frame(table: np.ndarray, schema: int = SCHEMA_VERSION) -> dict:
-    """Frame a client's r x c table for the wire (see module docstring)."""
+def encode_frame(table: np.ndarray, schema: int = SCHEMA_VERSION,
+                 max_frame_bytes: int = 0):
+    """Frame a client's r x c table for the wire (see module docstring).
+
+    Returns ONE frame dict when the payload fits `max_frame_bytes` (or the
+    cap is 0 = unlimited), else the LIST of `total` continuation frames in
+    sequence order — each frame's encoded line staying under the cap, the
+    header (nbytes/crc32 over the WHOLE payload) on frame 0."""
     t = np.ascontiguousarray(np.asarray(table, np.float32))
     if t.ndim != 2:
         raise ValueError(f"payload table must be 2-D [r, c], got {t.shape}")
     raw = t.astype(WIRE_DTYPE, copy=False).tobytes()
-    return {
+    head = {
         "schema": int(schema),
         "dtype": WIRE_DTYPE,
         "shape": [int(t.shape[0]), int(t.shape[1])],
         "nbytes": len(raw),
         "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
-        "data": base64.b64encode(raw).decode("ascii"),
+        "seq": 0,
+        "total": 1,
     }
+    budget = _chunk_raw_budget(max_frame_bytes) if max_frame_bytes > 0 else 0
+    if budget <= 0 or len(raw) <= budget:
+        return {**head, "data": base64.b64encode(raw).decode("ascii")}
+    total = -(-len(raw) // budget)
+    if total > MAX_CHUNKS:
+        raise ValueError(
+            f"table of {len(raw)} bytes needs {total} chunks at "
+            f"max_frame_bytes={max_frame_bytes}, over the MAX_CHUNKS "
+            f"{MAX_CHUNKS} bound — raise the frame cap")
+    frames = []
+    for i in range(total):
+        piece = raw[i * budget:(i + 1) * budget]
+        f = dict(head) if i == 0 else {"schema": int(schema)}
+        f["seq"], f["total"] = i, total
+        f["data"] = base64.b64encode(piece).decode("ascii")
+        frames.append(f)
+    return frames

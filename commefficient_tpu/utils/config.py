@@ -215,6 +215,39 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "capacity, BEFORE any per-submission work — "
                         "overload degrades gracefully instead of queuing "
                         "unboundedly. 0 = off (hard QUEUE_FULL only)")
+    p.add_argument("--serve_pipeline", action="store_true",
+                   help="always-on aggregation: run the serve cycle "
+                        "(invite -> collect -> close -> prep) on a "
+                        "double-buffered worker AHEAD of the merge, so "
+                        "round r+1's ingest overlaps round r's merge and "
+                        "the commit-to-dispatch gap collapses "
+                        "(server_idle_ms ~ 0). Bit-identical to the serial "
+                        "served loop by construction (same producer order, "
+                        "dispatch-gated payload compute)")
+    p.add_argument("--serve_async", action="store_true",
+                   help="buffered ASYNCHRONOUS aggregation (FedBuff-"
+                        "shaped): rounds close at a buffer-size trigger "
+                        "(--serve_buffer) instead of the W-of-N quorum, "
+                        "and late tables — stragglers past the trigger, "
+                        "pushes for a recently-closed round — fold into a "
+                        "later merge weighted (1+lag)^-alpha instead of "
+                        "being discarded. Requires --serve_payload sketch. "
+                        "Sync stays the parity reference: an async run "
+                        "where everyone answers on time is pinned bitwise "
+                        "== the sync run")
+    p.add_argument("--serve_buffer", type=int, default=0,
+                   help="--serve_async: merged-table count that triggers a "
+                        "round's merge (replaces the quorum; 0 = the "
+                        "--serve_quorum value)")
+    p.add_argument("--serve_staleness", type=float, default=0.5,
+                   help="--serve_async: staleness exponent alpha — a table "
+                        "lag rounds late folds with weight (1+lag)^-alpha "
+                        "(0 = unweighted, FedBuff default 0.5)")
+    p.add_argument("--serve_stale_rounds", type=int, default=1,
+                   help="--serve_async: how many rounds behind the newest "
+                        "window a late table is still admitted and folded; "
+                        "older submissions bounce OUT_OF_ROUND and the "
+                        "parked entry is dropped (counted)")
     p.add_argument("--serve_port", type=int, default=0,
                    help="--serve socket: loopback bind port (0 = ephemeral)")
     p.add_argument("--serve_metrics_port", type=int, default=-1,
@@ -458,6 +491,26 @@ def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
             "--watchdog_abort needs --checkpoint_dir: aborting without an "
             "emergency checkpoint would lose the run instead of resuming it"
         )
+    if getattr(args, "serve_async", False):
+        # the async fold is a compiled merge variant over wire tables —
+        # both prerequisites must fail AT LAUNCH, not as an attribute
+        # error rounds in
+        if getattr(args, "serve", "off") == "off":
+            raise SystemExit(
+                "--serve_async is a serving mode; arm --serve inproc|socket")
+        if getattr(args, "serve_payload", "announce") != "sketch":
+            raise SystemExit(
+                "--serve_async merges client tables as they arrive; the "
+                "announce path has none — arm --serve_payload sketch")
+    elif getattr(args, "serve_buffer", 0):
+        raise SystemExit(
+            "--serve_buffer is the --serve_async trigger size; without "
+            "--serve_async the close discipline is --serve_quorum")
+    if (getattr(args, "serve_pipeline", False)
+            and getattr(args, "serve", "off") == "off"):
+        raise SystemExit(
+            "--serve_pipeline pipelines the serving rounds; arm --serve "
+            "inproc|socket")
     if getattr(args, "profile_rounds", ""):
         # validate the window at launch: a typo'd spec (or a missing
         # output dir) must not surface hours later as a silently-absent

@@ -108,6 +108,11 @@ def build(args, fault_plan=None, retry_policy=None):
         # wire shape (client tables + table merge) the service round-trips
         wire_payloads=(getattr(args, "serve", "off") != "off"
                        and args.serve_payload == "sketch"),
+        # --serve_async: size the stale-fold merge variant to one cohort's
+        # worth of late tables (the buffer trigger bounds how many can
+        # straggle per round; the band bounds how long they stay foldable)
+        stale_slots=(args.num_workers
+                     if getattr(args, "serve_async", False) else 0),
         split_compile=args.split_compile,
         client_chunk=args.client_chunk,
         on_nonfinite=args.on_nonfinite,

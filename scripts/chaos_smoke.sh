@@ -18,6 +18,7 @@
 #        scripts/chaos_smoke.sh trace
 #        scripts/chaos_smoke.sh wire
 #        scripts/chaos_smoke.sh byzantine
+#        scripts/chaos_smoke.sh pipeline
 #
 # `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
 # restartPolicy would: it launches the tiny cv_train run with a fault plan
@@ -57,6 +58,13 @@
 # the per-kind attack counters fired, the run finished every round with
 # finite params, and the logged train loss FELL under attack (the trimmed
 # merge absorbing what would poison the linear sum). < 1 min CPU.
+#
+# `pipeline` mode drives the ALWAYS-ON serving stack (--serve_pipeline +
+# --serve_async, payload wire) through the real cv_train CLI under
+# client_drop + wire_delay, with the delayed submission CROSSING the round
+# boundary into a staleness-weighted fold — asserting the stale-fold and
+# fault counters fired, the runner measured the commit-to-dispatch gap,
+# and the logged loss fell finite through all of it. < 1 min CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -592,6 +600,119 @@ assert losses[-1] < losses[0], (
     f"train loss did not fall under attack: {losses}")
 print(f"byzantine: PASS (signflip+collude under trimmed merge; "
       f"loss {losses[0]:.4f} -> {losses[-1]:.4f}, 12 rounds, params finite)")
+EOF
+fi
+
+if [[ "${1:-}" == "pipeline" ]]; then
+    shift
+    exec timeout -k 10 "${CHAOS_TIMEOUT_S:-180}" python - "$@" <<'EOF'
+# pipeline chaos child (< 1 min CPU): the ALWAYS-ON serving stack end to
+# end through the real cv_train.main CLI path (tiny-model substitution) —
+# --serve_pipeline (the serve cycle on the always-on worker) AND
+# --serve_async (buffer-trigger closes, staleness-weighted folds) at once,
+# under client_drop, wire_delay (a delayed payload submission), and a
+# straggler CROSSING THE ROUND BOUNDARY (the buffer trigger fires before
+# the slow client lands; its validated table folds into the NEXT merge
+# with a staleness weight instead of being discarded). Asserts the fault
+# + stale-fold counters fired, every round committed, the runner measured
+# the commit-to-dispatch gap, and the logged train loss is finite and
+# FALLING through all of it.
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import flax.linen as nn
+
+import commefficient_tpu.data.cifar as cifar
+import cv_train
+from commefficient_tpu.obs import registry as obreg
+from commefficient_tpu.runner import loop as rloop
+
+
+class _TinyNet(nn.Module):
+    num_classes: int = 10
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+_orig = cifar.load_cifar_fed
+
+
+def _tiny(*a, **kw):
+    kw.update(synthetic_train=64, synthetic_test=32)
+    return _orig(*a, **kw)
+
+
+cv_train.ResNet9 = _TinyNet
+cv_train.load_cifar_fed = _tiny
+
+box = {}
+_orig_loop = rloop.run_loop
+
+
+def _capture_loop(*a, **kw):
+    stats = _orig_loop(*a, **kw)
+    box["stats"] = stats
+    return stats
+
+
+cv_train.run_loop = _capture_loop
+
+reg = obreg.default()
+before = {
+    "folded": reg.counter("serve_stale_folded_total").value,
+    "faults": reg.counter("resilience_faults_injected_total").value,
+}
+rows_path = os.path.join(tempfile.mkdtemp(), "rows.jsonl")
+# buffer 6-of-8 with one wire-delayed client: the trigger fires before the
+# delayed payload lands -> it is a straggler crossing the round boundary,
+# admitted into the stale band and folded into the next merge
+session = cv_train.main([
+    "--dataset", "cifar10", "--mode", "sketch",
+    "--k", "2048", "--num_rows", "3", "--num_cols", "8192",
+    "--num_clients", "16", "--num_workers", "8", "--local_batch_size", "4",
+    "--lr_scale", "0.02", "--weight_decay", "0",
+    "--data_root", "/nonexistent", "--num_rounds", "10",
+    "--eval_every", "2", "--log_jsonl", rows_path,
+    "--serve", "inproc", "--serve_payload", "sketch",
+    "--serve_pipeline", "--serve_async", "--serve_buffer", "6",
+    "--serve_deadline", "30.0",
+    "--fault_plan", "client_drop@3:clients=0;"
+    "wire_delay@4,5,6:clients=1,secs=5",
+])
+assert session.round == 10, session.round
+stats = box["stats"]
+
+folded = reg.counter("serve_stale_folded_total").value - before["folded"]
+assert folded >= 1, "no staleness-weighted fold fired (stale counter flat)"
+faults = (reg.counter("resilience_faults_injected_total").value
+          - before["faults"])
+assert faults >= 2, f"fault plan underfired: {faults}"
+assert stats.clients_dropped >= 1, stats
+assert stats.server_idle_ms >= 0.0, stats
+
+import jax
+from jax.flatten_util import ravel_pytree
+
+flat = np.asarray(ravel_pytree(jax.device_get(session.state["params"]))[0])
+assert np.isfinite(flat).all(), "params went non-finite in the async run"
+
+rows = [json.loads(l) for l in open(rows_path) if l.strip()]
+losses = [r["train_loss"] for r in rows]
+assert all(np.isfinite(losses)), losses
+assert losses[-1] < losses[0], (
+    f"train loss did not fall through the pipelined/async run: {losses}")
+print(f"pipeline: PASS (10 pipelined+async rounds; stale folds={int(folded)}, "
+      f"clients_dropped={stats.clients_dropped}, "
+      f"server_idle_ms={stats.server_idle_ms:.2f}, "
+      f"loss {losses[0]:.4f} -> {losses[-1]:.4f}, params finite)")
 EOF
 fi
 

@@ -1,0 +1,30 @@
+# graftlint: module=commefficient_tpu/federated/engine.py
+# G013 violating twin: arithmetic over the stale wire stack OUTSIDE the
+# declared staleness-fold boundary — a second, undeclared fold site whose
+# order and weight handling are pinned nowhere (the async==sync bit-
+# identity rests on there being exactly one), plus a second declared
+# boundary hiding under the first's exemption.
+import jax
+import jax.numpy as jnp
+
+
+# graftlint: staleness-fold — the declared fold site
+def _stale_fold(table, live, stale_tables, stale_weights):
+    def body(carry, xs):
+        tbl, w = carry
+        t, wt = xs
+        return (tbl + wt * t, w + wt), None
+
+    (folded, total), _ = jax.lax.scan(
+        body, (table, live), (stale_tables, stale_weights))
+    return folded, total
+
+
+def sneaky_inline_fold(table, stale_tables, stale_weights):
+    # undeclared second fold: a dense einsum reassociates the slot order
+    return table + jnp.einsum("s,src->rc", stale_weights, stale_tables)
+
+
+# graftlint: staleness-fold — a SECOND declared boundary (itself illegal)
+def another_fold(table, stale_tables, stale_weights):
+    return table + (stale_weights[:, None, None] * stale_tables).sum(0)

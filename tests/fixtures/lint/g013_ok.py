@@ -1,0 +1,24 @@
+# graftlint: module=commefficient_tpu/federated/engine.py
+# G013 conforming twin: the ONE declared staleness-fold boundary owns every
+# touch of the stale wire stack; the merge only FORWARDS the stack to it.
+import jax
+
+
+# graftlint: staleness-fold — THE declared fold site
+def _stale_fold(table, live, stale_tables, stale_weights):
+    def body(carry, xs):
+        tbl, w = carry
+        t, wt = xs
+        return (tbl + wt * t, w + wt), None
+
+    (folded, total), _ = jax.lax.scan(
+        body, (table, live), (stale_tables, stale_weights))
+    return folded, total, {"stale_weight": stale_weights.sum()}
+
+
+def merge_step(state, tables, live, stale_tables=None, stale_weights=None):
+    table = tables.sum(axis=0)
+    # bare forwarding to the boundary: the one legal shape outside it
+    folded, total, metrics = _stale_fold(
+        table, live, stale_tables, stale_weights)
+    return folded / total, metrics

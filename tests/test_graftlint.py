@@ -215,6 +215,83 @@ def test_g012_sketch_row_median_out_of_scope():
         os.unlink(path)
 
 
+def test_g013_second_declared_boundary_fires():
+    """THE staleness-fold boundary is one function in engine.py: a second
+    declaration is a second fold semantics hiding under the first's
+    exemption, and must itself be a violation."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/federated/engine.py\n"
+        "import jax\n"
+        "\n"
+        "\n"
+        "# graftlint: staleness-fold\n"
+        "def first(table, live, stale_tables, stale_weights):\n"
+        "    return table + (stale_weights[:, None, None]\n"
+        "                    * stale_tables).sum(0)\n"
+        "\n"
+        "\n"
+        "# graftlint: staleness-fold\n"
+        "def second(table, live, stale_tables, stale_weights):\n"
+        "    return table + (stale_tables * stale_weights[:, None,\n"
+        "                    None]).sum(0)\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        found = _codes(path)
+        assert found.count("G013") == 1, found  # the SECOND def, only
+    finally:
+        os.unlink(path)
+
+
+def test_g013_forwarding_is_legal_config_scalars_exempt():
+    """The merge may FORWARD the stale stack to the boundary, and the
+    stale_slots config scalar is not a wire value — neither fires; an
+    inline multiply outside the boundary does."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/federated/engine.py\n"
+        "# graftlint: staleness-fold\n"
+        "def _stale_fold(table, live, stale_tables, stale_weights):\n"
+        "    return table + (stale_weights[:, None, None]\n"
+        "                    * stale_tables).sum(0)\n"
+        "\n"
+        "\n"
+        "def merge(table, live, stale_tables, stale_weights,\n"
+        "          stale_slots=0):\n"
+        "    if stale_slots:\n"
+        "        return _stale_fold(table, live, stale_tables,\n"
+        "                           stale_weights)\n"
+        "    return table\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        assert "G013" not in _codes(path)
+    finally:
+        os.unlink(path)
+    bad = src + (
+        "\n\ndef sneaky(table, stale_tables, stale_weights):\n"
+        "    return table + (stale_weights[:, None, None]\n"
+        "                    * stale_tables).sum(0)\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(bad)
+        path = tmp.name
+    try:
+        assert "G013" in _codes(path)
+    finally:
+        os.unlink(path)
+
+
 def test_every_rule_has_fixture_pair():
     # adding a rule without fixtures should fail HERE, not in review
     for code in RULE_CODES:

@@ -1,0 +1,738 @@
+"""Always-on aggregation (PR 11): pipelined invites, double-buffered merge,
+buffered async mode, chunked payload frames.
+
+The acceptance pins live here:
+
+- a PIPELINED served run (--serve_pipeline: the serve cycle on the
+  always-on worker) is BIT-identical — params + every logged row + requeue
+  state — to the serial served run, announce AND payload paths;
+- a buffered-ASYNC run (--serve_async) where every submission answers the
+  open round dispatches the plain merge program every round and is
+  BIT-identical to the synchronous run (the FedBuff staleness machinery
+  costs nothing until someone is actually late);
+- the ingest queue holds TWO concurrently-open rounds with per-round
+  quarantine-median snapshots (the pipelined-invite admission path);
+- tables too big for one frame cross the wire as chunked continuation
+  frames, reassembled INSIDE validate_payload (G011) — any partial,
+  reordered, duplicated, or damaged sequence is MALFORMED.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+import cv_train
+from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+from commefficient_tpu.federated.api import FederatedSession, FedOptimizer
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.obs import registry as obreg
+from commefficient_tpu.obs import trace as obtrace
+from commefficient_tpu.resilience import EXIT_RESUMABLE, FaultPlan
+from commefficient_tpu.runner.loop import RunnerConfig, run_loop
+from commefficient_tpu.serve import (
+    AggregationService,
+    IngestQueue,
+    PayloadPolicy,
+    ServeConfig,
+    SocketTransport,
+    Submission,
+    TraceConfig,
+    TrafficGenerator,
+    submit_over_socket,
+    validate_payload,
+)
+from commefficient_tpu.serve.ingest import (
+    ACCEPTED,
+    ACCEPTED_STALE,
+    DUPLICATE,
+    MALFORMED,
+    NOT_INVITED,
+    OUT_OF_ROUND,
+    QUARANTINED,
+)
+from commefficient_tpu.sketch.payload import encode_frame
+
+LR = 0.05
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+def _quad_loss(params, net_state, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    err = pred - jax.nn.one_hot(batch["y"], pred.shape[-1])
+    mask = batch["mask"]
+    count = jnp.maximum(mask.sum(), 1.0)
+    per_ex = (err ** 2).sum(-1)
+    return (per_ex * mask).sum() / count, {
+        "net_state": net_state,
+        "metrics": {"loss_sum": (per_ex * mask).sum(), "count": mask.sum()}}
+
+
+def _tiny_session(payload=False, stale_slots=0, seed=0, workers=4):
+    rs = np.random.RandomState(0)
+    x = rs.randn(96, 6).astype(np.float32)
+    w_true = rs.randn(6, 3).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int32)
+    train = FedDataset(x, y, shard_iid(len(x), 12, np.random.RandomState(1)))
+    params = {"w": jnp.asarray(rs.randn(6, 3).astype(np.float32) * 0.1),
+              "b": jnp.zeros(3)}
+    d = ravel_pytree(params)[0].size
+    if payload:
+        mc = ModeConfig(mode="sketch", d=d, k=4, num_rows=3, num_cols=16,
+                        momentum_type="virtual", error_type="virtual")
+    else:
+        mc = ModeConfig(mode="uncompressed", d=d, momentum=0.9,
+                        momentum_type="virtual", error_type="none")
+    return FederatedSession(
+        train_loss_fn=_quad_loss, eval_loss_fn=_quad_loss,
+        params=params, net_state={}, mode_cfg=mc, train_set=train,
+        num_workers=workers, local_batch_size=4, seed=seed,
+        wire_payloads=payload, stale_slots=stale_slots,
+    )
+
+
+def _serve(session, cfg, rounds, trace_seed=5):
+    """Drive `rounds` served rounds through the REAL runner dispatch shape
+    (next -> dispatch -> on_dispatched -> commit -> on_committed); returns
+    the metric rows."""
+    svc = AggregationService(
+        session, cfg,
+        traffic=TrafficGenerator(
+            TraceConfig(population=session.train_set.num_clients,
+                        seed=trace_seed))).start()
+    rows = []
+    try:
+        src = svc.source()
+        for _ in range(rounds):
+            prep = src.next()
+            rows.append(session.commit_round(
+                session.dispatch_round(prep, LR))[0])
+            src.on_dispatched(session.round - 1)
+            src.on_committed(session.round)
+        src.stop()
+        # the run_loop exit discipline: the worker may have prepared
+        # rounds that never committed — rewind the live streams to the
+        # committed boundary exactly like the runner's finally does
+        import collections
+
+        with session.mutate_lock:
+            rng_state, rng_key = session.rng_snapshot
+            session.rng.set_state(rng_state)
+            session._rng_key = rng_key
+            session._requeue = collections.deque(
+                session._requeue_committed)
+            session._requeue_enqueued = dict(
+                session._requeue_ages_committed)
+    finally:
+        svc.close()
+    return rows
+
+
+def _assert_params_equal(sa, sb):
+    for x, y in zip(
+        jax.tree.leaves(jax.device_get(sa.state["params"])),
+        jax.tree.leaves(jax.device_get(sb.state["params"])),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _assert_rows_equal(ra, rb):
+    for a, b in zip(ra, rb):
+        assert set(a) == set(b)
+        for k in a:
+            assert a[k] == b[k], (k, a[k], b[k])
+
+
+# -------------------------------------------------- two concurrently-open rounds
+
+
+def _sub(cid, rnd=0, latency=0.1, payload=None):
+    return Submission(client_id=cid, round=rnd, latency_s=latency,
+                      payload=payload)
+
+
+def test_two_open_rounds_route_independently():
+    """The pipelined-invite admission path: rounds r and r+1 both open,
+    submissions route to THEIR window, NOT_INVITED/DUPLICATE are
+    per-round, and closing r leaves r+1 collecting."""
+    q = IngestQueue(capacity=8)
+    q.open_round(0, [1, 2])
+    q.open_round(1, [2, 3])
+    assert q.open_rounds() == [0, 1]
+    assert q.submit(_sub(1, rnd=0)) == ACCEPTED
+    assert q.submit(_sub(3, rnd=1)) == ACCEPTED
+    assert q.submit(_sub(3, rnd=0)) == NOT_INVITED  # per-round invites
+    assert q.submit(_sub(2, rnd=1)) == ACCEPTED
+    assert q.submit(_sub(2, rnd=1)) == DUPLICATE    # per-round dedup
+    arr0 = q.close_round(0)
+    assert [a.client_id for a in arr0] == [1]
+    assert q.open_rounds() == [1]
+    assert q.submit(_sub(9, rnd=0)) == OUT_OF_ROUND  # 0 closed
+    assert [a.client_id for a in q.arrivals(1)] == [3, 2]
+
+
+def test_third_concurrent_round_refused():
+    q = IngestQueue(capacity=8, max_open_rounds=2)
+    q.open_round(0, [1])
+    q.open_round(1, [2])
+    with pytest.raises(RuntimeError, match="max_open_rounds"):
+        q.open_round(2, [3])
+    q.close_round(0)
+    q.open_round(2, [3])  # a slot freed: fine
+
+
+def test_two_open_rounds_payload_medians_are_per_round():
+    """An early payload push for the OPEN round r+1 validates against
+    r+1's quarantine-median snapshot, never r's — the 'right state' half
+    of the pipelined-invite contract."""
+    medians = iter([1.0, 100.0])
+    policy = PayloadPolicy(rows=1, cols=4, clip_multiple=2.0,
+                           quarantine_median=lambda: next(medians))
+    q = IngestQueue(capacity=8, payload_policy=policy)
+    q.open_round(0, [1, 2])    # snapshots median 1.0
+    q.open_round(1, [1, 2])    # snapshots median 100.0
+    big = np.full((1, 4), 50.0, np.float32)  # L2 = 100 > 2*1, < 2*100
+    assert q.submit(_sub(1, rnd=0, payload=big)) == QUARANTINED
+    assert q.submit(_sub(1, rnd=1, payload=big)) == ACCEPTED
+    arr = q.arrivals(1)
+    assert len(arr) == 1 and arr[0].table is not None
+
+
+def test_stale_band_admits_late_payload_against_its_rounds_state():
+    """The buffered-async band: a late payload for a recently-closed round
+    is ACCEPTED_STALE (validated against ITS round's retained median and
+    invite list); beyond the band it bounces; dup/uninvited still mean
+    what they meant."""
+    medians = iter([1000.0, 1000.0, 1000.0])
+    policy = PayloadPolicy(rows=1, cols=4, clip_multiple=2.0,
+                           quarantine_median=lambda: next(medians))
+    q = IngestQueue(capacity=8, payload_policy=policy, stale_rounds=1,
+                    stale_capacity=4)
+    t = np.ones((1, 4), np.float32)
+    q.open_round(0, [1, 2, 3])
+    assert q.submit(_sub(1, rnd=0, payload=t)) == ACCEPTED
+    q.close_round(0)
+    q.open_round(1, [4])
+    # late for round 0: inside the 1-round band
+    assert q.submit(_sub(2, rnd=0, payload=t)) == ACCEPTED_STALE
+    assert q.submit(_sub(2, rnd=0, payload=t)) == DUPLICATE
+    assert q.submit(_sub(1, rnd=0, payload=t)) == DUPLICATE  # already in
+    assert q.submit(_sub(9, rnd=0, payload=t)) == NOT_INVITED
+    stale = q.drain_stale()
+    assert [(s.round, s.client_id) for s in stale] == [(0, 2)]
+    assert q.counters()["accepted_stale"] == 1
+    # the band moves with the newest window: round 0 ages out at open(2)
+    q.close_round(1)
+    q.open_round(2, [5])
+    assert q.submit(_sub(3, rnd=0, payload=t)) == OUT_OF_ROUND
+
+
+# ------------------------------------------------------------- chunked frames
+
+
+def _policy(rows=3, cols=128):
+    return PayloadPolicy(rows=rows, cols=cols)
+
+
+def test_chunked_frame_reassembles_bit_exact():
+    rs = np.random.RandomState(3)
+    table = rs.randn(3, 128).astype(np.float32)
+    frames = encode_frame(table, max_frame_bytes=1024)
+    assert isinstance(frames, list) and len(frames) >= 2
+    assert [f["seq"] for f in frames] == list(range(len(frames)))
+    got, decision, detail = validate_payload(frames, _policy())
+    assert decision == ACCEPTED, detail
+    np.testing.assert_array_equal(got, table)
+    # a table under the cap stays a single frame, same bytes decoded
+    single = encode_frame(table)
+    got1, decision1, _ = validate_payload(single, _policy())
+    assert decision1 == ACCEPTED
+    np.testing.assert_array_equal(got1, table)
+
+
+@pytest.mark.parametrize("damage", [
+    "drop_middle", "drop_last", "reorder", "duplicate", "flip_bit",
+    "mixed_schema", "head_only",
+])
+def test_chunk_sequence_damage_is_malformed(damage):
+    """Any broken chunk sequence — partial, reordered, duplicated,
+    bit-flipped, schema-mixed — is MALFORMED: reassembly lives inside the
+    G011 boundary and never guesses."""
+    rs = np.random.RandomState(4)
+    table = rs.randn(3, 128).astype(np.float32)
+    frames = encode_frame(table, max_frame_bytes=1024)
+    assert len(frames) >= 3
+    if damage == "drop_middle":
+        frames = [frames[0]] + frames[2:]
+    elif damage == "drop_last":
+        frames = frames[:-1]
+    elif damage == "reorder":
+        frames = [frames[1], frames[0]] + frames[2:]
+    elif damage == "duplicate":
+        frames = frames + [frames[-1]]
+    elif damage == "flip_bit":
+        frames[1] = dict(frames[1])
+        frames[1]["data"] = FaultPlan.corrupt_frame(
+            {"data": frames[1]["data"]})["data"]
+    elif damage == "mixed_schema":
+        frames[1] = dict(frames[1])
+        frames[1]["schema"] = 99
+    elif damage == "head_only":
+        frames = [frames[0]]
+    _, decision, _ = validate_payload(frames, _policy())
+    assert decision == MALFORMED
+
+
+@pytest.mark.parametrize("cap", [1000, 1002, 1003, 1024, 1100])
+def test_chunked_frames_reassemble_at_any_frame_cap(cap):
+    """The chunk raw-byte budget is floored to a base64 group (multiple of
+    3): a cap whose derived budget is NOT a multiple of 3 must not leave
+    '=' padding mid-stream and reject legitimate chunked submissions
+    (regression: caps like 1002/1003 used to MALFORMED every table)."""
+    rs = np.random.RandomState(7)
+    table = rs.randn(3, 128).astype(np.float32)
+    frames = encode_frame(table, max_frame_bytes=cap)
+    assert isinstance(frames, list) and len(frames) >= 2
+    got, decision, detail = validate_payload(frames, _policy())
+    assert decision == ACCEPTED, (cap, detail)
+    np.testing.assert_array_equal(got, table)
+
+
+def test_lone_mid_sequence_frame_is_malformed():
+    """A single frame claiming seq>0/total>1 (its siblings never arrived)
+    must not pass the single-frame path."""
+    rs = np.random.RandomState(5)
+    frames = encode_frame(rs.randn(3, 128).astype(np.float32),
+                          max_frame_bytes=1024)
+    _, decision, detail = validate_payload(frames[1], _policy())
+    assert decision == MALFORMED and "chunk" in detail or "partial" in detail
+
+
+def test_chunked_frames_over_real_socket():
+    """A table bigger than the transport's frame cap round-trips the
+    loopback socket as continuation lines and admits bit-exact; a
+    connection that dies mid-sequence admits nothing and counts
+    MALFORMED."""
+    rs = np.random.RandomState(6)
+    table = rs.randn(3, 128).astype(np.float32)  # 1536 B > 1024 cap
+    q = IngestQueue(capacity=8, payload_policy=_policy())
+    q.open_round(0, [7, 8])
+    t = SocketTransport(q, max_frame_bytes=1024, read_deadline_s=5.0)
+    t.start()
+    try:
+        status = submit_over_socket(t.address, _sub(7, payload=table),
+                                    max_frame_bytes=1024)
+        assert status == ACCEPTED
+        arr = q.arrivals(0)
+        assert len(arr) == 1
+        np.testing.assert_array_equal(arr[0].table, table)
+        # partial sequence: send only the first chunk line, then die
+        import json as _json
+        import socket as _socket
+
+        from commefficient_tpu.serve.transport import _wire_lines
+
+        lines = _wire_lines(_sub(8, payload=table), 1024)
+        assert len(lines) >= 2 and "chunk" in lines[0]
+        before = q.counters()["rejected_malformed"]
+        with _socket.create_connection(t.address, timeout=5) as s:
+            s.sendall(_json.dumps(lines[0]).encode() + b"\n")
+        # the handler sees EOF with the sequence open
+        deadline = 50
+        while (q.counters()["rejected_malformed"] == before
+               and deadline > 0):
+            import time as _time
+
+            _time.sleep(0.05)
+            deadline -= 1
+        assert q.counters()["rejected_malformed"] == before + 1
+        assert [a.client_id for a in q.arrivals(0)] == [7]
+    finally:
+        t.stop()
+
+
+def test_chunk_sequence_byte_flood_cut_off_before_completion():
+    """A hostile sequence claiming a huge total must be cut off once it
+    buffers more bytes than the expected payload could encode to — BEFORE
+    completion, so per-connection memory never waits on a complete
+    submission."""
+    q = IngestQueue(capacity=8, payload_policy=_policy())  # 1536-byte table
+    q.open_round(0, [7])
+    t = SocketTransport(q, max_frame_bytes=2048, read_deadline_s=5.0)
+    seqs: dict = {}
+    # the junk field shows the budget counts WIRE bytes, not just data —
+    # padding any other frame field must not evade the cut
+    reply = None
+    for i in range(64):  # way past one table's encoded size
+        reply = t._handle_chunk(
+            {"client_id": 7, "round": 0,
+             "chunk": {"schema": 2, "seq": i, "total": 64,
+                       "junk": "A" * 1500, "data": ""}},
+            seqs, 1600)
+        if reply is not None:
+            break
+    assert reply is not None and reply["status"] == MALFORMED
+    assert "exceeds" in reply["detail"]
+    assert not seqs  # the sequence was discarded, not retained
+
+
+def test_rewind_prunes_uncommitted_stale_entries_from_queue():
+    """A stale arrival for a round the runner never committed must not
+    survive rewind_to_committed — the round is re-served, and its
+    pre-rewind stale twin would otherwise double-merge the client."""
+    medians = iter([1000.0, 1000.0])
+    policy = PayloadPolicy(rows=1, cols=4, clip_multiple=2.0,
+                           quarantine_median=lambda: next(medians))
+    q = IngestQueue(capacity=8, payload_policy=policy, stale_rounds=2,
+                    stale_capacity=4)
+    t = np.ones((1, 4), np.float32)
+    q.open_round(5, [1, 2])
+    q.close_round(5)
+    q.open_round(6, [3])
+    assert q.submit(_sub(1, rnd=5, payload=t)) == ACCEPTED_STALE
+    # rounds >= 5 never committed: the entry (and round 5's retained band
+    # state) must unwind; a later push for round 5 is OUT_OF_ROUND until
+    # it is re-served
+    dropped = q.prune_stale(5)
+    assert dropped == 1
+    assert q.drain_stale() == []
+    assert q.submit(_sub(2, rnd=5, payload=t)) == OUT_OF_ROUND
+
+
+def test_prune_stale_rewinds_early_push_high_water_mark():
+    """After a rewind, the replayed timeline's BUFFERED/OUT_OF_ROUND
+    verdicts must match the original run's round for round — the
+    early-push high-water mark rewinds with the windows."""
+    from commefficient_tpu.serve.ingest import BUFFERED
+
+    q = IngestQueue(capacity=8)
+    q.open_round(0, [1])
+    q.open_round(1, [2])
+    q.close_round(0)
+    q.close_round(1)
+    q.prune_stale(1)  # rounds >= 1 never committed: replay from round 1
+    q.open_round(1, [2])
+    # a push for round 2 is EARLY again, exactly like the original run
+    # (without the high-water rewind it would bounce OUT_OF_ROUND)
+    assert q.submit(_sub(5, rnd=2)) == BUFFERED
+
+
+def test_shed_retry_of_stale_admitted_submission_hears_duplicate():
+    """At-least-once under overload, stale band included: a retry of a
+    submission already ACCEPTED_STALE must hear DUPLICATE, not SHEDDING."""
+    policy = PayloadPolicy(rows=1, cols=4)
+    q = IngestQueue(capacity=4, pending_capacity=0, payload_policy=policy,
+                    stale_rounds=1, stale_capacity=4, shed_watermark=0.25)
+    t = np.ones((1, 4), np.float32)
+    q.open_round(0, [1, 2, 3])
+    q.close_round(0)
+    q.open_round(1, [4, 5, 6])
+    assert q.submit(_sub(1, rnd=0, payload=t)) == ACCEPTED_STALE
+    # push depth past the shed watermark
+    assert q.submit(_sub(4, rnd=1, payload=t)) == ACCEPTED
+    assert q.submit(_sub(5, rnd=1, payload=t)) in (ACCEPTED, "SHEDDING")
+    assert q.depth() >= q._shed_depth
+    # the lost-reply retry: already in the stale band == success
+    assert q.submit(_sub(1, rnd=0, payload=t)) == DUPLICATE
+
+
+# ------------------------------------------------ THE pipelined parity pins
+
+
+def test_pipelined_announce_bitwise_equal_serial():
+    """Pipelined announce serving == serial announce serving, bitwise:
+    params, every logged row, and the requeue state — the worker is the
+    same single producer, just earlier."""
+    a = _tiny_session()
+    ra = _serve(a, ServeConfig(quorum=2, deadline_s=1.0), 4)
+    b = _tiny_session()
+    rb = _serve(b, ServeConfig(quorum=2, deadline_s=1.0, pipeline=True), 4)
+    _assert_rows_equal(ra, rb)
+    _assert_params_equal(a, b)
+    assert list(a._requeue) == list(b._requeue)
+    assert a._requeue_enqueued == b._requeue_enqueued
+
+
+def test_pipelined_payload_bitwise_equal_serial():
+    """Pipelined wire-payload serving == serial, bitwise — the dispatch
+    gate hands the worker the exact head state the serial source read."""
+    a = _tiny_session(payload=True)
+    ra = _serve(a, ServeConfig(quorum=2, deadline_s=1.0,
+                               payload="sketch"), 4)
+    b = _tiny_session(payload=True)
+    rb = _serve(b, ServeConfig(quorum=2, deadline_s=1.0, payload="sketch",
+                               pipeline=True), 4)
+    _assert_rows_equal(ra, rb)
+    _assert_params_equal(a, b)
+
+
+def test_pipelined_runner_loop_bitwise_equal_serial_and_idle_measured():
+    """Through the REAL async runner: pipelined == serial bitwise, and the
+    loop measured the commit-to-dispatch gap (server_idle_ms present)."""
+    def run(pipelined):
+        s = _tiny_session(payload=True)
+        svc = AggregationService(
+            s, ServeConfig(quorum=2, deadline_s=1.0, payload="sketch",
+                           pipeline=pipelined),
+            traffic=TrafficGenerator(
+                TraceConfig(population=12, seed=5))).start()
+        try:
+            stats = run_loop(
+                s, FedOptimizer(lambda e: LR, 3),
+                RunnerConfig(total_rounds=5, eval_every=100),
+                source=svc.source())
+        finally:
+            svc.close()
+        return s, stats
+
+    sa, stats_a = run(False)
+    sb, stats_b = run(True)
+    _assert_params_equal(sa, sb)
+    assert stats_b.rounds == stats_a.rounds == 5
+    assert stats_b.server_idle_ms >= 0.0
+    assert stats_b.server_idle_ms_max >= stats_b.server_idle_ms
+
+
+def test_pipelined_session_reuse_rewinds_to_committed():
+    """A pipelined loop stopped mid-stream (worker rounds prepared but
+    never committed) rewinds; a SECOND loop on the same session+service
+    continues bit-identically with an uninterrupted serial run."""
+    a = _tiny_session()
+    svc = AggregationService(
+        a, ServeConfig(quorum=2, deadline_s=1.0, pipeline=True),
+        traffic=TrafficGenerator(TraceConfig(population=12, seed=5))).start()
+    try:
+        opt = FedOptimizer(lambda e: LR, 3)
+        run_loop(a, opt, RunnerConfig(total_rounds=2, eval_every=100),
+                 source=svc.source())
+        run_loop(a, opt, RunnerConfig(total_rounds=5, eval_every=100),
+                 source=svc.source())
+    finally:
+        svc.close()
+    b = _tiny_session()
+    _serve(b, ServeConfig(quorum=2, deadline_s=1.0), 5)
+    _assert_params_equal(a, b)
+    assert a.round == b.round == 5
+
+
+def test_pipeline_stage_spans_and_histograms_emitted(tmp_path):
+    """The double-buffered pipeline is observable: serve-pipeline stage
+    spans land in the trace, the stage histograms fill, and the worker's
+    serve_round spans carry the round numbers."""
+    tracer = obtrace.get()
+    tracer.configure(trace_path=str(tmp_path / "trace.json"))
+    try:
+        base = {
+            st: obreg.default().histogram(f"serve_stage_{st}_ms").count
+            for st in obreg.SERVE_STAGES}
+        a = _tiny_session(payload=True)
+        _serve(a, ServeConfig(quorum=2, deadline_s=1.0, payload="sketch",
+                              pipeline=True), 3)
+        events = tracer.events()
+        pipe = [e for e in events if e.get("cat") == "serve-pipeline"]
+        names = {e.get("name") for e in pipe}
+        assert "serve_round" in names
+        for st in obreg.SERVE_STAGES:
+            assert f"stage:{st}" in names, names
+            assert (obreg.default().histogram(
+                f"serve_stage_{st}_ms").count > base[st]), st
+        rounds = {e.get("args", {}).get("round") for e in pipe
+                  if e.get("name") == "serve_round"}
+        assert {0, 1, 2} <= rounds
+    finally:
+        tracer.configure()
+
+
+# --------------------------------------------------- THE async parity pin
+
+
+def test_async_everyone_on_time_bitwise_equal_sync():
+    """Buffered async with the trigger at the full quorum and everyone on
+    time NEVER folds stale — every round dispatches the plain merge
+    program, and the run is bit-identical to the synchronous one (params +
+    every logged row)."""
+    a = _tiny_session(payload=True)
+    ra = _serve(a, ServeConfig(quorum=4, deadline_s=1e9,
+                               payload="sketch"), 4)
+    b = _tiny_session(payload=True, stale_slots=4)
+    rb = _serve(b, ServeConfig(quorum=4, deadline_s=1e9, payload="sketch",
+                               async_mode=True, buffer_size=4), 4)
+    _assert_rows_equal(ra, rb)
+    _assert_params_equal(a, b)
+
+
+def test_async_pipelined_straggler_folds_staleness_weighted():
+    """The FedBuff behavior: with the buffer trigger below the arrival
+    count, stragglers' validated tables fold into the NEXT merge
+    (stale_folded metric + counters fire, params stay finite) instead of
+    being discarded — and the folded run genuinely differs from the
+    drop-the-stragglers sync run."""
+    reg = obreg.default()
+    base_folded = reg.counter("serve_stale_folded_total").value
+    a = _tiny_session(payload=True, stale_slots=4)
+    ra = _serve(a, ServeConfig(quorum=4, deadline_s=60.0, payload="sketch",
+                               async_mode=True, buffer_size=2,
+                               pipeline=True), 5)
+    folded = reg.counter("serve_stale_folded_total").value - base_folded
+    assert folded > 0
+    assert any(r.get("stale_folded", 0) > 0 for r in ra)
+    assert any(r.get("stale_weight", 0) > 0 for r in ra)
+    # weights are (1+lag)^-0.5 <= 2^-0.5 < 1: the fold is down-weighted
+    for r in ra:
+        if r.get("stale_folded", 0):
+            assert r["stale_weight"] < r["stale_folded"]
+    flat = np.asarray(ravel_pytree(jax.device_get(a.state["params"]))[0])
+    assert np.isfinite(flat).all()
+    # vs sync at the same trigger (stragglers dropped): params differ —
+    # the stale mass really entered the table
+    b = _tiny_session(payload=True)
+    _serve(b, ServeConfig(quorum=2, deadline_s=60.0, payload="sketch"), 5)
+    fb = np.asarray(ravel_pytree(jax.device_get(b.state["params"]))[0])
+    assert not np.array_equal(flat, fb)
+
+
+def test_async_stale_band_expiry_drops_and_counts():
+    """An entry older than the stale_rounds band is dropped (counted),
+    never folded — staleness has a horizon."""
+    reg = obreg.default()
+    base = reg.counter("serve_stale_dropped_total").value
+    a = _tiny_session(payload=True, stale_slots=4)
+    svc = AggregationService(
+        a, ServeConfig(quorum=4, deadline_s=60.0, payload="sketch",
+                       async_mode=True, buffer_size=2, stale_rounds=1),
+        traffic=TrafficGenerator(TraceConfig(population=12, seed=5))).start()
+    try:
+        src = svc.source()
+        prep = src.next()
+        # age the stash artificially: pretend the stash entries came from
+        # far behind the band
+        with svc._meta_lock:
+            svc._stale_stash = [(e[0] - 5, e[1], e[2], e[3])
+                                for e in svc._stale_stash]
+        a.commit_round(a.dispatch_round(prep, LR))
+        src.on_dispatched(a.round - 1)
+        src.next()  # builds round 1's fold: the aged entries drop
+        src.stop()
+    finally:
+        svc.close()
+    assert reg.counter("serve_stale_dropped_total").value > base
+
+
+# ------------------------------------------------------------- config guards
+
+
+def test_async_config_validation():
+    with pytest.raises(ValueError, match="announce"):
+        AggregationService(
+            _tiny_session(),
+            ServeConfig(quorum=2, async_mode=True),
+            traffic=TrafficGenerator(TraceConfig()))
+    with pytest.raises(ValueError, match="stale_slots"):
+        AggregationService(
+            _tiny_session(payload=True),
+            ServeConfig(quorum=2, payload="sketch", async_mode=True),
+            traffic=TrafficGenerator(TraceConfig()))
+    with pytest.raises(ValueError, match="serve_buffer"):
+        AggregationService(
+            _tiny_session(),
+            ServeConfig(quorum=2, buffer_size=3),
+            traffic=TrafficGenerator(TraceConfig()))
+
+
+def test_engine_rejects_stale_slots_without_wire_or_with_robust():
+    from commefficient_tpu.federated import engine
+
+    mc = ModeConfig(mode="sketch", d=16, k=4, num_rows=2, num_cols=8,
+                    momentum_type="virtual", error_type="virtual")
+    with pytest.raises(ValueError, match="wire"):
+        engine.EngineConfig(mode=mc, stale_slots=4)
+    with pytest.raises(ValueError, match="merge_policy"):
+        engine.EngineConfig(mode=mc, stale_slots=4, wire_payloads=True,
+                            merge_policy="median")
+
+
+def test_cli_flag_validation():
+    from commefficient_tpu.utils.config import make_parser, resolve_defaults
+
+    base = ["--dataset", "cifar10", "--mode", "sketch", "--k", "4"]
+    with pytest.raises(SystemExit, match="serve_payload|sketch"):
+        resolve_defaults(make_parser("cv").parse_args(
+            base + ["--serve", "inproc", "--serve_async"]))
+    with pytest.raises(SystemExit, match="serve_async"):
+        resolve_defaults(make_parser("cv").parse_args(
+            base + ["--serve", "inproc", "--serve_buffer", "3"]))
+    with pytest.raises(SystemExit, match="serve"):
+        resolve_defaults(make_parser("cv").parse_args(
+            base + ["--serve_pipeline"]))
+
+
+# --------------------------------------------------------------- CLI chaos
+
+
+@pytest.fixture()
+def tiny_cv(tmp_path, monkeypatch):
+    import flax.linen as nn
+
+    import commefficient_tpu.data.cifar as cifar_mod
+
+    orig = cifar_mod.load_cifar_fed
+
+    def tiny(*a, **kw):
+        kw.update(synthetic_train=64, synthetic_test=32)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(cv_train, "load_cifar_fed", tiny)
+
+    class _TinyNet(nn.Module):
+        num_classes: int = 10
+        dtype: str = "float32"
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(32)(x))
+            return nn.Dense(self.num_classes)(x)
+
+    monkeypatch.setattr(cv_train, "ResNet9", _TinyNet)
+    return tmp_path
+
+
+@pytest.mark.chaos
+def test_cli_pipelined_preempt_resume_bit_identical(tiny_cv, tmp_path):
+    """--serve_pipeline through the real CLI, preempted mid-run: the
+    resumed run is bit-identical to the uninterrupted pipelined run —
+    prepared-but-uncommitted worker rounds unwind through the existing
+    committed-snapshot rewinds."""
+    flags = ("--serve", "inproc", "--serve_pipeline", "--serve_quorum", "5",
+             "--serve_deadline", "2.0", "--num_rounds", "4")
+    argv = [
+        "--dataset", "cifar10", "--mode", "uncompressed", "--num_clients",
+        "8", "--num_workers", "2", "--local_batch_size", "4", "--lr_scale",
+        "0.05", "--weight_decay", "0", "--data_root", "/nonexistent", *flags,
+    ]
+    before = {t.name for t in threading.enumerate()}
+    sa = cv_train.main(list(argv))  # uninterrupted pipelined reference
+
+    ckdir = str(tmp_path / "ck")
+    chaos = ["--checkpoint_dir", ckdir, "--checkpoint_every", "2",
+             "--fault_plan", "preempt@2"]
+    with pytest.raises(SystemExit) as ei:
+        cv_train.main(list(argv) + chaos)
+    assert ei.value.code == EXIT_RESUMABLE
+    sc = cv_train.main(list(argv) + chaos + ["--resume"])
+    assert sc.round == 4
+    _assert_params_equal(sa, sc)
+    assert list(sa._requeue) == list(sc._requeue)
+    # and the pipelined CLI run == the serial CLI run, end to end
+    sb = cv_train.main([a for a in argv if a != "--serve_pipeline"])
+    _assert_params_equal(sa, sb)
+    leaked = {t.name for t in threading.enumerate()} - before
+    assert not {n for n in leaked if n.startswith("serve-")}, leaked

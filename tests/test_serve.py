@@ -1084,7 +1084,7 @@ def test_close_wall_cut_excludes_arrivals_racing_the_drain():
     asm = CohortAssembler(q, quorum=2, deadline_s=0.05)
     orig_wait = q.wait_for
 
-    def racy_wait(count, timeout_s):
+    def racy_wait(count, timeout_s, rnd=None):
         q.submit(_sub(1))
         q.submit(_sub(2))
         snap = orig_wait(count, 0.0)
@@ -1109,7 +1109,7 @@ def test_close_wall_deadline_verdict_survives_racing_arrivals():
     asm = CohortAssembler(q, quorum=3, deadline_s=0.01)
     orig_wait = q.wait_for
 
-    def racy_wait(count, timeout_s):
+    def racy_wait(count, timeout_s, rnd=None):
         q.submit(_sub(1))
         snap = orig_wait(count, 0.01)  # times out short of quorum
         q.submit(_sub(2))
