@@ -96,6 +96,7 @@ from .core import Analyzer, Rule, SourceFile, Violation
 from .rules_config import UnvalidatedConfigRead
 from .rules_dataflow import DonationAfterUse, RngKeyReuse
 from .rules_io import RawCheckpointWrite
+from .rules_ledger import LedgerWriteOutsideCommit
 from .rules_obs import ObsCallInCompiledScope
 from .rules_parity import ReservedLeafAccess, UnorderedReduction
 from .rules_robust import (RobustOrderSensitivity,
@@ -118,6 +119,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     WireBytesInCompiledScope,
     RobustOrderSensitivity,
     StalenessFoldBoundary,
+    LedgerWriteOutsideCommit,
 )
 
 RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)

@@ -60,6 +60,7 @@ class FunctionInfo:
     payload_boundary: bool = False
     robust_merge: bool = False
     staleness_fold: bool = False
+    ledger_commit: bool = False
 
 
 class SourceFile:
@@ -109,9 +110,11 @@ class SourceFile:
                         cand & self.directives.robust_merge_linenos)
                     stale = bool(
                         cand & self.directives.staleness_fold_linenos)
+                    ledg = bool(
+                        cand & self.directives.ledger_commit_linenos)
                     out.append(FunctionInfo(qual, start, child.lineno, end,
                                             drain, sketch, payload, robust,
-                                            stale))
+                                            stale, ledg))
                     visit(child, f"{qual}.")
                 elif isinstance(child, ast.ClassDef):
                     visit(child, f"{prefix}{child.name}.")
@@ -157,6 +160,12 @@ class SourceFile:
         """True when any enclosing function is the declared staleness-fold
         boundary (G013's sanctioned stale-wire arithmetic site)."""
         return any(f.staleness_fold
+                   for f in self.enclosing_functions(lineno))
+
+    def in_ledger_commit(self, lineno: int) -> bool:
+        """True when any enclosing function is the declared ledger-commit
+        boundary (G014's sanctioned round-ledger append site)."""
+        return any(f.ledger_commit
                    for f in self.enclosing_functions(lineno))
 
     # -- import index --------------------------------------------------------

@@ -18,6 +18,12 @@ Three forms, all line-anchored comments:
                                          (G012 exempt) — the ONE place order
                                          statistics may run over
                                          client-stacked wires in parity scope
+    # graftlint: ledger-commit           on/above a `def`: this function IS
+                                         the declared round-ledger append
+                                         site (G014 exempt) — the ONE place
+                                         in runner/+federated/ that may
+                                         append to the durable ledger (the
+                                         commit boundary)
     # graftlint: module=<relpath>        fixture support: analyze this file as
                                          if it lived at <relpath> (scoped rules
                                          fire on test snippets)
@@ -66,6 +72,9 @@ class Directives:
     # linenos carrying a staleness-fold marker (G013's sanctioned
     # staleness-weighted fold site — engine._stale_fold)
     staleness_fold_linenos: set[int]
+    # linenos carrying a ledger-commit marker (G014's sanctioned round-
+    # ledger append site — FederatedSession._publish_round_obs)
+    ledger_commit_linenos: set[int]
     # fixture impersonation path, or None
     module_override: str | None
     # (lineno, message) for malformed directives — surfaced as G000
@@ -121,6 +130,7 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
         line_disables={}, file_disables=set(), drain_linenos=set(),
         sketch_boundary_linenos=set(), payload_boundary_linenos=set(),
         robust_merge_linenos=set(), staleness_fold_linenos=set(),
+        ledger_commit_linenos=set(),
         module_override=None, errors=[],
     )
     for lineno, line in _comments(text):
@@ -148,6 +158,8 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
             d.robust_merge_linenos.add(lineno)
         elif verb == "staleness-fold" and not has_eq:
             d.staleness_fold_linenos.add(lineno)
+        elif verb == "ledger-commit" and not has_eq:
+            d.ledger_commit_linenos.add(lineno)
         elif verb == "module" and has_eq:
             d.module_override = arg.strip()
         elif not verb:
@@ -158,6 +170,6 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
                 f"unknown graftlint directive {verb!r} "
                 "(expected disable/disable-file/drain-point/"
                 "sketch-boundary/payload-boundary/robust-merge/"
-                "staleness-fold/module)",
+                "staleness-fold/ledger-commit/module)",
             ))
     return d

@@ -1,0 +1,114 @@
+"""G014 ledger-write-outside-commit.
+
+The round ledger's entire value rests on ONE invariant: a record appears
+if and only if its round COMMITTED. That is what lets `replay-check` call
+a gap a bug, lets `diff` line two runs up round-by-round, and lets resume
+continue one file without duplicates — prepared-but-uncommitted rounds
+(prefetched, pipelined, rewound at loop exit) must be invisible to it.
+The invariant holds because appends happen at exactly one place: the
+commit-boundary publish hook, declared ``# graftlint: ledger-commit``
+(FederatedSession._publish_round_obs). An append anywhere else in the
+round machinery — a prepare path writing optimistically, a serving layer
+logging arrivals as if they were commits, an exit path "flushing" rounds
+that never published — silently turns the ledger from a commit log into
+a guess.
+
+Detection, in the round-machinery scope (runner/ + federated/):
+
+- any call resolving through the import table into ``obs.ledger``
+  (``RoundLedger(...)`` construction is legal — building the writer is
+  config wiring; ``append_round``/``write_postmortem_bundle`` reached as
+  module functions are not append sites either — the method call is);
+- any ``.append_round(...)`` method call — the ledger's one write verb
+  (no other API in the repo shares the name);
+- outside a function declared ``# graftlint: ledger-commit``. The
+  boundary lives in exactly one sanctioned file
+  (``federated/api.py``); a declaration elsewhere in scope — or a SECOND
+  one there — is itself a violation (the second-boundary discipline G012
+  and G013 established).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+# the round machinery: where commits happen, and therefore where a stray
+# append could masquerade as one
+_LEDGER_SCOPE = (
+    f"{PACKAGE}/runner/",
+    f"{PACKAGE}/federated/",
+)
+
+# the ONE file the ledger-commit boundary may be declared in
+_BOUNDARY_FILE = f"{PACKAGE}/federated/api.py"
+
+# the ledger's write verb — distinctive enough to flag on name alone
+_APPEND_ATTR = "append_round"
+
+
+class LedgerWriteOutsideCommit(Rule):
+    code = "G014"
+    name = "ledger-write-outside-commit"
+    fixit = ("route the ledger append through the ONE declared "
+             "`# graftlint: ledger-commit` boundary "
+             "(FederatedSession._publish_round_obs) — records exist iff "
+             "their round committed; an append elsewhere logs rounds the "
+             "committed-snapshot rewind may take back")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(_LEDGER_SCOPE)
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        declared = [f for f in src.functions if f.ledger_commit]
+        in_boundary_file = src.rel == _BOUNDARY_FILE
+        illegal = declared if not in_boundary_file else declared[1:]
+        for extra in illegal:
+            out.append(Violation(
+                code=self.code, name=self.name, rel=src.rel,
+                lineno=extra.def_lineno, col=0,
+                message=(
+                    f"ledger-commit boundary declared at {extra.qualname} — "
+                    f"the ledger append site is ONE declared function in "
+                    f"{_BOUNDARY_FILE}; another declaration is a second "
+                    f"write path hiding under the exemption"),
+                fixit=("fold the append into the existing declared "
+                       "boundary (FederatedSession._publish_round_obs)"),
+                line_text=src.line(extra.def_lineno),
+                symbol=extra.qualname,
+            ))
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = self._classify(src, node)
+            if msg is None:
+                continue
+            if in_boundary_file and src.in_ledger_commit(node.lineno):
+                continue
+            out.append(self.violation(src, node, msg))
+        return out
+
+    def _classify(self, src: SourceFile, node: ast.Call) -> str | None:
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == _APPEND_ATTR):
+            return (f".{_APPEND_ATTR}() appends to the round ledger "
+                    "outside the declared commit boundary — ledger records "
+                    "exist iff their round committed")
+        dotted = src.resolve_dotted(node.func)
+        if dotted is None:
+            return None
+        parts = dotted.split(".")
+        if "ledger" in parts and (
+                "obs" in parts or dotted.startswith(f"{PACKAGE}.obs")):
+            tail = parts[-1]
+            if tail in ("RoundLedger", "write_postmortem_bundle",
+                        "read_records", "round_records", "replay_check",
+                        "diff", "main"):
+                # constructing the writer / reading / postmortem dumps are
+                # wiring and diagnostics, not round appends
+                return None
+            return (f"{dotted}() reaches into obs.ledger from the round "
+                    "machinery outside the declared ledger-commit boundary")
+        return None

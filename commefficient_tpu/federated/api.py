@@ -65,6 +65,11 @@ class PreparedRound:
     # device-side aux (see FederatedSession.compute_client_tables). None =
     # a normal batch round; dispatch_round routes on it.
     payload: tuple | None = None
+    # sketch-health cadence (--health_every): whether THIS round's batch
+    # carries an armed `_health_on` flag — the host-side mirror of the
+    # compiled cond's gate, so commit knows which rounds' health blocks
+    # are real without reading device values
+    health_on: bool = False
 
 
 @dataclasses.dataclass
@@ -87,6 +92,11 @@ class InFlightRound:
     requeue_depths: list = dataclasses.field(default_factory=list)
     requeue: tuple = ()
     requeue_ages: tuple = ()
+    # round-ledger / health bookkeeping (aligned with lrs): each round's
+    # invited cohort ids and whether its health cadence was armed — the
+    # host-side context commit hands to the obs sinks (ledger, monitor)
+    cohorts: list = dataclasses.field(default_factory=list)
+    health_on: list = dataclasses.field(default_factory=list)
 
     @property
     def num_rounds(self) -> int:
@@ -136,6 +146,8 @@ class FederatedSession:
         merge_trim: int = 0,
         quarantine_scope: str = "cohort",
         stale_slots: int = 0,
+        health_every: int = 0,
+        ledger_fingerprint: bool = False,
     ):
         # client_shards: 0 = derive from the mesh (the default — on a >1-
         # device mesh with a mode in engine.supports_sharded_round's scope
@@ -172,10 +184,34 @@ class FederatedSession:
             # buffered-async serving (--serve_async): slot count of the
             # stale-fold merge variant; 0 keeps the sync programs only
             stale_slots=stale_slots,
+            # sketch-health estimators (--health_every N > 0) and round-
+            # ledger fingerprints (--ledger): in-program observability that
+            # only READS round state — armed runs stay bit-identical to
+            # unarmed ones (tests/test_sketch_health.py pins it)
+            health=health_every > 0,
+            ledger_fingerprint=ledger_fingerprint,
             # CLI "halt" is a host-side policy on top of the compiled "skip"
             # guard (state stays clean either way; the CLI decides to stop)
             on_nonfinite="skip" if on_nonfinite == "halt" else on_nonfinite,
         )
+        if health_every < 0:
+            raise ValueError(
+                f"health_every must be >= 0, got {health_every}")
+        if (health_every or ledger_fingerprint) and split_compile:
+            raise ValueError(
+                "health_every / ledger fingerprints are fused-paths-only "
+                "(the split program boundary does not thread the round "
+                "metrics the estimators ride); drop --split_compile or the "
+                "obs flag"
+            )
+        self._health_every = max(health_every, 1)
+        # obs sinks, attached by the CLIs (or tests) after construction:
+        # commit_rounds hands every committed round to them in order —
+        # monitor (health block -> registry/trace/history), slo (windowed
+        # rules), ledger (the durable append). All default None = inert.
+        self.health_monitor = None
+        self.slo = None
+        self.ledger = None
         if wire_payloads and split_compile:
             raise ValueError(
                 "wire_payloads IS a two-program round (client tables + "
@@ -695,12 +731,21 @@ class FederatedSession:
             scale, src = self.fault_plan.adversarial_plan(rnd, len(ids))
             batch[engine.ADV_SCALE_KEY] = scale
             batch[engine.ADV_SRC_KEY] = src
+        health_on = False
+        if self.cfg.health:
+            # the health-cadence flag rides the batch like `_valid` (shape-
+            # constant from round 0 — the cadence is the VALUE, the program
+            # never recompiles); [W]-shaped so it shards/stacks uniformly
+            health_on = rnd % self._health_every == 0
+            batch[engine.HEALTH_KEY] = np.full(
+                len(ids), 1.0 if health_on else 0.0, np.float32)
         self._rng_key, sub = jax.random.split(self._rng_key)
         return PreparedRound(
             rnd, ids, batch, sub, (self.rng.get_state(), self._rng_key),
             masked=masked, requeue_depth=len(self._requeue),
             requeue=tuple(self._requeue),
             requeue_ages=tuple(self._requeue_enqueued.items()),
+            health_on=health_on,
         )
 
     def _serve_requeue(self, ids, rnd: int = 0):
@@ -877,11 +922,13 @@ class FederatedSession:
             merge = self._payload_merge_stale
             extra = (jnp.asarray(stale[0], jnp.float32),
                      jnp.asarray(stale[1], jnp.float32))
+        kw = ({"health_on": jnp.float32(1.0 if prep.health_on else 0.0)}
+              if self.cfg.health else {})
         with self._mesh_ctx():
             new_state, metrics = merge(
                 state, jnp.asarray(wire_tables), nstates, mvals, part,
                 jnp.asarray(arrived, jnp.float32), jnp.float32(lr),
-                noise_rng, lnorms, *extra)
+                noise_rng, lnorms, *extra, **kw)
         self._head_state = new_state
         self._inflight += 1
         self._inflight_rounds += 1
@@ -890,7 +937,9 @@ class FederatedSession:
                              masked=[prep.masked],
                              requeue_depths=[prep.requeue_depth],
                              requeue=prep.requeue,
-                             requeue_ages=prep.requeue_ages)
+                             requeue_ages=prep.requeue_ages,
+                             cohorts=[prep.ids],
+                             health_on=[prep.health_on])
 
     def dispatch_round(self, prep: PreparedRound, lr: float) -> InFlightRound:
         """Enqueue one round on the device WITHOUT a host sync. Chains on the
@@ -929,7 +978,9 @@ class FederatedSession:
                              masked=[prep.masked],
                              requeue_depths=[prep.requeue_depth],
                              requeue=prep.requeue,
-                             requeue_ages=prep.requeue_ages)
+                             requeue_ages=prep.requeue_ages,
+                             cohorts=[prep.ids],
+                             health_on=[prep.health_on])
 
     def dispatch_block(self, preps: list[PreparedRound], lrs) -> InFlightRound:
         """Enqueue a K-round fused block (ONE device dispatch, lax.scan over
@@ -971,7 +1022,9 @@ class FederatedSession:
                              masked=[p.masked for p in preps],
                              requeue_depths=[p.requeue_depth for p in preps],
                              requeue=preps[-1].requeue,
-                             requeue_ages=preps[-1].requeue_ages)
+                             requeue_ages=preps[-1].requeue_ages,
+                             cohorts=[p.ids for p in preps],
+                             health_on=[p.health_on for p in preps])
 
     # graftlint: drain-point — commit IS the sanctioned per-round sync
     def commit_round(self, infl: InFlightRound, metrics_host=None) -> list[dict]:
@@ -994,20 +1047,41 @@ class FederatedSession:
         checkpoint: it observes either the pre-drain committed view or the
         fully-drained one, never a mix."""
         out = []
+        obs_records = []
         with self.mutate_lock:
             for infl, mh in zip(infls, metrics_hosts):
+                # the reserved obs prefixes never reach the metrics rows or
+                # totals any logging consumer sees — popping them here is
+                # half of the health/ledger bit-transparency contract (the
+                # other half: the compiled estimators only read)
+                mh = dict(mh)
+                health = {k[len("health/"):]: mh.pop(k)
+                          for k in [k for k in mh if k.startswith("health/")]}
+                fp = {k[len("ledger/"):]: mh.pop(k)
+                      for k in [k for k in mh if k.startswith("ledger/")]}
                 if infl.stacked:
-                    out.extend(
-                        self._finalize_metrics(
+                    for i, lr in enumerate(infl.lrs):
+                        m = self._finalize_metrics(
                             {k: v[i] for k, v in mh.items()}, lr,
                             masked=infl.masked[i],
                             requeue_depth=infl.requeue_depths[i])
-                        for i, lr in enumerate(infl.lrs)
-                    )
+                        out.append(m)
+                        obs_records.append((
+                            self.round - 1,
+                            infl.cohorts[i] if infl.cohorts else None, m,
+                            {k: v[i] for k, v in health.items()},
+                            {k: v[i] for k, v in fp.items()},
+                            infl.health_on[i] if infl.health_on else False))
                 else:
-                    out.append(self._finalize_metrics(
+                    m = self._finalize_metrics(
                         mh, infl.lrs[0], masked=infl.masked[0],
-                        requeue_depth=infl.requeue_depths[0]))
+                        requeue_depth=infl.requeue_depths[0])
+                    out.append(m)
+                    obs_records.append((
+                        self.round - 1,
+                        infl.cohorts[0] if infl.cohorts else None, m,
+                        health, fp,
+                        infl.health_on[0] if infl.health_on else False))
                 self._inflight -= 1
                 self._inflight_rounds -= infl.num_rounds
             last = infls[-1]
@@ -1026,7 +1100,34 @@ class FederatedSession:
             if self._inflight == 0:
                 self._head_state = None
                 self._head_client_state = None
+        # outside the mutate_lock: the sinks do host conversion + file IO —
+        # an emergency checkpoint from the watchdog thread must never wait
+        # on a ledger write
+        if (self.health_monitor is not None or self.slo is not None
+                or self.ledger is not None):
+            self._publish_round_obs(obs_records)
         return out
+
+    # graftlint: ledger-commit — THE one sanctioned ledger-append site
+    # (rule G014): rounds reach the durable ledger HERE, at commit, and
+    # nowhere else — which is the whole uncommitted-rounds-never-appear /
+    # resume-without-duplicates discipline (obs/ledger.py).
+    def _publish_round_obs(self, records):
+        """Hand each just-committed round to the attached obs sinks, in
+        dependency order: the health monitor first (its processed block
+        feeds the others), then the SLO engine (windowed rules over the
+        round series), then the durable ledger append. All values are host
+        data already — the drain's one batched device_get carried them."""
+        for rnd, ids, m, health, fp, health_on in records:
+            block = None
+            if (self.health_monitor is not None and health_on and health):
+                block = self.health_monitor.on_round(rnd, health, m)
+            if self.slo is not None:
+                self.slo.on_round(rnd, m, block)
+            if self.ledger is not None:
+                self.ledger.append_round(
+                    rnd, cohort=ids, metrics=m, health=block,
+                    fingerprint=fp)
 
     # -- one federated round -------------------------------------------------
     def run_round(self, lr: float) -> dict:

@@ -189,6 +189,25 @@ class EngineConfig:
     # sync program — bit-identity by construction, not fp luck. 0 = off
     # (the stale program is never built).
     stale_slots: int = 0
+    # Sketch-health observability (--health_every, obs/health.py): True
+    # compiles the per-round compression-quality estimators INTO the round
+    # program — estimated heavy-hitter mass / recall proxy, table
+    # saturation, error-feedback Verror telescoping health, per-leaf
+    # gradient-norm distribution — gated by the reserved `_health_on`
+    # batch leaf through a lax.cond (the --health_every cadence is a flag
+    # VALUE, never a recompile) and resolved at the runner's existing
+    # drain boundary under the reserved "health/" metrics prefix. The
+    # estimators only READ round state — a health-enabled run is pinned
+    # bit-identical (params + every logged row) to a disabled one.
+    # mode=sketch only (the quantities are sketch-wire quantities).
+    health: bool = False
+    # Round-ledger fingerprints (--ledger, obs/ledger.py): True adds
+    # order-fixed fp fingerprints of the round's committed params and
+    # optimizer state to every round's metrics under the reserved
+    # "ledger/" prefix — deterministic per program, so two runs of one
+    # config produce identical sequences and the ledger diff CLI can name
+    # the first divergent round. Reads only; bit-transparent like health.
+    ledger_fingerprint: bool = False
 
     def __post_init__(self):
         if self.client_shards < 1:
@@ -324,6 +343,13 @@ class EngineConfig:
                     "compose at different trust boundaries (see the README "
                     "always-on section); pick one"
                 )
+        if self.health and self.mode.mode != "sketch":
+            raise ValueError(
+                "health (--health_every) computes SKETCH-wire quality "
+                "estimators — recall proxy, table saturation, sketched "
+                f"Verror health; mode={self.mode.mode!r} has no table to "
+                "estimate from (use mode='sketch')"
+            )
         if self.dp_noise > 0 and self.dp_clip <= 0:
             raise ValueError("dp_noise > 0 requires dp_clip > 0 (unbounded "
                              "sensitivity has no meaningful noise scale)")
@@ -440,6 +466,168 @@ def split_valid(batch):
         batch = dict(batch)
         return batch, batch.pop(VALID_KEY)
     return batch, None
+
+
+# Reserved per-client batch leaf: the health-estimator cadence gate
+# (cfg.health / --health_every, obs/health.py). A [W] float — all 1.0 on
+# rounds where the in-program estimators run, all 0.0 elsewhere. It rides
+# the batch pytree like `_valid` so it shards/stacks/scans with the client
+# data and the compiled program's shape is round-invariant: the cadence is
+# a lax.cond on the flag's VALUE, never a recompile.
+HEALTH_KEY = "_health_on"
+
+
+def split_health(batch):
+    """Pop the reserved health-cadence leaf off a round batch. Returns
+    (batch_without_it, flag_array_or_None); absence = no in-program health
+    (sessions built without health_every never add the leaf — zero program
+    change, the seed behavior bit-for-bit)."""
+    if isinstance(batch, dict) and HEALTH_KEY in batch:
+        batch = dict(batch)
+        return batch, batch.pop(HEALTH_KEY)
+    return batch, None
+
+
+def _tree_sq_sum(tree) -> jnp.ndarray:
+    """Sum of squared entries over every leaf, folded in fixed leaf order
+    (f32 accumulation) — the fingerprint reduction. No flat concatenation:
+    the layerwise path's no-[d]-materialization contract extends here."""
+    leaves = [jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+              for leaf in jax.tree.leaves(tree)]
+    if not leaves:
+        return jnp.float32(0.0)
+    acc = leaves[0]
+    for x in leaves[1:]:
+        acc = acc + x
+    return acc
+
+
+def _tree_sum(tree) -> jnp.ndarray:
+    """Plain entry sum over every leaf, same fixed-order fold."""
+    leaves = [jnp.sum(leaf.astype(jnp.float32))
+              for leaf in jax.tree.leaves(tree)]
+    if not leaves:
+        return jnp.float32(0.0)
+    acc = leaves[0]
+    for x in leaves[1:]:
+        acc = acc + x
+    return acc
+
+
+def _ledger_fingerprints(cfg: EngineConfig, new_state) -> dict:
+    """Order-fixed fp fingerprints of the round's COMMITTED state, emitted
+    under the reserved "ledger/" metrics prefix on EVERY round when the
+    round ledger is armed (cfg.ledger_fingerprint / --ledger). These are
+    not cryptographic checksums — they are deterministic-per-program float
+    reductions, which is exactly what the ledger diff CLI needs: two runs
+    of the same config produce identical sequences, and the first round
+    where params_l2sq differs names where the trajectories split. Reads
+    only — a ledger-armed run stays bit-identical to an unarmed one."""
+    if not cfg.ledger_fingerprint:
+        return {}
+    return {
+        "ledger/params_l2sq": _tree_sq_sum(new_state["params"]),
+        "ledger/params_sum": _tree_sum(new_state["params"]),
+        "ledger/opt_state_l2sq": _tree_sq_sum(new_state["mode_state"]),
+    }
+
+
+def _health_metrics(cfg: EngineConfig, flag, raw_agg, delta, new_mode_state,
+                    weighted=None, weighted_tree=None,
+                    segments=None) -> dict:
+    """The in-program sketch-health block (obs/health.py's device half),
+    computed under a lax.cond on the `_health_on` cadence flag and emitted
+    under the reserved "health/" metrics prefix — the session pops the
+    prefix off the committed metrics before any row/totals consumer sees
+    them, which (together with estimators that only READ) is why a
+    health-armed run is pinned bit-identical to an unarmed one.
+
+    `raw_agg` is the PRE-guard aggregate wire (a poisoned round's health
+    block must show the poison the non-finite guard is about to discard);
+    `delta`/`new_mode_state` are the server step's release and new
+    Vvelocity/Verror tables; `weighted` (fused ravel path only) is the
+    dense reduced update — the dense-comparable reference the recall proxy
+    is validated against; `weighted_tree` is the layerwise path's per-leaf
+    counterpart (leaf-norm distribution without materializing [d]);
+    `segments` the BlockPlan leaf segments slicing `weighted`."""
+    if not cfg.health or flag is None:
+        return {}
+    from ..obs import health as obhealth
+    from ..sketch import csvec
+
+    mcfg = cfg.mode
+    spec = mcfg.sketch_spec
+
+    def on():
+        out: dict = {}
+        table = raw_agg["table"]
+        mass = obhealth.table_mass_estimate(table)
+        out["grad_mass_est"] = mass
+        out["grad_norm_est"] = jnp.sqrt(jnp.maximum(mass, 0.0))
+        out["row_mass_cv"] = obhealth.row_mass_cv(table)
+        out["table_occupancy"] = obhealth.table_occupancy(table)
+        # recall proxy (bracketed — see obs/health.py): the naive
+        # same-rows estimate inflates under saturation (selection picks
+        # noise), the split-row cross-estimate deflates (selection misses
+        # hitters); their midpoint is the proxy and their gap the
+        # estimator's own saturation-driven uncertainty
+        _, pvals = csvec.unsketch_topk(spec, table, mcfg.k,
+                                       impl=mcfg.topk_impl,
+                                       recall=mcfg.topk_recall)
+        naive = obhealth.energy_fraction(obhealth.topk_energy(pvals), mass)
+        if spec.r >= 2:
+            pess = obhealth.split_topk_energy_fraction(
+                spec, table, mcfg.k, mass)
+            out["topk_mass_proxy"] = 0.5 * (naive + pess)
+            out["topk_proxy_width"] = naive - pess
+        else:
+            out["topk_mass_proxy"] = naive
+            out["topk_proxy_width"] = jnp.zeros_like(naive)
+        # telescoping health: the energy actually released this round vs
+        # the energy the error accumulator retained — release_frac falling
+        # toward 0 while verror_ratio climbs is the diverging-Verror
+        # signature (error feedback no longer telescopes)
+        rel = (obhealth.topk_energy(delta["vals"]) if "vals" in delta
+               else jnp.float32(0.0))
+        out["release_energy"] = rel
+        vmass = obhealth.table_mass_estimate(new_mode_state["Verror"])
+        out["verror_norm_est"] = jnp.sqrt(jnp.maximum(vmass, 0.0))
+        out["release_frac"] = obhealth.energy_fraction(rel, rel + vmass)
+        out["verror_ratio"] = obhealth.energy_fraction(
+            out["verror_norm_est"], out["grad_norm_est"])
+        if weighted is not None:
+            # dense-comparable reference (fused ravel path): the true
+            # top-k energy fraction the proxy above estimates, plus the
+            # per-leaf norm distribution over the SAME segments the
+            # BlockPlan/per-layer quarantine use
+            gsq = jnp.sum(jnp.square(weighted.astype(jnp.float32)))
+            out["grad_norm_true"] = jnp.sqrt(gsq)
+            t_idx = csvec.topk_abs(weighted, mcfg.k, impl="exact")
+            out["topk_mass_true"] = obhealth.energy_fraction(
+                obhealth.topk_energy(weighted[t_idx]), gsq)
+            if segments is not None:
+                out["leaf_norms"] = jnp.stack([
+                    jnp.sqrt(jnp.sum(jnp.square(
+                        weighted[off:off + n].astype(jnp.float32))))
+                    for off, n in segments])
+        elif weighted_tree is not None:
+            leaf_norms = jnp.stack([
+                jnp.sqrt(jnp.sum(jnp.square(leaf.astype(jnp.float32))))
+                for leaf in jax.tree.leaves(weighted_tree)
+                if leaf.size])
+            out["leaf_norms"] = leaf_norms
+            gsq = jnp.sum(jnp.square(leaf_norms))
+            out["grad_norm_true"] = jnp.sqrt(gsq)
+        return out
+
+    shapes = jax.eval_shape(on)
+
+    def off():
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    gate = flag if jnp.ndim(flag) == 0 else flag.max()
+    block = jax.lax.cond(gate > 0, on, off)
+    return {f"health/{k}": v for k, v in block.items()}
 
 
 def participation_mask(rng, num_sampled: int, dropout: float) -> jnp.ndarray:
@@ -650,6 +838,14 @@ def _split_quarantine_scope_check(cfg: EngineConfig):
             "compile program boundary threads a single scalar median and "
             "the per-leaf rings cannot cross it; drop --split_compile or "
             "use quarantine_scope=cohort"
+        )
+    if cfg.health or cfg.ledger_fingerprint:
+        raise ValueError(
+            "health estimators / ledger fingerprints are fused-paths-only: "
+            "they ride the round metrics tree, which the split program "
+            "boundary does not thread (the client program's metrics are "
+            "emitted before the server algebra the estimators read); drop "
+            "--split_compile or the obs flag"
         )
 
 
@@ -1120,6 +1316,7 @@ def make_round_step(
         return delta, nstate, jax.tree.map(lambda m: m.sum(0), metrics)
 
     def step(state, batch, client_rows, lr, rng):
+        batch, health_flag = split_health(batch)
         batch, valid = split_valid(batch)
         params, net_state = state["params"], state["net_state"]
         if layerwise:
@@ -1236,6 +1433,9 @@ def make_round_step(
             new_q = _advance_quarantine_full(cfg, state["quarantine"], norms,
                                              lnorms, part_eff)
             out_metrics["quarantine_median"] = new_q["median"]
+        # the health block measures the PRE-guard wire: a poisoned round's
+        # estimators must show the poison the guard is about to discard
+        raw_agg = agg
         agg, new_net_state, new_rows, out_metrics, fin_ok = _guard_nonfinite(
             cfg, agg, new_net_state, net_state, new_rows, client_rows,
             out_metrics,
@@ -1263,6 +1463,20 @@ def make_round_step(
         }
         if new_q is not None:
             new_state["quarantine"] = new_q
+        if cfg.health and mcfg.mode == "sketch":
+            # mode=sketch always takes the linearity-shortcut branch above,
+            # so `weighted` is the dense reduced update (ravel) or the
+            # per-leaf tree (layerwise) — the dense-comparable reference
+            dense_w = tree_w = segs = None
+            if layerwise:
+                tree_w = weighted
+            else:
+                dense_w = weighted
+                segs = _leaf_segments(params)
+            out_metrics.update(_health_metrics(
+                cfg, health_flag, raw_agg, delta, mode_state,
+                weighted=dense_w, weighted_tree=tree_w, segments=segs))
+        out_metrics.update(_ledger_fingerprints(cfg, new_state))
         if mcfg.mode == "local_topk":
             # support of the actually-broadcast delta (SURVEY.md §6 row 4):
             # the union of client supports when momentum keeps nothing extra
@@ -1339,7 +1553,7 @@ def _normalize_merged_wire(mcfg: ModeConfig, wire_sum: dict, n_live) -> dict:
 
 def _merged_sharded_tail(
     cfg: EngineConfig, state, stacked_wire, stacked_ns, stacked_m, part_eff,
-    lr, noise_rng, part=None, norms=None, lnorms=None,
+    lr, noise_rng, part=None, norms=None, lnorms=None, health_flag=None,
 ):
     """Everything after the per-shard client phase, shared verbatim by the
     mesh execution and the single-device reference so they cannot drift:
@@ -1368,6 +1582,7 @@ def _merged_sharded_tail(
         new_q = _advance_quarantine_full(cfg, state["quarantine"], norms,
                                          lnorms, part_eff)
         out_metrics["quarantine_median"] = new_q["median"]
+    raw_agg = agg  # pre-guard wire: the health block must show the poison
     agg, new_net_state, _, out_metrics, fin_ok = _guard_nonfinite(
         cfg, agg, new_net_state, state["net_state"], {}, {}, out_metrics,
     )
@@ -1387,6 +1602,14 @@ def _merged_sharded_tail(
     }
     if new_q is not None:
         new_state["quarantine"] = new_q
+    if cfg.health and mcfg.mode == "sketch":
+        # sharded rounds merge WIRES, so only the wire-side estimators
+        # exist here (the dense reduced update never materializes — that
+        # is the sharded path's whole point); the dense-comparable
+        # reference stays a fused-path quantity
+        out_metrics.update(_health_metrics(
+            cfg, health_flag, raw_agg, delta, mode_state))
+    out_metrics.update(_ledger_fingerprints(cfg, new_state))
     return new_state, out_metrics
 
 
@@ -1499,7 +1722,7 @@ def make_sharded_round_step(
             return wire, ns_sum, m_sum, part_eff_l, part_l, norms_l
         return wire, ns_sum, m_sum, part_eff_l
 
-    def _tail(cfg_state, stacked, lr, noise_rng):
+    def _tail(cfg_state, stacked, lr, noise_rng, health_flag=None):
         """Unpack the per-shard stacks ([S, wl] leaves, shard-index order =
         cohort order row-major) and run the shared merged tail."""
         if layer_q:
@@ -1507,19 +1730,22 @@ def make_sharded_round_step(
             return _merged_sharded_tail(
                 cfg, cfg_state, wire_s, ns_s, m_s, pe_s.reshape(-1), lr,
                 noise_rng, part=pv_s.reshape(-1), norms=norms_s.reshape(-1),
-                lnorms=lnorms_s.reshape((-1,) + lnorms_s.shape[2:]))
+                lnorms=lnorms_s.reshape((-1,) + lnorms_s.shape[2:]),
+                health_flag=health_flag)
         if quarantine:
             wire_s, ns_s, m_s, pe_s, pv_s, norms_s = stacked
             return _merged_sharded_tail(
                 cfg, cfg_state, wire_s, ns_s, m_s, pe_s.reshape(-1), lr,
-                noise_rng, part=pv_s.reshape(-1), norms=norms_s.reshape(-1))
+                noise_rng, part=pv_s.reshape(-1), norms=norms_s.reshape(-1),
+                health_flag=health_flag)
         wire_s, ns_s, m_s, pe_s = stacked
         return _merged_sharded_tail(
             cfg, cfg_state, wire_s, ns_s, m_s, pe_s.reshape(-1), lr,
-            noise_rng)
+            noise_rng, health_flag=health_flag)
 
     if mesh is None:
         def step(state, batch, client_rows, lr, rng):
+            batch, health_flag = split_health(batch)
             params, net_state = state["params"], state["net_state"]
             pflat = None if layerwise else _ravel_params(params)[0]
             W = jax.tree.leaves(batch)[0].shape[0]
@@ -1554,7 +1780,8 @@ def make_sharded_round_step(
                                        *xs),
                 shards,
             )
-            new_state, out_metrics = _tail(state, stacked, lr, noise_rng)
+            new_state, out_metrics = _tail(state, stacked, lr, noise_rng,
+                                           health_flag)
             return new_state, client_rows, out_metrics
 
         return step
@@ -1612,9 +1839,13 @@ def make_sharded_round_step(
     )
 
     def step(state, batch, client_rows, lr, rng):
+        # popped BEFORE shard_map (the tail runs at jit top level on the
+        # replicated gathered stacks — the flag gates it there)
+        batch, health_flag = split_health(batch)
         outs = mapped(state, batch, lr, rng)
         stacked, noise_rng = outs[:-1], outs[-1]
-        new_state, out_metrics = _tail(state, stacked, lr, noise_rng)
+        new_state, out_metrics = _tail(state, stacked, lr, noise_rng,
+                                       health_flag)
         return new_state, client_rows, out_metrics
 
     return step
@@ -2222,6 +2453,7 @@ def make_payload_round_steps(
         S = max(cfg.client_shards, 1)
 
         def client_step(state, batch, rng):
+            batch, _ = split_health(batch)  # the MERGE computes health
             batch, adv = split_adv(batch)
             batch, valid = split_valid(batch)
             params, net_state = state["params"], state["net_state"]
@@ -2269,6 +2501,7 @@ def make_payload_round_steps(
 
         def body(state, batch_l, rng):
             params, net_state = state["params"], state["net_state"]
+            batch_l, _ = split_health(batch_l)  # the MERGE computes health
             batch_l, valid_l = split_valid(batch_l)
             pflat, _ = _ravel_params(params)
             wl = jax.tree.leaves(batch_l)[0].shape[0]
@@ -2307,7 +2540,7 @@ def make_payload_round_steps(
 
     def merge_step(state, tables, nstates, mvals, part, arrived, lr,
                    noise_rng, lnorms=None, stale_tables=None,
-                   stale_weights=None):
+                   stale_weights=None, health_on=None):
         """The server side: the cfg.merge_policy reduction of the
         (wire-delivered) per-client tables. `part` is the client program's
         validity mask, `arrived` the serving layer's 0/1 admission mask
@@ -2400,6 +2633,7 @@ def make_payload_round_steps(
                 cfg, state["quarantine"], norms,
                 lnorms if layer_q else None, part_eff)
             out_metrics["quarantine_median"] = new_q["median"]
+        raw_agg = agg  # pre-guard wire for the health estimators
         agg, new_net_state, _, out_metrics, _ = _guard_nonfinite(
             cfg, agg, new_net_state, state["net_state"], {}, {}, out_metrics,
         )
@@ -2416,6 +2650,13 @@ def make_payload_round_steps(
         }
         if new_q is not None:
             new_state["quarantine"] = new_q
+        if cfg.health:
+            # served rounds see only wire tables, so the health block is
+            # the wire-side estimator set — exactly what a real server
+            # that never holds a dense gradient can still measure
+            out_metrics.update(_health_metrics(
+                cfg, health_on, raw_agg, delta, mode_state))
+        out_metrics.update(_ledger_fingerprints(cfg, new_state))
         return new_state, out_metrics
 
     return client_step, merge_step
@@ -2430,11 +2671,14 @@ def compose_payload(client_step: Callable, merge_step: Callable) -> Callable:
     local state)."""
 
     def step(state, batch, client_rows, lr, rng):
+        # the cadence flag gates the MERGE's health block; popped here (a
+        # copy also rides into client_step, which discards its own)
+        _, health_flag = split_health(batch)
         tables, nstates, mvals, part, noise_rng, lnorms = client_step(
             state, batch, rng)
         new_state, metrics = merge_step(
             state, tables, nstates, mvals, part, jnp.ones_like(part), lr,
-            noise_rng, lnorms)
+            noise_rng, lnorms, health_on=health_flag)
         return new_state, client_rows, metrics
 
     return step

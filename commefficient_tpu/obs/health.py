@@ -1,0 +1,293 @@
+"""Sketch-health estimators: does the compression still deserve its bytes?
+
+PR 7's telemetry says where the milliseconds go; nothing said whether the
+ALGORITHM is healthy — a run whose Count-Sketch is saturating, whose
+error-feedback accumulator is diverging, or whose quarantine is silently
+eating a third of the cohort looks fine on every wall-clock gauge. This
+module closes that gap with two halves under one contract:
+
+DEVICE half (the top section, pure jnp): per-round compression-quality
+estimators the engine computes INSIDE the compiled round program at the
+``--health_every N`` cadence (a reserved ``_health_on`` batch leaf gates a
+``lax.cond``, so off-cadence rounds skip the FLOPs without recompiling) and
+resolves at the runner's existing drain boundary — the PR 7 deferred-span
+discipline: ZERO host syncs added, and a health-enabled run is pinned
+BIT-identical (params + every logged row) to a disabled one because every
+estimator only READS round state, never writes it. These functions are the
+one sanctioned compiled-scope corner of the obs package: graftlint G009
+exempts calls resolving into ``obs.health`` (and only those) in the parity
+modules — they are estimator arithmetic, not telemetry emission; the
+registry/tracer mutator backstop still fires on anything that mutates.
+
+The estimators, and what each one detects (README "Observability" has the
+operator-facing glossary):
+
+- ``table_mass_estimate``: mean over the r hash rows of the row's squared
+  L2 — an unbiased estimate of the sketched vector's squared norm (cross
+  terms cancel in expectation), i.e. the round-update energy READ FROM THE
+  WIRE ALONE, the quantity a server that never sees a dense gradient can
+  still know.
+- ``row_mass_cv``: coefficient of variation of the per-row mass estimates.
+  Clean sketch: every row estimates the same ||u||^2, CV near 0. Collision
+  noise grows like ||u||_2^2 / sqrt(c), so a rising CV is the
+  table-saturation signal — c is becoming too small for the gradient's
+  effective support.
+- ``table_occupancy``: fraction of nonzero buckets (hash-spread sanity; a
+  stuck-at-zero table or a degenerate hash shows here first).
+- ``topk_energy`` + ``split_topk_energy_fraction``: the RECALL PROXY
+  (recovered top-k energy / estimated total energy — the wire-side
+  stand-in for true top-k recall) is a BRACKETED estimate. The naive
+  same-rows estimate (energy of the table's own unsketch_topk values)
+  inflates under saturation: top-k selection over noisy estimates
+  preferentially picks coordinates whose collision noise ran high, so
+  E[estimate] > truth. The split-row cross-estimate (select with the even
+  hash rows, evaluate with the odd ones, subtract the cross-estimator's
+  variance) makes selection and evaluation noise independent — it can
+  only miss real heavy hitters, so E[estimate] < truth. The engine emits
+  their MIDPOINT as ``topk_mass_proxy`` and their gap as
+  ``topk_proxy_width`` — the gap is the estimator's own saturation-driven
+  uncertainty, a health signal in itself (a clean sketch brackets
+  tightly; a saturating one splays). SketchedSGD's accuracy-vs-
+  compression frontier is exactly this quantity against bytes; bench's
+  ``obs.health`` arm validates the midpoint against the true dense-path
+  top-k energy fraction (agreement within 0.05 on the dense-comparable
+  config is the acceptance bar).
+
+HOST half (``HealthMonitor``): the drain-side sink the session hands each
+committed round's health block to — converts the already-fetched arrays to
+floats (the drain's ONE batched device_get carried them; no extra sync),
+feeds ``health_*`` registry gauges, emits one trace instant per health
+round, keeps a bounded history for the SLO engine and the round ledger,
+and adds the static wire-economics figures (uplink bytes vs dense) that
+need no device at all.
+"""
+
+from __future__ import annotations
+
+import collections
+
+# NOTE: jax is imported lazily inside the device-side helpers so that
+# host-only consumers (the ledger CLI, replay tooling) can import this
+# module without touching jax at all.
+
+
+# ---------------------------------------------------------------- device half
+# Pure jnp readers, safe inside compiled scope (the G009 exemption). They
+# take arrays, return arrays, and touch no registry, tracer, or host state.
+
+
+def table_row_masses(table):
+    """[r] squared L2 mass of each hash row (f32 accumulation)."""
+    import jax.numpy as jnp
+
+    t = table.astype(jnp.float32)
+    return jnp.sum(jnp.square(t), axis=-1)
+
+
+def table_mass_estimate(table):
+    """Unbiased estimate of the sketched vector's squared L2 norm: the mean
+    over rows of the row mass (each row's bucket sums square to ||u||^2
+    plus zero-mean collision cross terms)."""
+    import jax.numpy as jnp
+
+    return jnp.mean(table_row_masses(table))
+
+
+def row_mass_cv(table, eps: float = 1e-12):
+    """Coefficient of variation of the per-row mass estimates — the
+    collision/saturation proxy (see module docstring)."""
+    import jax.numpy as jnp
+
+    masses = table_row_masses(table)
+    mean = jnp.mean(masses)
+    return jnp.std(masses) / jnp.maximum(mean, eps)
+
+
+def table_occupancy(table):
+    """Fraction of table buckets holding a nonzero value."""
+    import jax.numpy as jnp
+
+    return jnp.mean((table != 0.0).astype(jnp.float32))
+
+
+def topk_energy(vals):
+    """Recovered heavy-hitter energy of a k-sparse release: sum(vals^2)."""
+    import jax.numpy as jnp
+
+    return jnp.sum(jnp.square(vals.astype(jnp.float32)))
+
+
+def per_row_estimates(spec, table, idx):
+    """[r, n] per-hash-row point estimates of the coordinates `idx` — the
+    raw material of the split-row cross-estimator below. One gather per
+    row; callers bound `n` so the transient never scales past the
+    single-shot budget (see split_topk_energy_fraction)."""
+    import jax.numpy as jnp
+
+    from ..sketch import csvec
+
+    buckets, signs = csvec._block_hashes(spec, idx, jnp.float32)
+    return signs * jnp.take_along_axis(
+        table.astype(jnp.float32), buckets, axis=1)
+
+
+def split_topk_energy_fraction(spec, table, k: int, mass,
+                               eps: float = 1e-12):
+    """The PESSIMISTIC half of the recall-proxy bracket: select the top-k
+    with the EVEN hash rows only, cross-estimate their energy with the ODD
+    rows, and subtract the cross-estimator's known variance
+    (k * mass / (c * n_odd)). Selection noise and estimation noise are
+    independent by construction, so unlike the naive same-rows estimate
+    this can never inflate through noise-selected coordinates — it
+    UNDERESTIMATES instead (half-row selection misses real heavy hitters),
+    which is exactly what makes (naive, split) an (upper, lower) bracket
+    of the true top-k energy fraction. Requires r >= 2.
+
+    Memory: the [r, n] estimate transient is bounded by csvec's
+    single-shot budget (the no-[d]-materialization discipline
+    unsketch_topk's chunked path upholds extends here) — past it the
+    d-axis is scanned in chunks with a running top-k carry of (selection
+    score, cross-estimate value) pairs, so a GPT-2-dims health round
+    costs O(r * chunk), never O(r * d)."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..sketch import csvec
+
+    n_b = len(range(1, spec.r, 2))
+    bias = k * mass / (spec.c * n_b)
+
+    if spec.r * spec.d * 4 <= csvec.UNSKETCH_SINGLE_SHOT_BYTES:
+        est = per_row_estimates(
+            spec, table, jnp.arange(spec.d, dtype=jnp.int32))
+        a, b = est[0::2], est[1::2]
+        sel = jnp.abs(jnp.mean(a, axis=0))
+        _, idx = jax.lax.top_k(sel, k)
+        bv = jnp.mean(jnp.take(b, idx, axis=1), axis=0)
+        energy = jnp.sum(jnp.square(bv)) - bias
+        return jnp.clip(energy, 0.0) / jnp.maximum(mass, eps)
+
+    chunk = max(k, csvec.UNSKETCH_SINGLE_SHOT_BYTES // (4 * spec.r))
+    n_chunks = math.ceil(spec.d / chunk)
+
+    def body(carry, start):
+        top_scores, top_bvals = carry
+        idx = start + jnp.arange(chunk, dtype=jnp.int32)
+        valid = idx < spec.d
+        est = per_row_estimates(
+            spec, table, jnp.clip(idx, 0, spec.d - 1))
+        a, b = est[0::2], est[1::2]
+        score = jnp.where(valid, jnp.abs(jnp.mean(a, axis=0)), -jnp.inf)
+        bv = jnp.mean(b, axis=0)
+        cs = jnp.concatenate([top_scores, score])
+        cb = jnp.concatenate([top_bvals, bv])
+        ts, ti = jax.lax.top_k(cs, k)
+        return (ts, cb[ti]), None
+
+    init = (jnp.full((k,), -jnp.inf, jnp.float32),
+            jnp.zeros((k,), jnp.float32))
+    starts = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
+    (_, top_bvals), _ = jax.lax.scan(body, init, starts)
+    energy = jnp.sum(jnp.square(top_bvals)) - bias
+    return jnp.clip(energy, 0.0) / jnp.maximum(mass, eps)
+
+
+def energy_fraction(part, total, eps: float = 1e-12):
+    """part / max(total, eps) — the recall-proxy shape (recovered energy
+    over estimated total), clamped against empty/zero rounds."""
+    import jax.numpy as jnp
+
+    return part / jnp.maximum(total, eps)
+
+
+# ------------------------------------------------------------------ host half
+
+
+# the keys the engine emits under the reserved "health/" metrics prefix, by
+# estimator family — documented here (and in README) so the monitor, the
+# SLO engine, and the ledger agree on names without importing the engine
+SCALAR_KEYS = (
+    "grad_mass_est", "grad_norm_est", "row_mass_cv", "table_occupancy",
+    "topk_mass_proxy", "topk_proxy_width", "release_energy", "release_frac",
+    "verror_norm_est", "verror_ratio",
+    # dense-reference extras (fused ravel path only — the validation arm)
+    "grad_norm_true", "topk_mass_true",
+)
+ARRAY_KEYS = ("leaf_norms",)
+
+HEALTH_SCHEMA_VERSION = 1
+
+
+class HealthMonitor:
+    """Host sink for the per-round health blocks the session pops off the
+    committed metrics (see FederatedSession._publish_round_obs). One
+    instance per run; ``on_round`` is called on the drain thread with
+    ALREADY-FETCHED host arrays, so nothing here ever syncs the device.
+
+    ``history`` keeps a bounded (rnd, block) deque for the SLO engine,
+    bench's agreement arm, and the ledger's health column; ``last`` is the
+    newest block (serve's /metrics surfaces the gauges instead — the
+    registry is the cross-thread surface)."""
+
+    def __init__(self, mode_cfg=None, num_workers: int = 0,
+                 health_every: int = 1, registry=None, history: int = 1024):
+        from . import registry as obreg
+
+        self.mode_cfg = mode_cfg
+        self.num_workers = num_workers
+        self.health_every = max(int(health_every), 1)
+        self.registry = registry if registry is not None else obreg.default()
+        self.history: collections.deque = collections.deque(maxlen=history)
+        self.last: tuple[int, dict] | None = None
+        # static wire economics: bytes one client uploads per round vs the
+        # dense [d] upload — the compression the health block is pricing
+        self.uplink_bytes_per_client = None
+        self.dense_bytes_per_client = None
+        if mode_cfg is not None and getattr(mode_cfg, "mode", "") == "sketch":
+            r, c = mode_cfg.sketch_spec.table_shape
+            self.uplink_bytes_per_client = float(r * c * 4)
+            self.dense_bytes_per_client = float(mode_cfg.d * 4)
+
+    def on_round(self, rnd: int, health: dict, metrics: dict) -> dict:
+        """Fold one committed health-cadence round into the registry and the
+        bounded history. `health` maps bare estimator names to host scalars/
+        arrays (the engine's "health/" prefix already stripped); `metrics`
+        is the round's finalized metrics dict (for participants/uplink).
+        Returns the JSON-ready block the ledger records."""
+        import numpy as np
+
+        from . import trace as obtrace
+
+        block: dict = {}
+        for k, v in health.items():
+            a = np.asarray(v)
+            if a.ndim == 0:
+                block[k] = float(a)
+            else:
+                block[k] = [round(float(x), 8) for x in a.tolist()]
+        if self.uplink_bytes_per_client is not None:
+            # participants == 0.0 is a REAL value (a fully-degraded round
+            # uploaded nothing) — only a missing key falls back
+            p = metrics.get("participants")
+            uploaded = float(p) if p is not None else float(self.num_workers)
+            block["uplink_bytes"] = self.uplink_bytes_per_client * uploaded
+            block["uplink_vs_dense"] = (
+                self.uplink_bytes_per_client
+                / max(self.dense_bytes_per_client, 1.0))
+        scalars = {k: v for k, v in block.items() if isinstance(v, float)}
+        for k, v in scalars.items():
+            self.registry.gauge(f"health_{k}").set(v)
+        self.registry.counter("health_rounds_total").inc()
+        obtrace.instant("federated", "health", round=rnd,
+                        **{k: round(v, 6) for k, v in scalars.items()})
+        self.last = (rnd, block)
+        self.history.append((rnd, block))
+        return block
+
+    def series(self, key: str) -> list[float]:
+        """All recorded values of one scalar estimator, oldest first
+        (bench's proxy-vs-true agreement arm reads this)."""
+        return [b[key] for _, b in self.history
+                if isinstance(b.get(key), float)]

@@ -110,6 +110,14 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
+    def export_snapshot(self) -> tuple[list[dict], dict[str, int], int]:
+        """(events, tracks, dropped) copied atomically under the lock —
+        what an exporter other than flush() (the postmortem bundle) needs;
+        an unlocked read could catch a track being added mid-span on
+        another thread."""
+        with self._lock:
+            return list(self._events), dict(self._tracks), self.dropped_events
+
     # -- timestamps ------------------------------------------------------------
 
     def now_us(self) -> float:

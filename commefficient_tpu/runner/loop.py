@@ -205,6 +205,11 @@ class RunStats:
     # observed gap) + `runner_idle_ms` histogram.
     server_idle_ms: float = 0.0
     server_idle_ms_max: float = 0.0
+    # SLO engine firings while this loop ran (--slo warn|halt; the
+    # slo_violations_total registry counter's per-run delta) — a run that
+    # finished "green" with violations > 0 finished on a warn posture, not
+    # a healthy one
+    slo_violations: int = 0
 
 
 def make_save_ckpt(session: FederatedSession, checkpoint_dir: str):
@@ -247,6 +252,8 @@ def run_loop(
     logger=None,
     save_ckpt=None,
     source=None,
+    slo=None,
+    postmortem=None,
 ) -> RunStats:
     """Run the training loop from session.round to cfg.total_rounds.
 
@@ -263,6 +270,14 @@ def run_loop(
     pulling clients through the sampling prefetcher. When given, the loop
     neither wraps nor replaces it (the source owns its own overlap policy);
     default None builds the usual PreparedSource/RoundPrefetcher pair.
+
+    slo: an obs.SloEngine the SESSION feeds at each commit (the CLIs wire
+    both ends); the loop only checks its halt latch at drain boundaries
+    and exits through the same clean shutdown/save path --on_nonfinite
+    halt uses. postmortem: callable(reason) writing the crash bundle
+    (obs.ledger.write_postmortem_bundle) — invoked on the watchdog-abort
+    and preemption exit-75 paths, where the CLIs' exception handling
+    never runs (os._exit) or runs too late to matter.
 
     Exits the process (not returns) on preemption (EXIT_RESUMABLE) and on
     --on_nonfinite halt, after the same drain/save sequence the CLIs used
@@ -303,13 +318,30 @@ def run_loop(
     if save_ckpt is None and cfg.checkpoint_dir:
         save_ckpt = make_save_ckpt(session, cfg.checkpoint_dir)
 
+    def _postmortem(reason: str):
+        """Best-effort crash-bundle write: the exit it precedes is the
+        point — a failing bundle must never mask it."""
+        if postmortem is None:
+            return
+        try:
+            postmortem(reason)
+        except Exception as e:  # noqa: BLE001 — crash path
+            print(f"runner: postmortem bundle failed ({type(e).__name__}: "
+                  f"{e})", file=sys.stderr, flush=True)
+
+    def _abort():
+        # stage 4 of the watchdog ladder: flush the black box, THEN die
+        # with the resumable status (os._exit skips every finally — this
+        # is the one chance the bundle gets)
+        _postmortem("watchdog_abort")
+        os._exit(EXIT_RESUMABLE)
+
     # escalation ladder: warn -> stacks -> emergency ckpt -> (opt-in) abort
     # with the resumable status so a supervisor relaunches with --resume
     watchdog = RoundWatchdog(
         on_emergency=save_ckpt
         if save_ckpt and not cfg.no_emergency_checkpoint else None,
-        on_abort=(lambda: os._exit(EXIT_RESUMABLE))
-        if cfg.watchdog_abort and save_ckpt else None,
+        on_abort=_abort if cfg.watchdog_abort and save_ckpt else None,
     )
 
     async_mode = not cfg.sync_loop
@@ -611,6 +643,7 @@ def run_loop(
                                 f"preemption: emergency checkpoint at round "
                                 f"{session.round}: {path}", flush=True,
                             )
+                    _postmortem("preemption")
                     sys.exit(EXIT_RESUMABLE)
                 if nonfinite_total and cfg.on_nonfinite == "halt":
                     shutdown()
@@ -619,6 +652,20 @@ def run_loop(
                     sys.exit(
                         f"halting at round {rnd}: non-finite update skipped "
                         "(--on_nonfinite halt; "
+                        + ("state checkpointed clean)" if save_ckpt
+                           else "no --checkpoint_dir, nothing saved)")
+                    )
+                if slo is not None and slo.halted:
+                    # the session's commit hook fed the SLO engine at the
+                    # drain above; a latched halt exits through the SAME
+                    # clean sequence the non-finite halt uses — committed
+                    # state saved, writer drained, loud one-line verdict
+                    shutdown()
+                    if save_ckpt:
+                        save_ckpt()
+                    sys.exit(
+                        f"halting at round {rnd}: SLO violation "
+                        f"({slo.halted_reason}) (--slo halt; "
                         + ("state checkpointed clean)" if save_ckpt
                            else "no --checkpoint_dir, nothing saved)")
                     )
@@ -688,6 +735,7 @@ def run_loop(
         int(mark.delta(
             f"resilience_attack_{kind[len('client_'):]}_total"))
         for kind in ADVERSARIAL_KINDS)
+    stats.slo_violations = int(mark.delta("slo_violations_total"))
     stats.max_inflight_used = eff_inflight if async_mode else 0
     stats.server_idle_ms = idle_acc[0] / max(idle_acc[1], 1)
     stats.server_idle_ms_max = idle_acc[2]

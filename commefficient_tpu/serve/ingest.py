@@ -128,6 +128,17 @@ _REJECTION_COUNTERS = {
     ACCEPTED_STALE: "serve_stale_admitted_total",
 }
 
+# EVERY admission decision also mirrors into a serve_admission_* registry
+# counter: the round ledger (obs/ledger.py) records per-round deltas of
+# these, so a committed round's record carries its admission picture
+# without the ledger reaching into queue internals. Precomputed name map —
+# the admission path is hot (~1e5 submissions/s in the ingest bench) and
+# must not pay an f-string per call.
+_ADMISSION_COUNTERS = {s: f"serve_admission_{s.lower()}_total" for s in (
+    ACCEPTED, CLOSED, QUEUE_FULL, OUT_OF_ROUND, NOT_INVITED, DUPLICATE,
+    BUFFERED, ACCEPTED_STALE, MALFORMED, STALE_SCHEMA, QUARANTINED,
+    SHEDDING)}
+
 
 @dataclasses.dataclass(frozen=True)
 class Submission:
@@ -586,12 +597,15 @@ class IngestQueue:
         serve-ingest track, linked to the later merge span by the
         `submission` id (r<round>/c<cid>)."""
         status = self._decide(sub)
+        reg = obreg.default()
         counter = _REJECTION_COUNTERS.get(status)
         if counter is not None:
             # wire-facing rejection (or stale admission): a process-wide
             # resilience counter the chaos acceptance reads, alongside the
             # admission counter
-            obreg.default().counter(counter).inc()
+            reg.counter(counter).inc()
+        reg.counter(_ADMISSION_COUNTERS.get(
+            status, "serve_admission_other_total")).inc()
         if obtrace.get().enabled:
             # guard BEFORE building args: this is the admission hot path
             # (the ingest bench pushes ~1e5 submissions/s through it), and

@@ -1,5 +1,18 @@
 """Ops surface: the service's metrics snapshot + a loopback HTTP endpoint.
 
+Two exposition formats over the same numbers:
+
+- `GET /metrics` — one JSON object (the service's structured snapshot,
+  fields below);
+- `GET /metrics.prom` — Prometheus text exposition (text/plain; version
+  0.0.4) rendered straight from the process-wide obs registry, with
+  `# TYPE` lines per metric: counters as `counter`, gauges as `gauge`
+  (plus a `<name>_max` gauge), histograms as `summary` (p50/p99 quantile
+  samples + `_sum`/`_count`), meters as a `<name>_rate_per_s` gauge. A
+  scrape target for an off-the-shelf Prometheus without any sidecar —
+  `render_prometheus` is pure over a Registry, so tests and other servers
+  can reuse it.
+
 `GET /metrics` returns one JSON object (no query params, no auth — this is
 a loopback operator surface, the moral equivalent of a /healthz):
 
@@ -47,17 +60,68 @@ it never touches the dispatch path. Anything but GET /metrics is a 404.
 from __future__ import annotations
 
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
+from ..obs import registry as obreg
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Prometheus metric-name charset: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    name = _NAME_SANITIZE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def render_prometheus(registry: obreg.Registry | None = None) -> str:
+    """Prometheus text exposition (format 0.0.4) of every registered
+    metric — the `# TYPE`-annotated scrape body `GET /metrics.prom`
+    serves. Pure over the registry; one line per sample, `\\n`-terminated
+    as the format requires."""
+    if registry is None:
+        registry = obreg.default()
+    with registry._lock:
+        items = sorted(registry._metrics.items())
+    lines: list[str] = []
+    for name, m in items:
+        pname = _prom_name(name)
+        if isinstance(m, obreg.Counter):
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {m.value:g}")
+        elif isinstance(m, obreg.Gauge):
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {m.value:g}")
+            lines.append(f"# TYPE {pname}_max gauge")
+            lines.append(f"{pname}_max {m.max:g}")
+        elif isinstance(m, obreg.Histogram):
+            # quantiles over the bounded recent window, count/sum over the
+            # lifetime — the same honesty split Histogram.summary makes
+            lines.append(f"# TYPE {pname} summary")
+            for q, p in (("0.5", 50.0), ("0.99", 99.0)):
+                v = m.percentile(p)
+                if v is not None:
+                    lines.append(f'{pname}{{quantile="{q}"}} {v:g}')
+            lines.append(f"{pname}_sum {m.sum:g}")
+            lines.append(f"{pname}_count {m.count}")
+        elif isinstance(m, obreg.Meter):
+            lines.append(f"# TYPE {pname}_rate_per_s gauge")
+            lines.append(f"{pname}_rate_per_s {m.rate():g}")
+    return "\n".join(lines) + "\n"
+
 
 class MetricsServer:
-    """Loopback HTTP endpoint over a snapshot callable."""
+    """Loopback HTTP endpoint over a snapshot callable (+ the registry
+    for the Prometheus exposition; defaults to the process-wide one)."""
 
     def __init__(self, snapshot: Callable[[], dict], host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, registry: obreg.Registry | None = None):
         self._snapshot = snapshot
+        self._registry = registry if registry is not None else obreg.default()
         handler = self._make_handler()
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
@@ -79,20 +143,32 @@ class MetricsServer:
 
     def _make_handler(self):
         snapshot = self._snapshot
+        registry = self._registry
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-                if self.path.rstrip("/") not in ("/metrics", ""):
+                path = self.path.rstrip("/")
+                if path == "/metrics.prom":
+                    try:
+                        body = render_prometheus(registry).encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    except Exception as e:  # noqa: BLE001 — 500, not a
+                        # silently-dead handler thread
+                        self.send_error(500, f"{type(e).__name__}: {e}")
+                        return
+                elif path in ("/metrics", ""):
+                    try:
+                        body = json.dumps(snapshot()).encode()
+                        ctype = "application/json"
+                    except Exception as e:  # noqa: BLE001 — a broken
+                        # snapshot must 500, not kill the handler thread
+                        self.send_error(500, f"{type(e).__name__}: {e}")
+                        return
+                else:
                     self.send_error(404)
                     return
-                try:
-                    body = json.dumps(snapshot()).encode()
-                except Exception as e:  # noqa: BLE001 — a broken snapshot
-                    # must 500, not kill the handler thread silently
-                    self.send_error(500, f"{type(e).__name__}: {e}")
-                    return
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

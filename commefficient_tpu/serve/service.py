@@ -621,6 +621,27 @@ class AggregationService:
             "merge_policy": getattr(s.cfg, "merge_policy", "sum"),
             "merge_trim": int(getattr(s.cfg, "merge_trim", 0)),
             "quarantine_scope": getattr(s.cfg, "quarantine_scope", "cohort"),
+            # algorithm-health + SLO posture (null when unarmed): the
+            # health_* gauges the --health_every estimators publish, and
+            # the SLO engine's rule/violation snapshot (session.slo)
+            "health": self._health_block(),
+            "slo": (s.slo.snapshot()
+                    if getattr(s, "slo", None) is not None else None),
+        }
+
+    def _health_block(self) -> dict | None:
+        """The newest health-estimator gauge values (health_* registry
+        gauges, written by the session's HealthMonitor sink at the
+        --health_every cadence); None when health is unarmed."""
+        if getattr(self.session, "health_monitor", None) is None:
+            return None
+        snap = self.registry.snapshot()
+        return {
+            "rounds": int(snap.get("health_rounds_total", 0)),
+            **{k[len("health_"):]: v["value"]
+               for k, v in snap.items()
+               if k.startswith("health_") and isinstance(v, dict)
+               and "value" in v},
         }
 
 

@@ -400,6 +400,55 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "the profiler is unavailable. Without this flag "
                         "--profile_dir still captures the whole run")
     p.add_argument("--profile_dir", default="", help="write a jax.profiler trace here")
+    p.add_argument("--health_every", type=int, default=0,
+                   help="N > 0 computes sketch-health estimators ON DEVICE "
+                        "inside the round program every N rounds (mode="
+                        "sketch, fused/sharded/served paths): heavy-hitter "
+                        "mass + top-k recall proxy, table saturation/"
+                        "collision proxy, error-feedback Verror telescoping "
+                        "health, per-leaf gradient-norm distribution, "
+                        "uplink-vs-dense bytes — resolved at the existing "
+                        "drain boundary (zero added host syncs) into "
+                        "health_* registry gauges, /metrics, the trace, and "
+                        "the round ledger. Estimators only READ round "
+                        "state: a health-armed run is pinned bit-identical "
+                        "to an unarmed one. 0 = off (the seed program, "
+                        "bit-for-bit)")
+    p.add_argument("--ledger", default="",
+                   help="append one schema-versioned JSONL record per "
+                        "COMMITTED round here (cohort + masks, admission/"
+                        "quarantine/attack/stale-fold counter deltas, "
+                        "health block, params/optimizer fingerprints) — "
+                        "written with the whole-line crash-safe discipline, "
+                        "riding the committed-snapshot rewind (uncommitted "
+                        "rounds never appear; --resume continues the same "
+                        "file gap-free). Also arms the crash postmortem "
+                        "bundle at PATH.postmortem/ (trace + ledger tail + "
+                        "registry snapshot + resolved config on watchdog "
+                        "abort / unhandled exception / exit 75). Inspect "
+                        "with `python -m commefficient_tpu.obs.ledger "
+                        "diff|replay-check`")
+    p.add_argument("--slo", default="off", choices=["off", "warn", "halt"],
+                   help="arm the SLO/anomaly engine: windowed rules over "
+                        "the committed round series (default set: "
+                        "quarantine-rate spike, recall-proxy floor, stale-"
+                        "fold runaway, server_idle_ms regression, non-"
+                        "finite streak), evaluated at each commit. warn = "
+                        "stderr + slo_* counters + trace instant; halt = "
+                        "additionally checkpoint and exit cleanly at the "
+                        "next drain boundary (the --on_nonfinite halt "
+                        "discipline)")
+    p.add_argument("--slo_rules", default="",
+                   help="';'-separated rule specs overriding the default "
+                        "set: name:series(>|<|^)threshold[@window] — e.g. "
+                        "'q_spike:quarantine_rate>0.2@8;recall:"
+                        "topk_mass_proxy<0.1@4'. > / < compare the "
+                        "windowed mean; ^ fires when the current window "
+                        "exceeds threshold x the older baseline "
+                        "(regression). Series: any per-round metric, "
+                        "quarantine_rate, stale_fraction, server_idle_ms, "
+                        "or any health_* estimator name (needs "
+                        "--health_every). Requires --slo")
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"],
                    help="model compute dtype (params/BN/logits stay float32); "
                         "bfloat16 runs convs/matmuls on the TPU MXU at full rate")
@@ -511,6 +560,32 @@ def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
         raise SystemExit(
             "--serve_pipeline pipelines the serving rounds; arm --serve "
             "inproc|socket")
+    if getattr(args, "health_every", 0):
+        if args.health_every < 0:
+            raise SystemExit(
+                f"--health_every must be >= 0, got {args.health_every}")
+        if args.mode != "sketch":
+            raise SystemExit(
+                "--health_every computes SKETCH-wire quality estimators; "
+                f"--mode {args.mode} has no table to estimate from")
+        if getattr(args, "split_compile", False):
+            raise SystemExit(
+                "--health_every is fused-paths-only (the split program "
+                "boundary does not thread the estimator metrics); drop "
+                "--split_compile")
+    if getattr(args, "slo_rules", "") and getattr(args, "slo", "off") == "off":
+        raise SystemExit(
+            "--slo_rules names rules for the SLO engine; arm it with "
+            "--slo warn|halt")
+    if getattr(args, "slo", "off") != "off":
+        # validate the rule grammar at launch — a typo'd rule must not be
+        # a silently-absent guard discovered at the postmortem
+        from ..obs.slo import parse_rules
+
+        try:
+            parse_rules(getattr(args, "slo_rules", ""))
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
     if getattr(args, "profile_rounds", ""):
         # validate the window at launch: a typo'd spec (or a missing
         # output dir) must not surface hours later as a silently-absent

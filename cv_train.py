@@ -118,6 +118,14 @@ def build(args, fault_plan=None, retry_policy=None):
         on_nonfinite=args.on_nonfinite,
         fault_plan=fault_plan,
         retry_policy=retry_policy,
+        # sketch-health estimators compiled into the round program at the
+        # --health_every cadence; --ledger adds per-round state
+        # fingerprints (both read-only: armed == unarmed, bit-for-bit).
+        # Fingerprints are fused-paths-only — a split ledger run still
+        # records cohorts/counters/health, just without them.
+        health_every=getattr(args, "health_every", 0),
+        ledger_fingerprint=(bool(getattr(args, "ledger", ""))
+                            and not args.split_compile),
         # a checkpoint dir arms the watchdog's mid-round emergency save,
         # which needs the live (non-donated) server state readable; the
         # opt-out keeps donation for HBM-tight runs
@@ -186,6 +194,12 @@ def main(argv=None):
             "nonfinite_rounds": nonfinite_total,
         }
 
+    # --health_every / --slo / --ledger: sketch-health monitor, SLO
+    # engine, durable round ledger + postmortem bundle — attached AFTER
+    # restore so the ledger's resume truncation keys off the restored
+    # round (one gap-free, duplicate-free file across preemptions)
+    wiring = obs.attach_from_args(args, session)
+
     # --serve: the streaming aggregation service drives the loop from its
     # push arrival stream (built AFTER restore so a resumed service picks
     # up the persisted pending-submission queue)
@@ -202,8 +216,18 @@ def main(argv=None):
             build_row=build_row,
             logger=logger,
             source=service.source() if service is not None else None,
+            slo=wiring.slo_engine,
+            postmortem=wiring.postmortem,
         )
+    except Exception as e:
+        # unhandled-exception postmortem (the watchdog-abort and exit-75
+        # bundles are written inside run_loop, where os._exit/sys.exit
+        # would skip or outrun this handler)
+        if wiring.postmortem is not None:
+            wiring.postmortem(f"exception:{type(e).__name__}: {e}")
+        raise
     finally:
+        wiring.close()
         if service is not None:
             print(f"serve: final metrics {service.metrics_snapshot()}",
                   flush=True)

@@ -190,6 +190,12 @@ def build(args, fault_plan=None, retry_policy=None):
         on_nonfinite=args.on_nonfinite,
         fault_plan=fault_plan,
         retry_policy=retry_policy,
+        # sketch-health estimators + ledger fingerprints: read-only
+        # in-program observability (armed == unarmed bit-for-bit);
+        # fingerprints are fused-paths-only
+        health_every=getattr(args, "health_every", 0),
+        ledger_fingerprint=(bool(getattr(args, "ledger", ""))
+                            and not args.split_compile),
         # a checkpoint dir arms the watchdog's mid-round emergency save,
         # which needs the live (non-donated) server state readable; the
         # opt-out keeps donation for HBM-tight runs
@@ -328,6 +334,10 @@ def main(argv=None):
             row["val_f1"] = f1_eval(model.params, rnd)
         return row
 
+    # --health_every / --slo / --ledger: attached AFTER restore so the
+    # ledger's resume truncation keys off the restored round
+    wiring = obs.attach_from_args(args, session)
+
     # --serve: the streaming aggregation service drives the loop from its
     # push arrival stream (built AFTER restore so a resumed service picks
     # up the persisted pending-submission queue)
@@ -345,8 +355,17 @@ def main(argv=None):
             build_row=build_row,
             logger=logger,
             source=service.source() if service is not None else None,
+            slo=wiring.slo_engine,
+            postmortem=wiring.postmortem,
         )
+    except Exception as e:
+        # unhandled-exception postmortem (abort/exit-75 bundles are
+        # written inside run_loop, which this handler can't reach)
+        if wiring.postmortem is not None:
+            wiring.postmortem(f"exception:{type(e).__name__}: {e}")
+        raise
     finally:
+        wiring.close()
         if service is not None:
             print(f"serve: final metrics {service.metrics_snapshot()}",
                   flush=True)

@@ -19,6 +19,7 @@
 #        scripts/chaos_smoke.sh wire
 #        scripts/chaos_smoke.sh byzantine
 #        scripts/chaos_smoke.sh pipeline
+#        scripts/chaos_smoke.sh postmortem
 #
 # `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
 # restartPolicy would: it launches the tiny cv_train run with a fault plan
@@ -64,7 +65,16 @@
 # client_drop + wire_delay, with the delayed submission CROSSING the round
 # boundary into a staleness-weighted fold — asserting the stale-fold and
 # fault counters fired, the runner measured the commit-to-dispatch gap,
-# and the logged loss fell finite through all of it. < 1 min CPU.
+# and the logged loss fell finite through all of it.
+#
+# `postmortem` mode drives the CRASH POSTMORTEM BUNDLE (< 1 min CPU): a
+# real cv_train run with --ledger armed is wedged mid-round by an injected
+# data-loader stall; the (chaos-shrunk) watchdog walks its ladder to the
+# abort stage and os._exit(75)s through the bundle hook — asserting the
+# child died 75, the bundle directory holds trace + ledger tail + registry
+# snapshot + resolved config + reason=watchdog_abort, and the ledger's
+# rounds exactly match the rounds the registry says committed (gap-free,
+# no uncommitted round leaked). < 1 min CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -714,6 +724,123 @@ print(f"pipeline: PASS (10 pipelined+async rounds; stale folds={int(folded)}, "
       f"server_idle_ms={stats.server_idle_ms:.2f}, "
       f"loss {losses[0]:.4f} -> {losses[-1]:.4f}, params finite)")
 EOF
+fi
+
+if [[ "${1:-}" == "postmortem" ]]; then
+    shift
+    wdir="$(mktemp -d)"
+    trap 'rm -rf "$wdir"' EXIT
+    set +e
+    timeout -k 10 "${CHAOS_TIMEOUT_S:-180}" python - "$wdir" "$@" <<'EOF'
+# postmortem chaos child: the real cv_train.main CLI path (tiny-model
+# substitution, --sync_loop so the watchdog learns per-round medians)
+# with --ledger + --watchdog_abort armed and the watchdog chaos-shrunk
+# (floor 1.5 s instead of 120 s — the ladder in seconds, not minutes).
+# A 120 s data-loader stall at round 3 wedges the run; the ladder walks
+# warn -> stacks -> emergency ckpt -> abort, the abort hook writes the
+# bundle, and the process dies os._exit(75). The PARENT asserts the rc
+# and the bundle (os._exit skips everything in this file past main()).
+import functools
+import os
+import sys
+
+import flax.linen as nn
+
+import commefficient_tpu.data.cifar as cifar
+import cv_train
+from commefficient_tpu.runner import loop as rloop
+from commefficient_tpu.utils.watchdog import RoundWatchdog
+
+
+class _TinyNet(nn.Module):
+    num_classes: int = 10
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+_orig = cifar.load_cifar_fed
+
+
+def _tiny(*a, **kw):
+    kw.update(synthetic_train=64, synthetic_test=32)
+    return _orig(*a, **kw)
+
+
+cv_train.ResNet9 = _TinyNet
+cv_train.load_cifar_fed = _tiny
+# chaos-shrunk watchdog: same ladder, seconds instead of minutes
+rloop.RoundWatchdog = functools.partial(
+    RoundWatchdog, factor=3.0, min_history=2, floor_s=1.5)
+
+wdir = sys.argv[1]
+cv_train.main([
+    "--dataset", "cifar10", "--mode", "sketch",
+    "--k", "64", "--num_rows", "3", "--num_cols", "256",
+    "--num_clients", "8", "--num_workers", "2", "--local_batch_size", "4",
+    "--lr_scale", "0.02", "--weight_decay", "0",
+    "--data_root", "/nonexistent", "--num_rounds", "8", "--sync_loop",
+    "--checkpoint_dir", os.path.join(wdir, "ck"),
+    "--ledger", os.path.join(wdir, "run.jsonl"),
+    "--health_every", "1", "--watchdog_abort",
+    "--fault_plan", "stall@3:secs=120",
+])
+print("postmortem-child: UNEXPECTED clean finish", file=sys.stderr)
+sys.exit(3)
+EOF
+    rc=$?
+    set -e
+    if [[ $rc -ne 75 ]]; then
+        echo "postmortem: FAILED — expected watchdog abort rc=75, got $rc" >&2
+        exit 1
+    fi
+    python - "$wdir" <<'EOF'
+# bundle verifier (fresh process: the child died by os._exit)
+import json
+import os
+import sys
+
+wdir = sys.argv[1]
+ledger_path = os.path.join(wdir, "run.jsonl")
+bundle = ledger_path + ".postmortem"
+for name in ("reason.json", "trace.json", "ledger_tail.jsonl",
+             "registry.json", "config.json"):
+    p = os.path.join(bundle, name)
+    assert os.path.exists(p), f"bundle artifact missing: {name}"
+reason = json.load(open(os.path.join(bundle, "reason.json")))
+assert reason["reason"] == "watchdog_abort", reason
+assert not reason.get("artifact_failures"), reason
+trace = json.load(open(os.path.join(bundle, "trace.json")))
+assert "traceEvents" in trace and trace["traceEvents"], "empty trace"
+reg = json.load(open(os.path.join(bundle, "registry.json")))
+committed = int(reg.get("runner_rounds_total", 0))
+assert committed >= 1, reg
+
+from commefficient_tpu.obs import ledger as L
+
+assert L.replay_check(ledger_path) == [], L.replay_check(ledger_path)
+rounds = [r["round"] for r in L.round_records(ledger_path)]
+# THE invariant: ledger rounds == committed rounds, exactly — the
+# stalled round (and anything after) never committed, never appears
+assert rounds == list(range(committed)), (rounds, committed)
+tail = [json.loads(l) for l in
+        open(os.path.join(bundle, "ledger_tail.jsonl")) if l.strip()]
+assert [r["round"] for r in tail if r.get("kind") == "round"] \
+    == rounds[-len([r for r in tail if r.get("kind") == "round"]):]
+cfg = json.load(open(os.path.join(bundle, "config.json")))
+assert cfg.get("watchdog_abort") is True and cfg.get("ledger"), cfg
+health = [r for r in L.round_records(ledger_path) if r.get("health")]
+assert len(health) == len(rounds), "health blocks missing from ledger"
+print(f"postmortem: PASS (watchdog abort -> exit 75; bundle complete "
+      f"[trace {len(trace['traceEvents'])} events, registry, config, "
+      f"reason=watchdog_abort]; ledger rounds {rounds} == committed "
+      f"{committed}, gap-free, health on every round)")
+EOF
+    exit 0
 fi
 
 exec timeout -k 10 "${CHAOS_TIMEOUT_S:-600}" \

@@ -27,6 +27,7 @@ python -m pytest tests/test_sharded_round.py tests/test_engine.py \
     tests/test_client_state_sharding.py tests/test_cohort_faults.py \
     tests/test_serve.py tests/test_obs.py tests/test_layerwise.py \
     tests/test_byzantine.py tests/test_pipeline_serve.py \
+    tests/test_sketch_health.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 
 # bench mesh section must degrade to {"skipped": ...} on ONE device (the

@@ -1,0 +1,32 @@
+# graftlint: module=commefficient_tpu/federated/api.py
+# G014 violating twin: ledger appends OUTSIDE the declared commit
+# boundary — a prepare path writing optimistically (the round may never
+# commit; the rewind would take it back and the file would lie), plus a
+# SECOND declared boundary hiding under the first's exemption.
+from commefficient_tpu.obs import ledger as obledger
+
+
+# graftlint: ledger-commit — the declared append site
+def _publish_round_obs(session, records):
+    for rnd, m in records:
+        session.ledger.append_round(rnd, metrics=m)
+
+
+def prepare_round(session, rnd):
+    batch = {"x": None}
+    # optimistic append at PREPARE time: this round is not committed —
+    # prefetch may rewind it and the ledger would carry a phantom round
+    session.ledger.append_round(rnd, metrics={})
+    return batch
+
+
+def flush_tail(session, pending):
+    writer = obledger.RoundLedger("/tmp/l.jsonl")  # construction is legal
+    for rnd in pending:
+        # "flushing" uncommitted rounds on exit: the exact bug class
+        writer.append_round(rnd)
+
+
+# graftlint: ledger-commit — a SECOND declared boundary (itself illegal)
+def another_writer(session, rnd, m):
+    session.ledger.append_round(rnd, metrics=m)

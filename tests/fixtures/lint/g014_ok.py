@@ -1,0 +1,24 @@
+# graftlint: module=commefficient_tpu/federated/api.py
+# G014 conforming twin: ONE declared ledger-commit boundary owns the
+# append; everything else only builds the writer (config wiring) or hands
+# committed records to the boundary.
+from commefficient_tpu.obs import ledger as obledger
+
+
+def attach_ledger(session, path, resume_round):
+    # constructing the writer is wiring, not an append
+    session.ledger = obledger.RoundLedger(path, resume_round=resume_round)
+    return session.ledger
+
+
+# graftlint: ledger-commit — THE declared append site (commit boundary)
+def _publish_round_obs(session, records):
+    for rnd, ids, m, health, fp in records:
+        session.ledger.append_round(
+            rnd, cohort=ids, metrics=m, health=health, fingerprint=fp)
+
+
+def commit_rounds(session, infls, metrics_hosts):
+    records = [(0, [1, 2], m, None, None) for m in metrics_hosts]
+    _publish_round_obs(session, records)
+    return records

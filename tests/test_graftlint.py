@@ -292,6 +292,63 @@ def test_g013_forwarding_is_legal_config_scalars_exempt():
         os.unlink(path)
 
 
+def test_g014_second_declared_boundary_fires():
+    """THE ledger-commit boundary is one function in federated/api.py: a
+    second declaration is a second write path hiding under the first's
+    exemption, and must itself be a violation."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/federated/api.py\n"
+        "\n"
+        "\n"
+        "# graftlint: ledger-commit\n"
+        "def first(session, rnd, m):\n"
+        "    session.ledger.append_round(rnd, metrics=m)\n"
+        "\n"
+        "\n"
+        "# graftlint: ledger-commit\n"
+        "def second(session, rnd, m):\n"
+        "    session.ledger.append_round(rnd, metrics=m)\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        found = _codes(path)
+        assert found.count("G014") == 1, found  # the SECOND def, only
+    finally:
+        os.unlink(path)
+
+
+def test_g014_runner_scope_and_construction_legal():
+    """runner/ is in G014's scope (an exit path 'flushing' uncommitted
+    rounds is the bug class), and constructing the writer stays legal —
+    building a RoundLedger is wiring, appending is the policed verb."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/runner/loop.py\n"
+        "from commefficient_tpu.obs.ledger import RoundLedger\n"
+        "\n"
+        "\n"
+        "def run_loop(session, pending):\n"
+        "    ledger = RoundLedger('/tmp/run.jsonl')  # wiring: legal\n"
+        "    for rnd in pending:\n"
+        "        ledger.append_round(rnd)  # uncommitted flush: illegal\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        found = _codes(path)
+        assert found.count("G014") == 1, found  # the append, not the ctor
+    finally:
+        os.unlink(path)
+
+
 def test_every_rule_has_fixture_pair():
     # adding a rule without fixtures should fail HERE, not in review
     for code in RULE_CODES:
