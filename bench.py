@@ -1495,12 +1495,76 @@ def _byzantine_bench() -> dict:
                         clean[p]["updates_per_sec_warm"] / max(base, 1e-9),
                         3)}
                 for p in ("sum", "trimmed", "median")}
+        # async arm: the robust-merge overhead on the BUFFERED path — the
+        # per-buffer robust merge (order statistics over {current buffer +
+        # staleness-weighted stale folds}) vs the linear stale fold, both
+        # through the real serving stack (inproc transport, buffer-trigger
+        # closes, stragglers folding staleness-weighted into later merges)
+        try:
+            from commefficient_tpu.obs import registry as _obreg
+            from commefficient_tpu.serve.service import (
+                AggregationService, ServeConfig)
+            from commefficient_tpu.serve.traffic import (
+                TraceConfig, TrafficGenerator)
+
+            a_rounds = max(rounds // 2, 4)
+            trigger = max(workers * 3 // 4, 2)
+            reg = _obreg.default()
+            async_out: dict = {}
+            for pname, pkw in (("sum", {}),
+                               ("trimmed", {"merge_policy": "trimmed",
+                                            "merge_trim": trim})):
+                s = make_session(pkw.pop("merge_policy", "sum"), None,
+                                 wire_payloads=True, stale_slots=workers,
+                                 **pkw)
+                svc = AggregationService(
+                    s, ServeConfig(quorum=workers, deadline_s=60.0,
+                                   payload="sketch", async_mode=True,
+                                   buffer_size=trigger),
+                    traffic=TrafficGenerator(TraceConfig(
+                        population=s.train_set.num_clients,
+                        seed=7))).start()
+                try:
+                    src = svc.source()
+                    base_folded = reg.counter(
+                        "serve_stale_folded_total").value
+                    t0 = _time.perf_counter()
+                    for _ in range(a_rounds):
+                        prep = src.next()
+                        s.commit_round(s.dispatch_round(prep, 0.02))
+                        src.on_dispatched(s.round - 1)
+                        src.on_committed(s.round)
+                    src.stop()
+                    wall = _time.perf_counter() - t0
+                    async_out[pname] = {
+                        "rounds_per_sec": round(a_rounds / max(wall, 1e-9),
+                                                3),
+                        "stale_folded": int(reg.counter(
+                            "serve_stale_folded_total").value
+                            - base_folded),
+                        "wall_s_incl_compile": round(wall, 2),
+                    }
+                finally:
+                    svc.close()
+            if "sum" in async_out and "trimmed" in async_out:
+                base = async_out["sum"]["rounds_per_sec"]
+                async_out["trimmed"]["vs_sum"] = round(
+                    async_out["trimmed"]["rounds_per_sec"]
+                    / max(base, 1e-9), 3)
+            async_out["buffer_size"] = trigger
+            async_out["rounds_per_arm"] = a_rounds
+            out["async"] = async_out
+            _stage(f"byzantine async arm: {async_out}")
+        except Exception as e:  # noqa: BLE001 — partial arms still report
+            out["async"] = {"error": f"{type(e).__name__}: {e}"}
         out["note"] = (
             "accuracy = train accuracy over the last 3 rounds; attacks ride "
             "the per-client-table round (sum arms included, so damage is "
             "attack-caused, not shape-caused); overhead vs_sum < 1 is the "
             "robust policies' cost — the compress-once shortcut forfeited "
-            "plus the per-coordinate order statistics")
+            "plus the per-coordinate order statistics; the async block is "
+            "the BUFFERED path's twin (per-buffer robust merge vs linear "
+            "stale fold through the real serving stack, wall incl compile)")
     except Exception as e:  # noqa: BLE001 — the stanza IS the result
         out["error"] = f"{type(e).__name__}: {e}"
     return out

@@ -43,9 +43,19 @@ submission set; weights join the survivor normalization). Arithmetic over
 ``stale_*``-named values anywhere else in parity scope is a second,
 undeclared fold site: two sites that disagree about order or weight
 handling silently un-pin the async==sync bit-identity. Bare argument
-FORWARDING (``_stale_fold(tbl, live, stale_tables, stale_weights)``) is
-legal — the merge program has to hand the stack to the boundary; touching
-the values outside it is not.
+FORWARDING (``_stale_fold(tbl, live, stale_tables, stale_weights)``, or
+the keyword-forward through ``modes.merge_partial_wires(...)``) is
+legal — the merge program has to hand the stack to a boundary; touching
+the values outside one is not.
+
+The async x robust COMPOSITION (the per-buffer robust merge) ties the two
+rules together: stale wires are ALSO sanctioned inside the declared
+robust-merge boundary, where they join the weighted order statistics of
+the union stack — that is the one other place their semantics are pinned.
+The converse does NOT hold: the staleness-fold boundary sanctions the
+LINEAR slot-ordered scan only, so an order statistic smuggled into
+``_stale_fold`` fires G012 with a message naming the seam (the weighted
+forms live in the robust-merge boundary alone).
 """
 
 from __future__ import annotations
@@ -64,15 +74,20 @@ _PARITY_SCOPE = (
 _BOUNDARY_FILE = f"{PACKAGE}/modes/modes.py"
 
 # order-statistics primitives (import-resolved): the moves only the
-# declared boundary may make over client-stacked data
+# declared boundary may make over client-stacked data. The weighted forms
+# (the per-buffer robust merge: weighted trimmed mean / weighted median
+# over the union stack) add searchsorted/lexsort — rank machinery a
+# weighted median smuggled outside the boundary would reach for.
 _ORDER_STATS = frozenset({
     "jax.numpy.sort", "jax.numpy.argsort", "jax.numpy.partition",
     "jax.numpy.argpartition", "jax.numpy.median", "jax.numpy.nanmedian",
     "jax.numpy.percentile", "jax.numpy.nanpercentile",
     "jax.numpy.quantile", "jax.numpy.nanquantile",
+    "jax.numpy.searchsorted", "jax.numpy.lexsort",
     "jax.lax.sort", "jax.lax.sort_key_val",
     "numpy.sort", "numpy.argsort", "numpy.partition", "numpy.median",
     "numpy.nanmedian", "numpy.percentile", "numpy.quantile",
+    "numpy.searchsorted", "numpy.lexsort",
 })
 
 
@@ -118,6 +133,22 @@ class RobustOrderSensitivity(Rule):
                 continue
             if in_boundary_file and src.in_robust_merge(node.lineno):
                 continue
+            if src.in_staleness_fold(node.lineno):
+                # the stale-fold seam is explicitly IN scope: the declared
+                # staleness-fold boundary sanctions the LINEAR slot-ordered
+                # scan, never order statistics — a sort smuggled into
+                # _stale_fold is a robust merge hiding behind the wrong
+                # boundary's exemption (the weighted order statistics of
+                # the per-buffer robust merge live in the robust-merge
+                # boundary alone)
+                out.append(self.violation(
+                    src, node,
+                    f"{dotted}() inside the declared staleness-fold "
+                    "boundary — the stale fold is a LINEAR slot-ordered "
+                    "scan; weighted order statistics over stale wires "
+                    "belong in the robust-merge boundary "
+                    "(modes._robust_table_merge's union-stack form)"))
+                continue
             out.append(self.violation(
                 src, node,
                 f"{dotted}() is an order statistic in parity scope outside "
@@ -133,6 +164,11 @@ _STALE_BOUNDARY_FILE = f"{PACKAGE}/federated/engine.py"
 # args) — config scalars (stale_slots) and derived host metrics are not
 # wire values and stay legal outside the boundary
 _STALE_NAMES = frozenset({"stale_tables", "stale_weights"})
+# the boundary ENTRY POINTS an attribute call may forward the stale stack
+# into (the engine's `modes.merge_partial_wires(...)` shape); any other
+# attribute call is arithmetic in disguise, not forwarding
+_STALE_FORWARD_CALLEES = frozenset({
+    "merge_partial_wires", "_robust_table_merge", "_stale_fold"})
 
 
 class StalenessFoldBoundary(Rule):
@@ -165,18 +201,34 @@ class StalenessFoldBoundary(Rule):
                 line_text=src.line(extra.def_lineno),
                 symbol=extra.qualname,
             ))
-        # Name uses of stale_* values are legal in exactly two shapes:
-        # inside the declared boundary, or as a bare argument being
-        # FORWARDED to a plain function call (the merge handing the stack
-        # to the boundary). Anything else — a BinOp, a compare, a method
-        # call, an index — is stale arithmetic outside the boundary.
+        # Name uses of stale_* values are legal in exactly three shapes:
+        # inside the declared staleness-fold boundary, inside the declared
+        # ROBUST-MERGE boundary (the per-buffer robust merge: stale slots
+        # join the weighted order statistics there — the G012 boundary is
+        # the one other sanctioned fold semantics), or as a bare argument
+        # being FORWARDED toward a boundary: a plain Name call (the
+        # historical `_stale_fold(...)` hand-off), or an ATTRIBUTE call
+        # whose target IS one of the boundary entry points (the engine's
+        # `modes.merge_partial_wires(...)` keyword-forward). A generic
+        # attribute call is NOT forwarding — `jnp.average(stale_tables,
+        # weights=stale_weights)` is a smuggled fold wearing a call's
+        # clothes and must fire. Anything else — a BinOp, a compare, a
+        # method call on the value, an index — is stale arithmetic
+        # outside the boundaries.
         forwarded: set[int] = set()
         for node in ast.walk(src.tree):
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)):
-                for a in list(node.args) + [k.value for k in node.keywords]:
-                    if isinstance(a, ast.Name):
-                        forwarded.add(id(a))
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name):
+                pass  # plain-call forwarding (the historical shape)
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _STALE_FORWARD_CALLEES):
+                pass  # attribute-forward into a sanctioned boundary entry
+            else:
+                continue
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(a, ast.Name):
+                    forwarded.add(id(a))
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.Name):
                 continue
@@ -188,11 +240,18 @@ class StalenessFoldBoundary(Rule):
                 continue
             if in_boundary_file and src.in_staleness_fold(node.lineno):
                 continue
+            if (src.rel == _BOUNDARY_FILE
+                    and src.in_robust_merge(node.lineno)):
+                # the per-buffer robust merge: stale wires are sanctioned
+                # inside the ONE declared robust-merge boundary, where
+                # they join the weighted order statistics
+                continue
             out.append(self.violation(
                 src, node,
                 f"`{node.id}` used outside the declared staleness-fold "
-                "boundary — stale wire values may only be FORWARDED to "
-                "engine._stale_fold; arithmetic on them here is a second, "
-                "undeclared fold site (its order and weight handling are "
-                "pinned nowhere)"))
+                "and robust-merge boundaries — stale wire values may only "
+                "be FORWARDED to engine._stale_fold or "
+                "modes._robust_table_merge; arithmetic on them here is a "
+                "second, undeclared fold site (its order and weight "
+                "handling are pinned nowhere)"))
         return out

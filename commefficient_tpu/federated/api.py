@@ -146,6 +146,7 @@ class FederatedSession:
         merge_trim: int = 0,
         quarantine_scope: str = "cohort",
         stale_slots: int = 0,
+        robust_residual: bool = False,
         health_every: int = 0,
         ledger_fingerprint: bool = False,
     ):
@@ -182,8 +183,14 @@ class FederatedSession:
             merge_trim=merge_trim,
             quarantine_scope=quarantine_scope,
             # buffered-async serving (--serve_async): slot count of the
-            # stale-fold merge variant; 0 keeps the sync programs only
+            # stale-fold merge variant; 0 keeps the sync programs only.
+            # With a robust merge_policy the stale slots join the order
+            # statistics as weighted union-stack entries (the per-buffer
+            # robust merge) instead of folding linearly
             stale_slots=stale_slots,
+            # error-feedback-aware robust merges (--robust_residual): the
+            # winsorized robust-vs-mean residual accumulates into Verror
+            robust_residual=robust_residual,
             # sketch-health estimators (--health_every N > 0) and round-
             # ledger fingerprints (--ledger): in-program observability that
             # only READS round state — armed runs stay bit-identical to
@@ -228,6 +235,15 @@ class FederatedSession:
         adv_faults = (fault_plan is not None
                       and getattr(fault_plan, "has_adversarial",
                                   lambda: False)())
+        if (fault_plan is not None
+                and getattr(fault_plan, "has_normride", lambda: False)()
+                and client_update_clip <= 0):
+            raise ValueError(
+                "client_normride rides just UNDER the quarantine screen "
+                "(scale to ride * clip * running_median); with "
+                "--client_update_clip at 0 there is no threshold to ride "
+                "and the attack is undefined — arm the quarantine"
+            )
         self._table_round = bool(
             engine.uses_table_round(self.cfg) or adv_faults)
         if self._table_round and not wire_payloads:
@@ -731,6 +747,12 @@ class FederatedSession:
             scale, src = self.fault_plan.adversarial_plan(rnd, len(ids))
             batch[engine.ADV_SCALE_KEY] = scale
             batch[engine.ADV_SRC_KEY] = src
+            if self.fault_plan.has_normride():
+                # the norm-riding fraction leaf (0 = honest) rides every
+                # round of a plan that names the kind, like scale/src —
+                # the compiled program's shape stays constant from round 0
+                batch[engine.ADV_RIDE_KEY] = (
+                    self.fault_plan.normride_plan(rnd, len(ids)))
         health_on = False
         if self.cfg.health:
             # the health-cadence flag rides the batch like `_valid` (shape-

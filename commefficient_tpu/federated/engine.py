@@ -188,7 +188,31 @@ class EngineConfig:
     # ZERO stale entries, so async-with-everyone-on-time runs the exact
     # sync program — bit-identity by construction, not fp luck. 0 = off
     # (the stale program is never built).
+    # Composed with a robust merge_policy (the per-BUFFER robust merge),
+    # the stale slots do NOT fold linearly: they join the robust order
+    # statistics as weighted entries of the union stack {current buffer ∪
+    # staleness-weighted stale folds} inside the ONE G012 boundary
+    # (modes._robust_table_merge's extended form) — on-time tables at
+    # weight 1, stale tables at their (1+lag)^-alpha weight, so a stale
+    # adversarial table is trimmed/outvoted exactly like an on-time one.
+    # A zero-stale robust round dispatches the plain robust program — the
+    # PR 10 sync robust round, by program identity.
     stale_slots: int = 0
+    # Error-feedback-aware robust merges (--robust_residual; no effect
+    # unless a robust merge_policy is effective): accumulate the
+    # robust-vs-mean merge residual into the Verror table before the
+    # server step, with the "mean" evaluated over the WINSORIZED stack
+    # (every contribution clamped into the robust policy's kept window),
+    # so the honest mass the trim clips re-enters through error feedback
+    # — telescoping survives robust merges — while an adversary's
+    # residual contribution stays bounded by the clean cohort's value
+    # range (the PR 12 `verror_ratio` estimator stays bounded under
+    # sustained in-screen attack; pinned in tests/test_async_robust.py).
+    # Default OFF: the residual arithmetic is a different compiled robust
+    # program, and the PR 10 robust pins (mesh == single-device bitwise)
+    # stay on the exact shipped program until this soaks — MIGRATION.md
+    # records the intent to flip the default.
+    robust_residual: bool = False
     # Sketch-health observability (--health_every, obs/health.py): True
     # compiles the per-round compression-quality estimators INTO the round
     # program — estimated heavy-hitter mass / recall proxy, table
@@ -326,23 +350,23 @@ class EngineConfig:
             raise ValueError(
                 f"stale_slots must be >= 0, got {self.stale_slots}"
             )
-        if self.stale_slots > 0:
-            if not self.wire_payloads:
-                raise ValueError(
-                    "stale_slots (--serve_async) folds LATE WIRE TABLES "
-                    "into the payload merge; without wire_payloads there is "
-                    "no per-client table wire to arrive late — arm "
-                    "--serve_payload sketch"
-                )
-            if robust_policy(self) is not None:
-                raise ValueError(
-                    f"stale_slots with merge_policy={self.merge_policy!r} "
-                    "is unsupported: the robust order statistics run over "
-                    "ONE round's cohort stack, and a staleness-weighted "
-                    "extra fold would bypass them — the two defenses "
-                    "compose at different trust boundaries (see the README "
-                    "always-on section); pick one"
-                )
+        if self.stale_slots > 0 and not self.wire_payloads:
+            raise ValueError(
+                "stale_slots (--serve_async) folds LATE WIRE TABLES "
+                "into the payload merge; without wire_payloads there is "
+                "no per-client table wire to arrive late — arm "
+                "--serve_payload sketch"
+            )
+        if self.robust_residual and robust_policy(self) is None:
+            raise ValueError(
+                "robust_residual is the robust merge's error-feedback "
+                f"repair; merge_policy={self.merge_policy!r}"
+                f"{f' with merge_trim=0' if self.merge_policy == 'trimmed' else ''} "
+                "compiles the plain sum program, which has no residual to "
+                "accumulate — arm merge_policy='trimmed' (trim > 0) or "
+                "'median', or drop the flag (a silent no-op would be "
+                "discovered at the postmortem)"
+            )
         if self.health and self.mode.mode != "sketch":
             raise ValueError(
                 "health (--health_every) computes SKETCH-wire quality "
@@ -2284,35 +2308,60 @@ def _table_norms(tables: jnp.ndarray) -> jnp.ndarray:
 # client's transmitted table (sketch linearity makes scaling the table
 # EXACTLY scaling the update: sketch(a*u) == a*sketch(u) coordinate-wise);
 # `_adv_src` is a [W] int source position — a colluding client transmits a
-# (scaled) CLONE of the source's table instead of its own. Identity
-# defaults (src=arange, scale=1) keep the program's shapes constant from
-# round 0, so the first attack never triggers a mid-run recompile. The
-# leaves ride the batch pytree like `_valid` and are popped before the
-# client fwd/bwd ever sees them.
+# (scaled) CLONE of the source's table instead of its own. `_adv_ride`
+# (present only when the plan names client_normride) is a [W] float ride
+# fraction in (0, 1]: a riding client rescales its table so its sketch-
+# space L2 sits at ride * clip_multiple * running_median — just UNDER the
+# quarantine screen, probing the running median the server state carries
+# (0 = honest row). Identity defaults (src=arange, scale=1, ride=0) keep
+# the program's shapes constant from round 0, so the first attack never
+# triggers a mid-run recompile. The leaves ride the batch pytree like
+# `_valid` and are popped before the client fwd/bwd ever sees them.
 ADV_SCALE_KEY = "_adv_scale"
 ADV_SRC_KEY = "_adv_src"
+ADV_RIDE_KEY = "_adv_ride"
 
 
 def split_adv(batch):
     """Pop the reserved adversarial-transform leaves off a round batch.
-    Returns (batch_without_them, (scale, src) or None)."""
+    Returns (batch_without_them, (scale, src, ride_or_None) or None)."""
     if isinstance(batch, dict) and ADV_SCALE_KEY in batch:
         batch = dict(batch)
-        return batch, (batch.pop(ADV_SCALE_KEY), batch.pop(ADV_SRC_KEY))
+        scale = batch.pop(ADV_SCALE_KEY)
+        src = batch.pop(ADV_SRC_KEY)
+        return batch, (scale, src, batch.pop(ADV_RIDE_KEY, None))
     return batch, None
 
 
-def _apply_adv(tables: jnp.ndarray, adv) -> jnp.ndarray:
+def _apply_adv(tables: jnp.ndarray, adv, clip: float = 0.0,
+               qmed=None) -> jnp.ndarray:
     """Apply the adversarial wire transform to the replicated [W, r, c]
     table stack (AFTER any cross-shard gather, so the crafted table is
     mesh-shape-invariant): row i becomes scale[i] * tables[src[i]]. With
     the identity defaults this is a gather of every row in order times
-    1.0 — the same values bit-for-bit."""
+    1.0 — the same values bit-for-bit.
+
+    `clip`/`qmed` arm the client_normride transform (the ride leaf): a
+    riding row is rescaled so its table L2 equals ride * clip * qmed —
+    the norm-riding adversary sits just under the quarantine multiple of
+    the RUNNING median it is probing (sketch linearity: scaling the table
+    is exactly scaling the update, and the gauntlet/merge screens read
+    the table norm). Unarmed screens (qmed == 0, round 0's unseeded
+    baseline) leave the row untouched — with no threshold to ride there
+    is nothing to scale to."""
     if adv is None:
         return tables
-    scale, src = adv
+    scale, src, ride = adv if len(adv) == 3 else (*adv, None)
     cloned = jnp.take(tables, src.astype(jnp.int32), axis=0)
-    return cloned * scale.astype(tables.dtype)[:, None, None]
+    out = cloned * scale.astype(tables.dtype)[:, None, None]
+    if ride is not None and qmed is not None:
+        norms = jnp.sqrt(jnp.sum(
+            jnp.square(out.astype(jnp.float32)), axis=(1, 2)))
+        target = ride.astype(jnp.float32) * jnp.float32(clip) * qmed
+        factor = jnp.where((ride > 0) & (target > 0) & (norms > 0),
+                           target / jnp.maximum(norms, 1e-12), 1.0)
+        out = out * factor.astype(out.dtype)[:, None, None]
+    return out
 
 
 # graftlint: staleness-fold — THE one sanctioned staleness-weighted fold:
@@ -2413,12 +2462,6 @@ def make_payload_round_steps(
             "robust merge_policy, or allow_batch_tables=True (the announce "
             "path compiles make_round_step and friends)"
         )
-    if stale_slots and robust_policy(cfg) is not None:
-        raise ValueError(
-            "stale_slots composes with the linear sum only (the robust "
-            "order statistics run over one round's cohort stack; "
-            "EngineConfig rejects the combination too)"
-        )
     _sharded_scope_check(mcfg)
     if mcfg.mode != "sketch":
         raise ValueError(
@@ -2485,7 +2528,9 @@ def make_payload_round_steps(
                     lambda a: a.reshape((W,) + a.shape[2:]), stacked)
             tables, nstates, metrics = outs[:3]
             lnorms = outs[3] if layer_q else None
-            tables = _apply_adv(tables, adv)
+            tables = _apply_adv(
+                tables, adv, cfg.client_update_clip,
+                state["quarantine"]["median"] if quarantine else None)
             return tables, nstates, metrics, part, noise_rng, lnorms
 
     else:
@@ -2535,7 +2580,9 @@ def make_payload_round_steps(
             tables, nstates, metrics = outs[:3]
             lnorms = outs[3] if layer_q else None
             part, noise_rng = outs[-2], outs[-1]
-            tables = _apply_adv(tables, adv)
+            tables = _apply_adv(
+                tables, adv, cfg.client_update_clip,
+                state["quarantine"]["median"] if quarantine else None)
             return tables, nstates, metrics, part, noise_rng, lnorms
 
     def merge_step(state, tables, nstates, mvals, part, arrived, lr,
@@ -2561,7 +2608,13 @@ def make_payload_round_steps(
         equal to sync. Stale rows were screened at the wire (their source
         round's gauntlet); they carry no net-state/metric rows — a stale
         fold contributes its gradient sketch, nothing else (documented in
-        the README always-on section)."""
+        the README always-on section). Under a robust merge_policy the
+        stale slots do NOT fold linearly: they enter the robust order
+        statistics as staleness-weighted entries of the union stack (the
+        per-buffer robust merge — a stale adversarial table is trimmed
+        exactly like an on-time one), and a zero-stale round dispatches
+        the plain robust program: the sync robust round, by program
+        identity."""
         part = part * arrived
         part_eff = part
         norms = None
@@ -2589,6 +2642,7 @@ def make_payload_round_steps(
                 tables.shape[0], -1).all(axis=1)
             part_eff = part_eff * finite.astype(part_eff.dtype)
         stale_metrics = {}
+        residual_agg = None
         if pol is None:
             # THE merge: masked per-client tables through the same ordered-
             # sum entry point the sharded mesh round uses (client-index
@@ -2607,6 +2661,33 @@ def make_payload_round_steps(
                 wire_sum = {"table": folded}
             agg = _normalize_merged_wire(mcfg, wire_sum,
                                          jnp.maximum(total_w, 1.0))
+        elif stale_slots or cfg.robust_residual:
+            # Byzantine-robust merge, extended form: the per-BUFFER robust
+            # merge runs the order statistics over the union stack
+            # {current buffer ∪ staleness-weighted stale folds} — on-time
+            # tables at weight 1, stale slots at their (1+lag)^-alpha
+            # weight — inside the ONE G012 boundary (the stale stacks are
+            # only FORWARDED here, per G013's robust-merge sanction). The
+            # returned total weight (live count + stale weight mass) takes
+            # the place the linear path's _stale_fold total has in the
+            # agg_op="sum" rescale, and the winsorized robust-vs-mean
+            # residual (if armed) accumulates into Verror below so error-
+            # feedback telescoping survives the robust merge.
+            robust, total_w, extras = modes.merge_partial_wires(
+                mcfg, {"table": tables}, policy=pol, live=part_eff,
+                trim=cfg.merge_trim,
+                stale_tables=stale_tables, stale_weights=stale_weights,
+                want_residual=cfg.robust_residual)
+            if stale_slots:
+                stale_metrics = {"stale_folded": extras["stale_folded"],
+                                 "stale_weight": extras["stale_weight"]}
+            scale_w = jnp.maximum(total_w, 1.0)
+            agg = (robust if mcfg.agg_op != "sum" else {
+                k: v * scale_w for k, v in robust.items()})
+            if cfg.robust_residual:
+                res = extras["residual"]
+                residual_agg = (res if mcfg.agg_op != "sum"
+                                else res * scale_w)
         else:
             # Byzantine-robust merge: coordinate-wise trimmed mean / median
             # over the LIVE client tables (dead rows excluded from the
@@ -2639,8 +2720,20 @@ def make_payload_round_steps(
         )
         # dp_noise is unreachable here: EngineConfig rejects dp_noise with
         # mode=sketch, and wire_payloads requires mode=sketch
+        mode_state_in = state["mode_state"]
+        if residual_agg is not None:
+            # error-feedback-aware robust merge: the winsorized robust-vs-
+            # mean residual joins the error accumulator at the same lr
+            # scale the server step applies to the aggregate, so E tracks
+            # the untransmitted mass of the (winsorized) cohort mean and
+            # the honest mass the trim clipped re-enters through the
+            # normal top-k release instead of being lost forever. The
+            # momentum stays on the robust (trusted) series.
+            mode_state_in = dict(mode_state_in)
+            mode_state_in["Verror"] = (
+                mode_state_in["Verror"] + lr * residual_agg)
         delta, mode_state = modes.server_step_sparse(
-            mcfg, agg, state["mode_state"], lr)
+            mcfg, agg, mode_state_in, lr)
         pflat, unravel = _ravel_params(state["params"])
         new_state = {
             "params": unravel(modes.apply_delta(pflat, delta)),

@@ -202,8 +202,14 @@ def aggregate(cfg: ModeConfig, wires: dict, weights=None) -> dict:
 # (G012): the one place order statistics run over client-stacked wires.
 # Everything else in parity scope merges by the ORDERED SUM; a sort/median
 # over a client axis anywhere else silently changes the aggregation
-# semantics the parity pins rest on.
-def _robust_table_merge(stacked, live, policy: str, trim: int):
+# semantics the parity pins rest on. The buffered-async composition also
+# lives HERE: staleness-weighted stale tables join the order statistics
+# inside this one boundary (weighted trimmed mean / weighted median over
+# the union stack), so the G013 stale-wire values are sanctioned inside
+# this function and nowhere else in this file.
+def _robust_table_merge(stacked, live, policy: str, trim: int,
+                        stale_tables=None, stale_weights=None,
+                        want_residual: bool = False):
     """Coordinate-wise Byzantine-robust location estimate over the [W, ...]
     stacked client wires, dead rows (live == 0) excluded. Returns the
     robust MEAN-scale array (the caller rescales for agg_op="sum").
@@ -226,32 +232,141 @@ def _robust_table_merge(stacked, live, policy: str, trim: int):
     (an adversary pairing one NaN client with `trim` oversized clients
     must not smuggle an outlier past the trimmed window). With the
     quarantine armed, non-finite clients are already masked upstream and
-    this screen is value-transparent."""
+    this screen is value-transparent.
+
+    EXTENDED (buffered-async / error-feedback-aware) form — armed by
+    `stale_tables`/`stale_weights` (the per-buffer robust merge) or
+    `want_residual` (the error-feedback residual), returning the tuple
+    ``(robust, total_weight, extras)`` instead of the bare array:
+
+    - The order statistics run over the UNION stack {on-time cohort ∪
+      staleness-weighted stale slots}: on-time tables enter at weight 1,
+      stale slot i at weight ``stale_weights[i]`` ((1+lag)^-alpha, a pure
+      function of round lag). Ranks are over raw VALUES (a stale outlier
+      is trimmed exactly like an on-time one — the point of the
+      composition); the weights shape the location estimate (weighted
+      survivor mean / weighted median) and ``total_weight`` = Σ live
+      weights feeds the caller's survivor normalization, the same place
+      the linear stale fold's weight mass joins. Slot order — the union
+      stack order, cohort positions then slot order — stays a pure
+      function of the submission set, so the verdict is deterministic and
+      mesh-shape-invariant. Empty slots (weight 0, zero table) are
+      excluded like dead rows. With zero stale entries the weighted forms
+      reduce to the unweighted ones VALUE-exactly (unit weights: the
+      weighted survivor sum is the masked sum, the weighted denominator
+      the survivor count, the weighted-median ranks the lo/hi ranks);
+      the bitwise async==sync contract still comes from program identity
+      (zero-stale rounds dispatch the plain program), not from this
+      reduction.
+
+    - `want_residual`: `extras["residual"]` is the WINSORIZED-mean-minus-
+      robust residual at mean scale — the mass the robust statistic
+      declined to pass this round, with every contribution clamped into
+      the policy's kept window ([rank trim, rank n-trim) for "trimmed",
+      the interquartile ranks for "median") before averaging, so an
+      adversary's residual contribution is bounded by the clean cohort's
+      value range. Accumulated into Verror by the engine (error-feedback-
+      aware robust merges: honest mass the trim clipped re-enters through
+      error feedback, so telescoping survives; the clamp is what keeps
+      Verror — and the PR 12 `verror_ratio` estimator — bounded under a
+      sustained in-screen attack)."""
+    if stale_tables is None and not want_residual:
+        W = stacked.shape[0]
+        finite = jnp.isfinite(stacked).reshape(W, -1).all(axis=1)
+        live = live * finite.astype(live.dtype)
+        expand = live.reshape((-1,) + (1,) * (stacked.ndim - 1))
+        keyed = jnp.where(expand > 0, stacked, jnp.inf)
+        n = live.sum().astype(jnp.int32)
+        if policy == "median":
+            s = jnp.sort(keyed, axis=0)
+            lo = jnp.clip((n - 1) // 2, 0, W - 1)
+            hi = jnp.clip(n // 2, 0, W - 1)
+            med = 0.5 * (jnp.take(s, lo, axis=0) + jnp.take(s, hi, axis=0))
+            return jnp.where(n > 0, med, jnp.zeros_like(med))
+        if policy != "trimmed":
+            raise ValueError(f"unknown robust merge policy {policy!r}")
+        order = jnp.argsort(keyed, axis=0, stable=True)
+        ranks = jnp.argsort(order, axis=0, stable=True)  # inverse perm
+        keep = (ranks >= trim) & (ranks < n - trim) & (expand > 0)
+        kept = jnp.where(keep, stacked, jnp.zeros_like(stacked))
+        denom = jnp.maximum((n - 2 * trim).astype(stacked.dtype), 1.0)
+        return kept.sum(axis=0) / denom
+
+    if policy not in ("median", "trimmed"):
+        raise ValueError(f"unknown robust merge policy {policy!r}")
+    if stale_tables is not None:
+        # the union stack: on-time cohort first (client-index order), then
+        # the stale slots in slot order — deterministic, submission-set-pure
+        stacked = jnp.concatenate(
+            [stacked, stale_tables.astype(stacked.dtype)], axis=0)
+        weights = jnp.concatenate(
+            [live.astype(jnp.float32), stale_weights.astype(jnp.float32)])
+    else:
+        weights = live.astype(jnp.float32)
     W = stacked.shape[0]
     finite = jnp.isfinite(stacked).reshape(W, -1).all(axis=1)
-    live = live * finite.astype(live.dtype)
-    expand = live.reshape((-1,) + (1,) * (stacked.ndim - 1))
+    w_eff = weights * finite.astype(weights.dtype)
+    expand = w_eff.reshape((-1,) + (1,) * (stacked.ndim - 1))
     keyed = jnp.where(expand > 0, stacked, jnp.inf)
-    n = live.sum().astype(jnp.int32)
-    if policy == "median":
-        s = jnp.sort(keyed, axis=0)
-        lo = jnp.clip((n - 1) // 2, 0, W - 1)
-        hi = jnp.clip(n // 2, 0, W - 1)
-        med = 0.5 * (jnp.take(s, lo, axis=0) + jnp.take(s, hi, axis=0))
-        return jnp.where(n > 0, med, jnp.zeros_like(med))
-    if policy != "trimmed":
-        raise ValueError(f"unknown robust merge policy {policy!r}")
+    n = (w_eff > 0).sum().astype(jnp.int32)
+    total_w = w_eff.sum()
     order = jnp.argsort(keyed, axis=0, stable=True)
-    ranks = jnp.argsort(order, axis=0, stable=True)  # inverse permutation
-    keep = (ranks >= trim) & (ranks < n - trim) & (expand > 0)
-    kept = jnp.where(keep, stacked, jnp.zeros_like(stacked))
-    denom = jnp.maximum((n - 2 * trim).astype(stacked.dtype), 1.0)
-    return kept.sum(axis=0) / denom
+    svals = jnp.take_along_axis(keyed, order, axis=0)
+    sw = jnp.take_along_axis(
+        jnp.broadcast_to(expand, stacked.shape), order, axis=0)
+    if policy == "median":
+        # weighted median: the value where the cumulative sorted weight
+        # crosses half the total (lo = first >=, hi = first >) — the
+        # weighted generalization of the lo/hi even-count convention
+        # (unit weights reduce to ranks (n-1)//2 and n//2 exactly)
+        cum = jnp.cumsum(sw, axis=0)
+        half = total_w / 2.0
+        lo_idx = jnp.argmax(cum >= half, axis=0)
+        hi_idx = jnp.argmax(cum > half, axis=0)
+        v_lo = jnp.take_along_axis(svals, lo_idx[None], axis=0)[0]
+        v_hi = jnp.take_along_axis(svals, hi_idx[None], axis=0)[0]
+        med = 0.5 * (v_lo + v_hi)
+        robust = jnp.where(n > 0, med, jnp.zeros_like(med))
+        ok = n > 0
+        win_lo = n // 4  # interquartile kept window for the residual
+    else:
+        ranks = jnp.argsort(order, axis=0, stable=True)
+        keep = (ranks >= trim) & (ranks < n - trim) & (expand > 0)
+        kept_v = jnp.where(keep, stacked * expand,
+                           jnp.zeros_like(stacked))
+        kept_w = jnp.where(keep, jnp.broadcast_to(expand, stacked.shape),
+                           jnp.zeros_like(stacked))
+        # weighted survivor mean; unit weights make the denominator the
+        # survivor count (n - 2*trim) exactly
+        denom = jnp.maximum(kept_w.sum(axis=0), 1e-12)
+        robust = jnp.where(n > 2 * trim, kept_v.sum(axis=0) / denom, 0.0)
+        ok = n > 2 * trim
+        win_lo = jnp.int32(trim)
+    extras: dict = {}
+    if stale_tables is not None:
+        extras["stale_folded"] = (stale_weights > 0).sum()
+        extras["stale_weight"] = stale_weights.sum()
+    if want_residual:
+        # winsorized weighted mean: every live entry clamped into the kept
+        # window's edge values, so the residual an adversary can inject is
+        # bounded by the clean value range per coordinate
+        lo_i = jnp.clip(win_lo, 0, W - 1)
+        hi_i = jnp.clip(n - win_lo - 1, 0, W - 1)
+        v_floor = jnp.take(svals, lo_i, axis=0)
+        v_ceil = jnp.take(svals, hi_i, axis=0)
+        clamped = jnp.clip(stacked, v_floor, v_ceil)
+        wins = (jnp.where(expand > 0, clamped * expand,
+                          jnp.zeros_like(stacked)).sum(axis=0)
+                / jnp.maximum(total_w, 1e-12))
+        extras["residual"] = jnp.where(ok, wins - robust,
+                                       jnp.zeros_like(robust))
+    return robust, total_w, extras
 
 
 def merge_partial_wires(cfg: ModeConfig, stacked: dict, *,
                         policy: str = "sum", live=None,
-                        trim: int = 0) -> dict:
+                        trim: int = 0, stale_tables=None,
+                        stale_weights=None, want_residual: bool = False):
     """Merge S per-shard partial wires (leaves stacked on a leading [S] axis,
     in shard-index order) into one wire — the cross-device reduction of the
     data-parallel round. Linear modes only: the partial wires are compressions
@@ -272,7 +387,15 @@ def merge_partial_wires(cfg: ModeConfig, stacked: dict, *,
     live count for agg_op="sum" instead of normalizing. "trimmed" with
     trim=0 never reaches here: the engine compiles it as "sum" by
     construction (trimming nothing IS the sum — that is the bit-identity
-    contract, not an fp coincidence)."""
+    contract, not an fp coincidence).
+
+    EXTENDED robust form (buffered-async per-buffer merge and/or the
+    error-feedback residual): passing `stale_tables`/`stale_weights` (the
+    staleness-weighted fold slots) or `want_residual=True` forwards them
+    into the boundary and returns ``({"table": robust}, total_weight,
+    extras)`` instead of the bare wire — see `_robust_table_merge`'s
+    extended contract. Callers only FORWARD the stale stacks here (G013);
+    every piece of arithmetic over them happens inside the boundary."""
     if not is_linear(cfg):
         raise ValueError(
             f"mode={cfg.mode!r} is nonlinear: partial per-shard wires cannot "
@@ -297,6 +420,11 @@ def merge_partial_wires(cfg: ModeConfig, stacked: dict, *,
                 f"merge_trim={trim} would trim the whole cohort "
                 f"(2*{trim} >= W={W}); need 2*trim < num_workers"
             )
+        if stale_tables is not None or want_residual:  # graftlint: disable=G013 — presence check routing INTO the boundary, no stale arithmetic
+            robust, total_w, extras = _robust_table_merge(
+                stacked["table"], live, policy, trim,
+                stale_tables, stale_weights, want_residual)
+            return {"table": robust}, total_w, extras
         return {"table": _robust_table_merge(
             stacked["table"], live, policy, trim)}
     if cfg.mode == "sketch":

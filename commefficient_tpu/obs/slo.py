@@ -24,18 +24,25 @@ Rule grammar (``--slo_rules "spec;spec;..."``), one rule per spec:
 
 Series resolved per round, in precedence order: every numeric key of the
 round's metrics dict; the derived rates `quarantine_rate`
-(quarantined / (participants + quarantined)) and `stale_fraction`
-(stale_folded / (participants + stale_folded)); `server_idle_ms` read
-from the registry gauge the runner publishes; and every scalar of the
-round's health block by its bare estimator name (`topk_mass_proxy`,
-`verror_ratio`, ...) — absent on off-cadence rounds, in which case rules
-over health series simply don't accumulate that round.
+(quarantined / (participants + quarantined)), `stale_fraction`
+(stale_folded / (participants + stale_folded)) and `attack_rate` (the
+per-round delta of the `resilience_attack_*` counter family's sum —
+normride / stale_poison / signflip / scale / collude injections this
+round); `server_idle_ms` read from the registry gauge the runner
+publishes; and every scalar of the round's health block by its bare
+estimator name (`topk_mass_proxy`, `verror_ratio`, ...) — absent on
+off-cadence rounds, in which case rules over health series simply don't
+accumulate that round.
 
 The default rule set (``--slo warn|halt`` with no --slo_rules) watches
-the five failure classes the ROADMAP's adaptive-compression controller
+the six failure classes the ROADMAP's adaptive-compression controller
 needs guarded: a quarantine-rate spike, a recall-proxy floor, a runaway
-stale-fold fraction, a server_idle_ms regression, and a non-finite-round
-streak (windowed mean > 0.99 over 3 rounds == 3 consecutive skips).
+stale-fold fraction (tuned so a healthy small-buffer --serve_async run —
+which legitimately folds more stale tables than it has on-time
+participants — stays quiet; only a sustained near-total takeover fires),
+an adversarial-injection spike over the attack counter family, a
+server_idle_ms regression, and a non-finite-round streak (windowed
+mean > 0.99 over 3 rounds == 3 consecutive skips).
 
 Actions: every firing increments ``slo_violations_total`` +
 ``slo_rule_<name>_total`` (surfaced in /metrics and RunStats), emits a
@@ -56,7 +63,16 @@ import sys
 DEFAULT_RULES = (
     "quarantine_spike:quarantine_rate>0.3@5",
     "recall_floor:topk_mass_proxy<0.05@5",
-    "stale_runaway:stale_fraction>0.5@5",
+    # tuned for --serve_async: a healthy buffered run at a small
+    # --serve_buffer legitimately folds more stale tables than it has
+    # on-time participants (trigger 2-of-8 + a full slot stack puts
+    # stale_fraction well past the old 0.5), so the guard fires only on a
+    # SUSTAINED near-total stale takeover — the actual runaway signature
+    "stale_runaway:stale_fraction>0.85@8",
+    # adversarial-injection guard over the resilience_attack_* counter
+    # family (normride / stale_poison / signflip / scale / collude):
+    # attack_rate is the per-round delta of the family's sum
+    "attack_spike:attack_rate>0.5@3",
     "idle_regression:server_idle_ms^5@10",
     "nonfinite_streak:nonfinite_rounds>0.99@3",
 )
@@ -133,6 +149,20 @@ class SloEngine:
         self._hist: dict[str, collections.deque] = collections.defaultdict(
             lambda: collections.deque(maxlen=max(depth, 20)))
         self._violating: dict[str, bool] = {r.name: False for r in self.rules}
+        # attack_rate baseline: the per-round delta of the
+        # resilience_attack_* counter family starts at THIS engine's
+        # construction, so a fresh engine never inherits a predecessor
+        # run's cumulative attack count as one giant first-round spike
+        self._attack_seen = self._attack_total()
+
+    def _attack_total(self) -> float:
+        """Cumulative sum over the resilience_attack_* counter family."""
+        total = 0.0
+        for k, v in self.registry.snapshot().items():
+            if k.startswith("resilience_attack_") and isinstance(
+                    v, (int, float)):
+                total += float(v)
+        return total
 
     # -- series assembly -----------------------------------------------------
 
@@ -150,6 +180,12 @@ class SloEngine:
             s["stale_fraction"] = f / max(part + f, 1.0)
         s.setdefault("server_idle_ms",
                      self.registry.gauge("server_idle_ms").value)
+        # per-round attack injections (the resilience_attack_* family's
+        # delta since the last committed round): the attack_spike rule's
+        # series — counters are cumulative, rules want a rate
+        total = self._attack_total()
+        s["attack_rate"] = max(total - self._attack_seen, 0.0)
+        self._attack_seen = total
         for k, v in (health or {}).items():
             if isinstance(v, (int, float)):
                 s.setdefault(k, float(v))

@@ -74,6 +74,33 @@ entries, each `kind[@round,round,...][:key=val,...]`:
                                 Colluder positions draw from the plan's
                                 seed (finally consumed), pinned to
                                 (seed, round)
+    client_normride@2:clients=0,ride=0.9
+                                ADAPTIVE: position 0 rescales its table so
+                                its sketch-space L2 sits at ride x
+                                clip_multiple x the server's RUNNING
+                                median — just UNDER the quarantine screen
+                                it is probing (the screen reads the same
+                                baseline). Maximal in-screen magnitude;
+                                answerable by the robust merge, never the
+                                norm screens. Needs --client_update_clip
+                                (no threshold, nothing to ride); table
+                                rounds only, like the other attacks.
+    client_stale_poison@2:clients=1,factor=-1
+                                ADAPTIVE: position 1 WITHHOLDS its round-2
+                                submission (a no-show at the close) and
+                                instead submits factor x its real table
+                                LATE — into the buffered-async stale band
+                                during round 3's serving, through the real
+                                transport + gauntlet, where it validates
+                                against round 2's RETAINED (older) median.
+                                factor=-1 (default) is norm-invariant, so
+                                the band's screens pass it by design; the
+                                per-buffer robust merge (async
+                                --merge_policy trimmed|median) is the
+                                defense. Requires --serve_async with
+                                --serve_payload sketch (the band must
+                                exist; validate_stale_context rejects the
+                                plan elsewhere).
     host_preempt@3:host=0       SIGTERM round 3 ONLY on the host whose
                                 jax.process_index() == host — the one-host
                                 preemption the cross-host barrier
@@ -142,6 +169,12 @@ KINDS = {
     #                                           (model replacement)
     "client_collude": ("frac",),              # seeded minority clones one
     #                                           crafted (negated) table
+    "client_normride": ("clients", "ride"),   # ADAPTIVE: scale to ride *
+    #                                           clip * running median —
+    #                                           just under the quarantine
+    "client_stale_poison": ("clients", "factor"),  # ADAPTIVE: withhold,
+    #                                           then submit factor*table
+    #                                           into the async stale band
 }
 
 # the client_* sites fire inside a round's preparation: scheduled at or past
@@ -159,7 +192,14 @@ WIRE_KINDS = ("wire_corrupt", "wire_truncate", "wire_dup", "wire_delay",
 # reserved _adv_* batch leaves the engine consumes); same dead-schedule
 # validation, and the SESSION enforces the table-round context at build
 # (a plan naming them with no per-client wire would inject nothing)
-ADVERSARIAL_KINDS = ("client_signflip", "client_scale", "client_collude")
+ADVERSARIAL_KINDS = ("client_signflip", "client_scale", "client_collude",
+                     "client_normride")
+
+# client_stale_poison fires at the SERVING seam (withhold on time, submit
+# late into the buffered-async stale band): same dead-schedule validation,
+# plus validate_stale_context — on a run with no stale band the plan would
+# pass vacuously with zero injections
+STALE_POISON_KINDS = ("client_stale_poison",)
 
 
 class InjectedFault(RuntimeError):
@@ -245,6 +285,16 @@ def _parse_entry(entry: str) -> FaultSpec:
                             "expected a finite nonzero float (zero is a "
                             "drop, use client_drop)")
                     params[k] = f
+                elif k == "ride":
+                    f = float(v)
+                    if not 0.0 < f <= 1.0:
+                        # riding AT or above the multiple is just
+                        # client_scale wearing a costume — the point of
+                        # the kind is sitting strictly under the screen
+                        raise ValueError(
+                            "expected a ride fraction in (0, 1] (the "
+                            "attack sits UNDER the quarantine multiple)")
+                    params[k] = f
                 elif k == "frac":
                     f = float(v)
                     if not 0.0 < f <= 0.5:
@@ -329,7 +379,8 @@ class FaultPlan:
         never fire; reject it loudly instead of letting the chaos run pass
         vacuously."""
         for s in self.specs:
-            if (s.kind in CLIENT_KINDS + WIRE_KINDS + ADVERSARIAL_KINDS
+            if (s.kind in (CLIENT_KINDS + WIRE_KINDS + ADVERSARIAL_KINDS
+                           + STALE_POISON_KINDS)
                     or s.kind == "host_preempt") and s.rounds:
                 dead = [r for r in s.rounds if r >= total_rounds]
                 if dead:
@@ -337,6 +388,23 @@ class FaultPlan:
                         f"--fault_plan: {s.kind}@{','.join(map(str, dead))} "
                         f"can never fire — the run ends at round "
                         f"{total_rounds} (rounds are 0-based global indices)"
+                    )
+            if s.kind in STALE_POISON_KINDS and s.rounds:
+                # the attack's SECOND half (the late submission into the
+                # band) lands during round r+1's serving: scheduled at the
+                # final round, the withhold fires and the counter ticks
+                # but no poisoned table ever reaches the band — the
+                # vacuous-chaos-test failure mode, one round earlier
+                late = [r for r in s.rounds if r >= total_rounds - 1]
+                if late:
+                    raise ValueError(
+                        f"--fault_plan: {s.kind}@"
+                        f"{','.join(map(str, late))} withholds at that "
+                        f"round but its late submission lands during the "
+                        f"NEXT round's serving — the run ends at round "
+                        f"{total_rounds}, so the poisoned table would "
+                        "never reach the stale band; schedule it at most "
+                        f"at round {total_rounds - 2}"
                     )
             if s.kind == "host_preempt":
                 import jax
@@ -365,6 +433,24 @@ class FaultPlan:
                 "wire kinds damage payload frames at the serving transport "
                 "seam and need --serve inproc|socket with --serve_payload "
                 "sketch; on this run the chaos plan would pass vacuously")
+
+    def validate_stale_context(self, stale_band_armed: bool) -> None:
+        """Launch-time context validation for client_stale_poison: the
+        attack submits INTO the buffered-async stale band (--serve_async
+        with --serve_payload sketch), so a plan naming it on any other run
+        — sync serving, the batch loop — would pass vacuously with zero
+        injections; reject it loudly, same contract as the wire kinds."""
+        if stale_band_armed:
+            return
+        dead = sorted({s.kind for s in self.specs
+                       if s.kind in STALE_POISON_KINDS})
+        if dead:
+            raise ValueError(
+                f"--fault_plan: {', '.join(dead)} can never fire — the "
+                "stale-poison kind submits adversarial tables into the "
+                "buffered-async stale band and needs --serve_async with "
+                "--serve_payload sketch; on this run the chaos plan would "
+                "pass vacuously")
 
     def _log(self, msg: str):
         print(f"fault-injection: {msg}", file=sys.stderr, flush=True)
@@ -660,6 +746,73 @@ class FaultPlan:
             attack_mark("client_collude", clients=colluders, source=source,
                         frac=frac)
         return scale, src
+
+    def has_normride(self) -> bool:
+        """Whether the plan names client_normride — the session then
+        threads the `_adv_ride` batch leaf (and requires the quarantine
+        armed: with no threshold there is nothing to ride)."""
+        return any(s.kind == "client_normride" for s in self.specs)
+
+    def normride_plan(self, rnd: int, num_workers: int) -> np.ndarray:
+        """Round `rnd`'s [W] norm-ride fractions for the engine's reserved
+        `_adv_ride` leaf: 0 = honest row, r in (0, 1] = rescale the
+        transmitted table's L2 to r * clip_multiple * running_median —
+        just under the quarantine screen, probing the server's RUNNING
+        median (the scale is computed IN-PROGRAM against the live
+        baseline, so the attacker adapts round by round exactly like a
+        real probe would). One-shot per (round, clients) like the other
+        cohort sites; each armed round lands an obs instant + the
+        injected-faults counter + resilience_attack_normride_total."""
+        ride = np.zeros(num_workers, np.float32)
+        for s in self.specs_for("client_normride", rnd):
+            key = ("client_normride", rnd, s.params.get("clients", (0,)))
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            pos = list(self._positions(s, num_workers, rnd))
+            frac = float(s.params.get("ride", 0.9))
+            ride[pos] = frac
+            self._log(f"client_normride (ride={frac:g}) on positions "
+                      f"{pos} (round {rnd})")
+            self._mark("client_normride", rnd, clients=pos, ride=frac)
+            obreg.default().counter(
+                "resilience_attack_normride_total").inc()
+        return ride
+
+    # -------------------------------------------- stale-band poison site
+
+    def has_stale_poison(self) -> bool:
+        return any(s.kind in STALE_POISON_KINDS for s in self.specs)
+
+    def stale_poison_plan(self, rnd: int,
+                          num_workers: int) -> list[tuple[int, float]]:
+        """Round `rnd`'s stale-band poison schedule for the serving layer:
+        [(cohort_position, factor)] — each listed position WITHHOLDS its
+        on-time submission this round (a no-show at the close) and the
+        service submits factor x its real table into the NEXT round's
+        stale band through the real transport + gauntlet. One-shot per
+        (round, clients); every armed injection lands an obs instant +
+        the injected-faults counter + resilience_attack_stale_poison_total
+        (marked HERE, where the withhold is decided — the late submission
+        is the attack's second half and its admission is counted by the
+        ingest band like any wire submission)."""
+        out: list[tuple[int, float]] = []
+        for s in self.specs_for("client_stale_poison", rnd):
+            key = ("client_stale_poison", rnd, s.params.get("clients", (0,)))
+            if key in self._fired:
+                continue
+            self._fired.add(key)
+            pos = list(self._positions(s, num_workers, rnd))
+            factor = float(s.params.get("factor", -1.0))
+            out.extend((p, factor) for p in pos)
+            self._log(f"client_stale_poison (factor={factor:g}) on "
+                      f"positions {pos} (round {rnd}): withheld now, "
+                      "submitted into the stale band next round")
+            self._mark("client_stale_poison", rnd, clients=pos,
+                       factor=factor)
+            obreg.default().counter(
+                "resilience_attack_stale_poison_total").inc()
+        return out
 
     # ------------------------------------------------- transport-seam sites
 

@@ -848,6 +848,57 @@ class IngestQueue:
         with self._cv:
             self._pending = [(int(c), float(s)) for c, s in pending]
 
+    def band_snapshot(self) -> dict:
+        """Checkpointable view of the buffered-async band state: the
+        parked stale arrivals (validated tables included), the retained
+        closed-round screen state (median / invite map / dedup set), the
+        high-water mark, and the admission counter — everything a resumed
+        or rewound run needs so its stale folds (slot order included, via
+        recv_order) replay bit-identically. Tables stay ndarrays here;
+        the serving layer owns the JSON encoding (utils/checkpoint.py
+        writes the result into meta.json under serve.band)."""
+        with self._cv:
+            return self._band_snapshot_locked()
+
+    def _band_snapshot_locked(self) -> dict:
+        return {
+            "stale": [(s.round, s.client_id, s.latency_s,
+                       s.recv_order, s.wall_t, s.table)
+                      for s in self._stale],
+            "recent": [(r, m, dict(inv), set(seen))
+                       for r, (m, inv, seen) in self._recent.items()],
+            "newest": self._newest,
+            "recv_counter": self._recv_counter,
+        }
+
+    def boundary_snapshot(self) -> tuple[list, dict]:
+        """(pending, band) under ONE lock hold — the round-boundary
+        checkpoint pair. Taken separately, a submission landing between
+        the two reads would produce a torn boundary (an early arrival
+        recorded without its contemporaneous stale admission — a state
+        the live queue never held), and a resume from it would diverge
+        from the uninterrupted twin."""
+        with self._cv:
+            return list(self._pending), self._band_snapshot_locked()
+
+    def restore_band(self, band: dict) -> None:
+        """Re-seed the buffered-async band state from a snapshot (the
+        committed-round-boundary twin of restore_pending) — the rewind
+        half of the stale-buffer checkpoint discipline."""
+        with self._cv:
+            self._stale = [
+                StaleArrival(int(r), int(c), float(lat), int(ro),
+                             float(w), t)
+                for r, c, lat, ro, w, t in band.get("stale", [])]
+            self._recent = {
+                int(r): (float(m), {int(c): int(p) for c, p in inv.items()},
+                         {int(c) for c in seen})
+                for r, m, inv, seen in band.get("recent", [])}
+            self._newest = (None if band.get("newest") is None
+                            else int(band["newest"]))
+            self._recv_counter = int(band.get("recv_counter",
+                                              self._recv_counter))
+
     def counters(self) -> dict[str, int]:
         with self._cv:
             return {

@@ -39,11 +39,17 @@ boundary (`_pending_by_round`) and published to checkpoints through
 `session.serve_meta` (utils/checkpoint.py writes it into meta.json); a
 restored session's `restored_serve_meta` re-seeds the buffer, so resume
 replays the identical arrival stream the uninterrupted run saw — the same
-committed-snapshot discipline the host RNG and the re-queue ride.
+committed-snapshot discipline the host RNG and the re-queue ride. In
+buffered-async mode the FULL stale band rides the same snapshots
+(`_band_by_round` -> meta.json "band": parked late tables base64-exact,
+retained screen state, the straggler stash, in-flight stale-poison
+tables), so an async preempt -> resume with a NON-EMPTY stale buffer is
+bit-identical to the uninterrupted twin instead of trajectory-level.
 """
 
 from __future__ import annotations
 
+import base64
 import contextlib
 import dataclasses
 import sys
@@ -56,7 +62,7 @@ import numpy as np
 from ..obs import registry as obreg
 from ..obs import trace as obtrace
 from .assembler import ClosedRound, CohortAssembler
-from .ingest import IngestQueue, PayloadPolicy
+from .ingest import IngestQueue, PayloadPolicy, Submission
 from .metrics import MetricsServer
 from .traffic import TraceConfig, TrafficGenerator
 from .transport import (
@@ -65,6 +71,69 @@ from .transport import (
     abort_over_socket,
     submit_over_socket,
 )
+
+
+# -- stale-band checkpoint codec ---------------------------------------------
+# The band snapshots hold validated [r, c] float32 tables; meta.json needs
+# JSON. base64 of the raw little-endian float32 bytes is exact (no decimal
+# round-trip) and ~3x smaller than a JSON float list. The codec lives HERE,
+# not in ingest.py: the queue hands out live ndarrays, and the serving
+# layer owns what checkpoints look like (the G011 wire boundary stays the
+# only byte-decode in ingest).
+
+
+def _enc_table(t) -> dict:
+    a = np.ascontiguousarray(np.asarray(t, np.float32))
+    return {"shape": list(a.shape),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _dec_table(d) -> np.ndarray:
+    # decodes OUR OWN sha256-manifested checkpoint meta (tables that
+    # already passed the gauntlet when they first arrived), never
+    # untrusted transport input
+    return np.frombuffer(  # graftlint: disable=G011 — trusted checkpoint meta, not wire bytes
+        base64.b64decode(d["b64"]),  # graftlint: disable=G011 — trusted checkpoint meta, not wire bytes
+        np.float32).reshape(d["shape"]).copy()
+
+
+def _enc_band(band: dict, stash, poison) -> dict:
+    """JSON-ready encoding of (queue band snapshot, service stale stash,
+    pending stale-poison submissions) — the meta.json 'band' payload."""
+    return {
+        "stale": [[int(r), int(c), float(lat), int(ro), _enc_table(t)]
+                  for r, c, lat, ro, _w, t in band["stale"]],
+        "recent": [[int(r), float(m),
+                    [[int(c), int(p)] for c, p in inv.items()],
+                    sorted(int(c) for c in seen)]
+                   for r, m, inv, seen in band["recent"]],
+        "newest": band["newest"],
+        "recv_counter": int(band["recv_counter"]),
+        "stash": [[int(sr), int(pos), int(cid), _enc_table(t)]
+                  for sr, pos, cid, t in stash],
+        "poison": [[int(sr), int(pos), int(cid), _enc_table(t)]
+                   for sr, pos, cid, t in poison],
+    }
+
+
+def _dec_band(enc: dict):
+    """Inverse of _enc_band: (queue band dict, stash list, poison list).
+    wall_t restarts at 0.0 — it only feeds the latency histogram, and a
+    resumed process has a fresh perf_counter epoch anyway."""
+    band = {
+        "stale": [(int(r), int(c), float(lat), int(ro), 0.0, _dec_table(t))
+                  for r, c, lat, ro, t in enc.get("stale", [])],
+        "recent": [(int(r), float(m), {int(c): int(p) for c, p in inv},
+                    {int(c) for c in seen})
+                   for r, m, inv, seen in enc.get("recent", [])],
+        "newest": enc.get("newest"),
+        "recv_counter": int(enc.get("recv_counter", 0)),
+    }
+    stash = [(int(sr), int(pos), int(cid), _dec_table(t))
+             for sr, pos, cid, t in enc.get("stash", [])]
+    poison = [(int(sr), int(pos), int(cid), _dec_table(t))
+              for sr, pos, cid, t in enc.get("poison", [])]
+    return band, stash, poison
 
 
 @dataclasses.dataclass(frozen=True)
@@ -223,6 +292,12 @@ class AggregationService:
         # late-admission band, drained into merge folds in deterministic
         # (source round, position) order
         self._stale_stash: list[tuple[int, int, int, Any]] = []
+        # client_stale_poison's in-flight second halves: (source_round,
+        # position, client_id, poisoned table) withheld at source_round's
+        # close, submitted into the stale band at the NEXT round's serving
+        # — checkpointed with the band (an adversarial table in flight is
+        # band state like any other)
+        self._stale_poison_pending: list[tuple[int, int, int, Any]] = []
         # the pipelined worker's payload-compute gate (serve/pipeline.py
         # installs it; None = serial source, compute runs inline)
         self._compute_gate = None
@@ -254,13 +329,30 @@ class AggregationService:
         # round r must start from (checkpoints persist the committed one)
         self._meta_lock = threading.Lock()
         self._pending_by_round: dict[int, list] = {}
+        # buffered-async twin of _pending_by_round: per-round-boundary
+        # snapshots of the FULL stale-band state (queue band + stale stash
+        # + in-flight stale-poison tables), so checkpoints persist — and
+        # rewinds restore — the exact band a run positioned at that round
+        # must start from. None entries on sync configs (no band).
+        self._band_by_round: dict[int, Any] = {}
         restored = getattr(session, "restored_serve_meta", None)
         if restored:
             self.queue.restore_pending(restored.get("pending", []))
             print(f"serve: restored {len(restored.get('pending', []))} "
                   "pending early submission(s) from checkpoint meta",
                   file=sys.stderr, flush=True)
-        self._pending_by_round[session.round] = self.queue.pending_snapshot()
+            if restored.get("band") is not None and cfg.async_mode:
+                band, stash, poison = _dec_band(restored["band"])
+                self.queue.restore_band(band)
+                self._stale_stash = stash
+                self._stale_poison_pending = poison
+                print(f"serve: restored stale band from checkpoint meta "
+                      f"({len(band['stale'])} parked, {len(stash)} stashed, "
+                      f"{len(poison)} poison-pending)",
+                      file=sys.stderr, flush=True)
+        pending0, band0 = self._boundary_state()
+        self._pending_by_round[session.round] = pending0
+        self._band_by_round[session.round] = band0
         # checkpoint hook: utils/checkpoint.save calls this under the
         # session's mutate_lock and writes the result into meta.json
         session.serve_meta = self._serve_meta
@@ -372,6 +464,23 @@ class AggregationService:
                 plan = self.session.fault_plan
                 wire = (plan.wire_plan(rnd, len(ids))
                         if plan is not None else None)
+                if (self.cfg.async_mode and plan is not None
+                        and plan.has_stale_poison()):
+                    # the adaptive stale-band attack, first half: the
+                    # scheduled positions WITHHOLD their on-time payload
+                    # (a no-show at this close) and their poisoned table
+                    # parks for a LATE submission into the stale band at
+                    # the next round's serving — wire-faithful through
+                    # the real transport + gauntlet, where it validates
+                    # against THIS round's retained (older) median
+                    wire = dict(wire or {})
+                    for pos, factor in plan.stale_poison_plan(
+                            rnd, len(ids)):
+                        wire.setdefault(int(pos), {})["withhold"] = True
+                        self._stale_poison_pending.append(
+                            (rnd, int(pos), int(ids[pos]),
+                             np.asarray(factor * tables[pos],
+                                        np.float32)))
                 if self.cfg.transport == "socket":
                     # the REAL wire: every submission round-trips the
                     # loopback socket (frame encode -> recv -> gauntlet
@@ -392,11 +501,44 @@ class AggregationService:
         with self._stage("prep", rnd):
             stale = None
             if self.cfg.async_mode:
+                # stale-poison second halves land BEFORE the fold builds:
+                # the late adversarial submission goes through the real
+                # admission band (ACCEPTED_STALE / QUARANTINED /
+                # OUT_OF_ROUND — the gauntlet decides, not this code)
+                self._submit_stale_poison(rnd)
                 stale = self._build_stale_fold(rnd)
                 self._stash_stragglers(closed)
             prep = self.session.finish_served_payload(
                 prep0, closed.arrived, closed.tables, aux, stale=stale)
         return prep, closed
+
+    def _submit_stale_poison(self, rnd: int) -> None:
+        """Push the due stale-poison tables (withheld at an earlier
+        round's close) at the server as LATE submissions for their source
+        round, through the same transport a real client would use — the
+        socket path frames/checksums them like any wire table. The
+        admission verdict is the band's business: inside the band and
+        in-screen == ACCEPTED_STALE (the attack lands; the per-buffer
+        robust merge is the defense), oversized == QUARANTINED, aged out
+        == OUT_OF_ROUND."""
+        due = [e for e in self._stale_poison_pending if e[0] < rnd]
+        if not due:
+            return
+        self._stale_poison_pending = [
+            e for e in self._stale_poison_pending if e[0] >= rnd]
+        for sr, pos, cid, table in due:
+            sub = Submission(client_id=int(cid), round=int(sr),
+                             latency_s=0.0, payload=table)
+            if self.cfg.transport == "socket":
+                status = submit_over_socket(self.transport.address, sub)
+            else:
+                status = self.transport.submit(sub)
+            obtrace.instant("serve-ingest", "stale_poison_submit",
+                            round=int(rnd), source_round=int(sr),
+                            client=int(cid), status=status)
+            print(f"serve: stale-poison table from client {cid} "
+                  f"(round {sr}) submitted late -> {status}",
+                  file=sys.stderr, flush=True)
 
     # -- buffered-async staleness folds ---------------------------------------
 
@@ -513,26 +655,61 @@ class AggregationService:
 
     # -- checkpoint + metrics surfaces ----------------------------------------
 
+    def _boundary_state(self):
+        """One ATOMIC (pending, band) pair for a round-boundary snapshot:
+        the queue half comes from a single lock hold (a submission racing
+        two separate reads would produce a torn boundary no live instant
+        ever held — and a divergent resume); the stash/poison halves are
+        this thread's own (the serving thread is their only mutator).
+        band is None on sync configs (no band to checkpoint). Tables are
+        immutable once validated, so holding references is a consistent
+        frozen view — JSON encoding happens at checkpoint-save time."""
+        pending, qband = self.queue.boundary_snapshot()
+        band = ((qband, list(self._stale_stash),
+                 list(self._stale_poison_pending))
+                if self.cfg.async_mode else None)
+        return pending, band
+
     def _record_boundary(self, next_round: int) -> None:
-        """Snapshot the pending buffer as the state a run positioned at
-        `next_round` starts from; prune snapshots behind the committed
-        round (they can never be restored to)."""
+        """Snapshot the pending buffer (and, async, the stale band) as the
+        state a run positioned at `next_round` starts from; prune
+        snapshots behind the committed round (they can never be restored
+        to)."""
+        pending, band = self._boundary_state()
         with self._meta_lock:
-            self._pending_by_round[next_round] = self.queue.pending_snapshot()
+            self._pending_by_round[next_round] = pending
+            self._band_by_round[next_round] = band
             committed = self.session.round
             for r in [r for r in self._pending_by_round if r < committed]:
                 del self._pending_by_round[r]
+            for r in [r for r in self._band_by_round if r < committed]:
+                del self._band_by_round[r]
 
     def _serve_meta(self) -> dict:
-        """Checkpoint payload: the pending buffer AS OF the committed round
-        (the session's round counter under the caller's mutate_lock), not
-        the live buffer a later prepared round may already have drained."""
+        """Checkpoint payload: the pending buffer — and, in buffered-async
+        mode, the full stale band (parked arrivals, retained screen state,
+        stragglers stashed for later folds, in-flight stale-poison tables)
+        — AS OF the committed round (the session's round counter under the
+        caller's mutate_lock), not the live state a later prepared round
+        may already have advanced. This is what makes an async
+        preempt -> resume bit-identical to the uninterrupted twin even
+        with a NON-EMPTY stale buffer mid-flight."""
         with self._meta_lock:
             committed = self.session.round
-            pending = self._pending_by_round.get(
-                committed, self.queue.pending_snapshot())
-            return {"round": committed,
-                    "pending": [[int(c), float(s)] for c, s in pending]}
+            if (committed in self._pending_by_round
+                    and committed in self._band_by_round):
+                pending = self._pending_by_round[committed]
+                band = self._band_by_round[committed]
+            else:
+                # no recorded boundary for the committed round: fall back
+                # to one ATOMIC live pair (meta_lock -> queue lock is the
+                # established one-way order)
+                pending, band = self._boundary_state()
+            out = {"round": committed,
+                   "pending": [[int(c), float(s)] for c, s in pending]}
+            if band is not None:
+                out["band"] = _enc_band(*band)
+            return out
 
     def rewind_to_committed(self) -> None:
         """Restore the live pending buffer to the committed boundary — the
@@ -554,12 +731,36 @@ class AggregationService:
         self.queue.prune_stale(committed)
         with self._meta_lock:
             pending = self._pending_by_round.get(committed)
+            band = self._band_by_round.get(committed)
             self._unmerged = [c for c in self._unmerged
                               if c.rnd < committed]
-            self._stale_stash = [e for e in self._stale_stash
-                                 if e[0] < committed]
         if pending is not None:
             self.queue.restore_pending(pending)
+        if band is not None:
+            # async: the checkpointed-band discipline rewinds the WHOLE
+            # band to the committed boundary (parked arrivals, retained
+            # screen state, recv counter, stash, in-flight poison) — the
+            # prune above handled uncommitted rounds; this restores
+            # anything the served-but-uncommitted timeline consumed (a
+            # drained stash entry, an advanced admission counter), so the
+            # replay's fold slots land in the original order. In async
+            # mode a boundary snapshot ALWAYS exists for the committed
+            # round (seeded at construction, recorded every round, pruned
+            # only below committed), so this branch is the one that runs;
+            # sync configs record band=None and the stash/poison lists
+            # are empty by construction there.
+            qband, stash, poison = band
+            self.queue.restore_band(qband)
+            self._stale_stash = list(stash)
+            self._stale_poison_pending = list(poison)
+        elif self.cfg.async_mode:
+            # defensive fallback (a band snapshot missing for the
+            # committed round would be a bookkeeping bug): prune
+            # uncommitted entries — strictly weaker than the restore
+            self._stale_stash = [e for e in self._stale_stash
+                                 if e[0] < committed]
+            self._stale_poison_pending = [
+                e for e in self._stale_poison_pending if e[0] < committed]
 
     def metrics_snapshot(self) -> dict:
         """The /metrics payload (see serve/metrics.py for field docs). The

@@ -160,9 +160,13 @@ def save(ckpt_dir: str, session, keep: int = 3, fault_plan=None,
         requeue_ages = [[int(c), int(r)] for c, r in
                         getattr(session, "_requeue_ages_committed", ())]
         # serving-layer state (serve/): the service registers a callable
-        # returning a JSON-safe dict (pending arrival queue etc.) snapshotted
-        # at the committed round boundary; None when the session is driven by
-        # the batch simulator
+        # returning a JSON-safe dict snapshotted at the committed round
+        # boundary — the pending arrival queue and, in buffered-async mode,
+        # the FULL stale band (parked late tables base64-exact, retained
+        # screen state, straggler stash, in-flight stale-poison tables), so
+        # an async preempt -> resume replays its stale folds bit-identically
+        # (meta.json "serve"); None when the session is driven by the batch
+        # simulator
         serve_provider = getattr(session, "serve_meta", None)
         serve_meta = serve_provider() if callable(serve_provider) else None
     final = os.path.abspath(os.path.join(ckpt_dir, f"round_{rnd:08d}"))

@@ -127,6 +127,19 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "coordinate from EACH end (defends up to this many "
                         "colluders; needs 2*trim < --num_workers). 0 = "
                         "trim nothing = the sum program, bit-identically")
+    p.add_argument("--robust_residual", default="off",
+                   choices=["off", "on"],
+                   help="error-feedback-aware robust merges (--merge_policy "
+                        "trimmed|median): accumulate the robust-vs-mean "
+                        "merge residual into the Verror table, with the "
+                        "mean WINSORIZED into the policy's kept window — "
+                        "the honest mass the trim clips re-enters through "
+                        "error feedback (telescoping survives the robust "
+                        "merge) while an adversary's residual contribution "
+                        "stays bounded by the clean value range. off "
+                        "(default) keeps the PR 10 robust program "
+                        "bit-for-bit; MIGRATION.md notes the intent to "
+                        "flip after a soak")
     p.add_argument("--quarantine_scope", default="cohort",
                    choices=["cohort", "layer"],
                    help="--client_update_clip screen granularity. cohort "
@@ -232,9 +245,14 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "pushes for a recently-closed round — fold into a "
                         "later merge weighted (1+lag)^-alpha instead of "
                         "being discarded. Requires --serve_payload sketch. "
-                        "Sync stays the parity reference: an async run "
-                        "where everyone answers on time is pinned bitwise "
-                        "== the sync run")
+                        "Composes with --merge_policy trimmed|median: the "
+                        "per-BUFFER robust merge runs the order statistics "
+                        "over {current buffer + staleness-weighted stale "
+                        "folds}, so a stale adversarial table is trimmed "
+                        "like an on-time one. Sync stays the parity "
+                        "reference: an async run where everyone answers on "
+                        "time is pinned bitwise == the sync run (zero-"
+                        "stale robust rounds == the sync robust program)")
     p.add_argument("--serve_buffer", type=int, default=0,
                    help="--serve_async: merged-table count that triggers a "
                         "round's merge (replaces the quorum; 0 = the "
@@ -540,6 +558,18 @@ def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
             "--watchdog_abort needs --checkpoint_dir: aborting without an "
             "emergency checkpoint would lose the run instead of resuming it"
         )
+    if getattr(args, "robust_residual", "off") == "on":
+        # the residual is the robust merge's error-feedback repair; with
+        # no effective robust policy there is nothing to repair and the
+        # flag would be a silent no-op discovered at the postmortem
+        if (args.merge_policy == "sum"
+                or (args.merge_policy == "trimmed"
+                    and args.merge_trim == 0)):
+            raise SystemExit(
+                "--robust_residual on names the robust merge's error-"
+                "feedback residual; with --merge_policy sum (or trimmed@0, "
+                "which IS the sum program) there is no robust merge — arm "
+                "--merge_policy trimmed (trim > 0) or median")
     if getattr(args, "serve_async", False):
         # the async fold is a compiled merge variant over wire tables —
         # both prerequisites must fail AT LAUNCH, not as an attribute

@@ -99,9 +99,11 @@ def build(args, fault_plan=None, retry_policy=None):
         quarantine_window=args.quarantine_window,
         quarantine_scope=args.quarantine_scope,
         # Byzantine-robust table merge (trimmed/median run the per-client-
-        # table round; trim=0 trimmed IS sum, bit-identically)
+        # table round; trim=0 trimmed IS sum, bit-identically);
+        # --robust_residual on arms the error-feedback-aware residual
         merge_policy=args.merge_policy,
         merge_trim=args.merge_trim,
+        robust_residual=getattr(args, "robust_residual", "off") == "on",
         requeue_policy=args.requeue_policy,
         sketch_path=args.sketch_path,
         # --serve_payload sketch inverts the round into the two-program
@@ -157,6 +159,9 @@ def main(argv=None):
         fault_plan.validate_rounds(total_rounds)
         fault_plan.validate_wire_context(
             args.serve != "off" and args.serve_payload == "sketch")
+        fault_plan.validate_stale_context(
+            args.serve != "off" and args.serve_payload == "sketch"
+            and getattr(args, "serve_async", False))
     schedule = triangular(args.lr_scale, args.pivot_epoch, args.num_epochs)
     opt = FedOptimizer(schedule, rounds_per_epoch)
     model = FedModel(session)

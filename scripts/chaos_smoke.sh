@@ -19,6 +19,7 @@
 #        scripts/chaos_smoke.sh wire
 #        scripts/chaos_smoke.sh byzantine
 #        scripts/chaos_smoke.sh pipeline
+#        scripts/chaos_smoke.sh async_byzantine
 #        scripts/chaos_smoke.sh postmortem
 #
 # `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
@@ -66,6 +67,16 @@
 # boundary into a staleness-weighted fold — asserting the stale-fold and
 # fault counters fired, the runner measured the commit-to-dispatch gap,
 # and the logged loss fell finite through all of it.
+#
+# `async_byzantine` mode drives the ASYNC x ROBUST composition (< 1 min
+# CPU): a real cv_train run with --serve_async --serve_payload sketch
+# under --merge_policy trimmed, attacked by the ADAPTIVE kinds — a
+# client_normride rider probing the running median from just under the
+# quarantine multiple, a client_stale_poison table submitted INTO the
+# stale band (where the retained, older median screens it), plus an
+# honest wire_delay straggler crossing the round boundary — asserting the
+# per-kind attack counters fired, a stale fold survived the per-buffer
+# robust merge, and the logged train loss fell finite through all of it.
 #
 # `postmortem` mode drives the CRASH POSTMORTEM BUNDLE (< 1 min CPU): a
 # real cv_train run with --ledger armed is wedged mid-round by an injected
@@ -723,6 +734,117 @@ print(f"pipeline: PASS (10 pipelined+async rounds; stale folds={int(folded)}, "
       f"clients_dropped={stats.clients_dropped}, "
       f"server_idle_ms={stats.server_idle_ms:.2f}, "
       f"loss {losses[0]:.4f} -> {losses[-1]:.4f}, params finite)")
+EOF
+fi
+
+if [[ "${1:-}" == "async_byzantine" ]]; then
+    shift
+    exec timeout -k 10 "${CHAOS_TIMEOUT_S:-180}" python - "$@" <<'EOF'
+# async_byzantine chaos child (< 1 min CPU): the ASYNC x ROBUST
+# composition end to end through the real cv_train.main CLI path
+# (tiny-model substitution, sketch payload wire) — --serve_async with
+# --merge_policy trimmed (the per-buffer robust merge: order statistics
+# over {current buffer + staleness-weighted stale folds}) under the
+# ADAPTIVE attackers: client_normride (scale riding just under the
+# quarantine multiple, probing the running median) and
+# client_stale_poison (a sign-flipped table withheld on time and
+# submitted INTO the stale band, screened only by its round's RETAINED
+# median), plus an honest wire_delay straggler. Asserts the per-kind
+# attack counters fired, a stale fold entered (and survived) the robust
+# merge, every round committed, and the logged train loss fell finite.
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import flax.linen as nn
+
+import commefficient_tpu.data.cifar as cifar
+import cv_train
+from commefficient_tpu.obs import registry as obreg
+
+
+class _TinyNet(nn.Module):
+    num_classes: int = 10
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+_orig = cifar.load_cifar_fed
+
+
+def _tiny(*a, **kw):
+    kw.update(synthetic_train=64, synthetic_test=32)
+    return _orig(*a, **kw)
+
+
+cv_train.ResNet9 = _TinyNet
+cv_train.load_cifar_fed = _tiny
+
+reg = obreg.default()
+before = {
+    "normride": reg.counter("resilience_attack_normride_total").value,
+    "stale_poison": reg.counter(
+        "resilience_attack_stale_poison_total").value,
+    "folded": reg.counter("serve_stale_folded_total").value,
+    "stale_admitted": reg.counter("serve_stale_admitted_total").value,
+}
+# buffer 6-of-8: the withheld stale-poison client and the wire-delayed
+# straggler both miss the trigger; the poison enters the stale band late
+# (factor=-1 is norm-invariant — the retained-median screen passes it BY
+# DESIGN) and the per-buffer trimmed merge is what absorbs it. normride
+# starts at round 2, once the running median is seeded.
+rows_path = os.path.join(tempfile.mkdtemp(), "rows.jsonl")
+session = cv_train.main([
+    "--dataset", "cifar10", "--mode", "sketch",
+    "--k", "2048", "--num_rows", "3", "--num_cols", "8192",
+    "--num_clients", "16", "--num_workers", "8", "--local_batch_size", "4",
+    "--lr_scale", "0.02", "--weight_decay", "0",
+    "--data_root", "/nonexistent", "--num_rounds", "12",
+    "--eval_every", "3", "--log_jsonl", rows_path,
+    "--serve", "inproc", "--serve_payload", "sketch",
+    "--serve_async", "--serve_buffer", "6", "--serve_deadline", "30.0",
+    "--merge_policy", "trimmed", "--merge_trim", "2",
+    "--client_update_clip", "10",
+    "--fault_plan",
+    "client_normride@2,3,4,5,6,7,8,9,10,11:clients=0,ride=0.9;"
+    "client_stale_poison@3,5,7:clients=1;"
+    "wire_delay@4,6:clients=2,secs=5",
+])
+assert session.round == 12, session.round
+
+for kind in ("normride", "stale_poison"):
+    fired = (reg.counter(f"resilience_attack_{kind}_total").value
+             - before[kind])
+    assert fired >= 1, (
+        f"attack counter resilience_attack_{kind}_total never fired")
+admitted = (reg.counter("serve_stale_admitted_total").value
+            - before["stale_admitted"])
+assert admitted >= 1, "no late table entered the stale band"
+folded = reg.counter("serve_stale_folded_total").value - before["folded"]
+assert folded >= 1, (
+    "no stale fold reached the per-buffer robust merge (counter flat)")
+
+import jax
+from jax.flatten_util import ravel_pytree
+
+flat = np.asarray(ravel_pytree(jax.device_get(session.state["params"]))[0])
+assert np.isfinite(flat).all(), "params went non-finite under attack"
+
+rows = [json.loads(l) for l in open(rows_path) if l.strip()]
+losses = [r["train_loss"] for r in rows]
+assert all(np.isfinite(losses)), losses
+assert losses[-1] < losses[0], (
+    f"train loss did not fall under the async attacks: {losses}")
+print(f"async_byzantine: PASS (normride+stale_poison under the per-buffer "
+      f"trimmed merge; stale admitted={int(admitted)} folded={int(folded)}, "
+      f"loss {losses[0]:.4f} -> {losses[-1]:.4f}, 12 rounds, params finite)")
 EOF
 fi
 

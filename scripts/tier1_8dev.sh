@@ -27,8 +27,12 @@ python -m pytest tests/test_sharded_round.py tests/test_engine.py \
     tests/test_client_state_sharding.py tests/test_cohort_faults.py \
     tests/test_serve.py tests/test_obs.py tests/test_layerwise.py \
     tests/test_byzantine.py tests/test_pipeline_serve.py \
-    tests/test_sketch_health.py \
+    tests/test_sketch_health.py tests/test_async_robust.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
+
+# the async x robust composition end to end (per-buffer robust merge under
+# the adaptive attackers, through the real CLI): < 1 min CPU
+scripts/chaos_smoke.sh async_byzantine
 
 # bench mesh section must degrade to {"skipped": ...} on ONE device (the
 # real-chip driver path) instead of erroring: assert exactly that, cheaply.

@@ -1,0 +1,24 @@
+# graftlint: module=commefficient_tpu/federated/engine.py
+# G012 violating twin, weighted-order-statistics form: a "weighted median"
+# over the stale union stack smuggled INTO the staleness-fold boundary.
+# The staleness-fold declaration sanctions the LINEAR slot-ordered scan
+# only — order statistics over stale wires belong in the robust-merge
+# boundary (modes._robust_table_merge's union-stack form), so every sort/
+# searchsorted here must fire G012 even though the function is a declared
+# G013 boundary (the wrong boundary's exemption buys nothing).
+import jax.numpy as jnp
+
+
+# graftlint: staleness-fold — the declared (linear!) fold site
+def _stale_fold(table, live_weight, stale_tables, stale_weights):
+    # a weighted median hiding behind the stale-fold declaration: sorts
+    # and rank machinery over the stale union stack — an undeclared
+    # second robust-merge semantics
+    union = jnp.concatenate([table[None], stale_tables], axis=0)
+    order = jnp.argsort(union, axis=0, stable=True)
+    sw = jnp.take_along_axis(
+        jnp.broadcast_to(stale_weights[:, None, None], union.shape),
+        order, axis=0)
+    cum = jnp.cumsum(sw, axis=0)
+    lo = jnp.searchsorted(cum[:, 0, 0], stale_weights.sum() / 2.0)
+    return jnp.take(jnp.sort(union, axis=0), lo, axis=0), live_weight

@@ -1,0 +1,32 @@
+# graftlint: module=commefficient_tpu/federated/engine.py
+# G012/G013 conforming twin, weighted-order-statistics form: the merge
+# FORWARDS the stale union stacks into the robust-merge boundary (an
+# attribute call through modes.merge_partial_wires — the per-buffer
+# robust merge), and the declared staleness-fold stays strictly linear.
+# No order statistic, and no stale arithmetic, lives outside a boundary.
+import jax
+
+from commefficient_tpu.modes import modes
+
+
+# graftlint: staleness-fold — THE declared (linear) fold site
+def _stale_fold(table, live_weight, stale_tables, stale_weights):
+    def body(carry, xs):
+        tbl, wsum = carry
+        t, w = xs
+        return (tbl + w * t, wsum + w), None
+
+    (folded, total), _ = jax.lax.scan(
+        body, (table, live_weight), (stale_tables, stale_weights))
+    return folded, total
+
+
+def merge_step(mcfg, tables, part_eff, trim,
+               stale_tables=None, stale_weights=None):
+    # the per-buffer robust merge: bare keyword FORWARDING of the stale
+    # stacks into the ONE robust-merge boundary — the sanctioned shape
+    robust, total_w, extras = modes.merge_partial_wires(
+        mcfg, {"table": tables}, policy="trimmed", live=part_eff,
+        trim=trim, stale_tables=stale_tables, stale_weights=stale_weights,
+        want_residual=True)
+    return robust, total_w, extras

@@ -1,0 +1,788 @@
+"""Byzantine-robust always-on aggregation (async x robust composition).
+
+The pins, in dependency order:
+
+- the WEIGHTED robust merge (per-buffer union stack {current buffer +
+  staleness-weighted stale folds}) against a numpy reference, incl. the
+  unit-weight reduction to the PR 10 unweighted forms and the winsorized
+  error-feedback residual's boundedness;
+- program identity: a zero-stale async ROBUST round == the sync robust
+  round (params + every logged row, bitwise), and trimmed@0 async
+  on-time == the sync sum run bitwise;
+- THE seeded A/B: under the ADAPTIVE attackers (client_normride riding
+  just under the quarantine multiple, client_stale_poison submitting into
+  the stale band), async `--merge_policy trimmed|median` stays within the
+  PR 10 eps-band of its OWN clean async run while the attacked async sum
+  degrades measurably;
+- error feedback: `verror_ratio` (the PR 12 telescoping-health estimator)
+  stays bounded over a sustained-attack robust-merge run with
+  --robust_residual on;
+- the stale-buffer checkpoint discipline: band state rides meta.json, a
+  CLI async preempt -> --resume with a NON-EMPTY stale buffer mid-flight
+  is bit-identical to the uninterrupted twin (params + rows + ledger
+  fingerprints), and session reuse prunes/rewinds the checkpointed band.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+import cv_train
+from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+from commefficient_tpu.federated import engine
+from commefficient_tpu.federated.api import FederatedSession
+from commefficient_tpu.modes import modes
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.obs import registry as obreg
+from commefficient_tpu.resilience import FaultPlan
+from commefficient_tpu.runner.loop import EXIT_RESUMABLE
+from commefficient_tpu.serve.ingest import ACCEPTED_STALE
+from commefficient_tpu.serve.service import AggregationService, ServeConfig
+from commefficient_tpu.serve.traffic import TraceConfig, TrafficGenerator
+
+LR = 0.05
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+def quad_loss(params, net_state, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    err = pred - jax.nn.one_hot(batch["y"], pred.shape[-1])
+    mask = batch["mask"]
+    per_ex = (err ** 2).sum(-1)
+    return (per_ex * mask).sum() / jnp.maximum(mask.sum(), 1.0), {
+        "net_state": net_state,
+        "metrics": {"loss_sum": (per_ex * mask).sum(), "count": mask.sum()}}
+
+
+_RS = np.random.RandomState(0)
+_X = _RS.randn(240, 6).astype(np.float32)
+_Y = (_X @ _RS.randn(6, 3).astype(np.float32)).argmax(-1).astype(np.int32)
+
+
+def make_session(num_workers=12, stale_slots=0, seed=0, **kw):
+    train = FedDataset(_X, _Y,
+                       shard_iid(len(_X), 12, np.random.RandomState(1)))
+    params = {"w": jnp.full((6, 3), 0.1, jnp.float32), "b": jnp.zeros(3)}
+    d = ravel_pytree(params)[0].size
+    return FederatedSession(
+        train_loss_fn=quad_loss, eval_loss_fn=quad_loss,
+        params=params, net_state={},
+        mode_cfg=ModeConfig(mode="sketch", d=d, k=8, num_rows=3,
+                            num_cols=16, momentum=0.0, momentum_type="none",
+                            error_type="virtual"),
+        train_set=train, num_workers=num_workers, local_batch_size=16,
+        seed=seed, wire_payloads=True, stale_slots=stale_slots, **kw)
+
+
+def flat_params(session) -> np.ndarray:
+    return np.asarray(
+        ravel_pytree(jax.device_get(session.state["params"]))[0])
+
+
+def serve_rounds(session, cfg, rounds, trace_seed=5):
+    """Drive served rounds through the runner dispatch shape (the
+    test_pipeline_serve harness); returns the metric rows."""
+    svc = AggregationService(
+        session, cfg,
+        traffic=TrafficGenerator(
+            TraceConfig(population=session.train_set.num_clients,
+                        seed=trace_seed))).start()
+    rows = []
+    try:
+        src = svc.source()
+        for _ in range(rounds):
+            prep = src.next()
+            rows.append(session.commit_round(
+                session.dispatch_round(prep, LR))[0])
+            src.on_dispatched(session.round - 1)
+            src.on_committed(session.round)
+        src.stop()
+        with session.mutate_lock:
+            rng_state, rng_key = session.rng_snapshot
+            session.rng.set_state(rng_state)
+            session._rng_key = rng_key
+            session._requeue = collections.deque(
+                session._requeue_committed)
+            session._requeue_enqueued = dict(
+                session._requeue_ages_committed)
+    finally:
+        svc.close()
+    return rows
+
+
+def _assert_params_equal(sa, sb):
+    np.testing.assert_array_equal(flat_params(sa), flat_params(sb))
+
+
+def _assert_rows_equal(ra, rb):
+    for a, b in zip(ra, rb):
+        assert set(a) == set(b)
+        for k in a:
+            assert a[k] == b[k], (k, a[k], b[k])
+
+
+# ------------------------------------- the weighted union-stack robust merge
+
+
+def _np_weighted_trimmed(tables, weights, trim):
+    """Per-coordinate python reference: rank the positive-weight FINITE
+    entries by (value, stack index), drop `trim` from each end, weighted
+    mean of the survivors. Returns (robust, total_weight)."""
+    W = tables.shape[0]
+    flat = tables.reshape(W, -1)
+    w = np.array([weights[i] if np.isfinite(flat[i]).all() else 0.0
+                  for i in range(W)])
+    n = int((w > 0).sum())
+    res = np.zeros(flat.shape[1], np.float64)
+    for c in range(flat.shape[1]):
+        rows = sorted((flat[i, c], i) for i in range(W) if w[i] > 0)
+        kept = rows[trim:n - trim]
+        if kept and n > 2 * trim:
+            num = sum(v * w[i] for v, i in kept)
+            den = sum(w[i] for _, i in kept)
+            res[c] = num / den
+    return res.reshape(tables.shape[1:]).astype(np.float32), w.sum()
+
+
+def _np_weighted_median(tables, weights):
+    W = tables.shape[0]
+    flat = tables.reshape(W, -1)
+    w = np.array([weights[i] if np.isfinite(flat[i]).all() else 0.0
+                  for i in range(W)])
+    total = w.sum()
+    res = np.zeros(flat.shape[1], np.float64)
+    for c in range(flat.shape[1]):
+        rows = sorted((flat[i, c], i) for i in range(W) if w[i] > 0)
+        if not rows:
+            continue
+        cum, lo, hi = 0.0, None, None
+        for v, i in rows:
+            cum += w[i]
+            if lo is None and cum >= total / 2:
+                lo = v
+            if hi is None and cum > total / 2:
+                hi = v
+        if hi is None:
+            hi = rows[-1][0]
+        res[c] = 0.5 * (lo + hi)
+    return res.reshape(tables.shape[1:]).astype(np.float32)
+
+
+def test_weighted_union_merge_matches_numpy_reference():
+    rs = np.random.RandomState(3)
+    tables = rs.randn(5, 2, 4).astype(np.float32)
+    stale = rs.randn(3, 2, 4).astype(np.float32)
+    live = np.array([1, 0, 1, 1, 1], np.float32)
+    sw = np.array([2 ** -0.5, 3 ** -0.5, 0.0], np.float32)  # slot 2 empty
+    union = np.concatenate([tables, stale])
+    uw = np.concatenate([live, sw])
+
+    got, total, extras = modes._robust_table_merge(
+        jnp.asarray(tables), jnp.asarray(live), "trimmed", 1,
+        stale_tables=jnp.asarray(stale), stale_weights=jnp.asarray(sw))
+    ref, ref_total = _np_weighted_trimmed(union, uw, 1)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-7)
+    assert float(total) == pytest.approx(ref_total, rel=1e-6)
+    assert int(extras["stale_folded"]) == 2  # the empty slot excluded
+    assert float(extras["stale_weight"]) == pytest.approx(sw.sum(), 1e-6)
+
+    got_m, total_m, _ = modes._robust_table_merge(
+        jnp.asarray(tables), jnp.asarray(live), "median", 0,
+        stale_tables=jnp.asarray(stale), stale_weights=jnp.asarray(sw))
+    np.testing.assert_allclose(np.asarray(got_m),
+                               _np_weighted_median(union, uw),
+                               rtol=1e-5, atol=1e-7)
+    assert float(total_m) == pytest.approx(ref_total, rel=1e-6)
+
+
+def test_weighted_unit_weights_reduce_to_unweighted():
+    """The extended path with zero stale entries reduces VALUE-exactly to
+    the PR 10 unweighted forms (the bitwise async==sync contract rides on
+    program identity, but the weighted math itself must also agree)."""
+    rs = np.random.RandomState(7)
+    tables = rs.randn(6, 3, 5).astype(np.float32)
+    live = np.array([1, 0, 1, 1, 1, 1], np.float32)
+    for policy, trim in (("trimmed", 1), ("median", 0)):
+        old = np.asarray(modes._robust_table_merge(
+            jnp.asarray(tables), jnp.asarray(live), policy, trim))
+        new, total, extras = modes._robust_table_merge(
+            jnp.asarray(tables), jnp.asarray(live), policy, trim,
+            want_residual=True)
+        np.testing.assert_array_equal(old, np.asarray(new))
+        assert float(total) == live.sum()
+        assert np.isfinite(np.asarray(extras["residual"])).all()
+
+
+def test_residual_is_winsorized_and_bounded():
+    """The error-feedback residual clamps every contribution into the
+    policy's kept window before averaging: an adversarial outlier moves
+    the residual at most to the kept range's edge — never by its own
+    magnitude. (The naive mean-vs-robust residual would re-inject the
+    full attack mass into Verror, defeating the robust merge.)"""
+    honest = np.linspace(-1.0, 1.0, 5, dtype=np.float32).reshape(5, 1, 1)
+    attacked = honest.copy()
+    attacked[0] = 1e6  # a huge in-stack outlier
+    live = jnp.ones(5)
+    _, _, ex_h = modes._robust_table_merge(
+        jnp.asarray(honest), live, "trimmed", 1, want_residual=True)
+    _, _, ex_a = modes._robust_table_merge(
+        jnp.asarray(attacked), live, "trimmed", 1, want_residual=True)
+    r_h = float(np.asarray(ex_h["residual"]).squeeze())
+    r_a = float(np.asarray(ex_a["residual"]).squeeze())
+    # the outlier is clamped to the kept window's upper edge (value 1.0 at
+    # rank n-trim-1 = 0.5's neighbor): the residual shift is bounded by
+    # the clean value range, nowhere near 1e6 / 5
+    assert abs(r_a - r_h) <= 2.0, (r_h, r_a)
+    # and a reference check: residual == winsorized weighted mean - robust
+    vals = np.sort(attacked.squeeze())
+    clamped = np.clip(attacked.squeeze(), vals[1], vals[3])
+    robust = np.mean(np.sort(attacked.squeeze())[1:4])
+    assert r_a == pytest.approx(clamped.mean() - robust, rel=1e-5)
+
+
+def test_robust_residual_changes_params_and_stays_finite():
+    a = make_session(merge_policy="trimmed", merge_trim=3)
+    b = make_session(merge_policy="trimmed", merge_trim=3,
+                     robust_residual=True)
+    for _ in range(4):
+        a.run_round(LR)
+        b.run_round(LR)
+    fa, fb = flat_params(a), flat_params(b)
+    assert np.isfinite(fb).all()
+    assert not np.array_equal(fa, fb)  # the residual really entered Verror
+
+
+# ----------------------------------------------- program-identity pins
+
+
+def test_async_robust_zero_stale_bitwise_equals_sync_robust():
+    """An async ROBUST run where every submission answers the open round
+    dispatches the plain robust merge program every round — the PR 10
+    sync robust round by program identity: params + every logged row
+    bitwise equal to the sync robust run."""
+    for policy, kw in (("median", {}), ("trimmed", {"merge_trim": 3})):
+        a = make_session(merge_policy=policy, **kw)
+        ra = serve_rounds(a, ServeConfig(quorum=12, deadline_s=1e9,
+                                         payload="sketch"), 4)
+        b = make_session(merge_policy=policy, stale_slots=12, **kw)
+        rb = serve_rounds(b, ServeConfig(quorum=12, deadline_s=1e9,
+                                         payload="sketch", async_mode=True,
+                                         buffer_size=12), 4)
+        _assert_rows_equal(ra, rb)
+        _assert_params_equal(a, b)
+
+
+def test_trimmed_zero_async_on_time_bitwise_equals_sync_sum():
+    """trimmed@0 + zero stale: the async run compiles and dispatches the
+    plain SUM program (robust_policy resolves to None), pinned bitwise
+    against the sync sum run."""
+    a = make_session()
+    ra = serve_rounds(a, ServeConfig(quorum=12, deadline_s=1e9,
+                                     payload="sketch"), 4)
+    b = make_session(merge_policy="trimmed", merge_trim=0, stale_slots=12)
+    rb = serve_rounds(b, ServeConfig(quorum=12, deadline_s=1e9,
+                                     payload="sketch", async_mode=True,
+                                     buffer_size=12), 4)
+    _assert_rows_equal(ra, rb)
+    _assert_params_equal(a, b)
+
+
+def test_async_robust_straggler_folds_into_union_stack():
+    """With the buffer trigger below the arrival count, a robust async
+    round's stragglers JOIN the weighted order statistics (stale_folded /
+    stale_weight metrics emitted by the union-stack merge) and the
+    trajectory differs from the sync robust run that drops them."""
+    reg = obreg.default()
+    base = reg.counter("serve_stale_folded_total").value
+    a = make_session(merge_policy="trimmed", merge_trim=3, stale_slots=12)
+    ra = serve_rounds(a, ServeConfig(quorum=12, deadline_s=60.0,
+                                     payload="sketch", async_mode=True,
+                                     buffer_size=6), 5)
+    assert reg.counter("serve_stale_folded_total").value > base
+    folded_rows = [r for r in ra if r.get("stale_folded", 0) > 0]
+    assert folded_rows, ra
+    for r in folded_rows:
+        assert 0 < r["stale_weight"] < r["stale_folded"]  # (1+lag)^-0.5 < 1
+    assert np.isfinite(flat_params(a)).all()
+    b = make_session(merge_policy="trimmed", merge_trim=3)
+    serve_rounds(b, ServeConfig(quorum=6, deadline_s=60.0,
+                                payload="sketch"), 5)
+    assert not np.array_equal(flat_params(a), flat_params(b))
+
+
+def test_async_robust_union_stack_shard_invariant():
+    """Per-client tables make the union-stack robust statistic
+    shard-count-invariant, stale folds included: client_shards=2 bitwise
+    equals the unsharded async robust run (the mesh-shape-invariance
+    claim, on the CPU reference execution)."""
+
+    def run(shards):
+        s = make_session(merge_policy="trimmed", merge_trim=3,
+                         stale_slots=12, client_shards=shards)
+        serve_rounds(s, ServeConfig(quorum=12, deadline_s=60.0,
+                                    payload="sketch", async_mode=True,
+                                    buffer_size=6), 4)
+        return s
+
+    a, b = run(0), run(2)
+    _assert_params_equal(a, b)
+
+
+# ------------------------------------------------- THE adaptive-attack A/B
+
+
+_AB_ROUNDS = 6
+_AB_ALL = ",".join(str(r) for r in range(_AB_ROUNDS))
+# normride makes the sign-flip maximal: the flipped table rides at
+# 0.95 x clip x running_median — the largest in-screen poison there is
+ATTACKS = {
+    "client_normride": (
+        f"client_signflip@{_AB_ALL}:clients=0+1;"
+        f"client_normride@{_AB_ALL}:clients=0+1,ride=0.95"),
+    "client_stale_poison": (
+        f"client_stale_poison@{','.join(str(r) for r in range(_AB_ROUNDS - 1))}"
+        ":clients=0+1,factor=-5"),
+}
+
+_AB_POLICIES = {
+    "sum": {"merge_policy": "trimmed", "merge_trim": 0},
+    "trimmed": {"merge_policy": "trimmed", "merge_trim": 3},
+    "median": {"merge_policy": "median"},
+}
+
+
+def _ab_arm(policy_kw, plan_text=None) -> float:
+    s = make_session(
+        stale_slots=12, client_update_clip=10.0,
+        fault_plan=FaultPlan.parse(plan_text) if plan_text else None,
+        **policy_kw)
+    # buffer 10-of-12: a withheld stale-poison client's table enters the
+    # band late; the clean arms fold their own (honest) stragglers — the
+    # union stack is exercised in EVERY arm
+    serve_rounds(s, ServeConfig(quorum=12, deadline_s=1e9,
+                                payload="sketch", async_mode=True,
+                                buffer_size=10), _AB_ROUNDS)
+    ds = FedDataset(_X, _Y, shard_iid(len(_X), 12, np.random.RandomState(1)))
+    ev = s.evaluate(ds, batch_size=64)
+    return ev["loss_sum"] / max(ev["count"], 1)
+
+
+@pytest.mark.parametrize("kind", list(ATTACKS))
+def test_adaptive_attack_degrades_async_sum_robust_recovers(kind):
+    """THE acceptance A/B, fully seeded, on the BUFFERED path: under the
+    adaptive attackers the async linear sum ends measurably worse than
+    its own clean async run, while async trimmed AND median stay within
+    the PR 10 eps-band (0.75 x the sum's damage, one-sided) of their OWN
+    clean async runs and strictly beat the attacked sum — the per-buffer
+    robust merge answering what the screens cannot."""
+    clean = {p: _ab_arm(dict(kw)) for p, kw in _AB_POLICIES.items()}
+    att = {p: _ab_arm(dict(kw), ATTACKS[kind])
+           for p, kw in _AB_POLICIES.items()}
+    deg = att["sum"] - clean["sum"]
+    assert deg > 0.05, (
+        f"{kind} under the async linear sum should degrade the eval loss "
+        f"measurably (clean {clean['sum']:.4f}, attacked {att['sum']:.4f})")
+    eps = 0.75 * deg
+    for policy in ("trimmed", "median"):
+        gap = att[policy] - clean[policy]
+        assert gap < eps, (
+            f"{kind} under async {policy}: attacked {att[policy]:.4f} vs "
+            f"own clean {clean[policy]:.4f} — gap {gap:.4f} exceeds "
+            f"eps={eps:.4f} (sum degraded by {deg:.4f})")
+        assert att[policy] < att["sum"], (
+            f"{kind}: async {policy} ({att[policy]:.4f}) should strictly "
+            f"beat the attacked async sum ({att['sum']:.4f})")
+
+
+def test_stale_poison_is_wire_faithful():
+    """The attack's two halves land where a real adversary's would: the
+    withheld position no-shows its round (masked + requeued), the late
+    poisoned table is ACCEPTED_STALE through the real admission band
+    (counters + instants), and the per-kind attack counter fires."""
+    reg = obreg.default()
+    before = {
+        "attack": reg.counter("resilience_attack_stale_poison_total").value,
+        "admitted": reg.counter("serve_stale_admitted_total").value,
+    }
+    s = make_session(stale_slots=12, client_update_clip=10.0,
+                     fault_plan=FaultPlan.parse(
+                         "client_stale_poison@1:clients=0"))
+    rows = serve_rounds(s, ServeConfig(quorum=12, deadline_s=1e9,
+                                       payload="sketch", async_mode=True,
+                                       buffer_size=10), 4)
+    assert reg.counter("resilience_attack_stale_poison_total").value \
+        == before["attack"] + 1
+    assert reg.counter("serve_stale_admitted_total").value \
+        > before["admitted"]
+    # the withheld client was masked out of round 1 like any no-show
+    assert rows[1]["clients_dropped"] >= 1, rows[1]
+    # and its poisoned table folded into round 2's merge
+    assert rows[2].get("stale_folded", 0) >= 1, rows[2]
+
+
+def test_normride_rides_under_the_quarantine():
+    """The rider probes the running median from BELOW the multiple: the
+    quarantine never fires on it (that is the attack's whole point), the
+    per-kind counter does, and the trajectory moves measurably."""
+    reg = obreg.default()
+    base = reg.counter("resilience_attack_normride_total").value
+    plan = FaultPlan.parse("client_normride@1,2,3:clients=0,ride=0.9")
+    a = make_session(client_update_clip=3.0, fault_plan=plan)
+    ra = [a.run_round(LR) for _ in range(4)]
+    assert reg.counter("resilience_attack_normride_total").value > base
+    assert all(r.get("clients_quarantined", 0) == 0 for r in ra), ra
+    b = make_session(client_update_clip=3.0)
+    [b.run_round(LR) for _ in range(4)]
+    assert not np.array_equal(flat_params(a), flat_params(b))
+    assert np.isfinite(flat_params(a)).all()
+
+
+def test_normride_validation():
+    with pytest.raises(ValueError, match="client_update_clip"):
+        make_session(fault_plan=FaultPlan.parse(
+            "client_normride@1:clients=0"))
+    with pytest.raises(ValueError, match="ride fraction"):
+        FaultPlan.parse("client_normride@1:clients=0,ride=1.5")
+
+
+def test_stale_poison_context_validation():
+    plan = FaultPlan.parse("client_stale_poison@1:clients=0")
+    with pytest.raises(ValueError, match="stale"):
+        plan.validate_stale_context(False)
+    plan.validate_stale_context(True)  # armed: fine
+    # factor=0 is a drop in disguise, rejected at parse like client_scale
+    with pytest.raises(ValueError, match="finite nonzero"):
+        FaultPlan.parse("client_stale_poison@1:clients=0,factor=0")
+    # scheduled at the FINAL round the withhold would fire (and the
+    # counter tick) but the late submission could never land — rejected
+    # one round earlier than the generic schedule check
+    plan.validate_rounds(3)  # round 1 of 3: lands during round 2 — fine
+    with pytest.raises(ValueError, match="NEXT round"):
+        plan.validate_rounds(2)  # round 1 of 2 == the final round
+
+
+# ----------------------------------------- verror telescoping under attack
+
+
+def test_verror_ratio_bounded_under_sustained_attack():
+    """--robust_residual on + --health_every 1: over a sustained
+    norm-riding sign-flip attack against the trimmed merge, the PR 12
+    `verror_ratio` estimator (Verror mass vs round-update mass) stays
+    bounded — the winsorized residual re-enters honest mass through error
+    feedback without accumulating the attack (telescoping holds)."""
+    from commefficient_tpu.obs.health import HealthMonitor
+
+    def run(plan_text, rounds=16):
+        plan = FaultPlan.parse(plan_text) if plan_text else None
+        s = make_session(merge_policy="trimmed", merge_trim=3,
+                         robust_residual=True, client_update_clip=10.0,
+                         health_every=1, fault_plan=plan)
+        s.health_monitor = HealthMonitor(
+            mode_cfg=s.cfg.mode, num_workers=s.num_workers, health_every=1)
+        for _ in range(rounds):
+            s.run_round(LR)
+        return s.health_monitor.series("verror_ratio")
+
+    rng = ",".join(str(r) for r in range(1, 16))
+    attacked = run(f"client_signflip@{rng}:clients=0+1;"
+                   f"client_normride@{rng}:clients=0+1,ride=0.9")
+    clean = run(None)
+    assert len(attacked) >= 14 and len(clean) >= 14
+    assert all(np.isfinite(v) for v in attacked), attacked
+    # bounded: the attacked run's telescoping profile tracks the CLEAN
+    # run's own warm-up — the residual re-entered honest mass without
+    # accumulating the attack (a naive mean residual grows this ratio
+    # with the attack mass round over round, without limit)
+    assert max(attacked) < 25.0, attacked
+    assert max(attacked) <= 1.5 * max(clean) + 0.1, (attacked, clean)
+    assert attacked[-1] <= 1.5 * clean[-1] + 0.1, (attacked, clean)
+
+
+# --------------------------------------- stale-buffer checkpoint discipline
+
+
+def test_band_state_rides_serve_meta_and_restores():
+    """A non-empty stale band (parked arrival + straggler stash + poison
+    in flight) round-trips through the serve_meta checkpoint payload into
+    a fresh service on a restored session."""
+    s = make_session(stale_slots=12, client_update_clip=10.0,
+                     fault_plan=FaultPlan.parse(
+                         "client_stale_poison@2:clients=0"))
+    svc = AggregationService(
+        s, ServeConfig(quorum=12, deadline_s=1e9, payload="sketch",
+                       async_mode=True, buffer_size=10),
+        traffic=TrafficGenerator(
+            TraceConfig(population=12, seed=5))).start()
+    try:
+        src = svc.source()
+        for _ in range(3):
+            prep = src.next()
+            s.commit_round(s.dispatch_round(prep, LR))
+            src.on_dispatched(s.round - 1)
+            src.on_committed(s.round)
+        meta = s.serve_meta()
+        assert meta["round"] == 3
+        band = meta.get("band")
+        assert band is not None
+        # something is genuinely in flight mid-run: stragglers stashed
+        # and/or a poison pending and/or parked arrivals
+        depth = (len(band["stale"]) + len(band["stash"])
+                 + len(band["poison"]))
+        assert depth >= 1, band
+        src.stop()
+    finally:
+        svc.close()
+    # a fresh service on a "restored" session picks the band up
+    s2 = make_session(stale_slots=12, client_update_clip=10.0)
+    s2.restored_serve_meta = meta
+    svc2 = AggregationService(
+        s2, ServeConfig(quorum=12, deadline_s=1e9, payload="sketch",
+                        async_mode=True, buffer_size=10),
+        traffic=TrafficGenerator(
+            TraceConfig(population=12, seed=5))).start()
+    try:
+        qb = svc2.queue.band_snapshot()
+        assert len(qb["stale"]) == len(band["stale"])
+        assert len(svc2._stale_stash) == len(band["stash"])
+        assert len(svc2._stale_poison_pending) == len(band["poison"])
+        # tables decoded base64-exact
+        for enc, dec in zip(band["stash"], svc2._stale_stash):
+            got = np.asarray(dec[3], np.float32)
+            assert got.dtype == np.float32
+            assert list(got.shape) == enc[3]["shape"]
+    finally:
+        svc2.close()
+
+
+def test_rewind_restores_checkpointed_band_on_session_reuse():
+    """Session + service reuse after an interrupted async loop: the band
+    rewinds to the committed boundary SNAPSHOT (parked entries, retained
+    screen state, recv counter, stash), so the continued run replays the
+    stale folds bit-identically with an uninterrupted twin."""
+    from commefficient_tpu.runner import RunnerConfig, run_loop
+    from commefficient_tpu.federated.api import FedOptimizer
+
+    def build():
+        s = make_session(merge_policy="trimmed", merge_trim=3,
+                         stale_slots=12)
+        svc = AggregationService(
+            s, ServeConfig(quorum=12, deadline_s=60.0, payload="sketch",
+                           async_mode=True, buffer_size=6),
+            traffic=TrafficGenerator(
+                TraceConfig(population=12, seed=5))).start()
+        return s, svc
+
+    a, svc_a = build()
+    try:
+        opt = FedOptimizer(lambda e: LR, 3)
+        run_loop(a, opt, RunnerConfig(total_rounds=2, eval_every=100),
+                 source=svc_a.source())
+        # the stop/rewind between loops restores the committed band
+        run_loop(a, opt, RunnerConfig(total_rounds=5, eval_every=100),
+                 source=svc_a.source())
+    finally:
+        svc_a.close()
+    b, svc_b = build()
+    try:
+        run_loop(b, FedOptimizer(lambda e: LR, 3),
+                 RunnerConfig(total_rounds=5, eval_every=100),
+                 source=svc_b.source())
+    finally:
+        svc_b.close()
+    assert a.round == b.round == 5
+    _assert_params_equal(a, b)
+
+
+# --------------------------------------------------------------- CLI chaos
+
+
+@pytest.fixture()
+def tiny_cv(tmp_path, monkeypatch):
+    import flax.linen as nn
+
+    import commefficient_tpu.data.cifar as cifar_mod
+
+    orig = cifar_mod.load_cifar_fed
+
+    def tiny(*a, **kw):
+        kw.update(synthetic_train=64, synthetic_test=32)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(cv_train, "load_cifar_fed", tiny)
+
+    class _TinyNet(nn.Module):
+        num_classes: int = 10
+        dtype: str = "float32"
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(self.num_classes)(x)
+
+    monkeypatch.setattr(cv_train, "ResNet9", _TinyNet)
+    return tmp_path
+
+
+@pytest.mark.chaos
+def test_cli_async_preempt_resume_nonempty_stale_buffer(tiny_cv, tmp_path):
+    """THE resume acceptance: an async CLI run whose stale buffer is
+    NON-EMPTY mid-flight (a wire-delayed straggler crossing the round
+    boundary), preempted and resumed, is bit-identical to the
+    uninterrupted twin — params, every ledger row's fingerprints, and the
+    requeue — because the band rode meta.json with the committed
+    snapshot."""
+    from commefficient_tpu.obs import ledger as obledger
+
+    led = str(tmp_path / "run.jsonl")
+    led2 = str(tmp_path / "twin.jsonl")
+    base = [
+        "--dataset", "cifar10", "--mode", "sketch",
+        "--k", "64", "--num_rows", "3", "--num_cols", "256",
+        "--num_clients", "8", "--num_workers", "4",
+        "--local_batch_size", "4", "--lr_scale", "0.05",
+        "--weight_decay", "0", "--data_root", "/nonexistent",
+        "--num_rounds", "6", "--eval_every", "3",
+        "--serve", "inproc", "--serve_payload", "sketch",
+        "--serve_async", "--serve_buffer", "3",
+        "--serve_deadline", "30.0", "--merge_policy", "trimmed",
+        "--merge_trim", "1",
+        # the delayed payloads miss round 2/3's trigger and land in the
+        # stale band — the buffer is NON-EMPTY exactly when the preempt
+        # hits round 3
+        "--fault_plan", "wire_delay@2,3:clients=1,secs=5;preempt@3",
+    ]
+    before = {t.name for t in threading.enumerate()}
+    ckdir = str(tmp_path / "ck")
+    chaos = ["--checkpoint_dir", ckdir, "--checkpoint_every", "1",
+             "--ledger", led]
+    with pytest.raises(SystemExit) as ei:
+        cv_train.main(base + chaos)
+    assert ei.value.code == EXIT_RESUMABLE
+    # the emergency checkpoint really carried a non-empty band
+    import glob
+    import os
+
+    metas = sorted(glob.glob(os.path.join(ckdir, "round_*", "meta.json")))
+    assert metas
+    with open(metas[-1]) as f:
+        meta = json.load(f)
+    band = meta.get("serve", {}).get("band")
+    assert band is not None
+    assert (len(band.get("stale", [])) + len(band.get("stash", []))) >= 1, (
+        "the preempted checkpoint's stale band is empty — the scenario "
+        "did not exercise the non-empty-band resume")
+    # same argv + --resume: the plan replays by GLOBAL round, and the
+    # emergency checkpoint committed past round 3, so preempt@3 never
+    # re-fires (the faults.py round-schedule contract)
+    sc = cv_train.main(base + chaos + ["--resume"])
+    assert sc.round == 6
+    # the uninterrupted twin (same plan minus the preempt, its own ledger)
+    sa = cv_train.main(
+        [x.replace(";preempt@3", "") for x in base] + ["--ledger", led2])
+    _assert_params_equal(sa, sc)
+    assert list(sa._requeue) == list(sc._requeue)
+    recs = obledger.round_records(led)
+    twin = obledger.round_records(led2)
+    assert [r["round"] for r in recs] == [r["round"] for r in twin] \
+        == list(range(6))
+    assert [r.get("fingerprint") for r in recs] \
+        == [r.get("fingerprint") for r in twin]
+    # stale-fold activity really appears in the committed record stream
+    assert any(r.get("metrics", {}).get("stale_folded", 0) > 0
+               for r in twin), "no stale fold committed — vacuous scenario"
+    leaked = {t.name for t in threading.enumerate()} - before
+    assert not {t for t in leaked if "serve" in t}, leaked
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_engine_accepts_async_robust_composition():
+    mc = ModeConfig(mode="sketch", d=16, k=4, num_rows=2, num_cols=8,
+                    momentum_type="virtual", error_type="virtual")
+    cfg = engine.EngineConfig(mode=mc, stale_slots=4, wire_payloads=True,
+                              merge_policy="trimmed", merge_trim=1)
+    assert engine.robust_policy(cfg) == "trimmed"
+    # and the builder compiles the stale robust variant without complaint
+    client_p, merge_p = engine.make_payload_round_steps(
+        quad_loss, cfg, allow_batch_tables=True, stale_slots=4)
+    assert callable(client_p) and callable(merge_p)
+
+
+def test_engine_rejects_residual_without_robust_policy():
+    """robust_residual through the LIBRARY API with no effective robust
+    policy is a silent no-op waiting to be discovered at the postmortem —
+    EngineConfig rejects it like the CLI does (sum AND trimmed@0)."""
+    mc = ModeConfig(mode="sketch", d=16, k=4, num_rows=2, num_cols=8,
+                    momentum_type="virtual", error_type="virtual")
+    with pytest.raises(ValueError, match="robust_residual"):
+        engine.EngineConfig(mode=mc, robust_residual=True)
+    with pytest.raises(ValueError, match="robust_residual"):
+        engine.EngineConfig(mode=mc, robust_residual=True,
+                            merge_policy="trimmed", merge_trim=0)
+    cfg = engine.EngineConfig(mode=mc, robust_residual=True,
+                              merge_policy="median")
+    assert cfg.robust_residual
+
+
+def test_cli_robust_residual_validation():
+    from commefficient_tpu.utils.config import make_parser, resolve_defaults
+
+    base = ["--dataset", "cifar10", "--mode", "sketch", "--k", "4"]
+    with pytest.raises(SystemExit, match="robust_residual|merge_policy"):
+        resolve_defaults(make_parser("cv").parse_args(
+            base + ["--robust_residual", "on"]))
+    with pytest.raises(SystemExit, match="robust_residual|trimmed@0|sum"):
+        resolve_defaults(make_parser("cv").parse_args(
+            base + ["--robust_residual", "on", "--merge_policy", "trimmed"]))
+    args = resolve_defaults(make_parser("cv").parse_args(
+        base + ["--robust_residual", "on", "--merge_policy", "trimmed",
+                "--merge_trim", "1"]))
+    assert args.robust_residual == "on"
+
+
+def test_slo_attack_spike_and_tuned_stale_runaway():
+    """The new default rules: attack_spike fires on a sustained attack-
+    counter delta; the tuned stale_runaway stays QUIET on a healthy
+    small-buffer async profile (stale_fraction ~ 0.6) and fires on a
+    sustained near-total stale takeover."""
+    from commefficient_tpu.obs import slo as obslo
+
+    reg = obreg.default()
+    eng = obslo.SloEngine(obslo.parse_rules(""), mode="warn",
+                          alert=lambda m: None)
+    fired: list = []
+    # healthy buffered profile: trigger 2-of-8, three stale folds per
+    # round — the OLD 0.5@5 rule fired here; the tuned one must not
+    healthy = {"participants": 2.0, "stale_folded": 3.0,
+               "nonfinite_rounds": 0.0}
+    for rnd in range(10):
+        fired += eng.on_round(rnd, healthy)
+    assert not [e for e in fired if e["rule"] == "stale_runaway"], fired
+    # near-total takeover: 1 on-time vs 19 stale, sustained
+    takeover = {"participants": 1.0, "stale_folded": 19.0,
+                "nonfinite_rounds": 0.0}
+    for rnd in range(10, 20):
+        fired += eng.on_round(rnd, takeover)
+    assert [e for e in fired if e["rule"] == "stale_runaway"], fired
+    # attack_spike: a sustained per-round attack-counter delta
+    eng2 = obslo.SloEngine(obslo.parse_rules(""), mode="warn",
+                           alert=lambda m: None)
+    fired2: list = []
+    for rnd in range(5):
+        reg.counter("resilience_attack_normride_total").inc(2)
+        fired2 += eng2.on_round(rnd, {"participants": 8.0,
+                                      "nonfinite_rounds": 0.0})
+    assert [e for e in fired2 if e["rule"] == "attack_spike"], fired2

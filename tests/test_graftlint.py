@@ -292,6 +292,98 @@ def test_g013_forwarding_is_legal_config_scalars_exempt():
         os.unlink(path)
 
 
+def test_g012_weighted_sort_smuggled_into_stale_fold_fires():
+    """The weighted-order-statistics form (per-buffer robust merge): a
+    sort/searchsorted smuggled INTO the declared staleness-fold boundary
+    must fire G012 — the stale-fold declaration sanctions the LINEAR
+    slot-ordered scan only, never order statistics (the wrong boundary's
+    exemption buys nothing)."""
+    found = _codes(os.path.join(FIXTURES, "g012_weighted_bad.py"))
+    assert found.count("G012") >= 2, found  # sort + searchsorted at least
+    assert "G013" not in found, found  # the stale arithmetic IS in-boundary
+
+
+def test_g012_weighted_forwarding_to_robust_boundary_is_silent():
+    """The conforming twin: the merge FORWARDS the stale union stacks to
+    the robust-merge boundary through the attribute call
+    (modes.merge_partial_wires) — no G012, and no G013 (keyword
+    forwarding is the sanctioned shape)."""
+    found = _codes(os.path.join(FIXTURES, "g012_weighted_ok.py"))
+    assert "G012" not in found, found
+    assert "G013" not in found, found
+
+
+def test_g013_stale_arithmetic_inside_robust_merge_boundary_is_legal():
+    """The async x robust composition: stale wire values joining the
+    weighted order statistics INSIDE the declared robust-merge boundary
+    (modes/modes.py) are sanctioned — that is the one other place their
+    fold semantics are pinned; the same arithmetic outside it fires."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/modes/modes.py\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "# graftlint: robust-merge\n"
+        "def _robust_table_merge(stacked, live, policy, trim,\n"
+        "                        stale_tables=None, stale_weights=None):\n"
+        "    union = jnp.concatenate([stacked, stale_tables], axis=0)\n"
+        "    w = jnp.concatenate([live, stale_weights])\n"
+        "    order = jnp.argsort(union, axis=0, stable=True)\n"
+        "    return union.sum(0), w.sum(), order\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        found = _codes(path)
+        assert "G013" not in found, found
+        assert "G012" not in found, found
+    finally:
+        os.unlink(path)
+    bad = src + (
+        "\n\ndef outside(stale_tables, stale_weights):\n"
+        "    return (stale_weights[:, None, None] * stale_tables).sum(0)\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(bad)
+        path = tmp.name
+    try:
+        assert "G013" in _codes(path)
+    finally:
+        os.unlink(path)
+
+
+def test_g013_generic_attribute_call_is_not_forwarding():
+    """Attribute-call forwarding is sanctioned ONLY into the boundary
+    entry points (merge_partial_wires / _robust_table_merge /
+    _stale_fold): `jnp.average(stale_tables, weights=stale_weights)` is a
+    smuggled weighted fold wearing a call's clothes — not an order
+    statistic (G012 can't see it) and not forwarding — and must fire."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/federated/engine.py\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def sneaky(table, stale_tables, stale_weights):\n"
+        "    return table + jnp.average(stale_tables, axis=0,\n"
+        "                               weights=stale_weights)\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        assert "G013" in _codes(path)
+    finally:
+        os.unlink(path)
+
+
 def test_g014_second_declared_boundary_fires():
     """THE ledger-commit boundary is one function in federated/api.py: a
     second declaration is a second write path hiding under the first's

@@ -647,16 +647,19 @@ def test_async_config_validation():
             traffic=TrafficGenerator(TraceConfig()))
 
 
-def test_engine_rejects_stale_slots_without_wire_or_with_robust():
+def test_engine_rejects_stale_slots_without_wire_and_composes_robust():
     from commefficient_tpu.federated import engine
 
     mc = ModeConfig(mode="sketch", d=16, k=4, num_rows=2, num_cols=8,
                     momentum_type="virtual", error_type="virtual")
     with pytest.raises(ValueError, match="wire"):
         engine.EngineConfig(mode=mc, stale_slots=4)
-    with pytest.raises(ValueError, match="merge_policy"):
-        engine.EngineConfig(mode=mc, stale_slots=4, wire_payloads=True,
-                            merge_policy="median")
+    # async x robust COMPOSES since the per-buffer robust merge landed:
+    # stale slots join the weighted order statistics instead of folding
+    # linearly (tests/test_async_robust.py pins the semantics)
+    cfg = engine.EngineConfig(mode=mc, stale_slots=4, wire_payloads=True,
+                              merge_policy="median")
+    assert engine.robust_policy(cfg) == "median"
 
 
 def test_cli_flag_validation():
