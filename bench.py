@@ -356,6 +356,18 @@ SERVE_POPULATION = int(os.environ.get("BENCH_SERVE_POPULATION", 10_000_000))
 # real runs; BENCH_BYZANTINE=0 disables, BENCH_BYZANTINE_ROUNDS sizes them.
 BYZANTINE_BENCH = os.environ.get("BENCH_BYZANTINE", "1") == "1"
 BYZANTINE_ROUNDS = int(os.environ.get("BENCH_BYZANTINE_ROUNDS", 20))
+# C1M scale-out section (serve/scale/): (a) sustained submissions/s vs
+# concurrent-connection count for the threaded vs event-loop socket
+# transports (the reactor must hold >= 10x the threaded transport's
+# concurrent connections on this box — the transports' architectural
+# ceilings ARE the result), and (b) edge-tree vs flat merge wall-clock at
+# W=256 through real served sessions. Off by default (opens thousands of
+# loopback sockets and raises RLIMIT_NOFILE to its hard cap);
+# BENCH_SCALE=1 enables, BENCH_SCALE_CONNS caps the connection ramp,
+# BENCH_SCALE_ROUNDS sizes the edge arm.
+SCALE_BENCH = os.environ.get("BENCH_SCALE", "0") == "1"
+SCALE_CONNS = int(os.environ.get("BENCH_SCALE_CONNS", 2048))
+SCALE_ROUNDS = int(os.environ.get("BENCH_SCALE_ROUNDS", 3))
 # Mesh scaling section: time the SPMD sharded round (engine.
 # make_sharded_round_step — per-device partial sketch + one table merge)
 # at the same global cohort across 1, 2, 4, ... visible devices, and record
@@ -1570,6 +1582,229 @@ def _byzantine_bench() -> dict:
     return out
 
 
+def _scale_bench() -> dict:
+    """C1M scale-out measurements (serve/scale/): transport concurrency
+    ramp (threaded vs event-loop) and edge-tree vs flat merge wall-clock
+    at W=256. Never raises; {"skipped": ...} when the deps are missing."""
+    import json as _json
+    import resource
+    import socket as _socket
+    import time as _time
+
+    import numpy as np
+
+    try:
+        from commefficient_tpu.serve.ingest import IngestQueue
+        from commefficient_tpu.serve.scale.eventloop import EventLoopTransport
+        from commefficient_tpu.serve.transport import SocketTransport
+    except Exception as e:  # noqa: BLE001 — the skipped stanza IS the result
+        return {"skipped": f"scale deps unavailable: {type(e).__name__}: {e}"}
+
+    out: dict = {}
+    # loopback concurrency needs fds: raise the soft limit to the hard cap
+    # (each held connection is ~2 fds in-process: server side + client side)
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    # RLIM_INFINITY is -1: normalize both limbs before comparing/arithmetic
+    # (an "unlimited" container must not read as a 64-conn ceiling)
+    big = 1 << 20
+    soft_n = big if soft == resource.RLIM_INFINITY else soft
+    hard_n = big if hard == resource.RLIM_INFINITY else hard
+    if soft_n < hard_n:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+        soft_n = hard_n
+    max_conns = min(SCALE_CONNS, max((soft_n - 256) // 2, 64))
+    out["fd_limit"] = soft_n
+
+    def ramp(transport_factory, label: str) -> dict:
+        levels, results = [], {}
+        c = 64
+        while c <= max_conns:
+            levels.append(c)
+            c *= 2
+        max_sustained, best_rate = 0, 0.0
+        for level in levels:
+            q = IngestQueue(capacity=max(level * 2, 1024))
+            t = transport_factory(q)
+            t.start()
+            socks, ok = [], True
+            try:
+                q.open_round(0, list(range(level)))
+                for _ in range(level):
+                    try:
+                        socks.append(_socket.create_connection(
+                            t.address, timeout=5.0))
+                    except OSError:
+                        ok = False
+                        break
+                if ok:
+                    t0 = _time.perf_counter()
+                    for i, s in enumerate(socks):
+                        try:
+                            s.sendall(_json.dumps(
+                                {"client_id": i, "round": 0,
+                                 "latency_s": 0.1}).encode() + b"\n")
+                        except OSError:
+                            ok = False
+                    got = 0
+                    for s in socks:
+                        try:
+                            s.settimeout(30.0)
+                            buf = b""
+                            while b"\n" not in buf:
+                                chunk = s.recv(4096)
+                                if not chunk:
+                                    break
+                                buf += chunk
+                            if b"ACCEPTED" in buf:
+                                got += 1
+                        except OSError:
+                            pass
+                    wall = _time.perf_counter() - t0
+                    rate = round(got / max(wall, 1e-9), 1)
+                    results[str(level)] = {
+                        "held": len(socks), "accepted": got,
+                        "submissions_per_sec": rate,
+                    }
+                    if got == level:
+                        max_sustained = level
+                        best_rate = max(best_rate, rate)
+                    else:
+                        break
+                else:
+                    results[str(level)] = {"held": len(socks),
+                                           "accepted": 0,
+                                           "submissions_per_sec": 0.0}
+                    break
+            finally:
+                for s in socks:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                t.stop()
+                q.shutdown()
+        return {"levels": results, "max_sustained_conns": max_sustained,
+                "best_submissions_per_sec": best_rate, "label": label}
+
+    try:
+        threaded = ramp(lambda q: SocketTransport(q, read_deadline_s=60.0),
+                        "threaded (1 thread/conn, capped)")
+        eventloop = ramp(
+            lambda q: EventLoopTransport(q, read_deadline_s=60.0),
+            "eventloop (1 reactor thread)")
+        ratio = (eventloop["max_sustained_conns"]
+                 / max(threaded["max_sustained_conns"], 1))
+        out["transport_concurrency"] = {
+            "threaded": threaded, "eventloop": eventloop,
+            "eventloop_over_threaded": round(ratio, 2),
+            # the acceptance bar: the reactor holds >= 10x the threaded
+            # transport's concurrent connections on this box
+            "meets_10x": bool(ratio >= 10.0),
+        }
+    except Exception as e:  # noqa: BLE001 — degrade per sub-arm
+        out["transport_concurrency"] = {
+            "skipped": f"{type(e).__name__}: {e}"}
+
+    # (b) edge-tree vs flat merge wall-clock at W=256: real served payload
+    # sessions over a small quadratic model (the arm measures the MERGE
+    # topology, not the model) — same cohort, same trace, edges=8 vs flat
+    try:
+        import collections as _collections
+
+        import jax
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+
+        from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+        from commefficient_tpu.federated.api import FederatedSession
+        from commefficient_tpu.modes.config import ModeConfig
+        from commefficient_tpu.serve.service import (
+            AggregationService, ServeConfig)
+        from commefficient_tpu.serve.traffic import (
+            TraceConfig, TrafficGenerator)
+
+        W = 256
+
+        def quad_loss(params, net_state, batch, rng):
+            pred = batch["x"] @ params["w"] + params["b"]
+            err = pred - jax.nn.one_hot(batch["y"], pred.shape[-1])
+            mask = batch["mask"]
+            per_ex = (err ** 2).sum(-1)
+            return (per_ex * mask).sum() / jnp.maximum(mask.sum(), 1.0), {
+                "net_state": net_state,
+                "metrics": {"loss_sum": (per_ex * mask).sum(),
+                            "count": mask.sum()}}
+
+        def build(serve_edges):
+            rs = np.random.RandomState(0)
+            x = rs.randn(2048, 8).astype(np.float32)
+            y = rs.randint(0, 4, size=2048).astype(np.int32)
+            train = FedDataset(
+                x, y, shard_iid(len(x), 512, np.random.RandomState(1)))
+            params = {"w": jnp.asarray(
+                rs.randn(8, 4).astype(np.float32) * 0.1),
+                "b": jnp.zeros(4)}
+            d = ravel_pytree(params)[0].size
+            mc = ModeConfig(mode="sketch", d=d, k=8, num_rows=3,
+                            num_cols=16, momentum_type="virtual",
+                            error_type="virtual")
+            return FederatedSession(
+                train_loss_fn=quad_loss, eval_loss_fn=quad_loss,
+                params=params, net_state={}, mode_cfg=mc, train_set=train,
+                num_workers=W, local_batch_size=4, seed=0,
+                wire_payloads=True, serve_edges=serve_edges)
+
+        def run(serve_edges, edges):
+            session = build(serve_edges)
+            cfg = ServeConfig(quorum=W * 3 // 4, transport="inproc",
+                              payload="sketch", edges=edges)
+            svc = AggregationService(
+                session, cfg,
+                traffic=TrafficGenerator(
+                    TraceConfig(population=512, seed=9))).start()
+            try:
+                src = svc.source()
+                # one warmup (compiles), then timed rounds
+                prep = src.next()
+                session.commit_round(session.dispatch_round(prep, 0.05))
+                src.on_dispatched(session.round - 1)
+                src.on_committed(session.round)
+                t0 = _time.perf_counter()
+                for _ in range(SCALE_ROUNDS):
+                    prep = src.next()
+                    session.commit_round(
+                        session.dispatch_round(prep, 0.05))
+                    src.on_dispatched(session.round - 1)
+                    src.on_committed(session.round)
+                wall = _time.perf_counter() - t0
+                src.stop()
+                with session.mutate_lock:
+                    rng_state, rng_key = session.rng_snapshot
+                    session.rng.set_state(rng_state)
+                    session._rng_key = rng_key
+                    session._requeue = _collections.deque(
+                        session._requeue_committed)
+                    session._requeue_enqueued = dict(
+                        session._requeue_ages_committed)
+            finally:
+                svc.close()
+            return {"rounds": SCALE_ROUNDS,
+                    "round_ms": round(wall / SCALE_ROUNDS * 1e3, 2),
+                    "rounds_per_sec": round(SCALE_ROUNDS / wall, 3)}
+
+        flat = run(8, 0)     # grouped program, no tree (the parity twin)
+        tree = run(8, 8)     # the 8-edge two-tier topology
+        out["edge_vs_flat"] = {
+            "cohort": W, "edges": 8,
+            "flat": flat, "edge_tree": tree,
+            "edge_over_flat_round_ms": round(
+                tree["round_ms"] / max(flat["round_ms"], 1e-9), 3),
+        }
+    except Exception as e:  # noqa: BLE001 — degrade per sub-arm
+        out["edge_vs_flat"] = {"skipped": f"{type(e).__name__}: {e}"}
+    return out
+
+
 def _serve_bench() -> dict:
     """Streaming-aggregation service measurements (see the SERVE_BENCH
     comment). Never raises; {"skipped": ...} when the serving deps are
@@ -2129,6 +2364,18 @@ def run_bench(platform: str) -> dict:
             result["serve"] = {
                 "skipped": "serve section measures the flagship resnet9 "
                            "workload (BENCH_MODEL=resnet9)"}
+    if SCALE_BENCH:
+        _stage("scale (transport concurrency ramp + edge-tree vs flat "
+               "merge wall-clock at W=256) ...")
+        result["scale"] = _scale_bench()
+        _stage(f"scale: {result['scale']}")
+    else:
+        result["scale"] = {
+            "skipped": "gated off (BENCH_SCALE=0 default — opens thousands "
+                       "of loopback sockets and raises RLIMIT_NOFILE); set "
+                       "BENCH_SCALE=1 [+ BENCH_SCALE_CONNS/_ROUNDS] to run "
+                       "the threaded-vs-eventloop concurrency ramp and the "
+                       "edge-tree vs flat merge arm"}
     if BYZANTINE_BENCH:
         if BENCH_MODEL == "resnet9":
             _stage("byzantine (attack kind x merge policy accuracy + "

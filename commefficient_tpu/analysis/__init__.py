@@ -99,6 +99,7 @@ from .rules_io import RawCheckpointWrite
 from .rules_ledger import LedgerWriteOutsideCommit
 from .rules_obs import ObsCallInCompiledScope
 from .rules_parity import ReservedLeafAccess, UnorderedReduction
+from .rules_reactor import BlockingCallInEventLoop
 from .rules_robust import (RobustOrderSensitivity,
                            StalenessFoldBoundary)
 from .rules_sketch import FlatRavelInRoundPath
@@ -120,6 +121,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     RobustOrderSensitivity,
     StalenessFoldBoundary,
     LedgerWriteOutsideCommit,
+    BlockingCallInEventLoop,
 )
 
 RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)

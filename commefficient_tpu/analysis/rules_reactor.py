@@ -1,0 +1,71 @@
+"""G015 blocking-call-in-event-loop.
+
+The serve/scale event loop's whole promise is that ONE thread multiplexes
+every connection — which means one blocking call anywhere on the reactor's
+dispatch path blocks EVERY connection at once (the failure mode is worse
+than the threaded transport's, where a blocked handler costs one peer).
+This rule extends the G007 reachability machinery (rules_sync.py): from the
+reactor's loop root (`_loop`) it walks same-module calls and package-level
+import bindings, and fires on
+
+- the G007 blocking set (time.sleep / os.system / open / subprocess.* /
+  socket.create_connection), AND
+- the SOCKET-OP set — `.recv()` / `.recv_into()` / `.accept()` /
+  `.sendall()` / `.send()` / `.connect()` / `.makefile()` / `select.select`
+  — anywhere OUTSIDE a declared sanctioned seam: the reactor touches
+  sockets only through its non-blocking I/O helpers, each carrying
+  `# graftlint: drain-point` (the same in-code seam declaration G001/G007
+  use). `sendall` on a non-blocking socket can still spin-block on a slow
+  reader; the reactor's `_flush_out` seam uses `send` + an out-buffer,
+  which is why even `send` must live behind the declared seam.
+
+A sleep (or a blocking recv, or file IO) smuggled into a helper the loop
+calls is exactly the regression this guards: the reactor looks idle, every
+connection times out, and the admission path stalls wholesale.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE, SourceFile
+from .rules_sync import BlockingCallOnDispatchThread
+
+# socket-level attribute calls the reactor may only make inside its
+# declared seams: on the event loop, even a "non-blocking" socket op is a
+# policy decision (send can spin, recv on a blocking-mode socket parks the
+# whole loop), so every one of them must be an explicit, reviewed seam
+_SOCKET_OPS = ("recv", "recv_into", "accept", "sendall", "send", "connect",
+               "makefile")
+
+
+class BlockingCallInEventLoop(BlockingCallOnDispatchThread):
+    code = "G015"
+    name = "blocking-call-in-event-loop"
+    fixit = ("the reactor's only sanctioned waits are the selector poll "
+             "and the non-blocking I/O helpers, each declared `# graftlint: "
+             "drain-point`; move blocking work off the reactor thread (the "
+             "queue's own locks are the one sanctioned cross-thread seam)")
+
+    SCOPE = (f"{PACKAGE}/serve/scale/",)
+    EXEMPT = ()
+    # the reactor's dispatch-loop roots: everything reachable from the
+    # loop body runs with every connection's latency on the line
+    ROOTS = {"_loop"}
+
+    def _blocking(self, src: SourceFile, node: ast.Call) -> str | None:
+        # the full G007 blocking set first (sleep/open/subprocess/...)
+        msg = super()._blocking(src, node)
+        if msg:
+            return msg
+        dotted = src.resolve_dotted(node.func)
+        if dotted == "select.select":
+            return ("select.select() outside the reactor's declared "
+                    "selector seam — the loop's one wait is the declared "
+                    "poll, not ad-hoc selects")
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SOCKET_OPS):
+            return (f".{node.func.attr}() on the event loop outside a "
+                    "declared non-blocking I/O seam — one blocking socket "
+                    "op parks EVERY connection at once")
+        return None

@@ -136,6 +136,9 @@ class BlockingCallOnDispatchThread(Rule):
     SCOPE = (f"{PACKAGE}/runner/", f"{PACKAGE}/serve/")
     # the async writer runs on its own dedicated thread by design
     EXEMPT = (f"{PACKAGE}/runner/writer.py",)
+    # overridable per subclass: G015 (rules_reactor.py) reuses this whole
+    # reachability machine with the event loop's own roots
+    ROOTS = _ROOT_NAMES
 
     def __init__(self) -> None:
         # per-analyzer-run cache of parsed helper modules (abspath ->
@@ -306,7 +309,7 @@ class BlockingCallOnDispatchThread(Rule):
                 edges.setdefault(caller, set()).update(
                     nested or by_last[callee])
         roots = {f.qualname for f in src.functions
-                 if f.qualname.rsplit(".", 1)[-1] in _ROOT_NAMES}
+                 if f.qualname.rsplit(".", 1)[-1] in self.ROOTS}
         seen = set(roots)
         frontier = list(roots)
         while frontier:

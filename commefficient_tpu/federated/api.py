@@ -149,6 +149,7 @@ class FederatedSession:
         robust_residual: bool = False,
         health_every: int = 0,
         ledger_fingerprint: bool = False,
+        serve_edges: int = 0,
     ):
         # client_shards: 0 = derive from the mesh (the default — on a >1-
         # device mesh with a mode in engine.supports_sharded_round's scope
@@ -191,6 +192,12 @@ class FederatedSession:
             # error-feedback-aware robust merges (--robust_residual): the
             # winsorized robust-vs-mean residual accumulates into Verror
             robust_residual=robust_residual,
+            # two-tier edge-aggregation serving (--serve_edges >= 2,
+            # serve/scale/): compiles the grouped-flat + partials-root
+            # edge merge variants beside the plain program (linear merge
+            # only; the robust policies run the tree in forward mode with
+            # serve_edges=0 here — see EngineConfig)
+            serve_edges=serve_edges,
             # sketch-health estimators (--health_every N > 0) and round-
             # ledger fingerprints (--ledger): in-program observability that
             # only READS round state — armed runs stay bit-identical to
@@ -423,6 +430,8 @@ class FederatedSession:
         self._payload_client = None
         self._payload_merge = None
         self._payload_merge_stale = None
+        self._payload_merge_edge_flat = None
+        self._payload_merge_edge_root = None
         if self._table_round:
             # the per-client-table two-program round: client tables + table
             # merge (engine.make_payload_round_steps). The batch simulator
@@ -455,6 +464,27 @@ class FederatedSession:
                     stale_slots=self.cfg.stale_slots)
                 self._payload_merge_stale = jax.jit(
                     merge_s, donate_argnums=self._state_donation())
+            if self.cfg.serve_edges >= 2:
+                # the two-tier edge-aggregation variants (serve/scale/):
+                # the GROUPED flat program (full stack, per-edge scan
+                # grouping — the flat-serving parity twin) and the
+                # PARTIALS root program (edge-forwarded [E, r, c] stack).
+                # jit is lazy, so they cost nothing until the serving
+                # layer actually dispatches one.
+                _, merge_ef = engine.make_payload_round_steps(
+                    train_loss_fn, self.cfg,
+                    self.mesh if self._spmd and self.mesh is not None
+                    else None,
+                    allow_batch_tables=True, edge_input="tables")
+                _, merge_er = engine.make_payload_round_steps(
+                    train_loss_fn, self.cfg,
+                    self.mesh if self._spmd and self.mesh is not None
+                    else None,
+                    allow_batch_tables=True, edge_input="partials")
+                self._payload_merge_edge_flat = jax.jit(
+                    merge_ef, donate_argnums=self._state_donation())
+                self._payload_merge_edge_root = jax.jit(
+                    merge_er, donate_argnums=self._state_donation())
             self._step = engine.compose_payload(
                 self._payload_client, self._payload_merge)
         elif split_compile:
@@ -881,7 +911,7 @@ class FederatedSession:
 
     def finish_served_payload(self, prep: PreparedRound, arrived,
                               wire_tables, aux,
-                              stale=None) -> PreparedRound:
+                              stale=None, edge=None) -> PreparedRound:
         """Post-close bookkeeping of a served payload round: every invitee
         whose payload missed the merge (no-show, straggler, or a rejected
         frame) gets the client_drop treatment — counted as masked and
@@ -916,13 +946,18 @@ class FederatedSession:
                 "finish_served_payload got a stale-fold stack but the "
                 "session was built with stale_slots=0 — arm stale_slots "
                 "(--serve_async wires it) or drop the stale entries")
+        if edge is not None and self._payload_merge_edge_flat is None:
+            raise ValueError(
+                "finish_served_payload got an edge-tree block but the "
+                "session was built with serve_edges=0 — arm serve_edges "
+                "(--serve_edges wires it) or drop the edge routing")
         return dataclasses.replace(
             prep, masked=masked, requeue_depth=len(self._requeue),
             requeue=tuple(self._requeue),
             requeue_ages=tuple(self._requeue_enqueued.items()),
             # the gauntlet's validated table stack is host numpy already
             payload=(np.asarray(wire_tables, np.float32), arrived, aux,  # graftlint: disable=G001
-                     stale),
+                     stale, edge),
         )
 
     def _dispatch_payload_merge(self, prep: PreparedRound,
@@ -935,17 +970,32 @@ class FederatedSession:
         stale-slots merge variant; every other round — including every
         round of an async run where nobody was late — dispatches the plain
         program, the async==sync bit-identity's load-bearing routing."""
-        wire_tables, arrived, aux, stale = (
-            prep.payload if len(prep.payload) == 4
-            else (*prep.payload, None))
+        payload = prep.payload
+        if len(payload) < 5:
+            payload = payload + (None,) * (5 - len(payload))
+        wire_tables, arrived, aux, stale, edge = payload
         state, nstates, mvals, part, noise_rng, lnorms = aux
         merge, extra = self._payload_merge, ()
+        kw = ({"health_on": jnp.float32(1.0 if prep.health_on else 0.0)}
+              if self.cfg.health else {})
         if stale is not None:
             merge = self._payload_merge_stale
             extra = (jnp.asarray(stale[0], jnp.float32),
                      jnp.asarray(stale[1], jnp.float32))
-        kw = ({"health_on": jnp.float32(1.0 if prep.health_on else 0.0)}
-              if self.cfg.health else {})
+        elif edge is not None:
+            # the edge-tree round (serve/scale/edge.py): the root program
+            # over forwarded [E, r, c] partials when the tree ran, the
+            # grouped flat twin over the full stack otherwise — SAME
+            # downstream arithmetic on the same inputs (the wire-formula
+            # norms + hash assignment the serving layer computed), which
+            # is the edge == flat bitwise pin
+            if edge.get("partials") is not None:
+                merge = self._payload_merge_edge_root
+                wire_tables = edge["partials"]
+            else:
+                merge = self._payload_merge_edge_flat
+            kw["norms_wire"] = jnp.asarray(edge["norms"], jnp.float32)
+            kw["edge_assign"] = jnp.asarray(edge["assign"], jnp.int32)
         with self._mesh_ctx():
             new_state, metrics = merge(
                 state, jnp.asarray(wire_tables), nstates, mvals, part,

@@ -225,6 +225,32 @@ class EngineConfig:
     # bit-identical (params + every logged row) to a disabled one.
     # mode=sketch only (the quantities are sketch-wire quantities).
     health: bool = False
+    # Two-tier edge-aggregation serving (--serve_edges, serve/scale/): >= 2
+    # arms the EDGE-TREE merge variants of the wire-payload round. The
+    # serving topology hash-partitions each round's cohort over E edge
+    # aggregators; each edge ordered-sums its shard's validated tables and
+    # forwards ONE r x c partial to the root, which folds the partials in
+    # FIXED edge order (modes.merge_edge_partials). Two sibling merge
+    # programs compile beside the plain one:
+    #   - the GROUPED flat program (full [W, r, c] stack in, reduction
+    #     restructured as the same per-edge grouping — the flat-serving
+    #     reference the edge path is pinned bitwise against), and
+    #   - the PARTIALS root program ([E, r, c] edge partials in, plus the
+    #     per-client metadata the screens need — the wire-side L2 norms the
+    #     edges forward — everything downstream identical code on identical
+    #     values).
+    # Both take the per-client table norms as an INPUT (computed once, by
+    # the shared wire-formula helper, partition-invariantly per client)
+    # instead of in-program, so the quarantine screen/ring can never
+    # diverge between the two. The grouping (and the input norms) is a
+    # different fp association than the plain program — an edge-armed
+    # session differs from serve_edges=0 in last bits (MIGRATION.md);
+    # edge-armed flat vs edge-armed tree is the bitwise pin. Robust merge
+    # policies need per-client tables and never compile edge variants: the
+    # serving tree then FORWARDS per-client tables (bandwidth trade-off
+    # documented in the README) and dispatches the plain robust program.
+    # 0/1 = off: every compiled program is byte-identical to before.
+    serve_edges: int = 0
     # Round-ledger fingerprints (--ledger, obs/ledger.py): True adds
     # order-fixed fp fingerprints of the round's committed params and
     # optimizer state to every round's metrics under the reserved
@@ -357,6 +383,41 @@ class EngineConfig:
                 "no per-client table wire to arrive late — arm "
                 "--serve_payload sketch"
             )
+        if self.serve_edges < 0:
+            raise ValueError(
+                f"serve_edges must be >= 0, got {self.serve_edges}")
+        if self.serve_edges >= 2:
+            if not self.wire_payloads:
+                raise ValueError(
+                    "serve_edges (--serve_edges) is the two-tier edge-"
+                    "aggregation topology over WIRE tables; without "
+                    "wire_payloads there is no per-client table for an edge "
+                    "to sum — arm --serve_payload sketch"
+                )
+            if robust_policy(self) is not None:
+                raise ValueError(
+                    f"serve_edges={self.serve_edges} with merge_policy="
+                    f"{self.merge_policy!r}: a robust merge runs order "
+                    "statistics over PER-CLIENT tables, which a pre-summed "
+                    "edge partial has destroyed — the serving tree forwards "
+                    "per-client tables instead (set serve_edges=0 on the "
+                    "session; serve/scale/edge.py runs the tree in forward "
+                    "mode against the plain robust program)"
+                )
+            if self.stale_slots > 0:
+                raise ValueError(
+                    "serve_edges does not compose with the buffered-async "
+                    "stale fold yet (a stale table's edge assignment is a "
+                    "cross-round question the tree does not answer) — drop "
+                    "--serve_async or --serve_edges"
+                )
+            if self.quarantine_scope == "layer":
+                raise ValueError(
+                    "serve_edges with quarantine_scope='layer' is not "
+                    "supported: the per-leaf median rings are root state "
+                    "the edges cannot screen against — use the cohort "
+                    "scope (the wire-side L2 screen still runs per edge)"
+                )
         if self.robust_residual and robust_policy(self) is None:
             raise ValueError(
                 "robust_residual is the robust merge's error-feedback "
@@ -2401,6 +2462,7 @@ def _stale_fold(table, live_weight, stale_tables, stale_weights):
 def make_payload_round_steps(
     loss_fn: Callable, cfg: EngineConfig, mesh=None, *,
     allow_batch_tables: bool = False, stale_slots: int = 0,
+    edge_input: str = "none",
 ) -> tuple[Callable, Callable]:
     """The wire-payload round (cfg.wire_payloads) as TWO jittable programs —
     the shape a serving deployment actually has:
@@ -2462,6 +2524,28 @@ def make_payload_round_steps(
             "robust merge_policy, or allow_batch_tables=True (the announce "
             "path compiles make_round_step and friends)"
         )
+    # edge-tree merge variants (--serve_edges, serve/scale/edge.py):
+    #   "tables"   — the GROUPED flat program: full [W, r, c] stack in, the
+    #                reduction restructured as per-edge scan folds over the
+    #                edge_assign partition (the flat-serving parity twin);
+    #   "partials" — the ROOT program: [E, r, c] edge partials in, folded
+    #                in fixed edge order; everything downstream identical.
+    # Both take the per-client wire norms as an input (norms_wire) so the
+    # quarantine arithmetic is shared, value-for-value, with the edges.
+    if edge_input not in ("none", "tables", "partials"):
+        raise ValueError(
+            f"edge_input must be none|tables|partials, got {edge_input!r}")
+    if edge_input != "none":
+        if cfg.serve_edges < 2:
+            raise ValueError(
+                f"edge_input={edge_input!r} needs cfg.serve_edges >= 2, "
+                f"got {cfg.serve_edges} (the edge partition size is part "
+                "of the compiled program)")
+        if stale_slots:
+            raise ValueError(
+                "edge merge variants do not compose with stale_slots "
+                "(EngineConfig already rejects serve_edges + async)")
+    n_edges = cfg.serve_edges if edge_input != "none" else 0
     _sharded_scope_check(mcfg)
     if mcfg.mode != "sketch":
         raise ValueError(
@@ -2587,7 +2671,8 @@ def make_payload_round_steps(
 
     def merge_step(state, tables, nstates, mvals, part, arrived, lr,
                    noise_rng, lnorms=None, stale_tables=None,
-                   stale_weights=None, health_on=None):
+                   stale_weights=None, norms_wire=None, edge_assign=None,
+                   health_on=None):
         """The server side: the cfg.merge_policy reduction of the
         (wire-delivered) per-client tables. `part` is the client program's
         validity mask, `arrived` the serving layer's 0/1 admission mask
@@ -2620,7 +2705,14 @@ def make_payload_round_steps(
         norms = None
         qmed = state["quarantine"]["median"] if quarantine else None
         if quarantine:
-            norms = _table_norms(tables)
+            # edge variants take the per-client wire norms as an INPUT
+            # (computed once by serve/scale/edge.py's shared host formula,
+            # partition-invariantly per client) so the screen — and the
+            # ring it advances — can never diverge between the grouped
+            # flat program and the partials root program; the plain
+            # program keeps computing them in-program from the stack
+            norms = (norms_wire if edge_input != "none"
+                     else _table_norms(tables))
             bad = _quarantine_mask(cfg, norms, qmed)
             if layer_q:
                 bad = bad | _quarantine_layer_mask(
@@ -2648,8 +2740,19 @@ def make_payload_round_steps(
             # sum entry point the sharded mesh round uses (client-index
             # order). merge_policy="trimmed" with trim=0 compiles THIS
             # branch — the k=0 == sum bit-identity by construction.
-            masked = modes.mask_rows(part_eff, tables)
-            wire_sum = modes.merge_partial_wires(mcfg, {"table": masked})
+            if edge_input == "partials":
+                # the edge-tree ROOT: `tables` is the [E, r, c] stack of
+                # edge-forwarded partials; the fold is the one declared
+                # edge-partial merge entry, fixed edge order
+                wire_sum = {"table": modes.merge_edge_partials(tables)}
+            elif edge_input == "tables":
+                # the edge-armed FLAT twin: same two-level fold, computed
+                # in-program over the full stack and the same partition
+                wire_sum = {"table": modes.edge_grouped_sum(
+                    tables, part_eff, edge_assign, n_edges)}
+            else:
+                masked = modes.mask_rows(part_eff, tables)
+                wire_sum = modes.merge_partial_wires(mcfg, {"table": masked})
             total_w = part_eff.sum()
             if stale_slots:
                 # buffered-async: the late tables' ordered weighted fold

@@ -432,6 +432,71 @@ def merge_partial_wires(cfg: ModeConfig, stacked: dict, *,
     return {"dense": stacked["dense"].sum(axis=0)}
 
 
+# ------------------------------------------------------- edge-tree merge
+
+
+def edge_grouped_sum(tables: jnp.ndarray, live: jnp.ndarray,
+                     assign: jnp.ndarray, n_edges: int) -> jnp.ndarray:
+    """The two-tier (edge-tree) table reduction over the full [W, r, c]
+    client stack: per-EDGE partials accumulated in cohort-position order,
+    then the partials folded in FIXED edge-index order through
+    `merge_edge_partials` — the exact arithmetic the scale-out serving
+    topology performs when each edge aggregator sums its shard's tables
+    and forwards ONE r x c partial to the root (serve/scale/edge.py).
+
+    Both levels are EXPLICIT sequential folds (lax.scan — XLA honors scan's
+    loop-carried order, unlike a `.sum(axis=0)` reduce whose association is
+    the compiler's), and the per-client contribution is `where(live > 0,
+    table, 0)` — a select, not a multiply, so no FMA contraction can round
+    differently between this in-program grouping and an edge aggregator's
+    own shard-local fold. That is what pins the edge-tree serving path
+    BITWISE equal to the flat serving path over the same surviving cohort:
+    the flat path runs THIS grouping over the full stack, the edge path
+    folds edge-computed partials whose per-lane add sequence is identical
+    (tests/test_scale.py). The grouping is a different fp association than
+    the plain `merge_tables` ordered sum, so an edge-armed session differs
+    from an unarmed one in last bits (MIGRATION.md)."""
+    if tables.ndim < 1 or n_edges < 1:
+        raise ValueError(
+            f"edge_grouped_sum needs a [W, ...] stack and n_edges >= 1, "
+            f"got shape {tables.shape}, n_edges={n_edges}")
+    zero = jnp.zeros((n_edges,) + tables.shape[1:], tables.dtype)
+
+    def fold_client(acc, x):
+        t, m, e = x
+        # select (never multiply): a dead row contributes an exact zero —
+        # NaN-safe like mask_rows, and add-only so the per-lane sequence
+        # is pure fp adds an edge's own fold reproduces bit-for-bit
+        contrib = jnp.where(m > 0, t, jnp.zeros_like(t))
+        return acc.at[e].add(contrib), None
+
+    partials, _ = jax.lax.scan(
+        fold_client, zero,
+        (tables, live.astype(tables.dtype), assign.astype(jnp.int32)))
+    return merge_edge_partials(partials)
+
+
+def merge_edge_partials(partials: jnp.ndarray) -> jnp.ndarray:
+    """THE edge-partial merge entry: fold the [E, r, c] per-edge partial
+    tables into one [r, c] table in FIXED edge-index order (an explicit
+    lax.scan left fold — sketch linearity makes the tree merge exact, the
+    pinned order makes it deterministic). Shared by the edge-armed flat
+    merge program (after its in-program per-edge grouping) and the
+    edge-tree root program (over wire-forwarded partials): same code, same
+    association — the root of the edge == flat bitwise pin. A dead edge's
+    partial is an exact zero row, which folds transparently — an edge
+    dying IS its shard's clients dropped."""
+    if partials.ndim < 1:
+        raise ValueError(f"expected [E, ...] partials, got {partials.shape}")
+
+    def fold_edge(acc, p):
+        return acc + p, None
+
+    out, _ = jax.lax.scan(
+        fold_edge, jnp.zeros(partials.shape[1:], partials.dtype), partials)
+    return out
+
+
 # ------------------------------------------------------------- server side
 
 

@@ -175,6 +175,11 @@ KINDS = {
     "client_stale_poison": ("clients", "factor"),  # ADAPTIVE: withhold,
     #                                           then submit factor*table
     #                                           into the async stale band
+    # edge-tier site (two-tier serving, serve/scale/edge.py): kill edge
+    # aggregator(s) for the scheduled round — their whole hash-shard of
+    # the cohort forwards nothing (edge death == shard dropped, bitwise,
+    # with the requeue machinery re-serving the clients)
+    "edge_kill": ("edges",),
 }
 
 # the client_* sites fire inside a round's preparation: scheduled at or past
@@ -200,6 +205,11 @@ ADVERSARIAL_KINDS = ("client_signflip", "client_scale", "client_collude",
 # plus validate_stale_context — on a run with no stale band the plan would
 # pass vacuously with zero injections
 STALE_POISON_KINDS = ("client_stale_poison",)
+
+# edge_kill fires at the edge-aggregation tier of the two-tier serving
+# topology (--serve_edges >= 2): same dead-schedule validation, plus
+# validate_edge_context — with no edge tree there is nothing to kill
+EDGE_KINDS = ("edge_kill",)
 
 
 class InjectedFault(RuntimeError):
@@ -306,6 +316,14 @@ def _parse_entry(entry: str) -> FaultSpec:
                             "majority defeats every robust merge by "
                             "definition)")
                     params[k] = f
+                elif k == "edges":
+                    # "+"-separated edge indices, like clients= positions
+                    pos = tuple(int(p) for p in v.split("+") if p.strip())
+                    if not pos or any(p < 0 for p in pos):
+                        raise ValueError(
+                            "expected '+'-separated non-negative edge "
+                            "indices")
+                    params[k] = pos
                 elif k == "value":
                     allowed = (("nan", "inf", "big") if kind == "client_poison"
                                else ("nan", "inf"))
@@ -318,6 +336,10 @@ def _parse_entry(entry: str) -> FaultSpec:
                     f"bad value {v!r} for param {k!r} in --fault_plan entry "
                     f"{entry!r} ({e})"
                 ) from None
+    if kind == "edge_kill" and "edges" not in params:
+        raise ValueError(
+            f"fault kind 'edge_kill' needs edges=<i>[+<j>...] in "
+            f"--fault_plan entry {entry!r} (which edge aggregator dies)")
     return FaultSpec(kind=kind, rounds=rounds, params=params)
 
 
@@ -380,7 +402,7 @@ class FaultPlan:
         vacuously."""
         for s in self.specs:
             if (s.kind in (CLIENT_KINDS + WIRE_KINDS + ADVERSARIAL_KINDS
-                           + STALE_POISON_KINDS)
+                           + STALE_POISON_KINDS + EDGE_KINDS)
                     or s.kind == "host_preempt") and s.rounds:
                 dead = [r for r in s.rounds if r >= total_rounds]
                 if dead:
@@ -451,6 +473,49 @@ class FaultPlan:
                 "buffered-async stale band and needs --serve_async with "
                 "--serve_payload sketch; on this run the chaos plan would "
                 "pass vacuously")
+
+    def validate_edge_context(self, edge_tree_armed: bool,
+                              n_edges: int = 0) -> None:
+        """Launch-time context validation for edge_kill: it kills edge
+        aggregators of the two-tier serving topology (--serve_edges >= 2),
+        so a plan naming it on a flat run would pass vacuously; an edge
+        index past the tree's size could never fire either."""
+        specs = [s for s in self.specs if s.kind in EDGE_KINDS]
+        if not specs:
+            return
+        if not edge_tree_armed:
+            raise ValueError(
+                "--fault_plan: edge_kill can never fire — it kills edge "
+                "aggregators of the two-tier serving topology and needs "
+                "--serve_edges >= 2 with --serve_payload sketch; on this "
+                "run the chaos plan would pass vacuously")
+        for s in specs:
+            dead = [e for e in s.params.get("edges", ()) if e >= n_edges]
+            if dead:
+                raise ValueError(
+                    f"--fault_plan: edge_kill:edges="
+                    f"{'+'.join(map(str, dead))} can never fire — the "
+                    f"tree has {n_edges} edge(s) (0-based indices)")
+
+    def has_edge_kill(self) -> bool:
+        return any(s.kind in EDGE_KINDS for s in self.specs)
+
+    def edge_kill_plan(self, rnd: int) -> tuple:
+        """Edge indices scheduled to die at round `rnd` — DETERMINISTIC
+        per round (a re-served round after a rewind must kill the same
+        edges, exactly like the client_* sites replay): an edge is dead
+        for THAT round's serving and revives for the next, so a kill
+        costs its shard one round, like client_drop costs a client one.
+        Each kill is an obs instant + the per-kind counter."""
+        out: list[int] = []
+        for s in self.specs_for("edge_kill", rnd):
+            edges = [int(e) for e in s.params["edges"]]
+            out.extend(edges)
+            self._mark("edge_kill", rnd, edges=edges)
+            obreg.default().counter(
+                "resilience_fault_edge_kill_total").inc()
+            self._log(f"edge_kill: edge(s) {edges} die at round {rnd}")
+        return tuple(sorted(set(out)))
 
     def _log(self, msg: str):
         print(f"fault-injection: {msg}", file=sys.stderr, flush=True)

@@ -35,12 +35,19 @@ that inversion over the existing engine/runner machinery:
   `runner.run_loop(source=...)` instead of the loop pulling clients;
   `--serve_async` is the buffered FedBuff-shaped mode (buffer-size
   trigger closes, staleness-weighted folds of late tables)
+- `scale`     — the C1M scale-out subsystem: `eventloop` (selectors
+  reactor replacing thread-per-connection — `--serve_transport
+  eventloop`), `shard` (N hash-routed ingest reactors over one admission
+  queue — `--serve_shards`), `edge` (two-tier edge aggregation: shard-
+  local ordered table sums forwarded as one r x c partial per edge,
+  pinned bitwise == the flat merge — `--serve_edges`)
 
 Both CLIs expose it as `--serve {inproc,socket}` (+ `--serve_quorum`,
 `--serve_deadline`, `--serve_trace`, `--serve_metrics_port`,
 `--serve_payload {announce,sketch}`, `--serve_shed_watermark`,
 `--serve_pipeline`, `--serve_async` + `--serve_buffer` /
-`--serve_staleness` / `--serve_stale_rounds`).
+`--serve_staleness` / `--serve_stale_rounds`, `--serve_transport`,
+`--serve_shards`, `--serve_edges`).
 """
 
 from .assembler import ClosedRound, CohortAssembler

@@ -1,0 +1,33 @@
+"""serve/scale — the C1M scale-out ingest and aggregation subsystem.
+
+Three layers, each replacing a does-not-scale piece of the serving stack
+while keeping every admission decision, parity pin, and threat-model
+boundary of the original:
+
+- `eventloop.py` — `EventLoopTransport`: a selectors-based single-threaded
+  REACTOR replacing thread-per-connection for the socket path. One thread
+  multiplexes every connection (non-blocking accept, per-connection
+  incremental frame reassembly over an offset-consumed buffer with
+  memoryview slicing, read deadlines, max-frame caps), and the admission
+  path — including the G011 payload gauntlet — is the SAME shared
+  LineProtocol the threaded transport speaks. `--serve_transport
+  threaded|eventloop` picks the engine; threaded stays the reference.
+- `shard.py` — `ShardedIngest`: N reactors, each its own listener + thread,
+  all fronting ONE thread-safe IngestQueue; clients route by client-id
+  hash (`shard_for`). Per-shard admission/shed counters and a per-shard
+  SHEDDING retry-after gauge land in the process registry, so `/metrics`
+  and `/metrics.prom` can tell an overloaded SHARD from an overloaded
+  server.
+- `edge.py` — `EdgeTree`: two-tier edge aggregation. Each edge aggregator
+  ordered-sums its hash-shard's validated tables into ONE r x c partial
+  (sketch linearity makes the tree merge exact) and forwards it — plus the
+  per-client metadata the screens need (wire-formula L2 norms, live
+  masks) — to the root, which folds the partials in FIXED edge order.
+  Pinned BITWISE equal to the flat merge over the same surviving cohort
+  (tests/test_scale.py); an edge dying == its shard's clients dropped,
+  bitwise, with the cohort requeue machinery picking them up.
+"""
+
+from .edge import EdgeTree, assign_edges, table_norms_host  # noqa: F401
+from .eventloop import EventLoopTransport  # noqa: F401
+from .shard import ShardedIngest, shard_for  # noqa: F401

@@ -1,0 +1,282 @@
+"""Two-tier edge aggregation: shard-local ordered sums, one partial per edge.
+
+FetchSGD's central linearity (Count Sketches of partial sums add to the
+sketch of the full sum) makes hierarchical aggregation EXACT: an edge
+aggregator can sum its shard's validated r x c tables and forward ONE
+partial to the root, cutting root-ingress bytes from W tables to E — and
+the sum-only topology is precisely the shape FedSKETCH-style private
+aggregation wants (the root only ever sees sums; see the ROADMAP item).
+
+The parity discipline. "Exact" in real arithmetic is not "bitwise" in
+float32 — a two-level sum is a different fp association than a flat one.
+So the contract is pinned the way every prior subsystem pinned its mode
+flags: arming `--serve_edges E` compiles the round's merge as the SAME
+two-level fold on BOTH serving paths (engine.make_payload_round_steps
+edge variants over `modes.edge_grouped_sum` / `modes.merge_edge_partials`
+— explicit lax.scan folds, select-masked so no FMA can round differently),
+and each `EdgeAggregator.partial` here executes exactly one lane of that
+fold over its shard, in cohort-position order. Edge-tree serving is
+therefore BITWISE equal to flat serving of the same edge-armed session
+(params + every logged row, pinned in tests/test_scale.py); serve_edges=0
+keeps the original program byte-for-byte and differs from any E >= 2 in
+last bits (MIGRATION.md).
+
+What crosses the tree per edge: the [r, c] partial, the shard's live
+masks, and the per-client metadata the root's screens need — the
+WIRE-FORMULA L2 norms (`table_norms_host`, float64 accumulation per
+client, the exact formula the ingest gauntlet's screen uses — per-client
+independent, so edge-computed and root-computed values are identical) and
+the live count/weight sum for accounting. The root merge program consumes
+the forwarded norms for the quarantine screen + median ring, so screening
+can never diverge between the flat twin and the tree.
+
+Robust merge policies (`--merge_policy trimmed|median`) need PER-CLIENT
+tables — a pre-summed partial has destroyed exactly the per-client
+structure the order statistics rank. The tree then runs in FORWARD mode:
+edges validate and forward their shard's table stacks unsummed (the
+bandwidth win is forfeited — that is the robustness-vs-fanin trade-off,
+announced loudly at launch and documented in the README), and the root
+dispatches the plain robust program. Privacy note: forward mode also
+surrenders the sums-only topology; the per-tier compromise (robust merge
+at the edge, masked sums at the root) is the ROADMAP's private-aggregation
+item.
+
+Edge death: a dead edge contributes a zero partial under zero masks —
+bitwise the flat round with its shard's clients dropped — and the cohort
+requeue machinery re-serves them (`edge_kill` fault kind, chaos `edge`
+mode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ...obs import registry as obreg
+from ...obs import trace as obtrace
+from .shard import shard_for
+
+
+def assign_edges(client_ids, n_edges: int) -> np.ndarray:
+    """[W] int32 edge assignment of a cohort — the same client-id hash the
+    ingest shards route by (shard_for), so shard k's ingest worker IS edge
+    k's aggregator: one ownership function, both tiers."""
+    return np.asarray(shard_for(np.asarray(client_ids, np.int64), n_edges),
+                      np.int32)
+
+
+def table_norms_host(tables) -> np.ndarray:
+    """[W] float32 sketch-space L2 norms, per client, float64 accumulation
+    — the EXACT formula the ingest gauntlet's wire screen uses
+    (serve/ingest._screen_table), applied per row. Per-client independent,
+    so any partition of the stack computes identical values: this is what
+    lets edges compute their shard's norms locally and the root screen
+    against them as if it had computed them itself."""
+    t = np.asarray(tables, np.float32)
+    if t.shape[0] == 0:
+        return np.zeros(0, np.float32)  # an edge can own zero invitees
+    return np.sqrt(
+        np.square(t, dtype=np.float64).reshape(t.shape[0], -1).sum(axis=1)
+    ).astype(np.float32)
+
+
+def screen_mask(norms, clip_multiple: float, median: float) -> np.ndarray:
+    """[W] float32 1=kept / 0=quarantined — the HOST mirror of the merge
+    program's `_quarantine_mask` over the same f32 norms and the same
+    median scalar, with the multiply rounded in f32 exactly as the
+    compiled program rounds it, so the edge's pre-fold mask and the root
+    program's recomputed mask can never disagree on a boundary value."""
+    norms = np.asarray(norms, np.float32)
+    bad = ~np.isfinite(norms)
+    if clip_multiple > 0 and median > 0:
+        thresh = np.float32(clip_multiple) * np.float32(median)
+        bad = bad | (norms > thresh)
+    return (~bad).astype(np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeReport:
+    """What one edge forwards to the root for one round."""
+
+    edge: int
+    positions: np.ndarray        # cohort positions this edge owns (asc)
+    partial: np.ndarray | None   # [r, c] summed table (None in forward mode)
+    tables: np.ndarray | None    # [W_e, r, c] stack (forward mode only)
+    norms: np.ndarray            # [W_e] wire-formula L2 norms
+    live: np.ndarray             # [W_e] the masks the fold consumed
+    live_count: int
+    weight_sum: float
+
+
+def _shard_fold(tables, live):
+    """One edge's shard-local ordered sum: a sequential host left fold in
+    cohort-position order with select-masking — exactly one lane of
+    modes.edge_grouped_sum's in-program scan fold, bitwise: the lane's
+    arithmetic is a fixed sequence of float32 elementwise ADDS (the select
+    contributes exact zeros or the raw table — no multiply, so no FMA
+    contraction anywhere), and IEEE float32 addition of the same values in
+    the same order gives the same bits whether numpy or XLA executes it.
+    Host numpy deliberately: shard sizes vary round to round with the
+    cohort hash, and a jitted fold would recompile per (edge, W_e) shape —
+    all compile, no win, for what is a handful of r*c-sized adds."""
+    tables = np.asarray(tables, np.float32)
+    live = np.asarray(live, np.float32)
+    acc = np.zeros(tables.shape[1:], np.float32)
+    zero = np.zeros_like(acc)
+    for i in range(tables.shape[0]):
+        # dead rows ADD an exact zero rather than being skipped: the
+        # in-program lane performs that add too, and x + 0.0 flips a
+        # -0.0 accumulator entry to +0.0 — skipping would diverge on
+        # exactly that bit
+        acc = acc + (tables[i] if live[i] > 0 else zero)
+    return acc
+
+
+class EdgeAggregator:
+    """One edge: owns the cohort positions whose client ids hash to it,
+    validates + ordered-sums their tables (or forwards them unsummed in
+    robust/forward mode). The fold is a jitted lax.scan in cohort-position
+    order with select-masking — one lane of the root's grouped fold,
+    bitwise (see module doc)."""
+
+    def __init__(self, edge: int, table_shape: tuple,
+                 forward_tables: bool = False):
+        self.edge = edge
+        self.table_shape = tuple(table_shape)
+        self.forward_tables = forward_tables
+        self._fold = _shard_fold
+
+    def aggregate(self, positions, tables, base_live,
+                  screen: tuple | None = None) -> EdgeReport:
+        """One round's shard-local work: wire-formula norms, the quarantine
+        screen applied PRE-FOLD (the edge validates its own shard —
+        `screen` is (clip_multiple, median), the round's baseline the root
+        advertised; None = quarantine unarmed), then the masked ordered sum
+        in cohort-position order — the lane arithmetic of
+        modes.edge_grouped_sum — or the unsummed stack in forward mode."""
+        positions = np.asarray(positions, np.int64)
+        tables = np.asarray(tables, np.float32)
+        live = np.asarray(base_live, np.float32)
+        norms = table_norms_host(tables)
+        if screen is not None:
+            live = live * screen_mask(norms, screen[0], screen[1])
+        if self.forward_tables:
+            partial, stack = None, tables
+        else:
+            partial = np.asarray(self._fold(tables, live))
+            stack = None
+        return EdgeReport(
+            edge=self.edge, positions=positions, partial=partial,
+            tables=stack, norms=norms, live=live,
+            live_count=int((live > 0).sum()), weight_sum=float(live.sum()))
+
+
+class EdgeTree:
+    """The round-scoped two-tier topology: partition a cohort over E edge
+    aggregators by client-id hash, run each edge's shard-local validate +
+    sum, and assemble the root's inputs — the [E, r, c] partial stack in
+    FIXED edge order plus the forwarded per-client metadata ([W] norms,
+    masks) the root merge program screens with.
+
+    `forward_tables=True` (robust merge policies) forwards per-client
+    stacks instead of partials; the root then reassembles the full
+    [W, r, c] stack for the plain robust program.
+
+    `kill(edge)` marks an edge dead for the CURRENT round (the edge_kill
+    fault kind): its shard forwards nothing — a zero partial under zero
+    masks — which is bitwise its clients never arriving; the serving layer
+    zeroes their arrival mask so the requeue machinery re-serves them."""
+
+    def __init__(self, n_edges: int, table_shape: tuple,
+                 forward_tables: bool = False):
+        if n_edges < 2:
+            raise ValueError(
+                f"n_edges must be >= 2, got {n_edges} (one edge IS the "
+                "flat merge)")
+        self.n_edges = n_edges
+        self.table_shape = tuple(table_shape)
+        self.forward_tables = forward_tables
+        self.edges = [EdgeAggregator(e, table_shape, forward_tables)
+                      for e in range(n_edges)]
+        self._dead: set[int] = set()
+        self.registry = obreg.default()
+
+    def kill(self, edge: int) -> None:
+        if not 0 <= edge < self.n_edges:
+            raise ValueError(
+                f"edge {edge} out of range [0, {self.n_edges})")
+        self._dead.add(edge)
+        self.registry.counter("serve_edge_deaths_total").inc()
+        obtrace.instant("serve-edge", "edge:killed", edge=edge)
+
+    def revive_all(self) -> None:
+        self._dead.clear()
+
+    @property
+    def dead_edges(self) -> tuple:
+        return tuple(sorted(self._dead))
+
+    def dead_positions(self, ids) -> np.ndarray:
+        """Cohort positions owned by currently-dead edges — the serving
+        layer zeroes their arrival mask (edge death == shard dropped)."""
+        assign = assign_edges(ids, self.n_edges)
+        return np.flatnonzero(np.isin(assign, list(self._dead)))
+
+    def aggregate_round(self, rnd: int, ids, tables, base_live,
+                        screen: tuple | None = None):
+        """Run the tier for one closed round. `tables` is the assembler's
+        [W, r, c] validated stack, `base_live` the [W] pre-screen masks
+        (part * arrived — already zeroed for dead edges' clients by the
+        serving layer); each edge screens its own shard against `screen`
+        ((clip_multiple, median) or None) before folding. Returns
+        (reports, root_inputs) where root_inputs is the dict the session's
+        edge dispatch takes: {"assign", "norms", "partials"} — partials
+        None in forward mode (the root then uses the full stack it
+        already holds)."""
+        ids = np.asarray(ids, np.int64)
+        tables = np.asarray(tables, np.float32)
+        base_live = np.asarray(base_live, np.float32)
+        assign = assign_edges(ids, self.n_edges)
+        norms = np.zeros(len(ids), np.float32)
+        partials = (None if self.forward_tables else
+                    np.zeros((self.n_edges,) + self.table_shape, np.float32))
+        reports = []
+        for edge in self.edges:
+            pos = np.flatnonzero(assign == edge.edge)
+            if edge.edge in self._dead:
+                # a dead edge forwards NOTHING: zero partial, zero masks —
+                # its shard's norms never reach the root either (the
+                # serving layer already zeroed these clients' arrival)
+                reports.append(EdgeReport(
+                    edge=edge.edge, positions=pos, partial=None, tables=None,
+                    norms=np.zeros(len(pos), np.float32),
+                    live=np.zeros(len(pos), np.float32),
+                    live_count=0, weight_sum=0.0))
+                continue
+            rep = edge.aggregate(pos, tables[pos], base_live[pos], screen)
+            reports.append(rep)
+            norms[pos] = rep.norms
+            if partials is not None and rep.partial is not None:
+                partials[edge.edge] = rep.partial
+        self.registry.counter("serve_edge_partials_total").inc(
+            sum(1 for r in reports if r.partial is not None))
+        if obtrace.get().enabled:
+            obtrace.instant(
+                "serve-edge", "edge:round", round=int(rnd),
+                edges=self.n_edges, dead=len(self._dead),
+                live=int(sum(r.live_count for r in reports)))
+        return reports, {"assign": assign, "norms": norms,
+                         "partials": partials}
+
+    def counters(self) -> dict:
+        """The /metrics JSON `edge` block."""
+        return {
+            "n_edges": self.n_edges,
+            "mode": "forward" if self.forward_tables else "partial",
+            "dead": list(self.dead_edges),
+            "deaths": int(self.registry.counter(
+                "serve_edge_deaths_total").value),
+            "partials": int(self.registry.counter(
+                "serve_edge_partials_total").value),
+        }

@@ -1,0 +1,457 @@
+"""Event-loop ingest transport: a selectors reactor for the C1M socket path.
+
+The threaded transport (serve/transport.py) spends one OS thread per
+connection — fine for the chaos tests it exists for, dead at heavy traffic
+(128 threads is already a scheduler problem on a small box; 100k is not a
+number threads have). This module is the scale path: ONE reactor thread
+multiplexes every connection through `selectors.DefaultSelector` (epoll
+where the OS has it), with
+
+- **non-blocking accept**: the listener is registered with the selector;
+  an accept burst drains in one wakeup, each accepted socket set
+  non-blocking and registered for reads. A `max_conns` cap (fd-bounded,
+  default 8192) refuses connections past it — counted, never queued.
+- **incremental frame reassembly, zero-copy slicing**: each connection owns
+  one append-only `bytearray` consumed by OFFSET — received chunks append,
+  complete newline-frames are sliced out with `memoryview` views (no
+  per-line buffer recompaction; the buffer compacts once per drain), and
+  the payload inside a frame line crosses to the ingest gauntlet exactly
+  as the threaded transport hands it: `validate_payload` stays THE G011
+  deserialization boundary, reached through the same shared LineProtocol —
+  same admission decisions, same chunk-sequence bounds, same MALFORMED
+  verdicts, byte for byte.
+- **read deadlines**: the selector wait is capped at the nearest
+  per-connection deadline; a silent peer (slow-loris, died mid-frame) is
+  reaped when its deadline lapses — counted on the same
+  `serve_conn_deadline_total` counter the threaded transport uses.
+- **max-frame caps + SHEDDING**: the newline-less byte-flood cutoff and the
+  overload watermark run IN the shared protocol/queue code — the reactor
+  adds no second policy.
+- **write backpressure**: replies that would block park on the connection's
+  out-buffer and flush when the socket turns writable, so one slow reader
+  cannot stall the loop.
+
+Blocking discipline (graftlint G015 blocking-call-in-event-loop): the
+reactor's ONLY sanctioned waits are the selector poll and the non-blocking
+socket I/O helpers, each declared `# graftlint: drain-point` — a
+`time.sleep`, a blocking `recv`, file IO, or a subprocess reachable from
+`_loop` anywhere else is a lint failure, because a blocked reactor is every
+connection blocked at once.
+"""
+
+from __future__ import annotations
+
+import json
+import selectors
+import socket
+import sys
+import threading
+import time
+
+from ...obs import registry as obreg
+from ...obs import trace as obtrace
+from ..ingest import IngestQueue
+from ..transport import (
+    DEFAULT_MAX_FRAME_BYTES,
+    LineProtocol,
+    submit_over_socket,
+)
+
+# fd-bounded concurrent-connection cap of one reactor: each connection is
+# one fd + one small buffer, so thousands are cheap — the knob exists so a
+# connection flood hits a counted refusal instead of the process fd limit
+DEFAULT_MAX_CONNS_EVENTLOOP = 8192
+# compact a connection's receive buffer once this many consumed bytes
+# accumulate at its head (amortized O(1) per byte either way; this just
+# bounds the dead prefix a long-lived chatty connection can pin)
+_COMPACT_AT = 1 << 16
+
+
+class _NoopMetric:
+    """Inert counter/gauge stand-in for a standalone reactor's per-shard
+    series (see _shard_counter)."""
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+
+_NOOP_METRIC = _NoopMetric()
+
+
+class _Conn:
+    """Per-connection reactor state: the socket, the offset-consumed receive
+    buffer, the pending out-buffer, the read deadline, and the in-flight
+    chunk sequences (same dict shape the threaded handler keeps)."""
+
+    __slots__ = ("sock", "buf", "off", "out", "deadline", "sequences",
+                 "closing")
+
+    def __init__(self, sock: socket.socket, deadline: float):
+        self.sock = sock
+        self.buf = bytearray()
+        self.off = 0  # bytes of `buf` already consumed (frame starts here)
+        self.out = bytearray()  # pending reply bytes (write backpressure)
+        self.deadline = deadline
+        self.sequences: dict = {}
+        self.closing = False  # flush out-buffer, then close
+
+
+class EventLoopTransport(LineProtocol):
+    """Selectors-based single-threaded ingest reactor (see module doc)."""
+
+    def __init__(self, queue: IngestQueue, host: str = "127.0.0.1",
+                 port: int = 0, read_deadline_s: float = 30.0,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 max_conns: int = DEFAULT_MAX_CONNS_EVENTLOOP,
+                 shard_id: int | None = None):
+        if read_deadline_s <= 0:
+            raise ValueError(
+                f"read_deadline_s must be > 0, got {read_deadline_s} — an "
+                "unreaped silent peer would hold its fd forever")
+        if max_frame_bytes < 1024:
+            raise ValueError(
+                f"max_frame_bytes must be >= 1024, got {max_frame_bytes}")
+        if max_conns < 1:
+            raise ValueError(f"max_conns must be >= 1, got {max_conns}")
+        self.queue = queue
+        self.max_frame_bytes = max_frame_bytes
+        self.max_conns = max_conns
+        self.read_deadline_s = read_deadline_s
+        # None = a standalone reactor; an int = this reactor is shard k of
+        # a ShardedIngest — per-shard counters get distinct registry names
+        self.shard_id = shard_id
+        self._host, self._port = host, port
+        self._sock: socket.socket | None = None
+        self._sel: selectors.BaseSelector | None = None
+        self._thread: threading.Thread | None = None
+        self._conns: dict[socket.socket, _Conn] = {}
+        self._stop = threading.Event()
+        # self-pipe: stop() (another thread) writes one byte to wake the
+        # selector immediately instead of waiting out the poll timeout
+        self._wake_r: socket.socket | None = None
+        self._wake_w: socket.socket | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def address(self) -> tuple[str, int] | None:
+        return self._sock.getsockname() if self._sock is not None else None
+
+    def addr_for(self, client_id: int) -> tuple[str, int] | None:
+        return self.address
+
+    @property
+    def open_conns(self) -> int:
+        return len(self._conns)
+
+    def start(self) -> None:
+        if self._sock is not None:
+            return
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self._host, self._port))
+        s.listen(1024)
+        s.setblocking(False)
+        self._sock = s
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(s, selectors.EVENT_READ, "accept")
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._stop.clear()
+        name = ("serve-reactor" if self.shard_id is None
+                else f"serve-reactor-{self.shard_id}")
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, join_deadline_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._wake_w is not None:
+            try:
+                self._wake_w.send(b"x")
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=join_deadline_s)
+            if self._thread.is_alive():
+                print("serve: WARNING — reactor thread still alive past "
+                      "the stop deadline", file=sys.stderr, flush=True)
+            self._thread = None
+        # the reactor thread closes everything on its way out; these are
+        # the belt-and-braces for a thread that never ran / got wedged
+        for sock in (self._wake_w, self._wake_r, self._sock):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        self._wake_r = self._wake_w = None
+        self._sock = None
+        self._sel = None
+        self._conns.clear()
+
+    # graftlint: drain-point — client-side blocking round-trip (a test /
+    # traffic thread's convenience, never the reactor's)
+    def submit(self, sub) -> str:
+        addr = self.address
+        if addr is None:
+            raise RuntimeError("EventLoopTransport not started")
+        return submit_over_socket(addr, sub)
+
+    # -- the reactor ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        """THE event loop: one thread, every connection. Each iteration
+        waits on the selector (bounded by the nearest read deadline),
+        dispatches readable/writable sockets, then reaps expired
+        connections. Nothing in here — or reachable from here — may block
+        beyond the selector wait itself (graftlint G015)."""
+        assert self._sel is not None
+        while not self._stop.is_set():
+            timeout = self._next_timeout()
+            for key, events in self._select(timeout):
+                if key.data == "wake":
+                    self._drain_wake()
+                elif key.data == "accept":
+                    self._accept_burst()
+                else:
+                    conn: _Conn = key.data
+                    if events & selectors.EVENT_WRITE:
+                        self._on_writable(conn)
+                    if events & selectors.EVENT_READ and not conn.closing:
+                        self._on_readable(conn)
+            self._reap_deadlines()
+        # reactor exit: close every connection (partial chunk sequences
+        # count MALFORMED — same contract as a threaded handler's death)
+        for conn in list(self._conns.values()):
+            self._close_conn(conn, count_sequences=True)
+        for sock in (self._wake_r, self._wake_w, self._sock):
+            if sock is not None:
+                try:
+                    self._sel.unregister(sock)
+                except (KeyError, ValueError):
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        self._sel.close()
+
+    # graftlint: drain-point — the selector poll IS the reactor's one
+    # sanctioned wait (bounded by the nearest read deadline)
+    def _select(self, timeout: float):
+        try:
+            return self._sel.select(timeout)
+        except OSError:
+            return []
+
+    def _next_timeout(self) -> float:
+        if not self._conns:
+            return 0.5
+        now = time.monotonic()
+        nearest = min(c.deadline for c in self._conns.values())
+        return min(max(nearest - now, 0.0), 0.5)
+
+    # graftlint: drain-point — non-blocking drain of the self-pipe
+    def _drain_wake(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    # graftlint: drain-point — non-blocking accept burst on the listener
+    def _accept_burst(self) -> None:
+        while True:
+            try:
+                sock, _ = self._sock.accept()
+            except (BlockingIOError, OSError):
+                return
+            if len(self._conns) >= self.max_conns:
+                obreg.default().counter("serve_conn_refused_total").inc()
+                self._shard_counter("conn_refused").inc()
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            sock.setblocking(False)
+            conn = _Conn(sock, time.monotonic() + self.read_deadline_s)
+            self._conns[sock] = conn
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+            self._shard_gauge("conns").set(len(self._conns))
+
+    # graftlint: drain-point — non-blocking recv; a would-block falls
+    # straight back to the selector
+    def _on_readable(self, conn: _Conn) -> None:
+        try:
+            chunk = conn.sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._close_conn(conn, count_sequences=True)
+            return
+        if not chunk:
+            self._close_conn(conn, count_sequences=True)
+            return
+        conn.deadline = time.monotonic() + self.read_deadline_s
+        conn.buf += chunk
+        self._consume_frames(conn)
+
+    def _consume_frames(self, conn: _Conn) -> None:
+        """Incremental reassembly over the offset-consumed buffer: complete
+        newline-frames are sliced out as memoryview-backed line bytes (one
+        copy per line, for the json parse — the buffer itself is never
+        recompacted per line) and dispatched through the shared
+        LineProtocol; an unterminated tail past the frame cap is the
+        byte-flood rejection."""
+        buf = conn.buf
+        view = memoryview(buf)
+        while True:
+            nl = buf.find(b"\n", conn.off)
+            if nl < 0:
+                break
+            line = bytes(view[conn.off:nl])
+            conn.off = nl + 1
+            if not line.strip():
+                continue
+            reply = self._handle_line(line, conn.sequences, len(line))
+            if reply is None:
+                continue  # mid-sequence chunk: reply comes with the last
+            self._queue_reply(conn, reply)
+            if reply.get("detail") == "frame too large":
+                view.release()
+                self._close_conn(conn, count_sequences=True, flush=True)
+                return
+        pending = len(buf) - conn.off
+        if pending > self.max_frame_bytes:
+            # newline-less byte flood: cut it off at the cap — the same
+            # verdict, counter, and disconnect the threaded transport gives
+            obreg.default().counter("serve_rejected_malformed_total").inc()
+            self.queue.note_wire_malformed()
+            obtrace.instant("serve-ingest", "conn:frame_too_big",
+                            bytes=pending)
+            self._queue_reply(conn, {"status": "MALFORMED",
+                                     "detail": "frame too large"})
+            view.release()
+            self._close_conn(conn, count_sequences=True, flush=True)
+            return
+        view.release()
+        if conn.off >= _COMPACT_AT:
+            del buf[:conn.off]
+            conn.off = 0
+
+    def _queue_reply(self, conn: _Conn, reply: dict) -> None:
+        if self.shard_id is not None:
+            self._shard_counter("submissions").inc()
+            if reply.get("status") == "SHEDDING":
+                # per-shard overload posture: the shard's own shed counter
+                # and the load-scaled hint it handed out, so /metrics.prom
+                # can tell an overloaded shard from an overloaded server
+                reply = dict(reply)
+                reply["retry_after_s"] = self._retry_after_s()
+                self._shard_counter("shed").inc()
+                self._shard_gauge("retry_after_s").set(
+                    float(reply["retry_after_s"]))
+        conn.out += json.dumps(reply).encode() + b"\n"
+        self._flush_out(conn)
+
+    # graftlint: drain-point — non-blocking send; unsent bytes park on the
+    # out-buffer and the socket watches for writability
+    def _flush_out(self, conn: _Conn) -> None:
+        try:
+            while conn.out:
+                n = conn.sock.send(conn.out)
+                del conn.out[:n]
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close_conn(conn, count_sequences=True)
+            return
+        self._update_events(conn)
+        if conn.closing and not conn.out:
+            self._close_conn(conn)
+
+    def _on_writable(self, conn: _Conn) -> None:
+        self._flush_out(conn)
+
+    def _update_events(self, conn: _Conn) -> None:
+        if conn.sock not in self._conns:
+            return
+        events = selectors.EVENT_READ
+        if conn.out:
+            events |= selectors.EVENT_WRITE
+        try:
+            self._sel.modify(conn.sock, events, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _reap_deadlines(self) -> None:
+        now = time.monotonic()
+        for conn in [c for c in self._conns.values() if c.deadline <= now]:
+            obreg.default().counter("serve_conn_deadline_total").inc()
+            obtrace.instant("serve-ingest", "conn:deadline")
+            self._close_conn(conn, count_sequences=True)
+
+    def _close_conn(self, conn: _Conn, count_sequences: bool = False,
+                    flush: bool = False) -> None:
+        """Tear one connection down. `flush=True` keeps it alive just long
+        enough to drain the pending reply (MALFORMED verdicts should reach
+        the peer when the socket allows), then closes on the next
+        writable/deadline tick."""
+        if flush and conn.out:
+            # the sequences are already abandoned at the DECISION to close:
+            # count them now (the later drain-path close passes no flag,
+            # and the threaded transport's finally block always counts)
+            if count_sequences:
+                self._abandoned_sequences(conn.sequences)
+                conn.sequences = {}
+            conn.closing = True
+            self._update_events(conn)
+            # the deadline still bounds a peer that never reads the reply
+            return
+        if count_sequences:
+            self._abandoned_sequences(conn.sequences)
+            conn.sequences = {}
+        self._conns.pop(conn.sock, None)
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self._shard_gauge("conns").set(len(self._conns))
+
+    # -- per-shard metric names ----------------------------------------------
+    # a STANDALONE reactor (shard_id None) publishes no serve_shard* series
+    # at all: a phantom "shard 0" with connections but zero submissions
+    # reads as a broken shard in a deployment that isn't sharded
+
+    def _shard_counter(self, what: str):
+        if self.shard_id is None:
+            return _NOOP_METRIC
+        return obreg.default().counter(
+            f"serve_shard{self.shard_id}_{what}_total")
+
+    def _shard_gauge(self, what: str):
+        if self.shard_id is None:
+            return _NOOP_METRIC
+        return obreg.default().gauge(f"serve_shard{self.shard_id}_{what}")
+
+    def _retry_after_s(self) -> float:
+        """Per-shard load-scaled SHEDDING hint: the base hint stretched by
+        how far this reactor's connection count sits above its fair share,
+        so clients of a hot shard back off longer than clients of an idle
+        one — the per-shard half of the overload contract (the queue-depth
+        watermark itself is global)."""
+        base = self.queue.shed_retry_after_s
+        if self.shard_id is None:
+            return base
+        share = max(self.max_conns, 1)
+        return base * (1.0 + min(len(self._conns) / share, 4.0))

@@ -1,0 +1,157 @@
+"""Sharded ingest: N event-loop reactors over one admission queue.
+
+One reactor thread saturates around one core of frame parsing + payload
+gauntlet work (base64 + crc32 + ndarray checks are CPU-bound). The sharded
+ingest runs N reactors (`EventLoopTransport`, each its own listener socket
+and thread) in front of the SAME thread-safe `IngestQueue`, so admission
+state — windows, dedup, capacity, the shed watermark — stays exactly one
+source of truth while connection handling and decode CPU spread across
+workers.
+
+Routing is by client-id hash: `shard_for(client_id, n)` (splitmix64 — the
+same deterministic mixer the client-state streams use, so the assignment
+is uniform and stable across runs) names the shard a client connects to,
+and `addr_for` hands the serving layer / client helpers the right address.
+A submission that lands on the WRONG shard is still decided correctly (the
+queue is shared — correctness never depends on routing), but it is counted
+per shard as misrouted: in a real deployment that is a load-balancer bug
+an operator needs to see.
+
+Per-shard observability (the /metrics + /metrics.prom surfaces):
+
+- `serve_shard<k>_submissions_total` / `serve_shard<k>_shed_total` /
+  `serve_shard<k>_conn_refused_total` / `serve_shard<k>_misrouted_total`
+  counters,
+- `serve_shard<k>_conns` gauge (live connections),
+- `serve_shard<k>_retry_after_s` gauge — the load-scaled SHEDDING hint the
+  shard last handed out, stretched by its connection count over its fair
+  share, so an overloaded SHARD is distinguishable from an overloaded
+  SERVER at a glance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..clients import fold_in_host
+from ..ingest import IngestQueue
+from ..transport import DEFAULT_MAX_FRAME_BYTES, submit_over_socket
+from .eventloop import DEFAULT_MAX_CONNS_EVENTLOOP, EventLoopTransport
+
+
+# the routing stream's fixed seed: shard ownership is a property of the
+# DEPLOYMENT topology, not of a run's --seed — resuming a run (or changing
+# its seed) must not reshuffle which shard owns a client
+_ROUTE_SEED = 0x5CA1E
+
+
+def shard_for(client_id, n_shards: int):
+    """The shard (and edge, serve/scale/edge.py) a client id hashes to —
+    one splitmix64 fold of the bare id (serve/clients.py `fold_in_host`,
+    the same deterministic mixer the client-state streams use): uniform,
+    stable across runs, vectorized over an id array. The same function
+    routes ingest connections and partitions cohorts over edge
+    aggregators, so the two tiers agree about ownership by
+    construction."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    out = fold_in_host(_ROUTE_SEED, np.asarray(client_id)) % np.uint64(
+        n_shards)
+    return out.astype(np.int64) if out.ndim else int(out)
+
+
+class ShardedIngest:
+    """N event-loop reactors fronting one IngestQueue (see module doc).
+    Presents the same transport surface the service expects: start/stop,
+    submit(sub), address (shard 0 — the "primary" a single-address caller
+    sees), addr_for(client_id) for hash routing."""
+
+    def __init__(self, queue: IngestQueue, n_shards: int,
+                 host: str = "127.0.0.1", port: int = 0,
+                 read_deadline_s: float = 30.0,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 max_conns: int = DEFAULT_MAX_CONNS_EVENTLOOP):
+        if n_shards < 2:
+            raise ValueError(
+                f"n_shards must be >= 2, got {n_shards} (one shard IS the "
+                "plain event-loop transport — use EventLoopTransport)")
+        self.queue = queue
+        self.n_shards = n_shards
+        # an explicit base port pins shard k to port+k (operators can
+        # firewall/monitor per shard); port=0 lets the OS pick each
+        self.shards = [
+            _ShardReactor(queue, shard_id=k, n_shards=n_shards, host=host,
+                          port=(port + k if port else 0),
+                          read_deadline_s=read_deadline_s,
+                          max_frame_bytes=max_frame_bytes,
+                          max_conns=max_conns)
+            for k in range(n_shards)
+        ]
+
+    def start(self) -> None:
+        for s in self.shards:
+            s.start()
+
+    def stop(self, join_deadline_s: float = 5.0) -> None:
+        for s in self.shards:
+            s.stop(join_deadline_s=join_deadline_s)
+
+    @property
+    def address(self) -> tuple[str, int] | None:
+        return self.shards[0].address
+
+    @property
+    def addresses(self) -> list[tuple[str, int] | None]:
+        return [s.address for s in self.shards]
+
+    def addr_for(self, client_id: int) -> tuple[str, int] | None:
+        return self.shards[shard_for(client_id, self.n_shards)].address
+
+    # graftlint: drain-point — client-side blocking round-trip on the
+    # caller's thread (traffic generator / tests), hash-routed
+    def submit(self, sub) -> str:
+        addr = self.addr_for(sub.client_id)
+        if addr is None:
+            raise RuntimeError("ShardedIngest not started")
+        return submit_over_socket(addr, sub)
+
+    def counters(self) -> dict:
+        """Per-shard snapshot for the /metrics JSON `shards` block."""
+        from ...obs import registry as obreg
+
+        reg = obreg.default()
+        out = {}
+        for s in self.shards:
+            k = s.shard_id
+            out[str(k)] = {
+                "addr": (f"{s.address[0]}:{s.address[1]}"
+                         if s.address else None),
+                "conns": int(reg.gauge(f"serve_shard{k}_conns").value),
+                "submissions": int(reg.counter(
+                    f"serve_shard{k}_submissions_total").value),
+                "shed": int(reg.counter(
+                    f"serve_shard{k}_shed_total").value),
+                "misrouted": int(reg.counter(
+                    f"serve_shard{k}_misrouted_total").value),
+                "conn_refused": int(reg.counter(
+                    f"serve_shard{k}_conn_refused_total").value),
+                "retry_after_s": float(reg.gauge(
+                    f"serve_shard{k}_retry_after_s").value),
+            }
+        return out
+
+
+class _ShardReactor(EventLoopTransport):
+    """One shard's reactor: the event-loop transport plus ownership
+    accounting — a submission whose client id hashes elsewhere is decided
+    normally (the queue is shared) but counted misrouted."""
+
+    def __init__(self, queue: IngestQueue, shard_id: int, n_shards: int,
+                 **kw):
+        super().__init__(queue, shard_id=shard_id, **kw)
+        self.n_shards = n_shards
+
+    def _submit_reply(self, sub) -> dict:
+        if shard_for(sub.client_id, self.n_shards) != self.shard_id:
+            self._shard_counter("misrouted").inc()
+        return super()._submit_reply(sub)

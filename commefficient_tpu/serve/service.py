@@ -57,13 +57,16 @@ import threading
 import time
 from typing import Any
 
+import jax
 import numpy as np
 
+from ..federated import engine as fed_engine
 from ..obs import registry as obreg
 from ..obs import trace as obtrace
 from .assembler import ClosedRound, CohortAssembler
 from .ingest import IngestQueue, PayloadPolicy, Submission
 from .metrics import MetricsServer
+from .scale.edge import EdgeTree, assign_edges, table_norms_host
 from .traffic import TraceConfig, TrafficGenerator
 from .transport import (
     InProcessTransport,
@@ -178,6 +181,29 @@ class ServeConfig:
     buffer_size: int = 0
     staleness_alpha: float = 0.5
     stale_rounds: int = 1
+    # --serve_transport: which SOCKET engine serves connections.
+    # "threaded" (default, the reference): one OS thread per connection —
+    # fine for chaos tests, capped at DEFAULT_MAX_CONNS_THREADED.
+    # "eventloop": the serve/scale selectors reactor — one thread
+    # multiplexing thousands of connections (the C1M path). Identical
+    # admission decisions (shared LineProtocol); inproc ignores it.
+    socket_transport: str = "threaded"
+    # --serve_shards: >= 2 runs that many event-loop reactors over the one
+    # admission queue, clients routed by client-id hash (serve/scale/
+    # shard.py) — per-shard counters + shed hints in /metrics(.prom)
+    shards: int = 0
+    # --serve_max_conns: concurrent-connection cap of the socket engine
+    # (per reactor when sharded). 0 = the engine's default (threaded 128 —
+    # every connection is an OS thread; eventloop 8192, fd-bounded).
+    # Past the cap connections are refused and counted
+    # (serve_conn_refused_total), never queued.
+    max_conns: int = 0
+    # --serve_edges: >= 2 arms the two-tier edge-aggregation tree
+    # (serve/scale/edge.py): each edge ordered-sums its hash-shard's
+    # validated tables and forwards one r x c partial to the root, pinned
+    # bitwise == the flat merge of the same edge-armed session. Robust
+    # merge policies flip the tree into per-client FORWARD mode (loudly).
+    edges: int = 0
 
     @classmethod
     def from_args(cls, args) -> "ServeConfig":
@@ -194,6 +220,10 @@ class ServeConfig:
             buffer_size=getattr(args, "serve_buffer", 0),
             staleness_alpha=getattr(args, "serve_staleness", 0.5),
             stale_rounds=getattr(args, "serve_stale_rounds", 1),
+            socket_transport=getattr(args, "serve_transport", "threaded"),
+            shards=getattr(args, "serve_shards", 0),
+            edges=getattr(args, "serve_edges", 0),
+            max_conns=getattr(args, "serve_max_conns", 0),
         )
 
 
@@ -222,6 +252,39 @@ class AggregationService:
                 "zero submissions: every round would close at deadline "
                 "fully degraded (pass a TrafficGenerator, or use the "
                 "socket transport with external clients)")
+        if cfg.socket_transport not in ("threaded", "eventloop"):
+            raise ValueError(
+                f"--serve_transport must be threaded|eventloop, got "
+                f"{cfg.socket_transport!r}")
+        if cfg.shards >= 2:
+            if cfg.transport != "socket":
+                raise ValueError(
+                    "--serve_shards shards the SOCKET ingest across "
+                    "reactors; the inproc transport has no connections to "
+                    "shard — arm --serve socket")
+            if cfg.socket_transport != "eventloop":
+                raise ValueError(
+                    "--serve_shards runs N event-loop reactors; the "
+                    "thread-per-connection transport has no reactor to "
+                    "shard — arm --serve_transport eventloop")
+        elif cfg.shards < 0:
+            raise ValueError(f"--serve_shards must be >= 0, got {cfg.shards}")
+        if cfg.edges == 1 or cfg.edges < 0:
+            raise ValueError(
+                f"--serve_edges must be 0 (off) or >= 2, got {cfg.edges} "
+                "(one edge IS the flat merge)")
+        if cfg.edges >= 2:
+            if cfg.payload != "sketch":
+                raise ValueError(
+                    "--serve_edges aggregates client TABLES at the edge "
+                    "tier; the announce path has none — arm "
+                    "--serve_payload sketch")
+            if cfg.async_mode or cfg.pipeline:
+                raise ValueError(
+                    "--serve_edges does not compose with --serve_async/"
+                    "--serve_pipeline yet (a stale table's edge assignment "
+                    "and the worker's edge timing are open follow-ups) — "
+                    "drop one of the flags")
         if cfg.async_mode:
             if cfg.payload != "sketch":
                 raise ValueError(
@@ -260,6 +323,45 @@ class AggregationService:
                 rows=payload_shape[0], cols=payload_shape[1],
                 clip_multiple=float(ecfg.client_update_clip),
                 quarantine_median=session.quarantine_median_host)
+        # two-tier edge aggregation (serve/scale/edge.py): the session's
+        # serve_edges arms the edge-variant merge PROGRAMS (the grouped
+        # flat twin + the partials root); this service's cfg.edges arms the
+        # TOPOLOGY. edges >= 2 with a robust merge policy flips the tree
+        # into per-client FORWARD mode (order statistics need individual
+        # tables — the fan-in win is forfeited, loudly).
+        session_edges = int(getattr(session.cfg, "serve_edges", 0))
+        robust_pol = fed_engine.robust_policy(session.cfg)
+        self._edge_tree = None
+        if cfg.edges >= 2:
+            if robust_pol is not None:
+                if session_edges:
+                    raise ValueError(
+                        "robust merge policies run the edge tree in "
+                        "FORWARD mode against the plain robust program — "
+                        "build the session with serve_edges=0 (the CLIs "
+                        "do; EngineConfig rejects the combination too)")
+                print(
+                    f"serve: NOTE — --serve_edges {cfg.edges} with "
+                    f"merge_policy={robust_pol!r}: order statistics need "
+                    "per-client tables, so each edge FORWARDS its shard's "
+                    "validated tables unsummed (W tables cross the tree, "
+                    "not E partials — the robustness-vs-fanin trade-off; "
+                    "see README 'Scale-out serving')",
+                    file=sys.stderr, flush=True)
+            elif session_edges != cfg.edges:
+                raise ValueError(
+                    f"--serve_edges {cfg.edges} needs a session built with "
+                    f"serve_edges={cfg.edges} (got {session_edges}): the "
+                    "edge partition size is part of the compiled merge "
+                    "variants — the CLIs arm it from the flag")
+            self._edge_tree = EdgeTree(
+                cfg.edges, payload_shape,
+                forward_tables=robust_pol is not None)
+        elif session_edges >= 2:
+            # the FLAT twin of an edge-armed session: no tree runs, but
+            # every round dispatches the grouped edge variant over the
+            # full stack — the reference side of the edge == flat pin
+            pass
         self.session = session
         # async: the W-of-N quorum becomes the buffer-size trigger (the
         # round's merge fires when `trigger` validated tables are in, not
@@ -301,9 +403,28 @@ class AggregationService:
         # the pipelined worker's payload-compute gate (serve/pipeline.py
         # installs it; None = serial source, compute runs inline)
         self._compute_gate = None
-        self.transport = (
-            SocketTransport(self.queue, port=cfg.port)
-            if cfg.transport == "socket" else InProcessTransport(self.queue))
+        if cfg.transport == "socket":
+            # 0 = the engine's own default cap (threaded 128 threads,
+            # eventloop 8192 fds) — the knob exists so a deployment that
+            # legitimately holds more connections can raise it
+            cap = {"max_conns": cfg.max_conns} if cfg.max_conns else {}
+            if cfg.shards >= 2:
+                # sharded scale-out ingest: N event-loop reactors over the
+                # one admission queue, clients hash-routed per shard
+                from .scale.shard import ShardedIngest
+
+                self.transport = ShardedIngest(
+                    self.queue, n_shards=cfg.shards, port=cfg.port, **cap)
+            elif cfg.socket_transport == "eventloop":
+                from .scale.eventloop import EventLoopTransport
+
+                self.transport = EventLoopTransport(
+                    self.queue, port=cfg.port, **cap)
+            else:
+                self.transport = SocketTransport(
+                    self.queue, port=cfg.port, **cap)
+        else:
+            self.transport = InProcessTransport(self.queue)
         # all rate/latency metrics live in the process-wide obs registry —
         # the same store the runner's phase histograms land in, so the
         # /metrics endpoint reads ONE source of truth
@@ -485,10 +606,13 @@ class AggregationService:
                     # the REAL wire: every submission round-trips the
                     # loopback socket (frame encode -> recv -> gauntlet
                     # decode), and a conn_drop is an actual mid-send
-                    # connection death
-                    addr = self.transport.address
-                    submit = lambda sub: submit_over_socket(addr, sub)  # noqa: E731
-                    abort = lambda sub: abort_over_socket(addr, sub)  # noqa: E731
+                    # connection death. addr_for routes by client-id hash
+                    # when the ingest is sharded (one listener otherwise).
+                    tr = self.transport
+                    submit = lambda sub: submit_over_socket(  # noqa: E731
+                        tr.addr_for(sub.client_id), sub)
+                    abort = lambda sub: abort_over_socket(  # noqa: E731
+                        tr.addr_for(sub.client_id), sub)
                 else:
                     submit, abort = self.transport.submit, None
                 self.traffic.respond_to_invites(
@@ -508,9 +632,80 @@ class AggregationService:
                 self._submit_stale_poison(rnd)
                 stale = self._build_stale_fold(rnd)
                 self._stash_stragglers(closed)
+            arrived, wire_tables, edge_block = self._edge_round(
+                rnd, ids, closed, aux)
             prep = self.session.finish_served_payload(
-                prep0, closed.arrived, closed.tables, aux, stale=stale)
+                prep0, arrived, wire_tables, aux, stale=stale,
+                edge=edge_block)
         return prep, closed
+
+    def _edge_round(self, rnd: int, ids, closed, aux):
+        """The two-tier edge-aggregation stage of a payload round (None
+        everywhere when neither the topology nor the edge-armed session
+        is in play). Returns (arrived, wire_tables, edge_block):
+
+        - edge deaths scheduled for this round (edge_kill fault kind) zero
+          their shard's arrival mask BEFORE anything else — an edge dying
+          IS its shard's clients dropped (masked + re-queued), bitwise;
+        - with the TREE on, each edge screens + ordered-sums its shard and
+          the root dispatch takes the [E, r, c] partials (or, robust
+          forward mode, the reassembled per-client stacks) plus the
+          forwarded wire-formula norms;
+        - with an edge-armed session but no tree (the FLAT parity twin),
+          the same norms/assignment are computed over the full stack and
+          the grouped edge variant dispatches."""
+        session_edges = int(getattr(self.session.cfg, "serve_edges", 0))
+        if self._edge_tree is None and session_edges < 2:
+            return closed.arrived, closed.tables, None
+        arrived = np.array(closed.arrived, np.float32, copy=True)
+        if self._edge_tree is not None:
+            plan = self.session.fault_plan
+            if plan is not None:
+                for e in plan.edge_kill_plan(rnd):
+                    self._edge_tree.kill(int(e))
+            dead = self._edge_tree.dead_positions(ids)
+            if len(dead):
+                arrived[dead] = 0.0
+                print(f"serve: edge(s) {self._edge_tree.dead_edges} dead "
+                      f"at round {rnd}: {len(dead)} shard client(s) "
+                      "dropped + re-queued", file=sys.stderr, flush=True)
+        ecfg = self.session.cfg
+        screen = None
+        if ecfg.client_update_clip > 0:
+            # the same baseline the merge program will read at dispatch
+            # (the serial serve loop's head state — also the window median
+            # the gauntlet screened this round's wire against)
+            screen = (float(ecfg.client_update_clip),
+                      self.session.quarantine_median_host())
+        if self._edge_tree is None:
+            # FLAT twin: grouped edge variant over the full stack — same
+            # norms formula, same assignment, no partials
+            return arrived, closed.tables, {
+                "assign": assign_edges(ids, session_edges),
+                "norms": table_norms_host(closed.tables),
+                "partials": None,
+            }
+        # the tree: edges fold with the same masks the grouped program
+        # recomputes in-program (part * arrived * screen) — part synced to
+        # host at the payload round's existing host boundary
+        part_host = np.asarray(  # graftlint: disable=G001 — payload-boundary sync (the tables already synced this round)
+            jax.device_get(aux[3]), np.float32)
+        base_live = part_host * arrived
+        reports, root = self._edge_tree.aggregate_round(
+            rnd, ids, closed.tables, base_live,
+            screen=None if self._edge_tree.forward_tables else screen)
+        self._edge_tree.revive_all()  # an edge dies for ITS round
+        if self._edge_tree.forward_tables:
+            # robust FORWARD mode: the root reassembles the per-client
+            # stacks the edges forwarded (dead edges left zeros — their
+            # clients' arrival is zero too) and dispatches the plain
+            # robust program: no edge_block
+            stack = np.zeros_like(np.asarray(closed.tables, np.float32))
+            for rep in reports:
+                if rep.tables is not None and len(rep.positions):
+                    stack[rep.positions] = rep.tables
+            return arrived, stack, None
+        return arrived, closed.tables, root
 
     def _submit_stale_poison(self, rnd: int) -> None:
         """Push the due stale-poison tables (withheld at an earlier
@@ -530,7 +725,8 @@ class AggregationService:
             sub = Submission(client_id=int(cid), round=int(sr),
                              latency_s=0.0, payload=table)
             if self.cfg.transport == "socket":
-                status = submit_over_socket(self.transport.address, sub)
+                status = submit_over_socket(
+                    self.transport.addr_for(int(cid)), sub)
             else:
                 status = self.transport.submit(sub)
             obtrace.instant("serve-ingest", "stale_poison_submit",
@@ -815,6 +1011,16 @@ class AggregationService:
             "invited_per_round": s.num_workers,
             "deadline_s": self.cfg.deadline_s,
             "transport": self.cfg.transport,
+            # scale-out posture: which socket engine runs, the per-shard
+            # ingest picture (counters + load-scaled shed hints, also in
+            # /metrics.prom), and the edge-aggregation tier
+            "transport_engine": (self.cfg.socket_transport
+                                 if self.cfg.transport == "socket"
+                                 else None),
+            "shards": (self.transport.counters()
+                       if hasattr(self.transport, "counters") else None),
+            "edge": (self._edge_tree.counters()
+                     if self._edge_tree is not None else None),
             "payload": self.cfg.payload,
             # the armed Byzantine defense posture, so an operator can see
             # at a glance whether this aggregator's merge is the linear sum
@@ -936,7 +1142,13 @@ def service_from_args(args, session) -> AggregationService | None:
              else f"quorum {service.cfg.quorum}")
     print(
         f"serve: {service.cfg.transport} transport"
+        + (f" ({service.cfg.socket_transport})"
+           if service.cfg.transport == "socket" else "")
         + (f" on {addr[0]}:{addr[1]}" if addr else "")
+        + (f", {service.cfg.shards} ingest shards"
+           if service.cfg.shards >= 2 else "")
+        + (f", {service.cfg.edges}-edge tree"
+           if service.cfg.edges >= 2 else "")
         + f", payload {service.cfg.payload}"
         + (", pipelined" if service.cfg.pipeline else "")
         + (f", async (alpha={service.cfg.staleness_alpha:g}, "

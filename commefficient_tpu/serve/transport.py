@@ -11,8 +11,12 @@ the traffic generator, and the tests are transport-agnostic.
 - `SocketTransport`: newline-delimited JSON over a loopback TCP socket —
   the smallest wire that exercises real serialization, partial reads, and
   concurrent client connections. One accept-loop thread + one thread per
-  connection (daemon; bounded by the OS backlog and the traffic shape —
-  this is the realism transport, not the 10M-client path). Request
+  connection (daemon; CAPPED at `max_conns` live connections — every
+  connection is an OS thread, so past the cap new connections are refused
+  and counted rather than accepted into a scheduler collapse — this is
+  the realism/reference transport, not the scale path: the serve/scale
+  event-loop reactor is, and it speaks the SAME `LineProtocol` below, so
+  the two engines cannot diverge on an admission decision). Request
   ``{"client_id": int, "round": int, "latency_s": float?, "payload":
   frame?}`` — `frame` is the length-prefixed/checksummed dict of
   sketch/payload.py — is answered with ``{"status": "<admission
@@ -71,6 +75,13 @@ DEFAULT_MAX_FRAME_BYTES = 1 << 20
 # client submits one table at a time (a retry is a new connection), so a
 # peer spraying sequence keys is hostile — bounded, MALFORMED past it
 _MAX_SEQUENCES_PER_CONN = 4
+# concurrent-connection cap of the thread-per-connection transport: every
+# connection is a live OS thread, and an unbounded accept loop is a
+# thread-exhaustion DoS (and a scheduler collapse long before that). 128
+# threads is already heavy for the chaos-test reference this transport is;
+# the event-loop reactor (serve/scale/eventloop.py) is the scale path and
+# carries a correspondingly larger fd-bounded cap.
+DEFAULT_MAX_CONNS_THREADED = 128
 
 
 class InProcessTransport:
@@ -93,194 +104,19 @@ class InProcessTransport:
         return None
 
 
-class SocketTransport:
-    """Loopback-TCP ingest: a tiny always-on server in front of the queue."""
+class LineProtocol:
+    """The newline-JSON ingest wire, factored out of the server loops: one
+    request line (or chunk-sequence line) in, one admission-decision reply
+    dict out (None mid-sequence). Both socket servers — the thread-per-
+    connection `SocketTransport` and the selectors reactor
+    (serve/scale/eventloop.py) — speak EXACTLY this protocol through these
+    shared methods, so the two transports can never diverge on an
+    admission decision, a chunk-sequence bound, or a malformed-line
+    verdict: the scale path is a different EVENT ENGINE, not a different
+    wire. Subclasses provide `self.queue` and `self.max_frame_bytes`."""
 
-    def __init__(self, queue: IngestQueue, host: str = "127.0.0.1",
-                 port: int = 0, read_deadline_s: float = 30.0,
-                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
-        if read_deadline_s <= 0:
-            raise ValueError(
-                f"read_deadline_s must be > 0, got {read_deadline_s} — an "
-                "unbounded recv is exactly the slow-loris hole this knob "
-                "closes")
-        if max_frame_bytes < 1024:
-            raise ValueError(
-                f"max_frame_bytes must be >= 1024, got {max_frame_bytes}")
-        self.queue = queue
-        self._host = host
-        self._port = port
-        self.read_deadline_s = read_deadline_s
-        self.max_frame_bytes = max_frame_bytes
-        self._sock: socket.socket | None = None
-        self._accept_thread: threading.Thread | None = None
-        self._conn_threads: list[threading.Thread] = []
-        # live connection sockets, force-closed on stop() so every handler
-        # thread (including ones parked on a half-open peer) joins promptly
-        self._conns: set[socket.socket] = set()
-        self._conns_lock = threading.Lock()
-        self._stop = threading.Event()
-
-    @property
-    def address(self) -> tuple[str, int] | None:
-        """(host, port) once started (port resolved for port=0)."""
-        return self._sock.getsockname() if self._sock is not None else None
-
-    def start(self) -> None:
-        if self._sock is not None:
-            return
-        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind((self._host, self._port))
-        s.listen(64)
-        # poll-style accept: close() does not reliably wake a thread
-        # blocked in accept() on all platforms, so the loop wakes every
-        # half-second to check the stop flag — stop() then joins within
-        # the deadline instead of hanging on a parked accept
-        s.settimeout(0.5)
-        self._sock = s
-        self._stop.clear()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="serve-accept", daemon=True)
-        self._accept_thread.start()
-
-    def stop(self, join_deadline_s: float = 5.0) -> None:
-        """Stop accepting, force-close live connections, and join every
-        per-connection thread against one overall deadline — a peer that
-        never sends another byte cannot leak a thread past stop()."""
-        self._stop.set()
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-        with self._conns_lock:
-            live = list(self._conns)
-        for conn in live:
-            # a blocking recv on this socket raises immediately — the
-            # handler thread exits instead of waiting out its read deadline
-            try:
-                conn.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                conn.close()
-            except OSError:
-                pass
-        deadline = time.monotonic() + join_deadline_s
-        if self._accept_thread is not None:
-            self._accept_thread.join(
-                timeout=max(deadline - time.monotonic(), 0.1))
-        for t in self._conn_threads:
-            t.join(timeout=max(deadline - time.monotonic(), 0.1))
-        leaked = [t.name for t in self._conn_threads if t.is_alive()]
-        if leaked:
-            print(f"serve: WARNING — {len(leaked)} connection thread(s) "
-                  f"still alive past the stop deadline: {leaked}",
-                  file=sys.stderr, flush=True)
-        self._conn_threads = [t for t in self._conn_threads if t.is_alive()]
-        self._sock = None
-
-    def submit(self, sub: Submission) -> str:
-        """Round-trip one submission over the wire (client side)."""
-        addr = self.address
-        if addr is None:
-            raise RuntimeError("SocketTransport not started")
-        return submit_over_socket(addr, sub)
-
-    # graftlint: drain-point — the accept loop's OWN thread blocks in
-    # accept() by design; nothing on the dispatch path waits on it
-    def _accept_loop(self) -> None:
-        assert self._sock is not None
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._sock.accept()
-            except socket.timeout:  # poll tick: re-check the stop flag
-                continue
-            except OSError:  # socket closed by stop()
-                return
-            conn.settimeout(None)  # per-conn deadline set in _serve_conn
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 name="serve-conn", daemon=True)
-            t.start()
-            self._conn_threads.append(t)
-            # reap finished handler threads so a long-lived service's list
-            # doesn't grow one entry per historical connection
-            self._conn_threads = [x for x in self._conn_threads
-                                  if x.is_alive()]
-
-    # graftlint: drain-point — per-connection recv loop, dedicated thread
-    def _serve_conn(self, conn: socket.socket) -> None:
-        with self._conns_lock:
-            self._conns.add(conn)
-        # in-flight chunk sequences on THIS connection: (client_id, round)
-        # -> list of frame dicts in receive order. The handler only
-        # COLLECTS — reassembly and every integrity verdict live in the
-        # ingest gauntlet (the G011 boundary).
-        sequences: dict[tuple[int, int], list] = {}
-        try:
-            # the read deadline: a silent peer (slow-loris, a client that
-            # died mid-frame) times out of recv and the connection closes —
-            # the thread can never be parked forever
-            conn.settimeout(self.read_deadline_s)
-            with conn:
-                buf = b""
-                while not self._stop.is_set():
-                    try:
-                        chunk = conn.recv(65536)
-                    except socket.timeout:
-                        obreg.default().counter(
-                            "serve_conn_deadline_total").inc()
-                        obtrace.instant("serve-ingest", "conn:deadline")
-                        return
-                    except OSError:
-                        return
-                    if not chunk:
-                        return
-                    buf += chunk
-                    if len(buf) > self.max_frame_bytes and b"\n" not in buf:
-                        # newline-less byte flood: cut it off at the cap —
-                        # per-connection memory stays bounded no matter
-                        # what the peer sends
-                        obreg.default().counter(
-                            "serve_rejected_malformed_total").inc()
-                        self.queue.note_wire_malformed()
-                        obtrace.instant("serve-ingest", "conn:frame_too_big",
-                                        bytes=len(buf))
-                        self._reply(conn, {"status": "MALFORMED",
-                                           "detail": "frame too large"})
-                        return
-                    while b"\n" in buf:
-                        line, buf = buf.split(b"\n", 1)
-                        if not line.strip():
-                            continue
-                        reply = self._handle_line(line, sequences,
-                                                  len(line))
-                        if reply is None:
-                            continue  # mid-sequence chunk: reply at the end
-                        if not self._reply(conn, reply):
-                            return
-        finally:
-            if sequences:
-                # the peer died (EOF / deadline / force-close) with chunk
-                # sequences still open: each partial sequence is a
-                # MALFORMED submission that admitted nothing
-                for _ in sequences:
-                    obreg.default().counter(
-                        "serve_rejected_malformed_total").inc()
-                    self.queue.note_wire_malformed()
-                obtrace.instant("serve-ingest", "conn:partial_sequence",
-                                sequences=len(sequences))
-            with self._conns_lock:
-                self._conns.discard(conn)
-
-    @staticmethod
-    def _reply(conn: socket.socket, reply: dict) -> bool:
-        try:
-            conn.sendall(json.dumps(reply).encode() + b"\n")
-            return True
-        except OSError:
-            return False
+    queue: IngestQueue
+    max_frame_bytes: int
 
     def _handle_line(self, line: bytes, sequences: dict | None = None,
                      line_bytes: int | None = None) -> dict | None:
@@ -390,8 +226,232 @@ class SocketTransport:
         if status == SHEDDING:
             # the overload contract: a shed client is TOLD when to come
             # back, so a flood decays instead of hammering the queue
-            reply["retry_after_s"] = self.queue.shed_retry_after_s
+            reply["retry_after_s"] = self._retry_after_s()
         return reply
+
+    def _retry_after_s(self) -> float:
+        """The SHEDDING retry-after hint. The sharded reactors override
+        this with a per-SHARD load-scaled hint (serve/scale/shard.py) so
+        an overloaded shard is distinguishable from an overloaded
+        server."""
+        return self.queue.shed_retry_after_s
+
+    def _abandoned_sequences(self, sequences: dict) -> None:
+        """A peer died (EOF / deadline / force-close) with chunk sequences
+        still open: each partial sequence is a MALFORMED submission that
+        admitted nothing."""
+        if not sequences:
+            return
+        for _ in sequences:
+            obreg.default().counter("serve_rejected_malformed_total").inc()
+            self.queue.note_wire_malformed()
+        obtrace.instant("serve-ingest", "conn:partial_sequence",
+                        sequences=len(sequences))
+
+
+class SocketTransport(LineProtocol):
+    """Loopback-TCP ingest: a tiny always-on server in front of the queue."""
+
+    def __init__(self, queue: IngestQueue, host: str = "127.0.0.1",
+                 port: int = 0, read_deadline_s: float = 30.0,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 max_conns: int = DEFAULT_MAX_CONNS_THREADED):
+        if read_deadline_s <= 0:
+            raise ValueError(
+                f"read_deadline_s must be > 0, got {read_deadline_s} — an "
+                "unbounded recv is exactly the slow-loris hole this knob "
+                "closes")
+        if max_frame_bytes < 1024:
+            raise ValueError(
+                f"max_frame_bytes must be >= 1024, got {max_frame_bytes}")
+        if max_conns < 1:
+            raise ValueError(f"max_conns must be >= 1, got {max_conns}")
+        self.max_conns = max_conns
+        self.queue = queue
+        self._host = host
+        self._port = port
+        self.read_deadline_s = read_deadline_s
+        self.max_frame_bytes = max_frame_bytes
+        self._sock: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._conn_threads: list[threading.Thread] = []
+        # live connection sockets, force-closed on stop() so every handler
+        # thread (including ones parked on a half-open peer) joins promptly
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self._stop = threading.Event()
+
+    @property
+    def address(self) -> tuple[str, int] | None:
+        """(host, port) once started (port resolved for port=0)."""
+        return self._sock.getsockname() if self._sock is not None else None
+
+    def addr_for(self, client_id: int) -> tuple[str, int] | None:
+        """The address client `client_id` should connect to — one listener
+        here; the sharded ingest (serve/scale/shard.py) routes by
+        client-id hash instead."""
+        return self.address
+
+    def start(self) -> None:
+        if self._sock is not None:
+            return
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self._host, self._port))
+        s.listen(64)
+        # poll-style accept: close() does not reliably wake a thread
+        # blocked in accept() on all platforms, so the loop wakes every
+        # half-second to check the stop flag — stop() then joins within
+        # the deadline instead of hanging on a parked accept
+        s.settimeout(0.5)
+        self._sock = s
+        self._stop.clear()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True)
+        self._accept_thread.start()
+
+    def stop(self, join_deadline_s: float = 5.0) -> None:
+        """Stop accepting, force-close live connections, and join every
+        per-connection thread against one overall deadline — a peer that
+        never sends another byte cannot leak a thread past stop()."""
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            live = list(self._conns)
+        for conn in live:
+            # a blocking recv on this socket raises immediately — the
+            # handler thread exits instead of waiting out its read deadline
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + join_deadline_s
+        if self._accept_thread is not None:
+            self._accept_thread.join(
+                timeout=max(deadline - time.monotonic(), 0.1))
+        for t in self._conn_threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
+        leaked = [t.name for t in self._conn_threads if t.is_alive()]
+        if leaked:
+            print(f"serve: WARNING — {len(leaked)} connection thread(s) "
+                  f"still alive past the stop deadline: {leaked}",
+                  file=sys.stderr, flush=True)
+        self._conn_threads = [t for t in self._conn_threads if t.is_alive()]
+        self._sock = None
+
+    def submit(self, sub: Submission) -> str:
+        """Round-trip one submission over the wire (client side)."""
+        addr = self.address
+        if addr is None:
+            raise RuntimeError("SocketTransport not started")
+        return submit_over_socket(addr, sub)
+
+    # graftlint: drain-point — the accept loop's OWN thread blocks in
+    # accept() by design; nothing on the dispatch path waits on it
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:  # poll tick: re-check the stop flag
+                continue
+            except OSError:  # socket closed by stop()
+                return
+            # reap finished handler threads so a long-lived service's list
+            # doesn't grow one entry per historical connection
+            self._conn_threads = [x for x in self._conn_threads
+                                  if x.is_alive()]
+            if len(self._conn_threads) >= self.max_conns:
+                # thread-per-connection has a hard architectural ceiling:
+                # every live connection is an OS thread. Past the cap the
+                # connection is refused outright (closed, counted) — the
+                # honest overload answer for this transport; the event-loop
+                # reactor (serve/scale/) is the path that holds thousands
+                obreg.default().counter("serve_conn_refused_total").inc()
+                obtrace.instant("serve-ingest", "conn:refused",
+                                live=len(self._conn_threads))
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            conn.settimeout(None)  # per-conn deadline set in _serve_conn
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="serve-conn", daemon=True)
+            t.start()
+            self._conn_threads.append(t)
+
+    # graftlint: drain-point — per-connection recv loop, dedicated thread
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with self._conns_lock:
+            self._conns.add(conn)
+        # in-flight chunk sequences on THIS connection: (client_id, round)
+        # -> list of frame dicts in receive order. The handler only
+        # COLLECTS — reassembly and every integrity verdict live in the
+        # ingest gauntlet (the G011 boundary).
+        sequences: dict[tuple[int, int], list] = {}
+        try:
+            # the read deadline: a silent peer (slow-loris, a client that
+            # died mid-frame) times out of recv and the connection closes —
+            # the thread can never be parked forever
+            conn.settimeout(self.read_deadline_s)
+            with conn:
+                buf = b""
+                while not self._stop.is_set():
+                    try:
+                        chunk = conn.recv(65536)
+                    except socket.timeout:
+                        obreg.default().counter(
+                            "serve_conn_deadline_total").inc()
+                        obtrace.instant("serve-ingest", "conn:deadline")
+                        return
+                    except OSError:
+                        return
+                    if not chunk:
+                        return
+                    buf += chunk
+                    if len(buf) > self.max_frame_bytes and b"\n" not in buf:
+                        # newline-less byte flood: cut it off at the cap —
+                        # per-connection memory stays bounded no matter
+                        # what the peer sends
+                        obreg.default().counter(
+                            "serve_rejected_malformed_total").inc()
+                        self.queue.note_wire_malformed()
+                        obtrace.instant("serve-ingest", "conn:frame_too_big",
+                                        bytes=len(buf))
+                        self._reply(conn, {"status": "MALFORMED",
+                                           "detail": "frame too large"})
+                        return
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        reply = self._handle_line(line, sequences,
+                                                  len(line))
+                        if reply is None:
+                            continue  # mid-sequence chunk: reply at the end
+                        if not self._reply(conn, reply):
+                            return
+        finally:
+            self._abandoned_sequences(sequences)
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    @staticmethod
+    def _reply(conn: socket.socket, reply: dict) -> bool:
+        try:
+            conn.sendall(json.dumps(reply).encode() + b"\n")
+            return True
+        except OSError:
+            return False
 
 
 # graftlint: drain-point — client-side blocking round-trip (the traffic

@@ -266,8 +266,57 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "window a late table is still admitted and folded; "
                         "older submissions bounce OUT_OF_ROUND and the "
                         "parked entry is dropped (counted)")
+    p.add_argument("--serve_transport", default="threaded",
+                   choices=["threaded", "eventloop"],
+                   help="--serve socket: the connection engine. threaded "
+                        "(default, the reference): one OS thread per "
+                        "connection, capped — fine for chaos tests, dead "
+                        "at heavy traffic. eventloop: the serve/scale "
+                        "selectors reactor — ONE thread multiplexing "
+                        "thousands of connections (non-blocking accept, "
+                        "incremental frame reassembly, read deadlines), "
+                        "identical admission decisions (shared protocol, "
+                        "same G011 gauntlet). The C1M path.")
+    p.add_argument("--serve_shards", type=int, default=0,
+                   help=">= 2 runs that many event-loop ingest reactors "
+                        "(each its own listener + thread) over the ONE "
+                        "admission queue, clients routed by client-id "
+                        "hash — spreads connection handling and payload-"
+                        "gauntlet CPU across workers. Per-shard admission/"
+                        "shed counters and load-scaled retry-after hints "
+                        "land in /metrics and /metrics.prom, so an "
+                        "overloaded shard is distinguishable from an "
+                        "overloaded server. Requires --serve socket "
+                        "--serve_transport eventloop. 0 = one listener")
+    p.add_argument("--serve_edges", type=int, default=0,
+                   help=">= 2 arms TWO-TIER edge aggregation "
+                        "(serve/scale/edge.py): the cohort partitions "
+                        "over this many edge aggregators by client-id "
+                        "hash; each edge validates + ordered-sums its "
+                        "shard's tables and forwards ONE r x c partial "
+                        "to the root (sketch linearity makes the tree "
+                        "merge exact), which folds partials in fixed "
+                        "edge order — pinned BITWISE equal to the flat "
+                        "merge of the same edge-armed session over the "
+                        "same surviving cohort. An edge dying == its "
+                        "shard dropped + re-queued, bitwise (edge_kill "
+                        "fault kind). Robust --merge_policy forces per-"
+                        "client FORWARDING through the tree (loud note; "
+                        "order statistics need individual tables). "
+                        "Requires --serve_payload sketch; does not "
+                        "compose with --serve_async/--serve_pipeline "
+                        "yet. 0 = flat merge (the exact prior program)")
+    p.add_argument("--serve_max_conns", type=int, default=0,
+                   help="--serve socket: concurrent-connection cap of the "
+                        "connection engine (per reactor when sharded) — "
+                        "past it connections are refused and counted "
+                        "(serve_conn_refused_total), never queued. 0 = "
+                        "the engine default: threaded 128 (every "
+                        "connection is an OS thread), eventloop 8192 "
+                        "(fd-bounded)")
     p.add_argument("--serve_port", type=int, default=0,
-                   help="--serve socket: loopback bind port (0 = ephemeral)")
+                   help="--serve socket: loopback bind port (0 = ephemeral; "
+                        "sharded ingest binds port+k per shard when set)")
     p.add_argument("--serve_metrics_port", type=int, default=-1,
                    help=">= 0 serves GET /metrics (JSON: round, queue "
                         "depth, arrival rate, quarantine/requeue counters) "
@@ -590,6 +639,49 @@ def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
         raise SystemExit(
             "--serve_pipeline pipelines the serving rounds; arm --serve "
             "inproc|socket")
+    if (getattr(args, "serve_transport", "threaded") != "threaded"
+            and getattr(args, "serve", "off") != "socket"):
+        raise SystemExit(
+            "--serve_transport picks the SOCKET connection engine; arm "
+            "--serve socket (inproc has no connections to multiplex)")
+    if getattr(args, "serve_shards", 0):
+        if getattr(args, "serve_shards", 0) < 2:
+            raise SystemExit(
+                f"--serve_shards must be >= 2 (or 0 = one listener), got "
+                f"{args.serve_shards}")
+        if getattr(args, "serve", "off") != "socket":
+            raise SystemExit(
+                "--serve_shards shards the socket ingest; arm --serve "
+                "socket")
+        if getattr(args, "serve_transport", "threaded") != "eventloop":
+            raise SystemExit(
+                "--serve_shards runs N event-loop reactors; arm "
+                "--serve_transport eventloop (thread-per-connection has "
+                "no reactor to shard)")
+    if getattr(args, "serve_max_conns", 0) < 0:
+        raise SystemExit(
+            f"--serve_max_conns must be >= 0 (0 = engine default), got "
+            f"{args.serve_max_conns}")
+    if getattr(args, "serve_edges", 0):
+        if getattr(args, "serve_edges", 0) < 2:
+            raise SystemExit(
+                f"--serve_edges must be >= 2 (or 0 = flat merge), got "
+                f"{args.serve_edges} (one edge IS the flat merge)")
+        if getattr(args, "serve", "off") == "off":
+            raise SystemExit(
+                "--serve_edges is a serving topology; arm --serve "
+                "inproc|socket")
+        if getattr(args, "serve_payload", "announce") != "sketch":
+            raise SystemExit(
+                "--serve_edges aggregates client TABLES at the edge tier; "
+                "the announce path has none — arm --serve_payload sketch")
+        if (getattr(args, "serve_async", False)
+                or getattr(args, "serve_pipeline", False)):
+            raise SystemExit(
+                "--serve_edges does not compose with --serve_async/"
+                "--serve_pipeline yet (stale-fold edge assignment and the "
+                "pipelined worker's edge timing are open follow-ups) — "
+                "drop one of the flags")
     if getattr(args, "health_every", 0):
         if args.health_every < 0:
             raise SystemExit(

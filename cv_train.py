@@ -115,6 +115,14 @@ def build(args, fault_plan=None, retry_policy=None):
         # straggle per round; the band bounds how long they stay foldable)
         stale_slots=(args.num_workers
                      if getattr(args, "serve_async", False) else 0),
+        # --serve_edges >= 2 (linear merge): compile the two-tier edge
+        # merge variants (grouped flat twin + partials root). A robust
+        # merge_policy runs the tree in FORWARD mode against the plain
+        # robust program instead, so the session stays at 0 there.
+        serve_edges=(getattr(args, "serve_edges", 0)
+                     if args.merge_policy == "sum"
+                     or (args.merge_policy == "trimmed"
+                         and args.merge_trim == 0) else 0),
         split_compile=args.split_compile,
         client_chunk=args.client_chunk,
         on_nonfinite=args.on_nonfinite,
@@ -162,6 +170,10 @@ def main(argv=None):
         fault_plan.validate_stale_context(
             args.serve != "off" and args.serve_payload == "sketch"
             and getattr(args, "serve_async", False))
+        fault_plan.validate_edge_context(
+            args.serve != "off" and args.serve_payload == "sketch"
+            and getattr(args, "serve_edges", 0) >= 2,
+            getattr(args, "serve_edges", 0))
     schedule = triangular(args.lr_scale, args.pivot_epoch, args.num_epochs)
     opt = FedOptimizer(schedule, rounds_per_epoch)
     model = FedModel(session)

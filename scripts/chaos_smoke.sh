@@ -20,6 +20,7 @@
 #        scripts/chaos_smoke.sh byzantine
 #        scripts/chaos_smoke.sh pipeline
 #        scripts/chaos_smoke.sh async_byzantine
+#        scripts/chaos_smoke.sh edge
 #        scripts/chaos_smoke.sh postmortem
 #
 # `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
@@ -77,6 +78,14 @@
 # honest wire_delay straggler crossing the round boundary — asserting the
 # per-kind attack counters fired, a stale fold survived the per-buffer
 # robust merge, and the logged train loss fell finite through all of it.
+#
+# `edge` mode drives the TWO-TIER edge-aggregation topology (< 1 min
+# CPU): a real cv_train run over --serve_edges 2 (sketch payload wire)
+# with edge 1 KILLED mid-round and a wire_delay straggler — asserting the
+# edge-death and fault counters fired, the run finished finite/falling,
+# and THE pin: edge-death == the dead edge's whole hash-shard dropped,
+# BITWISE (a client_drop twin at the ledger-derived shard positions lands
+# on identical params).
 #
 # `postmortem` mode drives the CRASH POSTMORTEM BUNDLE (< 1 min CPU): a
 # real cv_train run with --ledger armed is wedged mid-round by an injected
@@ -845,6 +854,128 @@ assert losses[-1] < losses[0], (
 print(f"async_byzantine: PASS (normride+stale_poison under the per-buffer "
       f"trimmed merge; stale admitted={int(admitted)} folded={int(folded)}, "
       f"loss {losses[0]:.4f} -> {losses[-1]:.4f}, 12 rounds, params finite)")
+EOF
+fi
+
+if [[ "${1:-}" == "edge" ]]; then
+    shift
+    exec timeout -k 10 "${CHAOS_TIMEOUT_S:-180}" python - "$@" <<'EOF'
+# edge chaos child (< 1 min CPU): the real cv_train.main CLI path
+# (tiny-model substitution) over the TWO-TIER edge-aggregation topology
+# (--serve_edges 2, sketch payload wire) with edge 1 KILLED mid-run and a
+# wire_delay straggler in the plan. Asserts the edge-death and requeue
+# counters fired, the killed edge's whole hash-shard was dropped that
+# round, the run finished every round with finite falling loss — and THE
+# bitwise pin: the edge-death run's final params equal a twin run that
+# client_drops exactly the dead edge's shard positions (edge death == its
+# shard's clients dropped, bitwise).
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import flax.linen as nn
+
+import commefficient_tpu.data.cifar as cifar
+import cv_train
+from commefficient_tpu.obs import registry as obreg
+from commefficient_tpu.serve.scale.edge import assign_edges
+
+
+class _TinyNet(nn.Module):
+    num_classes: int = 10
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+_orig = cifar.load_cifar_fed
+
+
+def _tiny(*a, **kw):
+    kw.update(synthetic_train=64, synthetic_test=32)
+    return _orig(*a, **kw)
+
+
+cv_train.ResNet9 = _TinyNet
+cv_train.load_cifar_fed = _tiny
+
+KILL_ROUND, DEAD_EDGE, E = 3, 1, 2
+BASE = [
+    "--dataset", "cifar10", "--mode", "sketch",
+    "--k", "2048", "--num_rows", "3", "--num_cols", "8192",
+    "--num_clients", "16", "--num_workers", "8", "--local_batch_size", "4",
+    "--lr_scale", "0.02", "--weight_decay", "0",
+    "--data_root", "/nonexistent", "--num_rounds", "12",
+    "--eval_every", "3",
+    "--serve", "inproc", "--serve_payload", "sketch",
+    "--serve_quorum", "0", "--serve_deadline", "8.0",
+    "--serve_edges", str(E),
+]
+
+reg = obreg.default()
+before_kill = reg.counter("resilience_fault_edge_kill_total").value
+before_death = reg.counter("serve_edge_deaths_total").value
+before_delay = reg.counter("resilience_faults_injected_total").value
+
+wdir = tempfile.mkdtemp()
+rows_path = os.path.join(wdir, "rows.jsonl")
+ledger_path = os.path.join(wdir, "ledger.jsonl")
+session = cv_train.main(BASE + [
+    "--log_jsonl", rows_path, "--ledger", ledger_path,
+    "--fault_plan",
+    f"edge_kill@{KILL_ROUND}:edges={DEAD_EDGE};"
+    f"wire_delay@1:clients=2,secs=1.5",
+])
+assert session.round == 12, session.round
+assert reg.counter("resilience_fault_edge_kill_total").value \
+    - before_kill >= 1, "edge_kill counter never fired"
+assert reg.counter("serve_edge_deaths_total").value \
+    - before_death >= 1, "serve_edge_deaths_total never fired"
+assert reg.counter("resilience_faults_injected_total").value \
+    - before_delay >= 2, "fault instants missing"
+
+rows = [json.loads(l) for l in open(rows_path) if l.strip()]
+losses = [r["train_loss"] for r in rows]
+assert all(np.isfinite(losses)), losses
+assert losses[-1] < losses[0], f"loss did not fall: {losses}"
+
+# THE bitwise pin: the run's own round LEDGER records each committed
+# round's cohort — read the kill round's invite list from it, hash it to
+# edges, and a twin run that client_drops exactly the dead edge's shard
+# positions must land on identical params.
+import jax
+from jax.flatten_util import ravel_pytree
+
+from commefficient_tpu.obs import ledger as L
+
+ids = None
+for rec in L.round_records(ledger_path):
+    if rec["round"] == KILL_ROUND:
+        ids = np.asarray(rec["cohort"], np.int64)
+assert ids is not None, f"ledger has no round {KILL_ROUND}"
+doomed = np.flatnonzero(assign_edges(ids, E) == DEAD_EDGE)
+assert len(doomed) > 0, "hash assignment left the dead edge empty"
+drop = "+".join(str(int(p)) for p in doomed)
+twin = cv_train.main(BASE + [
+    "--fault_plan",
+    f"client_drop@{KILL_ROUND}:clients={drop};"
+    f"wire_delay@1:clients=2,secs=1.5",
+])
+fa = np.asarray(ravel_pytree(jax.device_get(session.state["params"]))[0])
+fb = np.asarray(ravel_pytree(jax.device_get(twin.state["params"]))[0])
+assert np.array_equal(fa, fb), (
+    "edge-death run != shard-dropped twin (max abs diff "
+    f"{np.abs(fa - fb).max()})")
+print(f"edge: PASS (edge {DEAD_EDGE} killed at round {KILL_ROUND}: "
+      f"{len(doomed)} shard client(s) dropped == client_drop twin "
+      f"BITWISE; wire_delay straggler; loss {losses[0]:.4f} -> "
+      f"{losses[-1]:.4f}, 12 rounds, params finite)")
 EOF
 fi
 

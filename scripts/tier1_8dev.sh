@@ -28,11 +28,18 @@ python -m pytest tests/test_sharded_round.py tests/test_engine.py \
     tests/test_serve.py tests/test_obs.py tests/test_layerwise.py \
     tests/test_byzantine.py tests/test_pipeline_serve.py \
     tests/test_sketch_health.py tests/test_async_robust.py \
+    tests/test_scale.py \
     -q -m 'not slow' -p no:cacheprovider "$@"
 
 # the async x robust composition end to end (per-buffer robust merge under
 # the adaptive attackers, through the real CLI): < 1 min CPU
 scripts/chaos_smoke.sh async_byzantine
+
+# the two-tier edge-aggregation topology end to end (real cv_train over
+# --serve_edges 2 with an edge killed mid-round + a wire_delay straggler;
+# edge-death == shard-dropped pinned BITWISE via the run's own ledger
+# cohort): < 1 min CPU
+scripts/chaos_smoke.sh edge
 
 # bench mesh section must degrade to {"skipped": ...} on ONE device (the
 # real-chip driver path) instead of erroring: assert exactly that, cheaply.

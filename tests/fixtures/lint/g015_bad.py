@@ -1,0 +1,20 @@
+# graftlint: module=commefficient_tpu/serve/scale/fake_reactor.py
+# G015 violating twin: a blocking sleep AND a raw socket recv reachable
+# from the reactor's dispatch loop (_loop -> _backoff / _read_now) — one
+# blocked reactor is every connection blocked at once.
+import time
+
+
+def _backoff():
+    time.sleep(0.1)
+
+
+def _read_now(conn):
+    return conn.recv(65536)  # raw socket op outside any declared seam
+
+
+def _loop(self):
+    while not self.stop:
+        _backoff()
+        for conn in self.conns:
+            _read_now(conn)

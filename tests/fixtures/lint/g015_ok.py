@@ -1,0 +1,25 @@
+# graftlint: module=commefficient_tpu/serve/scale/fake_reactor.py
+# G015 conforming twin: the loop waits ONLY in the declared selector seam
+# and touches sockets only through declared non-blocking I/O helpers; the
+# sleep lives on an unrelated client helper no loop root reaches.
+import time
+
+
+# graftlint: drain-point — the reactor's one sanctioned wait
+def _select(self, timeout):
+    return self.sel.select(timeout)
+
+
+# graftlint: drain-point — non-blocking recv; would-block falls back
+def _on_readable(self, conn):
+    return conn.sock.recv(65536)
+
+
+def _loop(self):
+    while not self.stop:
+        for key, _ in _select(self, 0.5):
+            _on_readable(self, key.data)
+
+
+def client_backoff_helper():
+    time.sleep(0.1)  # client-side thread: not reachable from _loop

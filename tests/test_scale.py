@@ -1,0 +1,730 @@
+"""C1M scale-out serving (serve/scale/): event-loop transport, sharded
+ingest, and the two-tier edge-aggregation tree.
+
+The acceptance pins live here:
+
+- the EDGE-TREE merge (each edge ordered-sums its hash-shard's validated
+  tables, the root folds the forwarded [E, r, c] partials in fixed edge
+  order) is BIT-identical — params + every logged row — to the FLAT merge
+  of the same edge-armed session over the same surviving cohort, under
+  randomized arrival orders, edge counts, and straggler/drop patterns,
+  fused AND client-sharded, inproc AND socket;
+- an edge dying mid-round == its whole hash-shard of the cohort dropped,
+  bitwise, with the requeue machinery re-serving the clients;
+- preempt -> resume mid-run through the edge-tree path is bit-identical to
+  the uninterrupted twin (the CLI path);
+- the EVENT-LOOP transport makes the same admission decisions as the
+  threaded reference (shared LineProtocol): accept/dup/uninvited/
+  out-of-round, chunked payload reassembly, mid-send death == MALFORMED
+  partial sequence, read-deadline reaping, byte-flood cap, connection cap;
+- the SHARDED ingest routes by client-id hash, keeps one admission truth
+  (the shared queue), and surfaces per-shard counters + load-scaled
+  SHEDDING retry-after hints in /metrics and /metrics.prom.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import socket
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+from commefficient_tpu.federated.api import FederatedSession
+from commefficient_tpu.federated import engine
+from commefficient_tpu.modes import modes
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.resilience import FaultPlan
+from commefficient_tpu.serve.ingest import (
+    ACCEPTED,
+    DUPLICATE,
+    IngestQueue,
+    NOT_INVITED,
+    OUT_OF_ROUND,
+    PayloadPolicy,
+    SHEDDING,
+    Submission,
+)
+from commefficient_tpu.serve.scale.edge import (
+    EdgeTree,
+    assign_edges,
+    table_norms_host,
+)
+from commefficient_tpu.serve.scale.eventloop import EventLoopTransport
+from commefficient_tpu.serve.scale.shard import ShardedIngest, shard_for
+from commefficient_tpu.serve.service import AggregationService, ServeConfig
+from commefficient_tpu.serve.traffic import TraceConfig, TrafficGenerator
+from commefficient_tpu.serve.transport import (
+    SocketTransport,
+    abort_over_socket,
+    submit_over_socket,
+)
+
+LR = 0.05
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+def _quad_loss(params, net_state, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    err = pred - jax.nn.one_hot(batch["y"], pred.shape[-1])
+    mask = batch["mask"]
+    count = jnp.maximum(mask.sum(), 1.0)
+    per_ex = (err ** 2).sum(-1)
+    return (per_ex * mask).sum() / count, {
+        "net_state": net_state,
+        "metrics": {"loss_sum": (per_ex * mask).sum(), "count": mask.sum()}}
+
+
+def _tiny_session(serve_edges=0, clip=0.0, shards=1, seed=0, workers=4,
+                  merge_policy="sum", merge_trim=0, fault_plan=None):
+    rs = np.random.RandomState(0)
+    x = rs.randn(96, 6).astype(np.float32)
+    w_true = rs.randn(6, 3).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int32)
+    train = FedDataset(x, y, shard_iid(len(x), 12, np.random.RandomState(1)))
+    params = {"w": jnp.asarray(rs.randn(6, 3).astype(np.float32) * 0.1),
+              "b": jnp.zeros(3)}
+    d = ravel_pytree(params)[0].size
+    mc = ModeConfig(mode="sketch", d=d, k=4, num_rows=3, num_cols=16,
+                    momentum_type="virtual", error_type="virtual")
+    return FederatedSession(
+        train_loss_fn=_quad_loss, eval_loss_fn=_quad_loss,
+        params=params, net_state={}, mode_cfg=mc, train_set=train,
+        num_workers=workers, local_batch_size=4, seed=seed,
+        wire_payloads=True, serve_edges=serve_edges,
+        client_update_clip=clip, client_shards=shards,
+        merge_policy=merge_policy, merge_trim=merge_trim,
+        fault_plan=fault_plan,
+    )
+
+
+def _serve(session, rounds, edges=0, transport="inproc", quorum=3,
+           trace_seed=5, deadline=4.0):
+    """Drive served rounds through the real dispatch shape; returns the
+    metric rows."""
+    cfg = ServeConfig(quorum=quorum, deadline_s=deadline,
+                      transport=transport, payload="sketch", edges=edges)
+    svc = AggregationService(
+        session, cfg,
+        traffic=TrafficGenerator(
+            TraceConfig(population=session.train_set.num_clients,
+                        seed=trace_seed))).start()
+    rows = []
+    try:
+        src = svc.source()
+        for _ in range(rounds):
+            prep = src.next()
+            rows.append(session.commit_round(
+                session.dispatch_round(prep, LR))[0])
+            src.on_dispatched(session.round - 1)
+            src.on_committed(session.round)
+        src.stop()
+        with session.mutate_lock:
+            rng_state, rng_key = session.rng_snapshot
+            session.rng.set_state(rng_state)
+            session._rng_key = rng_key
+            session._requeue = collections.deque(session._requeue_committed)
+            session._requeue_enqueued = dict(
+                session._requeue_ages_committed)
+    finally:
+        svc.close()
+    return rows
+
+
+def _assert_params_equal(sa, sb):
+    for x, y in zip(
+        jax.tree.leaves(jax.device_get(sa.state["params"])),
+        jax.tree.leaves(jax.device_get(sb.state["params"])),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _assert_rows_equal(ra, rb):
+    for a, b in zip(ra, rb):
+        assert set(a) == set(b)
+        for k in a:
+            assert a[k] == b[k], (k, a[k], b[k])
+
+
+def _sub(cid, rnd=0, latency=0.1, payload=None):
+    return Submission(client_id=cid, round=rnd, latency_s=latency,
+                      payload=payload)
+
+
+# ------------------------------------------------- edge fold arithmetic
+
+
+def test_edge_grouped_sum_matches_per_edge_folds_bitwise():
+    """The load-bearing arithmetic property: the in-program grouped fold
+    over the full stack == per-edge shard-local folds + the fixed-order
+    partial merge, BITWISE, for randomized tables/masks/assignments —
+    the mechanism the end-to-end pin rests on."""
+    fold = jax.jit(lambda ts, ms: jax.lax.scan(
+        lambda a, x: (a + jnp.where(x[1] > 0, x[0], jnp.zeros_like(x[0])),
+                      None),
+        jnp.zeros(ts.shape[1:], ts.dtype),
+        (ts, ms))[0])
+    for seed in range(5):
+        rs = np.random.RandomState(seed)
+        W, r, c = 8, 3, 7
+        E = int(rs.randint(2, 5))
+        scale = np.logspace(-3, 3, W).reshape(-1, 1, 1).astype(np.float32)
+        tables = (rs.randn(W, r, c).astype(np.float32) * scale)
+        live = (rs.rand(W) > 0.3).astype(np.float32)
+        assign = rs.randint(0, E, W).astype(np.int32)
+        grouped = np.asarray(modes.edge_grouped_sum(
+            jnp.asarray(tables), jnp.asarray(live), jnp.asarray(assign), E))
+        partials = []
+        for e in range(E):
+            idx = np.flatnonzero(assign == e)
+            partials.append(np.asarray(fold(jnp.asarray(tables[idx]),
+                                            jnp.asarray(live[idx]))))
+        tree = np.asarray(modes.merge_edge_partials(
+            jnp.asarray(np.stack(partials))))
+        np.testing.assert_array_equal(grouped, tree)
+
+
+def test_table_norms_host_partition_invariant():
+    rs = np.random.RandomState(3)
+    tables = rs.randn(9, 3, 5).astype(np.float32)
+    full = table_norms_host(tables)
+    assign = assign_edges(np.arange(100, 109), 3)
+    for e in range(3):
+        idx = np.flatnonzero(assign == e)
+        np.testing.assert_array_equal(full[idx], table_norms_host(tables[idx]))
+    assert table_norms_host(np.zeros((0, 3, 5), np.float32)).shape == (0,)
+
+
+def test_assign_edges_matches_shard_routing():
+    ids = np.arange(1000, 1050)
+    assign = assign_edges(ids, 4)
+    assert assign.dtype == np.int32
+    for i, cid in enumerate(ids):
+        assert assign[i] == shard_for(int(cid), 4)
+    # uses more than one edge on any reasonable cohort
+    assert len(set(assign.tolist())) > 1
+
+
+# ------------------------------------- THE pin: edge tree == flat, bitwise
+
+
+@pytest.mark.parametrize("clip,shards,edges,quorum,trace_seed", [
+    (0.0, 1, 2, 3, 5),    # fused, quarantine off
+    (3.0, 1, 3, 3, 7),    # fused, quarantine armed, 3 edges
+    (3.0, 2, 2, 3, 11),   # client-sharded session
+    (0.0, 1, 4, 2, 13),   # deep short-quorum drops (straggler patterns)
+])
+def test_edge_tree_merge_equals_flat_merge_bitwise(clip, shards, edges,
+                                                   quorum, trace_seed):
+    """THE acceptance pin: the two-tier edge-tree serving path (partials
+    crossing the tree) is bit-identical — params + every logged row — to
+    the flat serving path of the same edge-armed session, across
+    randomized arrival orders (trace seeds), edge counts, quarantine
+    armed/off, short-quorum straggler/no-show patterns, and client
+    sharding."""
+    sa = _tiny_session(serve_edges=edges, clip=clip, shards=shards)
+    ra = _serve(sa, 4, edges=edges, quorum=quorum, trace_seed=trace_seed)
+    sb = _tiny_session(serve_edges=edges, clip=clip, shards=shards)
+    rb = _serve(sb, 4, edges=0, quorum=quorum, trace_seed=trace_seed)
+    _assert_params_equal(sa, sb)
+    _assert_rows_equal(ra, rb)
+
+
+def test_edge_tree_over_socket_equals_inproc_bitwise():
+    """The pin holds over the REAL loopback socket wire (frames, checksums,
+    the gauntlet) — float32 serialization is exact, so the edge-tree
+    socket round is bitwise the inproc one."""
+    sa = _tiny_session(serve_edges=2)
+    ra = _serve(sa, 3, edges=2, transport="socket")
+    sb = _tiny_session(serve_edges=2)
+    rb = _serve(sb, 3, edges=2, transport="inproc")
+    _assert_params_equal(sa, sb)
+    _assert_rows_equal(ra, rb)
+
+
+def test_edge_death_equals_shard_dropped_bitwise():
+    """An edge killed mid-round == every client of its hash-shard dropped
+    (client_drop at the same positions), bitwise, and the casualties go
+    through the requeue machinery."""
+    E, kill_round, dead_edge = 2, 1, 1
+    plan = FaultPlan.parse(f"edge_kill@{kill_round}:edges={dead_edge}")
+    sa = _tiny_session(serve_edges=E, fault_plan=plan)
+    # derive the doomed positions the same way the tree will: the round's
+    # cohort is a pure function of the session's sampling stream
+    probe = _tiny_session(serve_edges=E)
+    ids_by_round = [probe.sample_cohort(r) for r in range(2)]
+    doomed = np.flatnonzero(
+        assign_edges(ids_by_round[kill_round], E) == dead_edge)
+    assert len(doomed) > 0, "hash assignment left the dead edge empty"
+    drop_spec = "+".join(str(int(p)) for p in doomed)
+    plan_b = FaultPlan.parse(f"client_drop@{kill_round}:clients={drop_spec}")
+    sb = _tiny_session(serve_edges=E, fault_plan=plan_b)
+    ra = _serve(sa, 3, edges=E, quorum=0)
+    rb = _serve(sb, 3, edges=E, quorum=0)
+    _assert_params_equal(sa, sb)
+    _assert_rows_equal(ra, rb)
+    # the whole shard was masked + the requeue machinery saw them
+    assert ra[kill_round]["clients_dropped"] >= len(doomed)
+    assert ra[kill_round]["requeue_depth"] >= len(doomed)
+
+
+def test_robust_merge_forces_forward_mode_and_stays_bitwise(capsys):
+    """--merge_policy trimmed with the edge tree: edges FORWARD per-client
+    tables (loud note), the plain robust program dispatches, and the
+    tree run is bitwise the flat robust run."""
+    sa = _tiny_session(merge_policy="trimmed", merge_trim=1)
+    ra = _serve(sa, 3, edges=2)
+    note = capsys.readouterr().err
+    assert "FORWARDS its shard's validated tables" in note
+    sb = _tiny_session(merge_policy="trimmed", merge_trim=1)
+    rb = _serve(sb, 3, edges=0)
+    _assert_params_equal(sa, sb)
+    _assert_rows_equal(ra, rb)
+
+
+def test_edge_config_validation():
+    # engine-side: serve_edges needs the wire, rejects robust/async/layer
+    with pytest.raises(ValueError, match="wire_payloads"):
+        engine.EngineConfig(
+            mode=ModeConfig(mode="sketch", d=8, k=2, num_rows=2,
+                            num_cols=8), serve_edges=2)
+    with pytest.raises(ValueError, match="robust"):
+        _tiny_session(serve_edges=2, merge_policy="median")
+    # service-side: the topology needs a session compiled for it
+    s = _tiny_session(serve_edges=0)
+    with pytest.raises(ValueError, match="serve_edges"):
+        AggregationService(
+            s, ServeConfig(quorum=3, transport="inproc", payload="sketch",
+                           edges=2),
+            traffic=TrafficGenerator(TraceConfig(population=12)))
+    with pytest.raises(ValueError, match="announce path has none"):
+        AggregationService(
+            s, ServeConfig(quorum=3, transport="inproc", edges=2),
+            traffic=TrafficGenerator(TraceConfig(population=12)))
+    with pytest.raises(ValueError, match="one edge IS the flat merge"):
+        AggregationService(
+            s, ServeConfig(quorum=3, transport="inproc", payload="sketch",
+                           edges=1),
+            traffic=TrafficGenerator(TraceConfig(population=12)))
+    # edge_kill context validation
+    plan = FaultPlan.parse("edge_kill@1:edges=0")
+    with pytest.raises(ValueError, match="edge_kill can never fire"):
+        plan.validate_edge_context(False)
+    with pytest.raises(ValueError, match="can never fire"):
+        plan.validate_edge_context(True, n_edges=0)
+    plan.validate_edge_context(True, n_edges=2)
+    with pytest.raises(ValueError, match="edge_kill"):
+        FaultPlan.parse("edge_kill@1")  # edges= required
+
+
+# --------------------------------------------- event-loop transport parity
+
+
+def test_eventloop_admission_decisions_match_threaded():
+    """Same LineProtocol, same queue: every admission decision the
+    threaded transport returns, the reactor returns."""
+    for cls in (SocketTransport, EventLoopTransport):
+        q = IngestQueue(capacity=16)
+        t = cls(q, read_deadline_s=2.0)
+        t.start()
+        try:
+            q.open_round(0, [1, 2, 3])
+            assert submit_over_socket(t.address, _sub(1)) == ACCEPTED
+            assert submit_over_socket(t.address, _sub(1)) == DUPLICATE
+            assert submit_over_socket(t.address, _sub(9)) == NOT_INVITED
+            assert submit_over_socket(t.address, _sub(2, rnd=7)) == \
+                OUT_OF_ROUND
+        finally:
+            t.stop()
+            q.shutdown()
+
+
+def test_eventloop_chunked_payload_roundtrip_exact():
+    q = IngestQueue(capacity=8,
+                    payload_policy=PayloadPolicy(rows=2, cols=4096))
+    t = EventLoopTransport(q, max_frame_bytes=4096, read_deadline_s=2.0)
+    t.start()
+    try:
+        q.open_round(0, [7])
+        tab = np.arange(2 * 4096, dtype=np.float32).reshape(2, 4096)
+        assert submit_over_socket(
+            t.address, _sub(7, payload=tab), max_frame_bytes=4096) == \
+            ACCEPTED
+        arr = q.arrivals(0)
+        assert len(arr) == 1
+        np.testing.assert_array_equal(arr[0].table, tab)
+    finally:
+        t.stop()
+        q.shutdown()
+
+
+def test_eventloop_mid_send_death_counts_malformed():
+    """A connection that dies mid chunk-sequence admits nothing and the
+    partial sequence counts MALFORMED when the deadline reaps it."""
+    q = IngestQueue(capacity=8,
+                    payload_policy=PayloadPolicy(rows=2, cols=4096))
+    t = EventLoopTransport(q, max_frame_bytes=4096, read_deadline_s=0.3)
+    t.start()
+    try:
+        q.open_round(0, [8])
+        tab = np.ones((2, 4096), np.float32)
+        abort_over_socket(t.address, _sub(8, payload=tab),
+                          max_frame_bytes=4096)
+        deadline = time.monotonic() + 5.0
+        while (q.counters()["rejected_malformed"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert q.counters()["rejected_malformed"] >= 1
+        assert q.arrivals(0) == []
+    finally:
+        t.stop()
+        q.shutdown()
+
+
+def test_eventloop_byte_flood_cut_off_at_cap():
+    q = IngestQueue(capacity=8)
+    t = EventLoopTransport(q, max_frame_bytes=2048, read_deadline_s=2.0)
+    t.start()
+    try:
+        with socket.create_connection(t.address, timeout=5.0) as s:
+            s.sendall(b"x" * 8192)  # newline-less flood
+            s.settimeout(5.0)
+            reply = b""
+            while b"\n" not in reply:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                reply += chunk
+        assert b"MALFORMED" in reply
+        assert q.counters()["rejected_malformed"] >= 1
+    finally:
+        t.stop()
+        q.shutdown()
+
+
+def test_eventloop_connection_cap_refuses():
+    q = IngestQueue(capacity=8)
+    t = EventLoopTransport(q, read_deadline_s=5.0, max_conns=4)
+    t.start()
+    socks = []
+    try:
+        q.open_round(0, list(range(16)))
+        for _ in range(4):
+            s = socket.create_connection(t.address, timeout=5.0)
+            socks.append(s)
+            # one byte each so the reactor has registered the conn
+            s.sendall(b"\n")
+        deadline = time.monotonic() + 5.0
+        while t.open_conns < 4 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert t.open_conns == 4
+        # the 5th is accepted by the OS but closed by the reactor: a
+        # round-trip on it must fail
+        with pytest.raises((ConnectionError, OSError)):
+            submit_over_socket(t.address, _sub(1), timeout_s=2.0)
+    finally:
+        for s in socks:
+            s.close()
+        t.stop()
+        q.shutdown()
+
+
+def test_eventloop_holds_many_concurrent_connections():
+    """The scale claim in miniature: the reactor holds an order of
+    magnitude more live connections than the threaded transport's default
+    cap, on one thread, and still answers."""
+    q = IngestQueue(capacity=4096)
+    t = EventLoopTransport(q, read_deadline_s=30.0)
+    t.start()
+    socks = []
+    try:
+        q.open_round(0, list(range(2000)))
+        n = 1500  # > 10x DEFAULT_MAX_CONNS_THREADED (128)
+        for _ in range(n):
+            socks.append(socket.create_connection(t.address, timeout=10.0))
+        # every connection live at once, then each submits
+        for i, s in enumerate(socks):
+            s.sendall(json.dumps(
+                {"client_id": i, "round": 0, "latency_s": 0.1}
+            ).encode() + b"\n")
+        got = 0
+        for s in socks:
+            s.settimeout(30.0)
+            buf = b""
+            while b"\n" not in buf:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+            if b"ACCEPTED" in buf:
+                got += 1
+        assert got == n
+        assert q.counters()["accepted"] == n
+    finally:
+        for s in socks:
+            s.close()
+        t.stop()
+        q.shutdown()
+
+
+def test_standalone_reactor_publishes_no_shard_series():
+    """A plain (non-sharded) eventloop reactor must not emit phantom
+    serve_shard0_* metrics — a shard 0 with connections but zero
+    submissions reads as a broken shard in an unsharded deployment."""
+    from commefficient_tpu.obs import registry as obreg
+
+    q = IngestQueue(capacity=8)
+    t = EventLoopTransport(q, read_deadline_s=2.0)
+    t.start()
+    try:
+        q.open_round(0, [1])
+        before = obreg.default().snapshot().get("serve_shard0_conns")
+        assert submit_over_socket(t.address, _sub(1)) == ACCEPTED
+        time.sleep(0.1)
+        after = obreg.default().snapshot().get("serve_shard0_conns")
+        assert before == after  # untouched (absent, or a prior test's relic)
+    finally:
+        t.stop()
+        q.shutdown()
+
+
+def test_serve_max_conns_plumbs_through_config():
+    s = _tiny_session()
+    cfg = ServeConfig(quorum=3, transport="socket", payload="sketch",
+                      socket_transport="eventloop", max_conns=7)
+    svc = AggregationService(
+        s, cfg, traffic=TrafficGenerator(
+            TraceConfig(population=12, seed=5)))
+    try:
+        assert svc.transport.max_conns == 7
+    finally:
+        svc.close()
+
+
+def test_eventloop_thread_hygiene():
+    before = {th.name for th in __import__("threading").enumerate()}
+    q = IngestQueue(capacity=8)
+    t = EventLoopTransport(q, read_deadline_s=1.0)
+    t.start()
+    q.open_round(0, [1])
+    submit_over_socket(t.address, _sub(1))
+    t.stop()
+    q.shutdown()
+    time.sleep(0.1)
+    after = {th.name for th in __import__("threading").enumerate()}
+    assert not [n for n in after - before if n.startswith("serve-reactor")]
+
+
+# --------------------------------------------------------- sharded ingest
+
+
+def test_sharded_ingest_routes_and_counts():
+    q = IngestQueue(capacity=64)
+    tr = ShardedIngest(q, n_shards=2, read_deadline_s=2.0)
+    tr.start()
+    try:
+        ids = list(range(40, 72))
+        q.open_round(0, ids)
+        for cid in ids:
+            assert tr.submit(_sub(cid)) == ACCEPTED
+        assert q.counters()["accepted"] == len(ids)
+        counts = tr.counters()
+        per_shard = [counts[str(k)]["submissions"] for k in range(2)]
+        assert sum(per_shard) == len(ids)
+        assert all(c > 0 for c in per_shard), per_shard
+        assert all(counts[str(k)]["misrouted"] == 0 for k in range(2))
+        # a misrouted submission is still decided correctly, but counted
+        cid = ids[0]
+        wrong = tr.shards[1 - shard_for(cid, 2)]
+        assert submit_over_socket(wrong.address, _sub(cid)) == DUPLICATE
+        counts = tr.counters()
+        assert sum(counts[str(k)]["misrouted"] for k in range(2)) == 1
+    finally:
+        tr.stop()
+        q.shutdown()
+
+
+def test_sharded_shedding_hint_is_per_shard():
+    """Per-shard SHEDDING: the shed reply carries a shard-load-scaled
+    retry-after hint and the shard's own gauges move — an overloaded
+    shard is distinguishable from an overloaded server."""
+    q = IngestQueue(capacity=4, pending_capacity=0, shed_watermark=0.25,
+                    shed_retry_after_s=1.0)
+    tr = ShardedIngest(q, n_shards=2, read_deadline_s=2.0)
+    tr.start()
+    try:
+        ids = list(range(8))
+        q.open_round(0, ids)
+        statuses = [tr.submit(_sub(cid)) for cid in ids]
+        assert SHEDDING in statuses
+        counts = tr.counters()
+        shed_total = sum(counts[str(k)]["shed"] for k in range(2))
+        assert shed_total >= 1
+        hints = [counts[str(k)]["retry_after_s"] for k in range(2)
+                 if counts[str(k)]["shed"]]
+        assert all(h >= 1.0 for h in hints)
+    finally:
+        tr.stop()
+        q.shutdown()
+
+
+def test_shard_metrics_reach_prometheus_exposition():
+    from commefficient_tpu.serve.metrics import render_prometheus
+
+    q = IngestQueue(capacity=16)
+    tr = ShardedIngest(q, n_shards=2, read_deadline_s=2.0)
+    tr.start()
+    try:
+        q.open_round(0, [1, 2])
+        tr.submit(_sub(1))
+        body = render_prometheus()
+        for k in range(2):
+            assert f"serve_shard{k}_submissions_total" in body
+            assert f"serve_shard{k}_retry_after_s" in body
+    finally:
+        tr.stop()
+        q.shutdown()
+
+
+def test_sharded_service_end_to_end_metrics():
+    """A full served payload run over the sharded event-loop ingest: the
+    rounds commit, and /metrics carries the shards block."""
+    s = _tiny_session()
+    cfg = ServeConfig(quorum=3, transport="socket", payload="sketch",
+                      socket_transport="eventloop", shards=2,
+                      metrics_port=0)
+    svc = AggregationService(
+        s, cfg, traffic=TrafficGenerator(
+            TraceConfig(population=12, seed=5))).start()
+    try:
+        src = svc.source()
+        for _ in range(2):
+            prep = src.next()
+            s.commit_round(s.dispatch_round(prep, LR))
+            src.on_dispatched(s.round - 1)
+            src.on_committed(s.round)
+        src.stop()
+        host, port = svc.metrics_server.address
+        snap = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10).read())
+        assert snap["transport_engine"] == "eventloop"
+        assert set(snap["shards"]) == {"0", "1"}
+        assert sum(snap["shards"][k]["submissions"]
+                   for k in snap["shards"]) > 0
+    finally:
+        svc.close()
+    assert s.round == 2
+
+
+def test_shard_transport_config_validation():
+    with pytest.raises(ValueError, match="n_shards must be >= 2"):
+        ShardedIngest(IngestQueue(capacity=4), n_shards=1)
+    s = _tiny_session()
+    with pytest.raises(ValueError, match="eventloop"):
+        AggregationService(
+            s, ServeConfig(quorum=3, transport="socket", payload="sketch",
+                           shards=2),
+            traffic=TrafficGenerator(TraceConfig(population=12)))
+    with pytest.raises(ValueError, match="no connections to shard"):
+        AggregationService(
+            s, ServeConfig(quorum=3, transport="inproc", payload="sketch",
+                           socket_transport="eventloop", shards=2),
+            traffic=TrafficGenerator(TraceConfig(population=12)))
+
+
+# ----------------------------------------------- CLI: flags + preempt/resume
+
+
+@pytest.fixture
+def tiny_cv(tmp_path, monkeypatch):
+    import flax.linen as nn
+
+    import commefficient_tpu.data.cifar as cifar_mod
+    import cv_train
+
+    orig = cifar_mod.load_cifar_fed
+
+    def tiny(*a, **kw):
+        kw.update(synthetic_train=64, synthetic_test=32)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(cv_train, "load_cifar_fed", tiny)
+
+    class _TinyNet(nn.Module):
+        num_classes: int = 10
+        dtype: str = "float32"
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(8)(x))
+            return nn.Dense(self.num_classes)(x)
+
+    monkeypatch.setattr(cv_train, "ResNet9", _TinyNet)
+    return tmp_path
+
+
+_CLI_ARGV = [
+    "--dataset", "cifar10", "--mode", "sketch", "--num_clients", "8",
+    "--num_workers", "4", "--local_batch_size", "4", "--num_rounds", "4",
+    "--k", "16", "--num_rows", "3", "--num_cols", "128", "--lr_scale",
+    "0.05", "--weight_decay", "0", "--data_root", "/nonexistent",
+    "--seed", "3", "--serve", "inproc", "--serve_payload", "sketch",
+    "--serve_quorum", "3", "--serve_deadline", "2.0", "--serve_edges", "2",
+]
+
+
+@pytest.mark.chaos
+def test_cli_edge_tree_preempt_resume_bit_identical(tiny_cv, tmp_path):
+    """preempt -> exit 75 -> --resume mid-run THROUGH the edge-tree path
+    == the uninterrupted edge-tree twin (params + requeue state) — the
+    edge layer is round-scoped, so the committed-snapshot rewinds carry
+    it for free, and this pins that they actually do."""
+    import cv_train
+    from commefficient_tpu.resilience import EXIT_RESUMABLE
+
+    sa = cv_train.main(list(_CLI_ARGV))  # uninterrupted reference
+    ckdir = str(tmp_path / "ck")
+    chaos = ["--checkpoint_dir", ckdir, "--checkpoint_every", "1",
+             "--fault_plan", "preempt@2"]
+    with pytest.raises(SystemExit) as ei:
+        cv_train.main(list(_CLI_ARGV) + chaos)
+    assert ei.value.code == EXIT_RESUMABLE
+    sc = cv_train.main(list(_CLI_ARGV) + chaos + ["--resume"])
+    assert sc.round == 4
+    _assert_params_equal(sa, sc)
+    assert list(sa._requeue) == list(sc._requeue)
+
+
+def test_cli_flag_validation(tiny_cv):
+    import cv_train
+
+    base = ["--dataset", "cifar10", "--mode", "sketch",
+            "--data_root", "/nonexistent", "--num_rounds", "1"]
+    with pytest.raises(SystemExit, match="one edge IS the flat merge"):
+        cv_train.main(base + ["--serve", "inproc", "--serve_payload",
+                              "sketch", "--serve_edges", "1"])
+    with pytest.raises(SystemExit, match="serve_payload sketch"):
+        cv_train.main(base + ["--serve", "inproc", "--serve_edges", "2"])
+    with pytest.raises(SystemExit, match="serve socket"):
+        cv_train.main(base + ["--serve", "inproc", "--serve_transport",
+                              "eventloop", "--serve_shards", "2"])
+    with pytest.raises(SystemExit, match="eventloop"):
+        cv_train.main(base + ["--serve", "socket", "--serve_shards", "2"])
+    with pytest.raises(SystemExit, match="does not compose"):
+        cv_train.main(base + [
+            "--serve", "inproc", "--serve_payload", "sketch",
+            "--serve_edges", "2", "--serve_pipeline"])
+    with pytest.raises(ValueError, match="edge_kill can never fire"):
+        cv_train.main(base + ["--serve", "inproc",
+                              "--fault_plan", "edge_kill@0:edges=0"])
