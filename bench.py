@@ -331,7 +331,10 @@ RUN_LOOP_ROUNDS = int(os.environ.get("BENCH_RUN_LOOP_ROUNDS", 30))
 # under the diurnal trace, (b) host-memory flatness of the O(1) fold_in
 # client state at a 10M-ID population vs 10k (the no-per-client-table
 # acceptance check), (c) submission-to-merge latency p50/p99 through a REAL
-# served session (invite -> push -> W-of-N close -> dispatch -> commit).
+# served session (invite -> push -> W-of-N close -> dispatch -> commit),
+# (e) the --serve_fastpath A/B over the loopback socket: submission-to-merge
+# p50/p99 and bytes_touched_per_table, slow path vs pinned ring + batched
+# gauntlet + ingest/H2D overlap (same trace, same seed).
 # resnet9 only, like run_loop; {"skipped": ...} when unavailable.
 # ravel-vs-layerwise sketch accumulation A/B on the run_loop bench (resnet9
 # only): updates/s + per-round ms through the REAL async runner for both
@@ -2020,9 +2023,154 @@ def _serve_bench() -> dict:
                     "the per-arm merged_submissions_per_sec and idle "
                     "figures are the A/B numbers",
         }
+        # (e) the --serve_fastpath A/B (its own function so a CPU archive
+        # run can produce just this section, like the r15 scale archive)
+        out["fastpath_vs_slow"] = _fastpath_bench()
     except Exception as e:  # noqa: BLE001 — partial sections still report
         out["error"] = f"{type(e).__name__}: {e}"
     return out
+
+
+def _fastpath_bench() -> dict:
+    """Zero-copy fast path A/B (--serve_fastpath): the SAME wire-payload
+    trace + seed over the LOOPBACK SOCKET (real frames, real decode — the
+    transport where the copy discipline differs), slow path vs pinned-ring
+    + batched gauntlet + H2D overlap. Headlines per arm: submission-to-
+    merge p50/p99 (percentile window reset between arms so each arm owns
+    its figures) and bytes_touched_per_table — the
+    serve_table_bytes_copied_total delta over accepted submissions (slow:
+    decode copy + close-time stack copy = 2x table bytes; fast: the one
+    ring-slot write). Never raises."""
+    import time as _time
+
+    import numpy as np
+
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+
+        from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+        from commefficient_tpu.federated.api import FederatedSession
+        from commefficient_tpu.modes.config import ModeConfig
+        from commefficient_tpu.serve import (
+            AggregationService, ServeConfig, TraceConfig, TrafficGenerator,
+        )
+    except Exception as e:  # noqa: BLE001 — the skipped stanza IS the result
+        return {"skipped": f"serve deps unavailable: {type(e).__name__}: {e}"}
+
+    # 2 MiB/table (the flagship GPT-2-scale sketch dims): the fast path's
+    # wins are BYTE wins — the close-time stack copy it deletes and the
+    # H2D it overlaps — so the arms are compared where table bytes are the
+    # round's dominant cost, not where fixed per-push overheads are
+    rows, cols = 8, 65536
+    din, dout, wire_workers = 16, 8, 8
+
+    def _quad_loss(params, net_state, batch, rng):
+        pred = batch["x"] @ params["w"] + params["b"]
+        err = pred - jax.nn.one_hot(batch["y"], pred.shape[-1])
+        mask = batch["mask"]
+        count = jnp.maximum(mask.sum(), 1.0)
+        per_ex = (err ** 2).sum(-1)
+        return (per_ex * mask).sum() / count, {
+            "net_state": net_state, "metrics": {}}
+
+    def _wire_session():
+        rs = np.random.RandomState(0)
+        xw = rs.randn(256, din).astype(np.float32)
+        w_true = rs.randn(din, dout).astype(np.float32)
+        yw = (xw @ w_true).argmax(-1).astype(np.int32)
+        wtrain = FedDataset(xw, yw, shard_iid(len(xw), 24,
+                                              np.random.RandomState(1)))
+        wparams = {"w": jnp.asarray(
+            rs.randn(din, dout).astype(np.float32) * 0.1),
+            "b": jnp.zeros(dout)}
+        dw = ravel_pytree(wparams)[0].size
+        return FederatedSession(
+            train_loss_fn=_quad_loss, eval_loss_fn=_quad_loss,
+            params=wparams, net_state={},
+            mode_cfg=ModeConfig(mode="sketch", d=dw, k=8,
+                                num_rows=rows, num_cols=cols,
+                                momentum=0.9, momentum_type="virtual",
+                                error_type="virtual"),
+            train_set=wtrain, num_workers=wire_workers,
+            local_batch_size=4, seed=0, wire_payloads=True,
+        )
+
+    def _fastpath_arm(fastpath: bool) -> dict:
+        wsess = _wire_session()
+        svc = AggregationService(
+            wsess,
+            ServeConfig(quorum=wire_workers, deadline_s=30.0,
+                        transport="socket", payload="sketch",
+                        fastpath=fastpath),
+            traffic=TrafficGenerator(
+                TraceConfig(population=wsess.train_set.num_clients,
+                            seed=0)),
+        ).start()
+        try:
+            reg = svc.registry
+            src = svc.source()
+            # warmup: each arm's first rounds pay their own XLA compiles
+            # (the fast arm's chunk-concat + capacity-shaped scatter, the
+            # slow arm's stack device_put + training step); the arms are
+            # compared on steady-state rounds only
+            for _ in range(2):
+                prep = src.next()
+                wsess.commit_round(wsess.dispatch_round(prep, 0.01))
+                src.on_committed(wsess.round)
+            reg.histogram("serve_submit_to_merge_ms").reset_window()
+            bytes0 = reg.counter("serve_table_bytes_copied_total").value
+            merged0 = svc._latency.count
+            accepted0 = svc.queue.counters()["accepted"]
+            t0 = _time.perf_counter()
+            for _ in range(SERVE_ROUNDS):
+                prep = src.next()
+                wsess.commit_round(wsess.dispatch_round(prep, 0.01))
+                src.on_committed(wsess.round)
+            wall = _time.perf_counter() - t0
+            accepted = svc.queue.counters()["accepted"] - accepted0
+            dbytes = (reg.counter("serve_table_bytes_copied_total").value
+                      - bytes0)
+            return {
+                "fastpath": fastpath,
+                "merged_submissions_per_sec": round(
+                    (svc._latency.count - merged0) / max(wall, 1e-9), 2),
+                "submission_to_merge_ms": {
+                    k: v for k, v in svc._latency.summary().items()
+                    if k in ("p50", "p99")},
+                "bytes_touched_per_table": round(
+                    dbytes / max(accepted, 1), 1),
+                "table_bytes": rows * cols * 4,
+                "accepted": accepted,
+                "gauntlet_batch_ms": (
+                    reg.histogram("serve_gauntlet_batch_ms").summary()
+                    if fastpath else None),
+            }
+        finally:
+            svc.close()
+
+    try:
+        slow_arm = _fastpath_arm(False)
+        fast_arm = _fastpath_arm(True)
+    except Exception as e:  # noqa: BLE001 — partial sections still report
+        return {"error": f"{type(e).__name__}: {e}"}
+    return {
+        "rounds": SERVE_ROUNDS,
+        "rows_cols": [rows, cols],
+        "invited_per_round": wire_workers,
+        "slow": slow_arm,
+        "fast": fast_arm,
+        "bytes_touched_ratio": round(
+            slow_arm["bytes_touched_per_table"]
+            / max(fast_arm["bytes_touched_per_table"], 1e-9), 3),
+        "note": "same trace, same seed, loopback socket; slow touches each "
+                "accepted table's bytes twice on host (decode astype + "
+                "close-time stack), fast once (the pinned ring-slot write) "
+                "with the validation gauntlet batched and the H2D upload "
+                "overlapping the open window. Both arms commit bitwise-"
+                "identical params (pinned in tests/test_serve.py)",
+    }
 
 
 def _mesh_bench(rt_ms: float) -> dict:

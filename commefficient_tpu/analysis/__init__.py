@@ -65,6 +65,27 @@ G012  robust-order-sensitivity                   order statistics (sort/
                                                  robust-merge boundary,
                                                  modes._robust_table_merge
                                                  (`# graftlint: robust-merge`)
+G013  staleness-fold-boundary                    staleness-weighted arithmetic
+                                                 over stale wires only inside
+                                                 the declared staleness-fold
+                                                 boundary (`# graftlint:
+                                                 staleness-fold`)
+G014  ledger-write-outside-commit                the durable round ledger is
+                                                 appended only at the declared
+                                                 commit site (`# graftlint:
+                                                 ledger-commit`)
+G015  blocking-call-in-event-loop                the socket reactor thread
+                                                 never blocks: no sleeps /
+                                                 sync IO / lock waits in the
+                                                 event-loop dispatch scope
+G016  per-submission-copy-in-fastpath            the zero-copy fast path
+                                                 touches table bytes ONCE: no
+                                                 base64 decode, per-item
+                                                 np.stack, or frombuffer().
+                                                 copy() in fast-path modules
+                                                 outside the ONE declared
+                                                 ring-slot write
+                                                 (`# graftlint: ring-write`)
 ====  =========================================  ================================
 
 Run it:
@@ -95,6 +116,7 @@ from __future__ import annotations
 from .core import Analyzer, Rule, SourceFile, Violation
 from .rules_config import UnvalidatedConfigRead
 from .rules_dataflow import DonationAfterUse, RngKeyReuse
+from .rules_fastpath import PerSubmissionCopyInFastpath
 from .rules_io import RawCheckpointWrite
 from .rules_ledger import LedgerWriteOutsideCommit
 from .rules_obs import ObsCallInCompiledScope
@@ -122,6 +144,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     StalenessFoldBoundary,
     LedgerWriteOutsideCommit,
     BlockingCallInEventLoop,
+    PerSubmissionCopyInFastpath,
 )
 
 RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)

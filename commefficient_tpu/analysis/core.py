@@ -61,6 +61,7 @@ class FunctionInfo:
     robust_merge: bool = False
     staleness_fold: bool = False
     ledger_commit: bool = False
+    ring_write: bool = False
 
 
 class SourceFile:
@@ -112,9 +113,11 @@ class SourceFile:
                         cand & self.directives.staleness_fold_linenos)
                     ledg = bool(
                         cand & self.directives.ledger_commit_linenos)
+                    ring = bool(
+                        cand & self.directives.ring_write_linenos)
                     out.append(FunctionInfo(qual, start, child.lineno, end,
                                             drain, sketch, payload, robust,
-                                            stale, ledg))
+                                            stale, ledg, ring))
                     visit(child, f"{qual}.")
                 elif isinstance(child, ast.ClassDef):
                     visit(child, f"{prefix}{child.name}.")
@@ -166,6 +169,12 @@ class SourceFile:
         """True when any enclosing function is the declared ledger-commit
         boundary (G014's sanctioned round-ledger append site)."""
         return any(f.ledger_commit
+                   for f in self.enclosing_functions(lineno))
+
+    def in_ring_write(self, lineno: int) -> bool:
+        """True when any enclosing function is the declared ring-slot
+        write boundary (G016's sanctioned per-submission copy site)."""
+        return any(f.ring_write
                    for f in self.enclosing_functions(lineno))
 
     # -- import index --------------------------------------------------------

@@ -24,6 +24,12 @@ Three forms, all line-anchored comments:
                                          in runner/+federated/ that may
                                          append to the durable ledger (the
                                          commit boundary)
+    # graftlint: ring-write              on/above a `def`: this function IS
+                                         the declared ring-slot write site
+                                         (G016 exempt) — the ONE place in
+                                         fast-path scope that may copy a
+                                         per-submission table (into its
+                                         pinned ring slot)
     # graftlint: module=<relpath>        fixture support: analyze this file as
                                          if it lived at <relpath> (scoped rules
                                          fire on test snippets)
@@ -75,6 +81,9 @@ class Directives:
     # linenos carrying a ledger-commit marker (G014's sanctioned round-
     # ledger append site — FederatedSession._publish_round_obs)
     ledger_commit_linenos: set[int]
+    # linenos carrying a ring-write marker (G016's sanctioned per-
+    # submission copy site — serve.ring.RingSlot.write)
+    ring_write_linenos: set[int]
     # fixture impersonation path, or None
     module_override: str | None
     # (lineno, message) for malformed directives — surfaced as G000
@@ -130,7 +139,7 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
         line_disables={}, file_disables=set(), drain_linenos=set(),
         sketch_boundary_linenos=set(), payload_boundary_linenos=set(),
         robust_merge_linenos=set(), staleness_fold_linenos=set(),
-        ledger_commit_linenos=set(),
+        ledger_commit_linenos=set(), ring_write_linenos=set(),
         module_override=None, errors=[],
     )
     for lineno, line in _comments(text):
@@ -160,6 +169,8 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
             d.staleness_fold_linenos.add(lineno)
         elif verb == "ledger-commit" and not has_eq:
             d.ledger_commit_linenos.add(lineno)
+        elif verb == "ring-write" and not has_eq:
+            d.ring_write_linenos.add(lineno)
         elif verb == "module" and has_eq:
             d.module_override = arg.strip()
         elif not verb:
@@ -170,6 +181,6 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
                 f"unknown graftlint directive {verb!r} "
                 "(expected disable/disable-file/drain-point/"
                 "sketch-boundary/payload-boundary/robust-merge/"
-                "staleness-fold/ledger-commit/module)",
+                "staleness-fold/ledger-commit/ring-write/module)",
             ))
     return d

@@ -1,0 +1,97 @@
+"""G016 per-submission-copy-in-fastpath.
+
+The zero-copy fast path (``--serve_fastpath``) exists to make the
+ingest-to-merge route touch each accepted table's bytes ONCE: the frame is
+decoded straight into its pinned ring slot (serve/ring.py), the uploader
+ships ring views to the device, and the merge consumes the device stack.
+Its whole performance claim dies by a thousand cuts — one well-meaning
+``np.frombuffer(...).copy()`` here, one per-item ``np.stack`` there — and
+none of those regressions fail a test, because the bytes are identical
+either way (the bitwise pin cannot see a copy). This rule is the
+regression tripwire the tests cannot be.
+
+Detection, in the declared fast-path modules (the transports, the batched
+gauntlet, and the ring itself):
+
+- any call resolving through the import table into ``base64.*`` — frame
+  text decoding belongs to ``validate_payload`` (G011's boundary), never
+  to the transport or gauntlet hot loop;
+- ``numpy.stack`` — the slow path's per-round stack copy is exactly what
+  the ring replaces; a stack call in fast-path scope is the old copy
+  sneaking back in;
+- ``.copy()`` chained directly onto a ``numpy.frombuffer(...)`` call —
+  the classic "defensive" per-submission duplication of freshly decoded
+  frame bytes.
+
+The ONE sanctioned per-submission copy — the write into the pinned ring
+slot (``serve.ring.RingSlot.write``) — is declared with ``# graftlint:
+ring-write`` on the line above its ``def`` and is exempt. Everything else
+in scope must move views, not bytes.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+# the declared fast-path modules: every function here is on (or one call
+# from) the per-submission hot loop
+_FASTPATH_MODULES = (
+    f"{PACKAGE}/serve/ring.py",
+    f"{PACKAGE}/serve/gauntlet.py",
+    f"{PACKAGE}/serve/transport.py",
+    f"{PACKAGE}/serve/scale/eventloop.py",
+)
+
+
+class PerSubmissionCopyInFastpath(Rule):
+    code = "G016"
+    name = "per-submission-copy-in-fastpath"
+    fixit = ("move views, not bytes: decode into the submission's pinned "
+             "ring slot (serve.ring.RingSlot.write, the declared "
+             "`# graftlint: ring-write` boundary) or hand the raw frame to "
+             "validate_payload — never re-copy or re-stack per-submission "
+             "data in fast-path scope")
+
+    def applies(self, rel: str) -> bool:
+        return rel in _FASTPATH_MODULES
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if src.in_ring_write(node.lineno):
+                continue
+            dotted = src.resolve_dotted(node.func)
+            if dotted is not None and (dotted == "base64"
+                                       or dotted.startswith("base64.")):
+                out.append(self.violation(
+                    src, node,
+                    f"{dotted}() decodes frame text on the fast path — "
+                    "frame decoding is validate_payload's job (G011 "
+                    "boundary), not the transport/gauntlet hot loop"))
+            elif dotted == "numpy.stack":
+                out.append(self.violation(
+                    src, node,
+                    "np.stack() re-materializes a per-round table copy in "
+                    "fast-path scope — the pinned ring replaces exactly "
+                    "this copy; build views over ring blocks instead"))
+            elif self._frombuffer_copy(src, node):
+                out.append(self.violation(
+                    src, node,
+                    "np.frombuffer(...).copy() duplicates freshly decoded "
+                    "frame bytes per submission — write them once into "
+                    "the ring slot instead"))
+        return out
+
+    @staticmethod
+    def _frombuffer_copy(src: SourceFile, node: ast.Call) -> bool:
+        """`.copy()` chained directly onto a numpy.frombuffer(...) call."""
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "copy"):
+            return False
+        inner = f.value
+        return (isinstance(inner, ast.Call)
+                and src.resolve_dotted(inner.func) == "numpy.frombuffer")

@@ -955,9 +955,13 @@ class FederatedSession:
             prep, masked=masked, requeue_depth=len(self._requeue),
             requeue=tuple(self._requeue),
             requeue_ages=tuple(self._requeue_enqueued.items()),
-            # the gauntlet's validated table stack is host numpy already
-            payload=(np.asarray(wire_tables, np.float32), arrived, aux,  # graftlint: disable=G001
-                     stale, edge),
+            # the gauntlet's validated table stack is host numpy already —
+            # EXCEPT the fast path, whose ring uploader already shipped it
+            # to device (a jax.Array passes through untouched; re-wrapping
+            # would force a device->host->device bounce)
+            payload=(wire_tables if isinstance(wire_tables, jax.Array)
+                     else np.asarray(wire_tables, np.float32),  # graftlint: disable=G001
+                     arrived, aux, stale, edge),
         )
 
     def _dispatch_payload_merge(self, prep: PreparedRound,

@@ -123,6 +123,13 @@ class Histogram:
         idx = min(len(vals) - 1, max(0, int(len(vals) * p / 100.0)))
         return vals[idx]
 
+    def reset_window(self) -> None:
+        """Drop the percentile window, keep the cumulative count/sum — for
+        A/B drivers (bench arms) that need each arm's p50/p99 over its OWN
+        observations while rates/means stay whole-run."""
+        with self._lock:
+            self._window.clear()
+
     def summary(self) -> dict:
         """{p50, p99, count} — the /metrics-endpoint shape (p50/p99 None
         when nothing was observed yet)."""

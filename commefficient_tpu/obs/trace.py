@@ -38,8 +38,8 @@ from . import export
 
 # canonical track order (chrome-trace tid assignment; unknown tracks get
 # the next free id at first use)
-TRACKS = ("runner", "device", "writer", "serve-ingest", "assembler",
-          "federated", "resilience")
+TRACKS = ("runner", "device", "writer", "serve-ingest", "gauntlet",
+          "assembler", "federated", "resilience")
 
 EVENT_SCHEMA_VERSION = 1
 

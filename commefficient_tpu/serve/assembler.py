@@ -37,6 +37,7 @@ import dataclasses
 
 import numpy as np
 
+from ..obs import registry as obreg
 from ..obs import trace as obtrace
 from .ingest import IngestQueue
 
@@ -80,7 +81,8 @@ class CohortAssembler:
     def __init__(self, queue: IngestQueue, quorum: int, deadline_s: float,
                  payload_shape: tuple | None = None,
                  trigger_label: str = "quorum",
-                 collect_stragglers: bool = False):
+                 collect_stragglers: bool = False,
+                 ring_mode: bool = False):
         if quorum < 1:
             raise ValueError(f"quorum must be >= 1, got {quorum}")
         self.queue = queue
@@ -89,6 +91,12 @@ class CohortAssembler:
         # (r, c) of the wire-payload tables; None = announce path (closed
         # rounds carry no table stack)
         self.payload_shape = payload_shape
+        # --serve_fastpath: accepted tables already live in the round's
+        # pinned ring block, so the close skips the [N, r, c] stack copy —
+        # the serving layer builds the device stack from the ring instead
+        # (ClosedRound.tables is None; straggler stashes COPY out of the
+        # ring, because a ring view must never outlive its round's block)
+        self.ring_mode = ring_mode
         # what a count-triggered close is CALLED: "quorum" (W-of-N sync
         # close) or "buffer" (the async buffer-size trigger) — same cut
         # arithmetic, different operational meaning in the counters
@@ -173,13 +181,20 @@ class CohortAssembler:
         made the close, an exact-zero row everywhere else (no-show,
         straggler, rejected frame) — so downstream a rejected payload is
         bitwise a dropped client. None on the announce path."""
-        if self.payload_shape is None:
+        if self.payload_shape is None or self.ring_mode:
             return None
         out = np.zeros((n,) + tuple(self.payload_shape), np.float32)
+        copied = 0
         for a in arrivals:
             p = pos.get(int(a.client_id))
             if p is not None and arrived[p] == 1.0 and a.table is not None:
                 out[p] = a.table
+                copied += 1
+        if copied:
+            # the slow path's second per-table host copy (the first was the
+            # decode) — what bytes_touched_per_table in the bench measures
+            obreg.default().counter("serve_table_bytes_copied_total").inc(
+                copied * int(np.prod(self.payload_shape)) * 4)
         return out
 
     def _collect_stragglers(self, pos, arrivals, arrived) -> tuple:
@@ -194,7 +209,12 @@ class CohortAssembler:
         for a in arrivals:
             p = pos.get(int(a.client_id))
             if p is not None and arrived[p] == 0.0 and a.table is not None:
-                out.append((int(p), int(a.client_id), a.table))
+                # ring mode: detach from the ring (the block is released
+                # when the round's device stack is built, but a straggler
+                # stash outlives the round by design)
+                table = (np.array(a.table, np.float32) if self.ring_mode
+                         else a.table)
+                out.append((int(p), int(a.client_id), table))
         return tuple(sorted(out, key=lambda e: e[0]))
 
     def _finish(self, rnd, invited, arrived, lat, closed_by,

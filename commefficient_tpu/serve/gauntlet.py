@@ -1,0 +1,170 @@
+"""Batched validation gauntlet: the worker pool behind --serve_fastpath.
+
+The threaded and event-loop transports used to run the full payload
+gauntlet (base64 + crc32 + dtype/shape + L2 screen) inline, one frame at a
+time, on whatever thread read the frame. Under load that serializes pure
+numpy work behind socket reads. The fast path hands raw, UNPARSED
+submissions to this pool instead: each worker drains every submission
+available (up to `max_batch`) and pushes the whole block through
+`IngestQueue.submit_block`, which decodes frames straight into the round's
+pinned ring slots (serve/ring.py) and runs the finite/L2 screen ONE numpy
+pass per block. Batching is drain-available — a lone frame on an idle
+server is a batch of one (no added latency), a burst becomes a real block.
+
+Verdicts stay per-submission: `submit_block` returns one admission status
+per entry, bitwise the status the inline path would have produced, and the
+pool delivers each to its `done` callback. The two transports ride the
+pool differently: the event-loop reactor `submit()`s and takes the verdict
+on its deferred-reply queue (serve/scale/eventloop.py) so the G015 reactor
+never blocks on a batch, while the threaded transport's per-connection
+thread uses `submit_and_wait()` — a CALLER-RUNS policy where the pushing
+thread itself drains batches until its own verdict lands, so a lone push
+on an idle server pays zero cross-thread handoffs and a concurrent burst
+still forms real blocks.
+
+`stop()` guarantees every waiter a verdict: workers finish the batches they
+hold, then anything still pending is failed out with CLOSED (the same
+status a submission racing the server's shutdown has always seen).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+
+from ..obs import registry as obreg
+from ..obs import trace as obtrace
+from .ingest import CLOSED
+
+
+class GauntletPool:
+    """Small shared worker pool running the batched gauntlet (module
+    docstring). One pool serves every transport shard — blocks form across
+    shards, which is exactly what the sharded ingest wants: a shard's
+    output is a validated table block, not a pile of per-frame copies."""
+
+    def __init__(self, queue, workers: int = 2, max_batch: int = 32):
+        if workers < 1:
+            raise ValueError(f"gauntlet workers must be >= 1, got {workers}")
+        self.queue = queue
+        self.max_batch = int(max_batch)
+        self._cv = threading.Condition()
+        self._pending: deque = deque()  # (submission, done_callback)
+        self._stopping = False
+        self._threads = [
+            threading.Thread(target=self._run, name=f"serve-gauntlet-{i}",
+                             daemon=True)
+            for i in range(int(workers))
+        ]
+        self._started = False
+
+    def start(self) -> "GauntletPool":
+        if not self._started:
+            self._started = True
+            for t in self._threads:
+                t.start()
+        return self
+
+    def submit(self, sub, done) -> None:
+        """Enqueue one UNPARSED submission; `done(status)` fires exactly
+        once with its individually-attributed admission verdict. This is
+        the event-loop entry point — it wakes a worker, because the
+        reactor itself can never pitch in (G015)."""
+        with self._cv:
+            if not self._stopping:
+                self._pending.append((sub, done))
+                self._cv.notify()
+                return
+        done(CLOSED)
+
+    def submit_and_wait(self, sub) -> str:
+        """Caller-runs submit for the THREADED transport: enqueue, then
+        help drain the queue until this submission's verdict lands. A
+        lone push on an idle server validates on the pushing thread
+        itself — no worker wake, no cross-thread handoff on the reply
+        path — while concurrent pushing threads still form real blocks
+        (each drain takes everything pending, across every connection and
+        shard). Workers are deliberately NOT notified for these entries;
+        they exist for the event-loop path, whose reactor must not
+        block."""
+        done = threading.Event()
+        box: dict = {}
+
+        def deliver(status: str) -> None:
+            box["status"] = status
+            done.set()
+
+        with self._cv:
+            if self._stopping:
+                return CLOSED
+            self._pending.append((sub, deliver))
+        while not done.is_set() and self._drain_one():
+            pass
+        if not done.is_set():
+            # the entry rode out in another thread's batch — park for its
+            # verdict (generous backstop only: stop() fails every still-
+            # pending waiter out with CLOSED, so one always arrives)
+            done.wait(timeout=60.0)
+        return box.get("status", CLOSED)
+
+    def _drain_one(self) -> bool:
+        """Pop one batch if anything is pending and run the gauntlet over
+        it on the calling thread; False when the queue was empty."""
+        with self._cv:
+            if not self._pending:
+                return False
+            batch = []
+            while self._pending and len(batch) < self.max_batch:
+                batch.append(self._pending.popleft())
+        self._process(batch)
+        return True
+
+    def stop(self, join_deadline_s: float = 5.0) -> None:
+        """Stop the workers; every still-pending waiter gets CLOSED."""
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        if self._started:
+            for t in self._threads:
+                t.join(timeout=join_deadline_s)
+        with self._cv:
+            leftovers = list(self._pending)
+            self._pending.clear()
+        for _sub, done in leftovers:
+            done(CLOSED)
+
+    # graftlint: drain-point — the gauntlet worker's own thread parks on
+    # the batch condvar by design; nothing on the reactor or dispatch
+    # path ever waits here
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._stopping:
+                    self._cv.wait()
+                if not self._pending:
+                    return  # stopping, and the queue is drained
+                batch = []
+                while self._pending and len(batch) < self.max_batch:
+                    batch.append(self._pending.popleft())
+            self._process(batch)
+
+    def _process(self, batch) -> None:
+        """Run one validation block and deliver every verdict — shared by
+        the worker loop and the caller-runs drain."""
+        t0 = time.perf_counter()
+        try:
+            with obtrace.span("gauntlet", "validate-block",
+                              frames=len(batch)):
+                statuses = self.queue.submit_block(
+                    [sub for sub, _done in batch])
+        except Exception as exc:  # a verdict MUST reach every waiter
+            print(f"serve: gauntlet batch failed ({exc!r}); failing "
+                  f"{len(batch)} submission(s) CLOSED",
+                  file=sys.stderr, flush=True)
+            statuses = [CLOSED] * len(batch)
+        obreg.default().histogram("serve_gauntlet_batch_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        for (_sub, done), status in zip(batch, statuses):
+            done(status)

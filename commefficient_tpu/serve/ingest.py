@@ -78,6 +78,15 @@ gauntlet's scalar median snapshot is the same table-space ring the merge
 advances, so a payload rejected QUARANTINED here is bitwise the payload
 the merge would have quarantined (pinned in tests/test_byzantine.py).
 
+With ``--serve_fastpath`` armed the gauntlet runs BATCHED: the socket
+transports hand raw, unparsed frames to a small worker pool
+(serve/gauntlet.py) that pushes whole blocks through ``submit_block`` —
+decoded tables land directly in the round's pinned ring slots
+(serve/ring.py, one write, no per-submission ndarray) and the finite/L2
+screen vectorizes over the stacked block (``screen_block``). Decisions
+stay per-submission, individually attributed, and bitwise identical to
+the inline path; `validate_payload` remains the single G011 boundary.
+
 All counters are cumulative over the service lifetime and feed the metrics
 endpoint (serve/metrics.py); the wire-facing rejections additionally bump
 process-wide resilience counters in the obs registry.
@@ -253,12 +262,21 @@ def _reassemble_chunks(payload):
 # graftlint: payload-boundary — THE sanctioned decode of untrusted wire
 # bytes; every transport payload passes through here before compiled scope
 def validate_payload(payload, policy: PayloadPolicy,
-                     median: float | None = None):
+                     median: float | None = None,
+                     out=None, screen: bool = True):
     """THE deserialization boundary for untrusted wire bytes (graftlint
     G011): every byte a transport hands the server passes through here
     before anything can reach compiled scope. Returns (table, decision,
     detail) — `table` is a validated host float32 [r, c] ndarray only when
     decision == ACCEPTED, else None.
+
+    `out` is the fast path's landing zone (--serve_fastpath): a RingSlot
+    (serve/ring.py) the decoded table is written into ONCE, after every
+    structural check passed — the returned `table` is then the slot VIEW,
+    never a fresh per-submission ndarray. `screen=False` defers the
+    finite/L2 screen so the batched gauntlet can run it vectorized over a
+    whole block (`screen_block`) — the verdicts are bitwise the same;
+    ONLY the batched admission path may pass screen=False.
 
     Check order (first failure wins — a frame with several defects reports
     the EARLIEST stage, so an unknown-schema frame with a bad checksum is
@@ -293,7 +311,18 @@ def validate_payload(payload, policy: PayloadPolicy,
         if t.shape != (policy.rows, policy.cols):
             return None, MALFORMED, (
                 f"shape {t.shape} != ({policy.rows}, {policy.cols})")
-        return _screen_table(np.ascontiguousarray(t), policy, median)
+        if out is not None:
+            # inproc fast path: the client program's output table lands
+            # straight in its ring slot — no encode/decode round-trip,
+            # no standalone copy
+            t = out.write(t)
+            obreg.default().counter(
+                "serve_table_bytes_copied_total").inc(policy.nbytes)
+        else:
+            t = np.ascontiguousarray(t)
+        if not screen:
+            return t, ACCEPTED, ""
+        return _screen_table(t, policy, median)
     if isinstance(payload, (list, tuple)):
         payload, decision, detail = _reassemble_chunks(list(payload))
         if decision is not None:
@@ -335,8 +364,19 @@ def validate_payload(payload, policy: PayloadPolicy,
             f"decoded {len(raw)} bytes, length prefix says {nbytes}")
     if (zlib.crc32(raw) & 0xFFFFFFFF) != crc:
         return None, MALFORMED, "checksum mismatch"
-    t = np.frombuffer(raw, dtype=WIRE_DTYPE).astype(
-        np.float32).reshape(policy.rows, policy.cols)
+    wire_view = np.frombuffer(raw, dtype=WIRE_DTYPE).reshape(
+        policy.rows, policy.cols)
+    if out is not None:
+        # the fast path's ONE per-table copy: the decoded wire view lands
+        # in the pinned ring slot (the write casts <f4 -> float32
+        # bit-exactly, same bytes astype would produce)
+        t = out.write(wire_view)
+    else:
+        t = wire_view.astype(np.float32)
+    obreg.default().counter(
+        "serve_table_bytes_copied_total").inc(policy.nbytes)
+    if not screen:
+        return t, ACCEPTED, ""
     return _screen_table(t, policy, median)
 
 
@@ -358,6 +398,56 @@ def _screen_table(t: np.ndarray, policy: PayloadPolicy,
                     f"sketch L2 {norm:.3g} > {policy.clip_multiple:g} x "
                     f"median {med:.3g}")
     return t, ACCEPTED, ""
+
+
+def screen_block(entries, policy: PayloadPolicy):
+    """The batched gauntlet's vectorized finite/L2 screen: one numpy pass
+    over each contiguous ring range instead of a per-table reduction.
+    `entries` is a list of (table, median, block, slot_index) — block/slot
+    identify the ring row a slot-backed table occupies; (table, median,
+    None, -1) marks a standalone table (ring overflow), screened scalar.
+    Returns one (decision, detail) per entry.
+
+    Verdicts are BITWISE the per-table `_screen_table` results: a row of a
+    contiguous [m, r, c] block reduces over the same r*c contiguous
+    elements in the same order as the 2-D full-sum (numpy's pairwise
+    summation is layout-deterministic), the float64 square/sqrt are
+    elementwise IEEE-exact, and the detail strings format the identical
+    double. Medians arrive RESOLVED (the target round's snapshot) — the
+    batched path never reaches for the live quarantine_median callable."""
+    verdicts: list = [None] * len(entries)
+    want_norms = (policy.clip_multiple > 0
+                  and policy.quarantine_median is not None)
+    # group slot-backed entries by their owning ring block; each group
+    # screens over ONE contiguous view of the block's buffer
+    groups: dict[int, tuple[Any, list[int]]] = {}
+    for i, (t, _med, blk, slot) in enumerate(entries):
+        if blk is not None and slot >= 0:
+            groups.setdefault(id(blk), (blk, []))[1].append(i)
+        else:
+            _t, decision, detail = _screen_table(t, policy, _med)
+            verdicts[i] = (decision, detail)
+    for blk, idxs in groups.values():
+        rows = [entries[i][3] for i in idxs]
+        lo, hi = min(rows), max(rows)
+        chunk = blk.tables[lo:hi + 1]
+        finite = np.isfinite(chunk).all(axis=(1, 2))
+        norms = (np.sqrt(np.square(chunk, dtype=np.float64).sum(axis=(1, 2)))
+                 if want_norms else None)
+        for i, row in zip(idxs, rows):
+            if not finite[row - lo]:
+                verdicts[i] = (QUARANTINED, "non-finite table")
+                continue
+            med = float(entries[i][1])
+            if want_norms and med > 0:
+                norm = float(norms[row - lo])
+                if norm > policy.clip_multiple * med:
+                    verdicts[i] = (QUARANTINED, (
+                        f"sketch L2 {norm:.3g} > {policy.clip_multiple:g} x "
+                        f"median {med:.3g}"))
+                    continue
+            verdicts[i] = (ACCEPTED, "")
+    return verdicts
 
 
 class _Window:
@@ -435,6 +525,10 @@ class IngestQueue:
         self._cv = threading.Condition()
         # open windows, keyed by round (at most max_open_rounds entries)
         self._windows: dict[int, _Window] = {}
+        # --serve_fastpath: the open rounds' attached ring blocks
+        # (serve/ring.py) — decoded tables land straight in their slots.
+        # Popped at close_round; the block lock is a LEAF under this one.
+        self._blocks: dict[int, Any] = {}
         # the newest round ever opened; the pending buffer targets
         # _newest + 1 (the round a client may push early for — whether the
         # newest window is still open or the server is mid-merge)
@@ -531,6 +625,24 @@ class IngestQueue:
             self._pending = still_pending
             self._cv.notify_all()
 
+    def attach_block(self, rnd: int, block) -> None:
+        """Arm the fast path for an OPEN round: decoded payloads for `rnd`
+        land in `block`'s ring slots from here until close_round."""
+        with self._cv:
+            if rnd in self._windows:
+                self._blocks[rnd] = block
+
+    def _acquire_slot(self, rnd: int):
+        """(block, slot) for a fast-path decode: the round's attached ring
+        block and a free slot in it — (block, None) when the block is full
+        (the decode falls back to a standalone table, counted as ring
+        overflow), (None, None) when no fast path is armed for `rnd`."""
+        with self._cv:
+            blk = self._blocks.get(int(rnd))
+        if blk is None:
+            return None, None
+        return blk, blk.acquire()
+
     def close_round(self, rnd: int | None = None) -> list[Arrival]:
         """Close one open window — `rnd` names it; None closes the OLDEST
         open round (the single-window callers' historical behavior) — and
@@ -543,6 +655,7 @@ class IngestQueue:
                     return []
                 rnd = min(self._windows)
             win = self._windows.pop(rnd, None)
+            self._blocks.pop(rnd, None)  # no new ring acquires past close
             if win is None:
                 return []
             if self.stale_rounds:
@@ -597,6 +710,13 @@ class IngestQueue:
         serve-ingest track, linked to the later merge span by the
         `submission` id (r<round>/c<cid>)."""
         status = self._decide(sub)
+        self._finish_submit(sub, status)
+        return status
+
+    def _finish_submit(self, sub: Submission, status: str) -> None:
+        """Per-submission attribution tail shared by the inline and the
+        BATCHED gauntlet paths: whichever way a submission was decided,
+        it gets its own registry counters and its own trace instant."""
         reg = obreg.default()
         counter = _REJECTION_COUNTERS.get(status)
         if counter is not None:
@@ -615,7 +735,76 @@ class IngestQueue:
                 "serve-ingest", f"submit:{status}",
                 submission=f"r{int(sub.round)}/c{int(sub.client_id)}",
                 round=int(sub.round), client=int(sub.client_id))
-        return status
+
+    def submit_block(self, subs) -> list[str]:
+        """Batched admission (the gauntlet pool's entry point): one
+        decision per submission, in order. The batching changes WHEN the
+        screen arithmetic runs — one vectorized numpy pass over the
+        stacked ring rows instead of per-table reductions — never what it
+        computes: every verdict is bitwise the per-submission submit()
+        verdict, and every submission keeps its own individually-
+        attributed decision (admission counters, stderr rejection line,
+        trace instant)."""
+        subs = list(subs)
+        statuses = self._decide_block(subs)
+        for sub, status in zip(subs, statuses):
+            self._finish_submit(sub, status)
+        return statuses
+
+    def _decide_block(self, subs: list[Submission]) -> list[str]:
+        n = len(subs)
+        statuses: list[str | None] = [None] * n
+        medians = [0.0] * n
+        # phase 1 — the O(1) prechecks for the whole block under ONE lock
+        # hold (announce-path submissions admit right here, as inline)
+        with self._cv:
+            announced = False
+            for i, sub in enumerate(subs):
+                cid = int(sub.client_id)
+                status, stale_median = self._precheck(sub, cid)
+                if status is not None:
+                    statuses[i] = status
+                    continue
+                win = self._windows.get(sub.round)
+                if self.payload_policy is None:
+                    self._admit(win, cid, float(sub.latency_s))
+                    statuses[i] = ACCEPTED
+                    announced = True
+                    continue
+                medians[i] = win.median if win is not None else stale_median
+            if announced:
+                self._cv.notify_all()
+        if self.payload_policy is None or all(s is not None for s in statuses):
+            return statuses
+        # phase 2 — structural gauntlet per frame, OUTSIDE the lock (same
+        # reasoning as _decide): accepted tables land straight in their
+        # round's ring slots, screens deferred to the block pass
+        entries = []  # (i, sub, blk, slot, table)
+        for i, sub in enumerate(subs):
+            if statuses[i] is not None:
+                continue
+            blk, slot = self._acquire_slot(sub.round)
+            table, decision, detail = validate_payload(
+                sub.payload, self.payload_policy, median=medians[i],
+                out=slot, screen=False)
+            if decision != ACCEPTED:
+                statuses[i] = self._reject_decoded(
+                    sub, decision, detail, blk, slot)
+                continue
+            entries.append((i, sub, blk, slot, table))
+        # phase 3 — ONE vectorized finite/L2 pass over the stacked block
+        verdicts = screen_block(
+            [(t, medians[i], blk, (slot.index if slot is not None else -1))
+             for i, _sub, blk, slot, t in entries], self.payload_policy)
+        # phase 4 — per-survivor admission re-check, same as inline
+        for (i, sub, blk, slot, table), (decision, detail) in zip(
+                entries, verdicts):
+            if decision != ACCEPTED:
+                statuses[i] = self._reject_decoded(
+                    sub, decision, detail, blk, slot)
+            else:
+                statuses[i] = self._admit_decoded(sub, table, blk, slot)
+        return statuses
 
     def _decide(self, sub: Submission) -> str:
         cid = int(sub.client_id)
@@ -638,40 +827,82 @@ class IngestQueue:
         # the TARGET ROUND's snapshot median (taken at its open_round):
         # every payload answering a round is judged against that round's
         # baseline no matter how its arrival races the merge — and no
-        # device fetch under the lock.
+        # device fetch under the lock. With a ring block attached (the
+        # inproc fast path validates inline), the decode writes straight
+        # into a slot; blk/slot are None otherwise and nothing changes.
+        blk, slot = self._acquire_slot(sub.round)
         table, decision, detail = validate_payload(
-            sub.payload, self.payload_policy, median=median)
+            sub.payload, self.payload_policy, median=median, out=slot)
         if decision != ACCEPTED:
-            with self._cv:
-                if decision == MALFORMED:
-                    self.rejected_malformed += 1
-                elif decision == STALE_SCHEMA:
-                    self.rejected_stale_schema += 1
-                else:
-                    self.rejected_quarantined += 1
-            print(f"serve: payload from client {cid} rejected "
-                  f"{decision} ({detail})", file=sys.stderr, flush=True)
-            return decision
+            return self._reject_decoded(sub, decision, detail, blk, slot)
+        return self._admit_decoded(sub, table, blk, slot)
+
+    def _reject_decoded(self, sub: Submission, decision: str, detail: str,
+                        blk=None, slot=None) -> str:
+        """Post-decode rejection bookkeeping, identical between the inline
+        and batched paths: the class counter and the per-client stderr
+        line. A ring slot the decode already wrote is zeroed back — a
+        rejected payload stays bitwise a client that never submitted."""
+        if slot is not None:
+            blk.reject(slot)
         with self._cv:
-            # re-check: the world may have moved while this thread decoded
-            # (round closed, a duplicate landed, capacity filled)
+            if decision == MALFORMED:
+                self.rejected_malformed += 1
+            elif decision == STALE_SCHEMA:
+                self.rejected_stale_schema += 1
+            else:
+                self.rejected_quarantined += 1
+        print(f"serve: payload from client {int(sub.client_id)} rejected "
+              f"{decision} ({detail})", file=sys.stderr, flush=True)
+        return decision
+
+    def _admit_decoded(self, sub: Submission, table, blk=None,
+                       slot=None) -> str:
+        """Post-gauntlet admission re-check (inline and batched paths):
+        the world may have moved while this thread decoded — round closed,
+        a duplicate landed, capacity filled. On the fast path the slot is
+        committed at the client's cohort position (ACCEPTED) or rejected
+        back to zero; a stale admission copies OUT of the ring first (a
+        ring view must never outlive its round's block)."""
+        cid = int(sub.client_id)
+        pos = -1
+        with self._cv:
             if self._closed:
                 self.rejected_closed += 1
-                return CLOSED
-            win = self._windows.get(sub.round)
-            if win is None:
-                # the window closed mid-decode: the stale band may still
-                # take it (the same re-check _precheck ran, post-decode)
-                return self._admit_stale(sub, cid, table)
-            if cid in win.seen:
-                self.rejected_dup += 1
-                return DUPLICATE
-            if len(win.arrivals) >= self.capacity:
-                self.rejected_full += 1
-                return QUEUE_FULL
-            self._admit(win, cid, float(sub.latency_s), table)
-            self._cv.notify_all()
-            return ACCEPTED
+                status = CLOSED
+            else:
+                win = self._windows.get(sub.round)
+                if win is None:
+                    # the window closed mid-decode: the stale band may
+                    # still take it (the same re-check _precheck ran). A
+                    # ring-backed table detaches first — host numpy both
+                    # sides, the slot's block dies with its round
+                    status = self._admit_stale(
+                        sub, cid,
+                        np.array(table, np.float32)  # graftlint: disable=G001 — host ring-view detach
+                        if slot is not None else table)
+                elif cid in win.seen:
+                    self.rejected_dup += 1
+                    status = DUPLICATE
+                elif len(win.arrivals) >= self.capacity:
+                    self.rejected_full += 1
+                    status = QUEUE_FULL
+                else:
+                    self._admit(win, cid, float(sub.latency_s), table)
+                    pos = win.invited[cid]
+                    self._cv.notify_all()
+                    status = ACCEPTED
+        if slot is not None:
+            if status == ACCEPTED:
+                blk.commit(slot, pos)
+            else:
+                blk.reject(slot)
+        elif status == ACCEPTED and blk is not None:
+            # ring overflow fallback: the block had no free slot, so the
+            # admitted table is standalone — register it so the close's
+            # scatter still sees it at its cohort position
+            blk.add_extra(pos, table)
+        return status
 
     def _precheck(self, sub: Submission,
                   cid: int) -> tuple[str | None, float]:

@@ -1,0 +1,207 @@
+"""Pinned host table ring: the zero-copy landing zone of the ingest fast path.
+
+The wire-payload round used to pay two host copies per accepted table: the
+gauntlet decoded each frame into a fresh per-submission ndarray, and the
+assembler's close stacked those ndarrays into the [N, r, c] block the merge
+uploads. FetchSGD's whole point is that the sketch IS the unit of work
+(arXiv:2007.07682 §1) — the table's bytes are final the moment the gauntlet
+validates them, so the fast path (--serve_fastpath) writes them ONCE,
+directly into a preallocated host ring block sized by the cohort:
+
+- `TableRing` owns a small pool of reusable blocks (one per concurrently
+  open round window, at most `max_open_rounds`); `open_block` zero-fills
+  and hands one out at invite time, `release` returns it after the round's
+  device stack is built.
+- `RingBlock` is one round's landing zone: a [capacity, r, c] float32
+  buffer plus per-slot (position, valid, final) state. Decoders `acquire`
+  a slot, the gauntlet writes the decoded table into it (`RingSlot.write`
+  — THE one sanctioned per-table copy of the fast path, declared
+  `# graftlint: ring-write` for G016), and the admission outcome either
+  `commit`s the slot (cohort position recorded, valid) or `reject`s it
+  (zeroed back — a rejected payload stays bitwise a client that never
+  submitted). Slots are never reused within a round, so a finalized slot's
+  bytes are immutable: the H2D uploader (serve/service.py) can ship the
+  contiguous finalized prefix while the window is still open.
+- Overflow is a fallback, never a correctness cliff: when every slot is
+  taken (a client retrying after a rejection, a burst past the cohort
+  size), `acquire` returns None, the decode falls back to a standalone
+  ndarray, and the admission path registers it via `add_extra` — the
+  close's scatter picks extras up individually. Counted on
+  `serve_ring_overflow_total`.
+
+The ring is a LAYOUT change, not an order change: the device stack built
+from ring slots + validity mask is bitwise the host stack the assembler
+used to collect (tests pin fastpath == slowpath on every transport).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..obs import registry as obreg
+
+
+class RingSlot:
+    """One acquired slot of a RingBlock: where exactly one submission's
+    decoded table lands. The gauntlet holds it from decode to verdict;
+    `write` is the fast path's single per-table copy."""
+
+    __slots__ = ("block", "index")
+
+    def __init__(self, block: "RingBlock", index: int):
+        self.block = block
+        self.index = index
+
+    @property
+    def view(self) -> np.ndarray:
+        return self.block.tables[self.index]
+
+    # graftlint: ring-write — THE sanctioned per-table copy of the fast
+    # path (G016): the validated wire table lands in the pinned ring once
+    def write(self, arr) -> np.ndarray:
+        """Copy a decoded [r, c] table into this slot (the assignment
+        casts the wire dtype to float32 bit-exactly) and return the slot
+        VIEW — downstream holds the view, never a fresh ndarray."""
+        self.block.tables[self.index][...] = arr
+        return self.block.tables[self.index]
+
+
+class RingBlock:
+    """One round's pinned landing zone (see module docstring). Thread-safe:
+    decoders acquire/commit/reject from transport or gauntlet-worker
+    threads; the uploader polls `final_prefix`; the close waits on
+    `wait_final`. The block lock is a LEAF lock — ingest's queue lock may
+    be held while taking it, never the reverse."""
+
+    def __init__(self, rows: int, cols: int, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.rows, self.cols = int(rows), int(cols)
+        self.capacity = int(capacity)
+        self.tables = np.zeros((capacity, rows, cols), np.float32)
+        # cohort position of each committed slot (-1 = not committed)
+        self.positions = np.full(capacity, -1, np.int32)
+        # slot holds a validated, ADMITTED table (commit); False = rejected
+        # or still in flight
+        self.valid = np.zeros(capacity, bool)
+        # slot content is immutable from here on (commit OR reject): the
+        # uploader only ever ships finalized slots
+        self._final = np.zeros(capacity, bool)
+        self.rnd = -1
+        self.count = 0  # slots acquired (monotone; frozen once the round closes)
+        self.extras: list[tuple[int, np.ndarray]] = []
+        self._watermark = 0  # cached contiguous finalized prefix
+        self._cv = threading.Condition()
+
+    def reset(self, rnd: int) -> None:
+        """Re-arm a pooled block for a new round: zero the buffer (the
+        exact +0.0 every untouched slot must read as) and clear the state."""
+        with self._cv:
+            self.tables[...] = 0.0
+            self.positions[...] = -1
+            self.valid[...] = False
+            self._final[...] = False
+            self.rnd = int(rnd)
+            self.count = 0
+            self.extras = []
+            self._watermark = 0
+
+    def acquire(self) -> RingSlot | None:
+        """Claim the next free slot (None when the block is full — the
+        caller falls back to a standalone table + `add_extra`, counted)."""
+        with self._cv:
+            if self.count >= self.capacity:
+                obreg.default().counter("serve_ring_overflow_total").inc()
+                return None
+            i = self.count
+            self.count += 1
+            return RingSlot(self, i)
+
+    def commit(self, slot: RingSlot, position: int) -> None:
+        """Finalize an ADMITTED slot at its cohort position — from here
+        its bytes are immutable and the uploader may ship them."""
+        with self._cv:
+            self.positions[slot.index] = int(position)
+            self.valid[slot.index] = True
+            self._final[slot.index] = True
+            self._cv.notify_all()
+
+    def reject(self, slot: RingSlot) -> None:
+        """Finalize a REJECTED (or stale-detached) slot: zero it back so a
+        rejected payload stays bitwise a client that never submitted."""
+        with self._cv:
+            self.tables[slot.index][...] = 0.0
+            self.valid[slot.index] = False
+            self._final[slot.index] = True
+            self._cv.notify_all()
+
+    def add_extra(self, position: int, table: np.ndarray) -> None:
+        """Register an admitted table the ring had no slot for (overflow
+        fallback) so the close's scatter still sees it."""
+        with self._cv:
+            self.extras.append((int(position), table))
+
+    def final_prefix(self) -> int:
+        """Length of the contiguous finalized prefix — the slots the
+        overlap uploader may ship right now (their bytes can no longer
+        change)."""
+        with self._cv:
+            w = self._watermark
+            while w < self.count and self._final[w]:
+                w += 1
+            self._watermark = w
+            return w
+
+    def wait_final(self, timeout_s: float) -> bool:
+        """Block until every ACQUIRED slot is finalized (the close barrier:
+        acquires stop when the round's window closes, so this is a bounded
+        wait on in-flight decodes). False on timeout."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: bool(self._final[: self.count].all()),
+                timeout=timeout_s)
+
+    def snapshot(self) -> tuple[int, np.ndarray, np.ndarray, list]:
+        """(count, positions, valid, extras) copied under the lock — what
+        the close's scatter consumes after wait_final."""
+        with self._cv:
+            return (self.count, self.positions.copy(), self.valid.copy(),
+                    list(self.extras))
+
+
+class TableRing:
+    """The pool of reusable RingBlocks (see module docstring). `depth`
+    bounds how many released blocks are retained per capacity — the
+    pipelined serving mode keeps at most `max_open_rounds` (2) blocks
+    live, so the default never allocates past warm-up."""
+
+    def __init__(self, rows: int, cols: int, depth: int = 4):
+        self.rows, self.cols = int(rows), int(cols)
+        self.depth = int(depth)
+        self._lock = threading.Lock()
+        self._pool: list[RingBlock] = []
+
+    def open_block(self, rnd: int, capacity: int) -> RingBlock:
+        """A zeroed block sized for the round's cohort — pooled when a
+        released block of the same capacity is available, freshly
+        allocated otherwise (capacity only changes if the cohort size
+        does, which a session never does mid-run)."""
+        with self._lock:
+            for i, blk in enumerate(self._pool):
+                if blk.capacity == int(capacity):
+                    block = self._pool.pop(i)
+                    break
+            else:
+                block = RingBlock(self.rows, self.cols, int(capacity))
+        block.reset(rnd)
+        return block
+
+    def release(self, block: RingBlock) -> None:
+        """Return a block once its round's device stack is built (nothing
+        downstream holds ring views past that point: stale admissions and
+        straggler stashes copy out, the device stack owns its own bytes)."""
+        with self._lock:
+            if len(self._pool) < self.depth:
+                self._pool.append(block)

@@ -133,6 +133,15 @@ class EventLoopTransport(LineProtocol):
         # selector immediately instead of waiting out the poll timeout
         self._wake_r: socket.socket | None = None
         self._wake_w: socket.socket | None = None
+        # batched-gauntlet deferral (--serve_fastpath): verdicts land on
+        # gauntlet-worker threads and queue HERE for the reactor to flush
+        # on its next self-pipe wake — the reactor itself never blocks on
+        # a validation batch (G015)
+        self._deferred: list[tuple[_Conn, str]] = []
+        self._deferred_lock = threading.Lock()
+        # the connection whose frames _consume_frames is dispatching right
+        # now (reactor thread only) — what a deferred reply routes back to
+        self._cur_conn: _Conn | None = None
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -263,6 +272,50 @@ class EventLoopTransport(LineProtocol):
                 pass
         except (BlockingIOError, OSError):
             pass
+        self._flush_deferred()
+
+    def _wake(self) -> None:
+        """One byte down the self-pipe: wake the selector now (safe from
+        any thread — the gauntlet's done-callbacks use it)."""
+        w = self._wake_w
+        if w is not None:
+            try:
+                w.send(b"x")
+            except OSError:
+                pass
+
+    def _deferred_submit(self, sub) -> None:
+        """The reactor's non-blocking fast-path defer (overrides the
+        threaded transport's parked-Event version): hand the raw
+        submission to the gauntlet pool with a callback that queues the
+        verdict for the NEXT loop iteration, and return None — no reply
+        yet. The reactor keeps serving every other connection while the
+        batch validates (G015: a blocked reactor is every connection
+        blocked at once)."""
+        conn = self._cur_conn
+
+        def deliver(status: str) -> None:
+            with self._deferred_lock:
+                self._deferred.append((conn, status))
+            self._wake()
+
+        self.gauntlet.submit(sub, deliver)
+        return None
+
+    def _flush_deferred(self) -> None:
+        """Queue the batched gauntlet's verdicts onto their connections'
+        out-buffers (reactor thread only). A connection that died while
+        its frame sat in a batch just drops the reply — the same contract
+        as a threaded handler whose peer vanished mid-submit."""
+        if self.gauntlet is None:
+            return
+        with self._deferred_lock:
+            if not self._deferred:
+                return
+            items, self._deferred = self._deferred, []
+        for conn, status in items:
+            if self._conns.get(conn.sock) is conn and not conn.closing:
+                self._queue_reply(conn, self._reply_for(status))
 
     # graftlint: drain-point — non-blocking accept burst on the listener
     def _accept_burst(self) -> None:
@@ -311,6 +364,7 @@ class EventLoopTransport(LineProtocol):
         byte-flood rejection."""
         buf = conn.buf
         view = memoryview(buf)
+        self._cur_conn = conn  # deferred fast-path replies route back here
         while True:
             nl = buf.find(b"\n", conn.off)
             if nl < 0:

@@ -58,6 +58,7 @@ import time
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..federated import engine as fed_engine
@@ -204,6 +205,16 @@ class ServeConfig:
     # bitwise == the flat merge of the same edge-armed session. Robust
     # merge policies flip the tree into per-client FORWARD mode (loudly).
     edges: int = 0
+    # --serve_fastpath: the zero-copy ingest-to-merge fast path. Accepted
+    # tables land ONCE in a preallocated host ring block (serve/ring.py),
+    # the socket transports validate in batches off a worker pool
+    # (serve/gauntlet.py), and the host->device upload of finalized ring
+    # slots overlaps the still-open window. A layout/timing change only:
+    # served params stay bitwise identical to fastpath off.
+    fastpath: bool = False
+    # --serve_gauntlet_workers: batched-gauntlet pool size (socket
+    # transports; the inproc path validates inline into the ring)
+    gauntlet_workers: int = 2
 
     @classmethod
     def from_args(cls, args) -> "ServeConfig":
@@ -224,7 +235,73 @@ class ServeConfig:
             shards=getattr(args, "serve_shards", 0),
             edges=getattr(args, "serve_edges", 0),
             max_conns=getattr(args, "serve_max_conns", 0),
+            fastpath=bool(getattr(args, "serve_fastpath", False)),
+            gauntlet_workers=getattr(args, "serve_gauntlet_workers", 2),
         )
+
+
+class _RingUploader:
+    """Chunked host->device upload of ring slots AS THEY FINALIZE — the
+    ingest/H2D-overlap leg of the fast path. A small poller thread ships
+    each finalized FIXED-BOUNDARY chunk of slots with `jax.device_put`
+    while the round's window is still open; `finish()` ships whatever
+    boundaries remain and concatenates the chunks into ONE
+    [capacity, r, c] device array whose bytes are EXACTLY the ring's —
+    device_put moves bytes, never arithmetic, so the chunking
+    concatenates back to the identical stack (the bitwise pin's overlap
+    half).
+
+    The chunk boundaries are a pure function of the block CAPACITY, never
+    of arrival timing: the concatenate (and the downstream scatter) then
+    see the same shapes every round, so XLA compiles them once — a
+    timing-dependent split would recompile on almost every round and eat
+    the latency the overlap buys."""
+
+    def __init__(self, block, poll_s: float = 0.002):
+        self.block = block
+        self.poll_s = poll_s
+        cap = block.tables.shape[0]
+        step = max(1, cap // 4)
+        self._bounds = list(range(step, cap, step)) + [cap]
+        self._bi = 0  # next unshipped boundary (poll thread only, then
+        self._uploaded = 0  # finish() after the join — never concurrent)
+        self._chunks: list[Any] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="serve-ring-upload", daemon=True)
+
+    def start(self) -> "_RingUploader":
+        self._thread.start()
+        return self
+
+    def _ship_through(self, ready: int) -> None:
+        while self._bi < len(self._bounds) and self._bounds[self._bi] <= ready:
+            b = self._bounds[self._bi]
+            # the ring VIEW goes straight to device_put — no host-side
+            # staging copy (finalized slot bytes are immutable, and the
+            # not-yet-acquired tail slots are exact zeros)
+            self._chunks.append(jax.device_put(
+                self.block.tables[self._uploaded:b]))
+            self._uploaded = b
+            self._bi += 1
+
+    # graftlint: drain-point — the uploader's own poll thread sleeps by
+    # design; nothing on the dispatch path waits on it mid-window
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self._ship_through(self.block.final_prefix())
+
+    def finish(self):
+        """Join the poller, ship every remaining boundary (the caller has
+        already waited for all slots to finalize; untouched tail slots are
+        zeros and masked out downstream), and return the [capacity, r, c]
+        device stack."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._ship_through(self._bounds[-1])
+        if len(self._chunks) == 1:
+            return self._chunks[0]
+        return jnp.concatenate(self._chunks, axis=0)
 
 
 class AggregationService:
@@ -309,6 +386,22 @@ class AggregationService:
                 "--serve_buffer is the ASYNC buffer-size trigger; without "
                 "--serve_async the close discipline is the W-of-N quorum "
                 "(--serve_quorum)")
+        if cfg.fastpath:
+            if cfg.payload != "sketch":
+                raise ValueError(
+                    "--serve_fastpath accelerates the wire-PAYLOAD ingest "
+                    "path; the announce path moves no tables — arm "
+                    "--serve_payload sketch")
+            if (cfg.edges >= 2
+                    or int(getattr(session.cfg, "serve_edges", 0)) >= 2):
+                raise ValueError(
+                    "--serve_fastpath does not compose with --serve_edges "
+                    "yet (the edge tier consumes the host table stack the "
+                    "ring replaces) — drop one of the flags")
+            if cfg.gauntlet_workers < 1:
+                raise ValueError(
+                    f"--serve_gauntlet_workers must be >= 1, got "
+                    f"{cfg.gauntlet_workers}")
         payload_policy = payload_shape = None
         if cfg.payload == "sketch":
             ecfg = session.cfg
@@ -387,7 +480,8 @@ class AggregationService:
             self.queue, trigger, cfg.deadline_s,
             payload_shape=payload_shape,
             trigger_label="buffer" if cfg.async_mode else "quorum",
-            collect_stragglers=cfg.async_mode)
+            collect_stragglers=cfg.async_mode,
+            ring_mode=cfg.fastpath)
         # buffered-async stale stash: (source_round, cohort_position,
         # client_id, table) entries awaiting their staleness-weighted fold
         # — filled from each closed round's stragglers and the queue's
@@ -425,6 +519,33 @@ class AggregationService:
                     self.queue, port=cfg.port, **cap)
         else:
             self.transport = InProcessTransport(self.queue)
+        # --serve_fastpath wiring: the table ring every payload round
+        # lands in, and — socket transports only — the batched-gauntlet
+        # pool the connection engines hand raw frames to (the inproc path
+        # validates inline, straight into its ring slot)
+        self._ring = None
+        self._gauntlet = None
+        self._ring_blocks: dict[int, Any] = {}
+        if cfg.fastpath:
+            from .gauntlet import GauntletPool
+            from .ring import TableRing
+
+            self._ring = TableRing(payload_shape[0], payload_shape[1])
+            # pre-register the fastpath metrics so /metrics(.prom) shows
+            # them at zero from the first scrape, not from first incident
+            obreg.default().counter("serve_ring_overflow_total")
+            obreg.default().counter("serve_table_bytes_copied_total")
+            obreg.default().histogram("serve_ring_occupancy")
+            obreg.default().histogram("serve_gauntlet_batch_ms")
+            if cfg.transport == "socket":
+                self._gauntlet = GauntletPool(
+                    self.queue, workers=cfg.gauntlet_workers)
+                # one shared pool across every connection engine — the
+                # sharded ingest's reactors all defer to the same gauntlet
+                for tr in (self.transport.shards
+                           if hasattr(self.transport, "shards")
+                           else (self.transport,)):
+                    tr.gauntlet = self._gauntlet
         # all rate/latency metrics live in the process-wide obs registry —
         # the same store the runner's phase histograms land in, so the
         # /metrics endpoint reads ONE source of truth
@@ -483,6 +604,8 @@ class AggregationService:
 
     def start(self) -> "AggregationService":
         if not self._started:
+            if self._gauntlet is not None:
+                self._gauntlet.start()
             self.transport.start()
             if self.metrics_server is not None:
                 self.metrics_server.start()
@@ -491,7 +614,12 @@ class AggregationService:
 
     def close(self) -> None:
         self.queue.shutdown()
+        # transport first: connection threads may be parked on in-flight
+        # gauntlet verdicts, and the pool's stop fails the rest out CLOSED
         self.transport.stop()
+        if self._gauntlet is not None:
+            self._gauntlet.stop()
+        self._ring_blocks.clear()
         if self.metrics_server is not None:
             self.metrics_server.stop()
         self._started = False
@@ -580,6 +708,15 @@ class AggregationService:
             tables, aux = self.session.compute_client_tables(prep0)
         with self._stage("invite", rnd):
             self.queue.open_round(rnd, ids)
+            uploader = None
+            if self._ring is not None:
+                # arm the fast path for this round: a ring block sized by
+                # the cohort, and a chunked H2D uploader shipping slots as
+                # they finalize — the ingest/H2D overlap
+                block = self._ring.open_block(rnd, len(ids))
+                self.queue.attach_block(rnd, block)
+                self._ring_blocks[rnd] = block
+                uploader = _RingUploader(block).start()
         with self._stage("collect", rnd):
             if self.traffic is not None:
                 plan = self.session.fault_plan
@@ -632,12 +769,65 @@ class AggregationService:
                 self._submit_stale_poison(rnd)
                 stale = self._build_stale_fold(rnd)
                 self._stash_stragglers(closed)
-            arrived, wire_tables, edge_block = self._edge_round(
-                rnd, ids, closed, aux)
+            if self._ring is not None:
+                # fast path: the merge's [N, r, c] stack comes straight
+                # off the ring (device-side scatter of the uploaded
+                # slots) — bitwise the assembler's host stack. The edge
+                # tier is excluded by construction (__init__ validation).
+                arrived = closed.arrived
+                wire_tables = self._finish_ring_stack(rnd, closed, uploader)
+                edge_block = None
+            else:
+                arrived, wire_tables, edge_block = self._edge_round(
+                    rnd, ids, closed, aux)
             prep = self.session.finish_served_payload(
                 prep0, arrived, wire_tables, aux, stale=stale,
                 edge=edge_block)
         return prep, closed
+
+    def _finish_ring_stack(self, rnd: int, closed, uploader):
+        """Build the merge's [N, r, c] DEVICE stack from the round's ring
+        block: wait for in-flight decodes to finalize their slots, finish
+        the chunked upload the open window overlapped, and scatter the
+        valid slots that made the close into a zero stack at their cohort
+        positions (overflow extras land individually). Bitwise the host
+        reference (assembler stack + one device_put): device_put moves
+        bytes, never arithmetic; every scattered position is written at
+        most once; everything unwritten is the same exact +0.0.
+
+        The scatter's index array is ALWAYS block-capacity long — slots
+        that must not land (rejected, stale-banded, masked at the close,
+        never acquired) carry the out-of-bounds sentinel N, which
+        mode="drop" discards. One shape per capacity means XLA compiles
+        the scatter once, not once per round's admission pattern."""
+        block = self._ring_blocks.pop(rnd)
+        if not block.wait_final(timeout_s=30.0):
+            print(f"serve: WARNING — ring block for round {rnd} has "
+                  "unfinalized slot(s) past the wait deadline",
+                  file=sys.stderr, flush=True)
+        count, positions, valid, extras = block.snapshot()
+        allslots = uploader.finish()
+        n = len(closed.invited)
+        r, c = self.assembler.payload_shape
+        self.registry.histogram("serve_ring_occupancy").observe(
+            float(count))
+        cap = allslots.shape[0]
+        pos_full = np.full(cap, n, np.int32)  # n == dropped sentinel
+        if count:
+            pos = positions[:count]
+            sel = np.flatnonzero(valid[:count] & (pos >= 0))
+            sel = sel[closed.arrived[pos[sel]] == 1.0]
+            pos_full[sel] = pos[sel]
+        stack = jnp.zeros((n, r, c), jnp.float32).at[
+            jnp.asarray(pos_full)].set(allslots, mode="drop")
+        for pos_e, table in extras:
+            if 0 <= pos_e < n and closed.arrived[pos_e] == 1.0:
+                stack = stack.at[pos_e].set(table)
+        # nothing downstream holds ring views past this point (stale
+        # admissions and straggler stashes copied out; the device stack
+        # owns its own bytes) — the block goes back to the pool
+        self._ring.release(block)
+        return stack
 
     def _edge_round(self, rnd: int, ids, closed, aux):
         """The two-tier edge-aggregation stage of a payload round (None
@@ -1007,6 +1197,21 @@ class AggregationService:
                 "dropped": int(self.registry.counter(
                     "serve_stale_dropped_total").value),
             } if self.cfg.async_mode else None,
+            # zero-copy fast-path posture (null when off): gauntlet batch
+            # timing, ring fill levels, and the cumulative host bytes the
+            # ingest-to-merge path actually touched (the bench's
+            # bytes_touched_per_table numerator)
+            "fastpath": {
+                "gauntlet_workers": int(self.cfg.gauntlet_workers),
+                "gauntlet_batch_ms": self.registry.histogram(
+                    "serve_gauntlet_batch_ms").summary(),
+                "ring_occupancy": self.registry.histogram(
+                    "serve_ring_occupancy").summary(),
+                "ring_overflow": int(self.registry.counter(
+                    "serve_ring_overflow_total").value),
+                "bytes_copied": int(self.registry.counter(
+                    "serve_table_bytes_copied_total").value),
+            } if self.cfg.fastpath else None,
             "quorum": self.cfg.quorum,
             "invited_per_round": s.num_workers,
             "deadline_s": self.cfg.deadline_s,
@@ -1150,6 +1355,7 @@ def service_from_args(args, session) -> AggregationService | None:
         + (f", {service.cfg.edges}-edge tree"
            if service.cfg.edges >= 2 else "")
         + f", payload {service.cfg.payload}"
+        + (", fastpath" if service.cfg.fastpath else "")
         + (", pipelined" if service.cfg.pipeline else "")
         + (f", async (alpha={service.cfg.staleness_alpha:g}, "
            f"band={service.cfg.stale_rounds})"

@@ -117,6 +117,10 @@ class LineProtocol:
 
     queue: IngestQueue
     max_frame_bytes: int
+    # the batched-gauntlet worker pool (serve/gauntlet.py) when the fast
+    # path is armed (--serve_fastpath); None = validate inline on the
+    # thread that read the frame
+    gauntlet = None
 
     def _handle_line(self, line: bytes, sequences: dict | None = None,
                      line_bytes: int | None = None) -> dict | None:
@@ -220,14 +224,32 @@ class LineProtocol:
             payload=frames,
         ))
 
-    def _submit_reply(self, sub: Submission) -> dict:
-        status = self.queue.submit(sub)
+    def _submit_reply(self, sub: Submission) -> dict | None:
+        if self.gauntlet is not None:
+            # fast path: the raw submission joins a validation block on
+            # the gauntlet pool; the reply is deferred until the batch's
+            # verdicts land (None here = no reply yet, reactor engine)
+            return self._deferred_submit(sub)
+        return self._reply_for(self.queue.submit(sub))
+
+    def _reply_for(self, status: str) -> dict:
         reply = {"status": status}
         if status == SHEDDING:
             # the overload contract: a shed client is TOLD when to come
             # back, so a flood decays instead of hammering the queue
             reply["retry_after_s"] = self._retry_after_s()
         return reply
+
+    # graftlint: drain-point — the threaded transport's per-connection
+    # thread runs/awaits the batch verdict by design (its blocking
+    # model); the event-loop reactor overrides this with a non-blocking
+    # defer
+    def _deferred_submit(self, sub: Submission) -> dict | None:
+        # caller-runs: this connection thread drains gauntlet batches
+        # itself until its submission's verdict lands — a lone push
+        # validates right here (no cross-thread reply handoff), a burst
+        # of connection threads forms real blocks
+        return self._reply_for(self.gauntlet.submit_and_wait(sub))
 
     def _retry_after_s(self) -> float:
         """The SHEDDING retry-after hint. The sharded reactors override

@@ -306,6 +306,28 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "Requires --serve_payload sketch; does not "
                         "compose with --serve_async/--serve_pipeline "
                         "yet. 0 = flat merge (the exact prior program)")
+    p.add_argument("--serve_fastpath", action="store_true",
+                   help="zero-copy ingest-to-merge fast path: accepted "
+                        "r x c tables decode ONCE straight into a pinned "
+                        "host ring block sized by the cohort (serve/"
+                        "ring.py) and upload to device in chunks WHILE "
+                        "the round window is still open; socket "
+                        "transports also batch the validation gauntlet "
+                        "over blocks of arrivals (vectorized finite/L2 "
+                        "screening, --serve_gauntlet_workers). Per-"
+                        "submission admission verdicts, their counters, "
+                        "and the served round's bytes are pinned "
+                        "BITWISE identical to the slow path — the ring "
+                        "changes layout and copy count, never order. "
+                        "Requires --serve_payload sketch; does not "
+                        "compose with --serve_edges yet")
+    p.add_argument("--serve_gauntlet_workers", type=int, default=2,
+                   help="--serve_fastpath + --serve socket: worker "
+                        "threads draining the batched validation "
+                        "gauntlet (each drains up to 32 queued frames "
+                        "per wake and screens them as one numpy block). "
+                        "Inproc serving validates inline and ignores "
+                        "this")
     p.add_argument("--serve_max_conns", type=int, default=0,
                    help="--serve socket: concurrent-connection cap of the "
                         "connection engine (per reactor when sharded) — "
@@ -682,6 +704,24 @@ def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
                 "--serve_pipeline yet (stale-fold edge assignment and the "
                 "pipelined worker's edge timing are open follow-ups) — "
                 "drop one of the flags")
+    if getattr(args, "serve_fastpath", False):
+        if getattr(args, "serve", "off") == "off":
+            raise SystemExit(
+                "--serve_fastpath is a serving-path optimization; arm "
+                "--serve inproc|socket")
+        if getattr(args, "serve_payload", "announce") != "sketch":
+            raise SystemExit(
+                "--serve_fastpath pins client TABLES into a host ring; "
+                "the announce path has none — arm --serve_payload sketch")
+        if getattr(args, "serve_edges", 0) >= 2:
+            raise SystemExit(
+                "--serve_fastpath does not compose with --serve_edges yet "
+                "(the edge tier consumes the host table stack the ring "
+                "replaces) — drop one of the flags")
+    if getattr(args, "serve_gauntlet_workers", 2) < 1:
+        raise SystemExit(
+            f"--serve_gauntlet_workers must be >= 1, got "
+            f"{args.serve_gauntlet_workers}")
     if getattr(args, "health_every", 0):
         if args.health_every < 0:
             raise SystemExit(

@@ -17,6 +17,7 @@
 #        scripts/chaos_smoke.sh serve
 #        scripts/chaos_smoke.sh trace
 #        scripts/chaos_smoke.sh wire
+#        scripts/chaos_smoke.sh fastpath
 #        scripts/chaos_smoke.sh byzantine
 #        scripts/chaos_smoke.sh pipeline
 #        scripts/chaos_smoke.sh async_byzantine
@@ -48,6 +49,15 @@
 # transport seam — asserting every rejection fired as an admission counter
 # AND a resilience obs counter, and the committed params are bit-identical
 # to the batch wire-payload round over the surviving cohort. < 1 min CPU.
+#
+# `fastpath` mode drives the ZERO-COPY ingest-to-merge fast path
+# (--serve_fastpath) under the hostile wire: framed sketch tables over the
+# loopback socket with wire_corrupt + wire_dup + client_poison injected,
+# validated by the BATCHED gauntlet and landed once in the pinned host
+# table ring with the H2D upload overlapping the open window — asserting
+# every rejection class fired, the fast path touched HALF the host bytes
+# per accepted table, and the committed params are BIT-identical to the
+# identically-seeded slow-path run. < 1 min CPU.
 #
 # `trace` mode drives the OBSERVABILITY layer (obs/) under chaos: a real
 # cv_train run with --fault_plan AND --trace, ending in an injected
@@ -545,6 +555,126 @@ print(f"wire: PASS (3 socket payload rounds; rejections "
       f"[malformed={c['rejected_malformed']} dup={c['rejected_dup']} "
       f"quarantined={c['rejected_quarantined']}], casualties {drops}, "
       f"committed params bit-identical to the batch round over survivors)")
+EOF
+fi
+
+if [[ "${1:-}" == "fastpath" ]]; then
+    shift
+    exec timeout -k 10 "${CHAOS_TIMEOUT_S:-120}" python - "$@" <<'EOF'
+# fastpath chaos child (< 1 min CPU): the zero-copy fast path under the
+# hostile-wire plan. Two identically-seeded --serve_payload sketch runs
+# over the loopback SOCKET — fastpath ON (batched gauntlet -> pinned ring
+# -> overlapped H2D) and fastpath OFF (the inline reference) — with
+# wire_corrupt (flipped byte -> checksum), wire_dup (at-least-once double
+# send -> dedup), and client_poison (NaN table -> wire quarantine)
+# injected at the transport seam of BOTH. Asserts every rejection class
+# fired on the fast run, the gauntlet actually ran blocks, the fast run
+# touched HALF the host bytes per accepted table, the casualty sets
+# match round for round — and THE pin: committed params bit-identical
+# across the two runs.
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+from commefficient_tpu.federated.api import FederatedSession
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.obs import registry as obreg
+from commefficient_tpu.resilience import FaultPlan
+from commefficient_tpu.serve import (
+    AggregationService, ServeConfig, TraceConfig, TrafficGenerator)
+from commefficient_tpu.serve.clients import DeviceClass
+
+RELIABLE = (DeviceClass("lab", weight=1.0, latency_median_s=0.1,
+                        latency_sigma=0.1, no_show_prob=0.0),)
+PLAN = ("wire_corrupt@1:clients=0;wire_dup@1:clients=1;"
+        "client_poison@2:clients=3,value=nan")
+
+
+def quad_loss(params, net_state, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    err = pred - jax.nn.one_hot(batch["y"], pred.shape[-1])
+    mask = batch["mask"]
+    per_ex = (err ** 2).sum(-1)
+    return (per_ex * mask).sum() / jnp.maximum(mask.sum(), 1.0), {
+        "net_state": net_state,
+        "metrics": {"loss_sum": (per_ex * mask).sum(), "count": mask.sum()}}
+
+
+def mk():
+    rs = np.random.RandomState(0)
+    x = rs.randn(96, 6).astype(np.float32)
+    w_true = rs.randn(6, 3).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int32)
+    train = FedDataset(x, y, shard_iid(len(x), 12, np.random.RandomState(1)))
+    params = {"w": jnp.asarray(rs.randn(6, 3).astype(np.float32) * 0.1),
+              "b": jnp.zeros(3)}
+    d = ravel_pytree(params)[0].size
+    return FederatedSession(
+        train_loss_fn=quad_loss, eval_loss_fn=quad_loss,
+        params=params, net_state={},
+        mode_cfg=ModeConfig(mode="sketch", d=d, k=4, num_rows=3, num_cols=8,
+                            momentum=0.9, momentum_type="virtual",
+                            error_type="virtual"),
+        train_set=train, num_workers=4, local_batch_size=4, seed=0,
+        wire_payloads=True, client_update_clip=3.0,
+        fault_plan=FaultPlan.parse(PLAN), quarantine_window=4)
+
+
+def run(fastpath):
+    served = mk()
+    svc = AggregationService(
+        served, ServeConfig(quorum=4, deadline_s=30.0, transport="socket",
+                            payload="sketch", fastpath=fastpath),
+        traffic=TrafficGenerator(TraceConfig(population=12, seed=3),
+                                 classes=RELIABLE)).start()
+    reg = obreg.default()
+    bytes0 = reg.counter("serve_table_bytes_copied_total").value
+    src = svc.source()
+    drops = []
+    try:
+        for _ in range(3):
+            prep = src.next()
+            arrived = prep.payload[1]
+            drops.append(sorted(int(p) for p in np.flatnonzero(arrived == 0.0)))
+            served.commit_round(served.dispatch_round(prep, 0.05))
+    finally:
+        svc.close()
+    c = svc.queue.counters()
+    dbytes = reg.counter("serve_table_bytes_copied_total").value - bytes0
+    return served, drops, c, dbytes / max(c["accepted"], 1)
+
+
+reg = obreg.default()
+gauntlet0 = reg.histogram("serve_gauntlet_batch_ms").count
+ring0 = reg.histogram("serve_ring_occupancy").count
+fast_sess, fdrops, fc, fbytes = run(True)
+slow_sess, sdrops, sc, sbytes = run(False)
+
+print("fastpath chaos admission counters:", {k: v for k, v in fc.items() if v})
+assert fc["rejected_malformed"] >= 1, fc     # wire_corrupt -> checksum
+assert fc["rejected_dup"] >= 1, fc           # wire_dup -> dedup
+assert fc["rejected_quarantined"] >= 1, fc   # client_poison -> wire screen
+assert fdrops == sdrops, (fdrops, sdrops)    # same casualties, round for round
+assert reg.histogram("serve_gauntlet_batch_ms").count > gauntlet0, \
+    "the batched gauntlet never ran a block"
+assert reg.histogram("serve_ring_occupancy").count > ring0, \
+    "no round closed through the ring"
+assert 0 < fbytes < sbytes, (fbytes, sbytes)  # the deleted per-table copy
+
+# THE pin: a layout/timing change only — committed params bitwise equal
+for a, b in zip(jax.tree.leaves(jax.device_get(fast_sess.state["params"])),
+                jax.tree.leaves(jax.device_get(slow_sess.state["params"]))):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+flat = np.asarray(ravel_pytree(jax.device_get(fast_sess.state["params"]))[0])
+assert np.isfinite(flat).all()
+print(f"fastpath: PASS (3 socket payload rounds through gauntlet+ring; "
+      f"rejections [malformed={fc['rejected_malformed']} "
+      f"dup={fc['rejected_dup']} quarantined={fc['rejected_quarantined']}], "
+      f"casualties {fdrops}, bytes/table {int(fbytes)} vs {int(sbytes)} slow, "
+      f"committed params bit-identical to fastpath off)")
 EOF
 fi
 

@@ -1,0 +1,24 @@
+# graftlint: module=commefficient_tpu/serve/gauntlet.py
+# G016 violating twin: three per-submission byte-touching moves in
+# fast-path scope — a base64 decode on the hot loop, a "defensive"
+# frombuffer().copy(), and the old per-round np.stack the pinned ring
+# exists to replace. Each one silently doubles bytes-touched-per-table
+# without failing any bitwise test.
+import base64
+
+import numpy as np
+
+
+def decode_in_gauntlet(frame):
+    # frame decoding belongs to validate_payload, not the gauntlet loop
+    return base64.b64decode(frame["data"])
+
+
+def defensive_copy(raw):
+    # duplicates freshly decoded frame bytes per submission
+    return np.frombuffer(raw, dtype="<f4").copy()
+
+
+def restack_block(tables):
+    # the slow path's per-round stack copy sneaking back in
+    return np.stack(tables, axis=0)

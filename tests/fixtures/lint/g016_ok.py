@@ -1,0 +1,26 @@
+# graftlint: module=commefficient_tpu/serve/ring.py
+# G016 conforming twin: the ONE sanctioned per-submission copy — the
+# write into the pinned ring slot — is declared `# graftlint: ring-write`
+# on its def; everything else in fast-path scope moves views, not bytes.
+import numpy as np
+
+
+class RingSlot:
+    def __init__(self, block, index):
+        self.block = block
+        self.index = index
+
+    # graftlint: ring-write — the one sanctioned per-submission copy
+    def write(self, table):
+        self.block.tables[self.index][...] = table
+        return self.block.tables[self.index]
+
+
+def block_view(block, lo, hi):
+    # contiguous ring view: no bytes move
+    return block.tables[lo:hi]
+
+
+def finite_mask(chunk):
+    # vectorized screen over a stacked VIEW — reductions, not copies
+    return np.isfinite(chunk).all(axis=(1, 2))

@@ -441,6 +441,56 @@ def test_g014_runner_scope_and_construction_legal():
         os.unlink(path)
 
 
+def test_g016_ring_write_is_a_declaration_not_a_loophole():
+    """The conforming twin's slot write is legal ONLY because its def
+    carries `# graftlint: ring-write` — strip the directive and re-point
+    the copy at a banned move, and the same module must fire (the
+    boundary is a declaration, not a loophole)."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/serve/ring.py\n"
+        "import numpy as np\n"
+        "\n"
+        "\n"
+        "def write_slot(block, index, raw):\n"
+        "    # undeclared per-submission copy in fast-path scope\n"
+        "    block.tables[index][...] = np.frombuffer(raw, '<f4').copy()\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        assert "G016" in _codes(path)
+    finally:
+        os.unlink(path)
+
+
+def test_g016_scope_is_fastpath_modules_only():
+    """np.stack is the serve/ slow path's bread and butter — the rule must
+    stay silent outside the declared fast-path modules (the assembler's
+    stack copy is the thing the bench COMPARES against, not a bug)."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/serve/assembler.py\n"
+        "import numpy as np\n"
+        "\n"
+        "\n"
+        "def collect(tables):\n"
+        "    return np.stack(tables, axis=0)\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        assert "G016" not in _codes(path)
+    finally:
+        os.unlink(path)
+
+
 def test_every_rule_has_fixture_pair():
     # adding a rule without fixtures should fail HERE, not in review
     for code in RULE_CODES:

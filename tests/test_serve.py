@@ -679,13 +679,13 @@ def _sketch_session(shards=0, seed=0, fault_plan=None, clip=0.0, window=1,
 
 def _serve_payload_rounds(session, n, transport="inproc", quorum=2,
                           deadline=5.0, trace_seed=5,
-                          classes=RELIABLE_CLASSES):
+                          classes=RELIABLE_CLASSES, fastpath=False):
     """Run n served wire-payload rounds; returns (service, per-round dropped
     positions). The service is closed before returning."""
     svc = AggregationService(
         session,
         ServeConfig(quorum=quorum, deadline_s=deadline, transport=transport,
-                    payload="sketch"),
+                    payload="sketch", fastpath=fastpath),
         traffic=TrafficGenerator(
             TraceConfig(population=session.train_set.num_clients,
                         seed=trace_seed), classes=classes),
@@ -1009,6 +1009,68 @@ def test_serve_payload_mode_requires_wire_payload_session():
             traffic=TrafficGenerator(TraceConfig(population=12)))
 
 
+# ------------------------------------------ zero-copy fast path (bitwise pin)
+
+
+@pytest.mark.parametrize("transport", ["inproc", "socket"])
+@pytest.mark.parametrize("shards", [0, 2], ids=["fused", "sharded"])
+def test_fastpath_served_round_bit_identical_to_slow_path(shards, transport):
+    """THE fast-path acceptance pin: --serve_fastpath (pinned ring +
+    batched gauntlet + chunked ingest/H2D overlap) commits params BITWISE
+    identical to the slow path over the same trace and the same injected
+    chaos — fused and sharded, inproc and socket. The ring is a layout
+    change, never an order change."""
+    plan = "wire_corrupt@1:clients=0;client_poison@2:clients=3,value=nan"
+    a = _sketch_session(shards=shards, fault_plan=_FP.parse(plan), clip=3.0)
+    svc_a, drops_a = _serve_payload_rounds(
+        a, 3, transport=transport, quorum=4, deadline=30.0, fastpath=True)
+    b = _sketch_session(shards=shards, fault_plan=_FP.parse(plan), clip=3.0)
+    svc_b, drops_b = _serve_payload_rounds(
+        b, 3, transport=transport, quorum=4, deadline=30.0, fastpath=False)
+    assert drops_a == drops_b
+    _assert_params_equal(a, b)
+    assert list(a._requeue) == list(b._requeue)
+    # the chaos actually went through the fast-path gauntlet
+    ca = svc_a.queue.counters()
+    assert ca["rejected_malformed"] >= 1, ca
+    assert ca["rejected_quarantined"] >= 1, ca
+    if transport == "socket":
+        # and the socket run really batched: the gauntlet histogram saw
+        # blocks, and the ring saw occupancy
+        assert svc_a.registry.histogram("serve_gauntlet_batch_ms").count > 0
+    assert svc_a.registry.histogram("serve_ring_occupancy").count > 0
+
+
+def test_fastpath_touches_fewer_bytes_than_slow_path_over_socket():
+    """The perf claim the lint rule guards, as a counter: over the socket
+    the slow path touches each accepted table's bytes twice (decode copy +
+    assembler stack copy), the fast path once (the ring-slot write)."""
+    a = _sketch_session()
+    svc_a, _ = _serve_payload_rounds(
+        a, 2, transport="socket", quorum=4, deadline=30.0, fastpath=True)
+    fast = svc_a.registry.counter("serve_table_bytes_copied_total").value
+    b = _sketch_session()
+    svc_b, _ = _serve_payload_rounds(
+        b, 2, transport="socket", quorum=4, deadline=30.0, fastpath=False)
+    slow = svc_b.registry.counter("serve_table_bytes_copied_total").value
+    assert 0 < fast < slow, (fast, slow)
+    _assert_params_equal(a, b)  # fewer copies, same bytes served
+
+
+def test_fastpath_requires_sketch_payload_and_no_edges():
+    a = _sketch_session()
+    with pytest.raises(ValueError, match="serve_edges"):
+        AggregationService(
+            a, ServeConfig(quorum=2, payload="sketch", fastpath=True,
+                           transport="socket", edges=2),
+            traffic=TrafficGenerator(TraceConfig(population=12)))
+    b = _tiny_session()
+    with pytest.raises(ValueError, match="fastpath"):
+        AggregationService(
+            b, ServeConfig(quorum=2, payload="announce", fastpath=True),
+            traffic=TrafficGenerator(TraceConfig(population=12)))
+
+
 # ------------------------------------- single-damaged-frame property (bitwise)
 
 
@@ -1070,6 +1132,84 @@ def test_duplicated_frame_is_counted_once_bitwise():
         _sketch_session(), mutate=lambda f: [f, f])
     clean = _one_payload_round(_sketch_session(), mutate=None)
     np.testing.assert_array_equal(duplicated, clean)
+
+
+def _one_payload_round_batched(session, mutate=None, target=2):
+    """_one_payload_round's batched-gauntlet twin: every submission goes
+    through ONE submit_block call (the worker-pool entry point), so the
+    damaged frame sits INSIDE a vectorized validation block surrounded by
+    clean neighbors. Returns committed params (flat)."""
+    ids = session.sample_cohort(0)
+    prep0 = session.prepare_served_round(
+        0, ids, np.ones(len(ids), np.float32))
+    tables, aux = session.compute_client_tables(prep0)
+    q = IngestQueue(capacity=16, payload_policy=_policy())
+    q.open_round(0, ids)
+    asm = CohortAssembler(q, quorum=len(ids), deadline_s=10.0,
+                          payload_shape=_PAYLOAD_SHAPE)
+    subs = []
+    for i, cid in enumerate(ids):
+        payload = encode_frame(tables[i])
+        if i == target and mutate is not None:
+            sent = mutate(payload)
+            for p in sent if isinstance(sent, list) else [sent]:
+                if p is not None:
+                    subs.append(Submission(int(cid), 0, 0.1, payload=p))
+        else:
+            subs.append(Submission(int(cid), 0, 0.1, payload=payload))
+    statuses = q.submit_block(subs)
+    assert len(statuses) == len(subs)
+    closed = asm.close_virtual(0, ids)
+    prep = session.finish_served_payload(
+        prep0, closed.arrived, closed.tables, aux)
+    session.commit_round(session.dispatch_round(prep, LR))
+    return np.asarray(
+        ravel_pytree(jax.device_get(session.state["params"]))[0])
+
+
+@pytest.mark.parametrize("kind", sorted(DAMAGE))
+def test_damaged_frame_inside_batched_block_rejects_only_itself(kind):
+    """The batched gauntlet inherits the per-frame robustness property: a
+    corrupted / truncated / stale / garbled / half-sent frame inside a
+    validation BLOCK rejects only that submission — committed params are
+    bitwise the round where that client never submitted, and its clean
+    block-mates all land."""
+    damaged = _one_payload_round_batched(
+        _sketch_session(), mutate=DAMAGE[kind])
+    reference = _one_payload_round_batched(
+        _sketch_session(), mutate=lambda f: None)
+    np.testing.assert_array_equal(damaged, reference)
+    # and the batched path is bitwise the scalar path, damage and all
+    scalar = _one_payload_round(_sketch_session(), mutate=DAMAGE[kind])
+    np.testing.assert_array_equal(damaged, scalar)
+
+
+def test_duplicated_frame_inside_batched_block_is_counted_once():
+    duplicated = _one_payload_round_batched(
+        _sketch_session(), mutate=lambda f: [f, f])
+    clean = _one_payload_round_batched(_sketch_session(), mutate=None)
+    np.testing.assert_array_equal(duplicated, clean)
+
+
+def test_batched_block_screens_poison_against_quarantine_median():
+    """The vectorized L2 screen reproduces the scalar quarantine verdict:
+    a NaN table and an outlier-norm table inside one block both reject,
+    their clean neighbors accept, with the same detail discipline."""
+    q = IngestQueue(capacity=16, payload_policy=_policy(clip=2.0, median=1.0))
+    q.open_round(0, [1, 2, 3, 4])
+    nan_t = _table()
+    nan_t[0, 0] = np.nan
+    subs = [
+        Submission(1, 0, 0.1, payload=encode_frame(_table(0.1))),
+        Submission(2, 0, 0.1, payload=encode_frame(nan_t)),
+        Submission(3, 0, 0.1, payload=encode_frame(_table(100.0))),
+        Submission(4, 0, 0.1, payload=encode_frame(_table(0.2))),
+    ]
+    statuses = q.submit_block(subs)
+    assert statuses == [ACCEPTED, QUARANTINED, QUARANTINED, ACCEPTED]
+    c = q.counters()
+    assert c["rejected_quarantined"] == 2
+    assert c["accepted"] == 2
 
 
 # --------------------------------------------- close_wall under concurrency
