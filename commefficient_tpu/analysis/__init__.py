@@ -86,6 +86,15 @@ G016  per-submission-copy-in-fastpath            the zero-copy fast path
                                                  outside the ONE declared
                                                  ring-slot write
                                                  (`# graftlint: ring-write`)
+G017  fork-unsafe-import-in-shard-worker         the spawned shard-worker /
+                                                 loadgen import chain stays
+                                                 numpy/stdlib-only: no
+                                                 module-level import (direct
+                                                 or transitive, package
+                                                 __init__s included) of jax
+                                                 or other accelerator-
+                                                 runtime packages from the
+                                                 worker-entry modules
 ====  =========================================  ================================
 
 Run it:
@@ -121,6 +130,7 @@ from .rules_io import RawCheckpointWrite
 from .rules_ledger import LedgerWriteOutsideCommit
 from .rules_obs import ObsCallInCompiledScope
 from .rules_parity import ReservedLeafAccess, UnorderedReduction
+from .rules_procsafe import ForkUnsafeImportInShardWorker
 from .rules_reactor import BlockingCallInEventLoop
 from .rules_robust import (RobustOrderSensitivity,
                            StalenessFoldBoundary)
@@ -145,6 +155,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     LedgerWriteOutsideCommit,
     BlockingCallInEventLoop,
     PerSubmissionCopyInFastpath,
+    ForkUnsafeImportInShardWorker,
 )
 
 RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)

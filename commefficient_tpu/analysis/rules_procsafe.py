@@ -1,0 +1,269 @@
+"""G017 fork-unsafe-import-in-shard-worker.
+
+The process-sharded ingest (``--serve_shard_mode process``) spawns its
+worker processes with the multiprocessing "spawn" start method, and each
+worker re-imports its entry module (serve/scale/procshard_worker.py) plus
+everything that module pulls in at module level. That import chain must
+stay numpy/stdlib-only:
+
+- a worker that imports jax initializes a SECOND copy of the accelerator
+  runtime per shard — on TPU that is a hard failure (the device is owned
+  by the root process), on CPU it silently multiplies startup cost and
+  memory by the shard count;
+- the workers are the scale-out story: they move bytes and verdicts,
+  never arithmetic. A jax import creeping into the worker chain is the
+  first step of arithmetic creeping in after it, which would break the
+  served==batch bitwise contract the process shards are pinned to.
+
+The runtime guard (the spawn smoke asserting ``jax`` absent from
+``sys.modules``) only fires when someone runs it; this rule is the static
+tripwire. Detection, from each declared worker-entry module:
+
+- any MODULE-LEVEL import whose top-level package is fork-unsafe (jax,
+  jaxlib, flax, optax) is a direct violation;
+- module-level imports into this package are followed transitively —
+  through the imported module files AND every package ``__init__.py`` on
+  their dotted path (importing ``a.b.c`` executes ``a/__init__`` and
+  ``a/b/__init__`` too; that is exactly why serve/ and sketch/ carry lazy
+  PEP 562 ``__init__``s) — and a chain that reaches a fork-unsafe import
+  is reported at the root import with the path spelled out.
+
+Function-local imports are exempt on both ends: a lazy import inside a
+function that only the ROOT process calls is the sanctioned way to keep
+device-touching helpers next to worker-safe code (PEP 562 ``__getattr__``
+bodies are exactly this shape).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+# the declared worker-entry modules: everything importable from these at
+# module level runs inside a spawned shard worker / loadgen client process
+_WORKER_ENTRY_MODULES = (
+    f"{PACKAGE}/serve/scale/procshard_worker.py",
+    f"{PACKAGE}/serve/scale/shmring.py",
+    f"{PACKAGE}/serve/scale/loadgen.py",
+)
+
+# top-level packages whose import initializes an accelerator runtime (or
+# transitively always does) — never allowed in a spawned worker's chain
+_FORK_UNSAFE = ("jax", "jaxlib", "flax", "optax")
+
+# transitive traversal bound — measured in modules visited, not hops; the
+# seen-set makes the walk terminate anyway, this caps pathological trees
+_MAX_MODULES = 256
+
+
+def _top(name: str) -> str:
+    return name.split(".")[0]
+
+
+def _package_root(start: str) -> str | None:
+    """Nearest ancestor directory CONTAINING the package dir (same contract
+    as rules_sync's resolver — works for real modules and for fixture files
+    living outside the package tree)."""
+    cur = os.path.dirname(os.path.abspath(start))
+    for _ in range(12):
+        if os.path.isdir(os.path.join(cur, PACKAGE)):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return None
+        cur = nxt
+    return None
+
+
+def _ancestor_inits(root: str, mod_file: str) -> list[str]:
+    """Every package __init__.py ON the dotted path to `mod_file` under
+    `root` — importing the module executes all of them, so a fork-unsafe
+    import in any ancestor __init__ poisons the whole subtree."""
+    out: list[str] = []
+    rel = os.path.relpath(os.path.abspath(mod_file), root)
+    parts = rel.replace(os.sep, "/").split("/")[:-1]
+    cur = root
+    for p in parts:
+        cur = os.path.join(cur, p)
+        init = os.path.join(cur, "__init__.py")
+        if os.path.isfile(init):
+            out.append(init)
+    return out
+
+
+class ForkUnsafeImportInShardWorker(Rule):
+    code = "G017"
+    name = "fork-unsafe-import-in-shard-worker"
+    fixit = ("keep the worker-entry import chain numpy/stdlib-only: move "
+             "the device-touching import behind a function body the worker "
+             "never calls, or behind a lazy PEP 562 __getattr__ in the "
+             "package __init__ (how serve/ and sketch/ stay importable "
+             "from spawned shard workers)")
+
+    def __init__(self) -> None:
+        # abspath -> SourceFile | None, cached across the analyzer run
+        self._modules: dict[str, SourceFile | None] = {}
+
+    def applies(self, rel: str) -> bool:
+        return rel in _WORKER_ENTRY_MODULES
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        for node in self._module_level_imports(src):
+            direct = self._direct_unsafe(node)
+            if direct:
+                out.append(self.violation(
+                    src, node,
+                    f"module-level `import {direct}` in a worker-entry "
+                    "module — a spawned shard worker re-imports this chain "
+                    "and would initialize the accelerator runtime per "
+                    "shard"))
+                continue
+            for mod_file, label in self._in_package_targets(src, node):
+                hit = self._chain_unsafe(src.path, mod_file, [label])
+                if hit is not None:
+                    chain, unsafe = hit
+                    out.append(self.violation(
+                        src, node,
+                        f"worker-entry import chain reaches `import "
+                        f"{unsafe}` via {' -> '.join(chain)} — the spawned "
+                        "shard worker would pull the accelerator runtime "
+                        "in at module import"))
+                    break  # one report per root import is enough
+        return out
+
+    # -- per-file scanning -----------------------------------------------------
+
+    @staticmethod
+    def _module_level_imports(src: SourceFile):
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if src.enclosing_symbol(node.lineno) != "<module>":
+                continue  # function-local imports are the sanctioned shape
+            yield node
+
+    @staticmethod
+    def _direct_unsafe(node: ast.Import | ast.ImportFrom) -> str | None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if _top(a.name) in _FORK_UNSAFE:
+                    return a.name
+        elif node.level == 0 and node.module:
+            if _top(node.module) in _FORK_UNSAFE:
+                return node.module
+        return None
+
+    def _in_package_targets(self, src: SourceFile,
+                            node: ast.Import | ast.ImportFrom):
+        """Module FILES a module-level import statement executes: the
+        imported module(s) themselves plus every package __init__ on their
+        dotted path. Relative imports resolve against the file's REAL
+        directory (fixture helpers included); absolute imports resolve
+        only within this package."""
+        here = os.path.dirname(os.path.abspath(src.path))
+        root = _package_root(src.path)
+        files: list[tuple[str, str]] = []
+
+        def add(mod_file: str, label: str) -> None:
+            if root is not None:
+                for init in _ancestor_inits(root, mod_file):
+                    files.append((init, _display(root, init)))
+            files.append((mod_file, label))
+
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if _top(a.name) != PACKAGE or root is None:
+                    continue
+                parts = a.name.split(".")
+                mod_file = os.path.join(root, *parts) + ".py"
+                pkg_init = os.path.join(root, *parts, "__init__.py")
+                if os.path.isfile(mod_file):
+                    add(mod_file, a.name)
+                elif os.path.isfile(pkg_init):
+                    add(pkg_init, a.name)
+            return files
+        # ImportFrom: resolve the base, then each name as a submodule (or
+        # fall back to the base module file holding the attribute)
+        if node.level > 0:
+            base = here
+            for _ in range(node.level - 1):
+                base = os.path.dirname(base)
+        elif node.module and _top(node.module) == PACKAGE and root is not None:
+            base = root
+        else:
+            return files
+        if node.module:
+            base = os.path.join(base, *node.module.split("."))
+        for a in node.names:
+            if a.name == "*":
+                continue
+            sub = os.path.join(base, a.name + ".py")
+            mod_file = base + ".py"
+            pkg_init = os.path.join(base, "__init__.py")
+            if os.path.isfile(sub):
+                add(sub, _display(root, sub) if root else a.name)
+            elif os.path.isfile(mod_file):
+                add(mod_file, _display(root, mod_file) if root else a.name)
+            elif os.path.isfile(pkg_init):
+                add(pkg_init, _display(root, pkg_init) if root else a.name)
+        return files
+
+    # -- transitive chain ------------------------------------------------------
+
+    def _chain_unsafe(self, entry_path: str, mod_file: str,
+                      chain: list[str]) -> tuple[list[str], str] | None:
+        """BFS over module-level imports from `mod_file`; returns the first
+        (chain, unsafe-import) found, or None. Explicit G017 disables on
+        the offending import line in the HELPER stop the traversal — the
+        declared escape hatch for host-only modules that are provably
+        never imported by a worker."""
+        seen: set[str] = {os.path.abspath(entry_path)}
+        frontier: list[tuple[str, list[str]]] = [(mod_file, chain)]
+        visited = 0
+        while frontier and visited < _MAX_MODULES:
+            path, trail = frontier.pop(0)
+            apath = os.path.abspath(path)
+            if apath in seen:
+                continue
+            seen.add(apath)
+            visited += 1
+            helper = self._load(path)
+            if helper is None:
+                continue
+            for node in self._module_level_imports(helper):
+                if helper.directives.disabled(self.code, node.lineno):
+                    continue
+                direct = self._direct_unsafe(node)
+                if direct:
+                    return trail, direct
+                for nxt_file, nxt_label in self._in_package_targets(
+                        helper, node):
+                    if os.path.abspath(nxt_file) not in seen:
+                        frontier.append((nxt_file, trail + [nxt_label]))
+        return None
+
+    def _load(self, path: str) -> SourceFile | None:
+        apath = os.path.abspath(path)
+        if apath in self._modules:
+            return self._modules[apath]
+        src: SourceFile | None = None
+        try:
+            with open(apath, encoding="utf-8") as f:
+                text = f.read()
+            from .core import project_rel
+
+            src = SourceFile(apath, project_rel(apath), text,
+                             frozenset({self.code}))
+        except (OSError, SyntaxError, ValueError):
+            src = None  # unreadable: out of static reach
+        self._modules[apath] = src
+        return src
+
+
+def _display(root: str | None, path: str) -> str:
+    if root is None:
+        return os.path.basename(path)
+    return os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")

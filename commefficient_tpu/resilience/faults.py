@@ -180,6 +180,12 @@ KINDS = {
     # the cohort forwards nothing (edge death == shard dropped, bitwise,
     # with the requeue machinery re-serving the clients)
     "edge_kill": ("edges",),
+    # ingest-shard site (process-sharded serving, serve/scale/
+    # procshard.py): SIGKILL shard worker process(es) at the scheduled
+    # round's collect — the dead shard's clients fail at the socket and
+    # the round closes without them (shard death == its client set
+    # dropped + re-queued, bitwise); the worker respawns at the next open
+    "shard_kill": ("shards",),
 }
 
 # the client_* sites fire inside a round's preparation: scheduled at or past
@@ -210,6 +216,12 @@ STALE_POISON_KINDS = ("client_stale_poison",)
 # topology (--serve_edges >= 2): same dead-schedule validation, plus
 # validate_edge_context — with no edge tree there is nothing to kill
 EDGE_KINDS = ("edge_kill",)
+
+# shard_kill fires at the process-sharded ingest (--serve_shards >= 2
+# with --serve_shard_mode process): same dead-schedule validation, plus
+# validate_shard_context — thread shards share the root process and
+# cannot be killed out from under it
+SHARD_KINDS = ("shard_kill",)
 
 
 class InjectedFault(RuntimeError):
@@ -324,6 +336,14 @@ def _parse_entry(entry: str) -> FaultSpec:
                             "expected '+'-separated non-negative edge "
                             "indices")
                     params[k] = pos
+                elif k == "shards":
+                    # "+"-separated shard indices, like edges=
+                    pos = tuple(int(p) for p in v.split("+") if p.strip())
+                    if not pos or any(p < 0 for p in pos):
+                        raise ValueError(
+                            "expected '+'-separated non-negative shard "
+                            "indices")
+                    params[k] = pos
                 elif k == "value":
                     allowed = (("nan", "inf", "big") if kind == "client_poison"
                                else ("nan", "inf"))
@@ -340,6 +360,10 @@ def _parse_entry(entry: str) -> FaultSpec:
         raise ValueError(
             f"fault kind 'edge_kill' needs edges=<i>[+<j>...] in "
             f"--fault_plan entry {entry!r} (which edge aggregator dies)")
+    if kind == "shard_kill" and "shards" not in params:
+        raise ValueError(
+            f"fault kind 'shard_kill' needs shards=<i>[+<j>...] in "
+            f"--fault_plan entry {entry!r} (which shard worker dies)")
     return FaultSpec(kind=kind, rounds=rounds, params=params)
 
 
@@ -402,7 +426,7 @@ class FaultPlan:
         vacuously."""
         for s in self.specs:
             if (s.kind in (CLIENT_KINDS + WIRE_KINDS + ADVERSARIAL_KINDS
-                           + STALE_POISON_KINDS + EDGE_KINDS)
+                           + STALE_POISON_KINDS + EDGE_KINDS + SHARD_KINDS)
                     or s.kind == "host_preempt") and s.rounds:
                 dead = [r for r in s.rounds if r >= total_rounds]
                 if dead:
@@ -496,6 +520,54 @@ class FaultPlan:
                     f"--fault_plan: edge_kill:edges="
                     f"{'+'.join(map(str, dead))} can never fire — the "
                     f"tree has {n_edges} edge(s) (0-based indices)")
+
+    def validate_shard_context(self, proc_shards_armed: bool,
+                               n_shards: int = 0) -> None:
+        """Launch-time context validation for shard_kill: it SIGKILLs
+        worker processes of the process-sharded ingest (--serve_shards
+        >= 2 with --serve_shard_mode process), so a plan naming it on a
+        thread-sharded or unsharded run would pass vacuously; a shard
+        index past the worker count could never fire either."""
+        specs = [s for s in self.specs if s.kind in SHARD_KINDS]
+        if not specs:
+            return
+        if not proc_shards_armed:
+            raise ValueError(
+                "--fault_plan: shard_kill can never fire — it SIGKILLs "
+                "worker processes of the process-sharded ingest and needs "
+                "--serve_shards >= 2 with --serve_shard_mode process; on "
+                "this run the chaos plan would pass vacuously")
+        for s in specs:
+            dead = [k for k in s.params.get("shards", ()) if k >= n_shards]
+            if dead:
+                raise ValueError(
+                    f"--fault_plan: shard_kill:shards="
+                    f"{'+'.join(map(str, dead))} can never fire — the "
+                    f"ingest has {n_shards} shard worker(s) (0-based "
+                    "indices)")
+
+    def has_shard_kill(self) -> bool:
+        return any(s.kind in SHARD_KINDS for s in self.specs)
+
+    def shard_kill_plan(self, rnd: int) -> tuple:
+        """Shard-worker indices scheduled to die at round `rnd` —
+        DETERMINISTIC per round, same replay contract as edge_kill_plan.
+        The kill lands at the collect window's start: the worker is
+        SIGKILLed mid-run (no drain), its clients' submissions fail at
+        the socket, and the close masks + re-queues them — bitwise a
+        client_drop of the dead shard's client set. The worker respawns
+        at the NEXT round's open, so a kill costs its shard one round.
+        Each kill is an obs instant + the per-kind counter."""
+        out: list[int] = []
+        for s in self.specs_for("shard_kill", rnd):
+            shards = [int(k) for k in s.params["shards"]]
+            out.extend(shards)
+            self._mark("shard_kill", rnd, shards=shards)
+            obreg.default().counter(
+                "resilience_fault_shard_kill_total").inc()
+            self._log(f"shard_kill: shard worker(s) {shards} SIGKILLed "
+                      f"at round {rnd}")
+        return tuple(sorted(set(out)))
 
     def has_edge_kill(self) -> bool:
         return any(s.kind in EDGE_KINDS for s in self.specs)

@@ -50,19 +50,45 @@ Both CLIs expose it as `--serve {inproc,socket}` (+ `--serve_quorum`,
 `--serve_shards`, `--serve_edges`).
 """
 
-from .assembler import ClosedRound, CohortAssembler
-from .ingest import IngestQueue, PayloadPolicy, Submission, validate_payload
-from .metrics import MetricsServer
-from .pipeline import RoundPipeline
-from .service import AggregationService, ServeConfig, ServedSource
-from .traffic import TraceConfig, TrafficGenerator
-from .transport import (
-    InProcessTransport,
-    SocketTransport,
-    abort_over_socket,
-    submit_over_socket,
-    submit_with_retries,
-)
+# Lazy (PEP 562) re-exports: shard WORKER processes (serve/scale/procshard)
+# import `commefficient_tpu.serve.<mod>` submodules, and an eager
+# `from .service import ...` here would drag jax into every worker — the
+# exact fork/spawn hazard graftlint G017 polices. Names resolve on first
+# attribute access; the public surface is unchanged.
+_EXPORTS = {
+    "ClosedRound": "assembler",
+    "CohortAssembler": "assembler",
+    "IngestQueue": "ingest",
+    "PayloadPolicy": "ingest",
+    "Submission": "ingest",
+    "validate_payload": "ingest",
+    "MetricsServer": "metrics",
+    "RoundPipeline": "pipeline",
+    "AggregationService": "service",
+    "ServeConfig": "service",
+    "ServedSource": "service",
+    "TraceConfig": "traffic",
+    "TrafficGenerator": "traffic",
+    "InProcessTransport": "transport",
+    "SocketTransport": "transport",
+    "abort_over_socket": "transport",
+    "submit_over_socket": "transport",
+    "submit_with_retries": "transport",
+}
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
 
 __all__ = [
     "AggregationService",

@@ -1,8 +1,8 @@
 """serve/scale — the C1M scale-out ingest and aggregation subsystem.
 
-Three layers, each replacing a does-not-scale piece of the serving stack
-while keeping every admission decision, parity pin, and threat-model
-boundary of the original:
+Layers, each replacing a does-not-scale piece of the serving stack while
+keeping every admission decision, parity pin, and threat-model boundary
+of the original:
 
 - `eventloop.py` — `EventLoopTransport`: a selectors-based single-threaded
   REACTOR replacing thread-per-connection for the socket path. One thread
@@ -18,6 +18,28 @@ boundary of the original:
   SHEDDING retry-after gauge land in the process registry, so `/metrics`
   and `/metrics.prom` can tell an overloaded SHARD from an overloaded
   server.
+- `procshard.py` / `procshard_worker.py` / `shmring.py` —
+  `ProcShardedIngest`: the shard promotion from reactor threads to real
+  WORKER PROCESSES (`--serve_shard_mode process`). Each worker bind+
+  listens on the shared port with SO_REUSEPORT, runs its own reactor +
+  batched gauntlet, and OWNS its `shard_for` admission slice
+  (kernel-misrouted frames forward to the owner's direct port, verdicts
+  relayed); validated tables land in a per-shard
+  `multiprocessing.shared_memory` ring speaking the in-process ring's
+  block/slot protocol, so the root reads worker bytes directly and
+  served == batch stays bitwise (tests/test_procshard.py). Lifecycle is
+  first-class: SIGTERM drain, respawn at next round open, the
+  `shard_kill` fault kind (dead shard == its hash-shard client_drop'd,
+  bitwise), per-shard counters aggregated across the process boundary.
+  NOTE: procshard/loadgen are deliberately NOT re-exported here — a
+  spawned worker imports this package on its entry chain, which must
+  stay numpy/stdlib-only (graftlint G017) and lean; import them by
+  module path (`serve.scale.procshard`, `serve.scale.loadgen`).
+- `loadgen.py` — the multi-process closed-loop load harness: M client
+  processes (own loopback source IPs, per-worker fd-cap accounting)
+  ramp 2048 -> 100k connections against the shared port, closed-loop per
+  connection so submissions/s is a capacity number; the ramp names the
+  fd/rlimit ceiling it hits (bench `scale.loadgen_ramp`).
 - `edge.py` — `EdgeTree`: two-tier edge aggregation. Each edge aggregator
   ordered-sums its hash-shard's validated tables into ONE r x c partial
   (sketch linearity makes the tree merge exact) and forwards it — plus the

@@ -106,7 +106,7 @@ class EventLoopTransport(LineProtocol):
                  port: int = 0, read_deadline_s: float = 30.0,
                  max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
                  max_conns: int = DEFAULT_MAX_CONNS_EVENTLOOP,
-                 shard_id: int | None = None):
+                 shard_id: int | None = None, reuse_port: bool = False):
         if read_deadline_s <= 0:
             raise ValueError(
                 f"read_deadline_s must be > 0, got {read_deadline_s} — an "
@@ -123,6 +123,12 @@ class EventLoopTransport(LineProtocol):
         # None = a standalone reactor; an int = this reactor is shard k of
         # a ShardedIngest — per-shard counters get distinct registry names
         self.shard_id = shard_id
+        # SO_REUSEPORT bind: N worker-process reactors listen on the SAME
+        # (host, port) and the kernel spreads accepted connections among
+        # them by 4-tuple hash (serve/scale/procshard.py). The root
+        # reserves the port with a never-listening socket first, so the
+        # bind can never race an unrelated process.
+        self.reuse_port = reuse_port
         self._host, self._port = host, port
         self._sock: socket.socket | None = None
         self._sel: selectors.BaseSelector | None = None
@@ -161,6 +167,8 @@ class EventLoopTransport(LineProtocol):
             return
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if self.reuse_port:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         s.bind((self._host, self._port))
         s.listen(1024)
         s.setblocking(False)
@@ -303,12 +311,14 @@ class EventLoopTransport(LineProtocol):
         return None
 
     def _flush_deferred(self) -> None:
-        """Queue the batched gauntlet's verdicts onto their connections'
-        out-buffers (reactor thread only). A connection that died while
-        its frame sat in a batch just drops the reply — the same contract
-        as a threaded handler whose peer vanished mid-submit."""
-        if self.gauntlet is None:
-            return
+        """Queue deferred verdicts — batched-gauntlet replies, and a
+        worker-process reactor's forwarded-misroute replies (serve/scale/
+        procshard_worker.py) — onto their connections' out-buffers
+        (reactor thread only). A connection that died while its frame sat
+        in a batch just drops the reply — the same contract as a threaded
+        handler whose peer vanished mid-submit."""
+        if not self._deferred:  # racy-but-benign emptiness peek: a miss
+            return              # is re-checked on the next wake
         with self._deferred_lock:
             if not self._deferred:
                 return

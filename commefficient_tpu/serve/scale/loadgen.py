@@ -1,0 +1,312 @@
+"""Multi-process closed-loop load harness for the scale-out serving path.
+
+The thread-sharded C1M work (PR 15) measured the ingest with in-process
+callers; the process-sharded promotion (PR 18) needs the thing it
+actually claims — submissions/s through REAL sockets at six-figure
+connection counts — measured from OUTSIDE the server's processes. This
+module is that harness: M client PROCESSES (spawn context; this module is
+on their import chain and stays numpy/stdlib-only, graftlint G017), each
+running one selectors reactor over its share of persistent connections to
+the service's shared SO_REUSEPORT port, ramping the fleet from 2048
+toward 100k connections in doubling stages.
+
+Each connection is CLOSED-LOOP: submit one announce-style line, wait for
+the verdict, think, submit again — offered load tracks service rate
+instead of overrunning it, so a stage's submissions/s is a real capacity
+number, not a buffer-depth artifact. The think time is modulated by the
+diurnal/bursty traffic model the serve tier is benched against
+(serve/traffic.py's shapes, re-expressed as a rate multiplier over wall
+time): "flat" holds the base think, "diurnal" sweeps a day-curve sinusoid
+across each stage, "bursty" alternates quiet baseline with duty-cycle
+bursts of near-zero think.
+
+Six-figure fan-out mechanics, all counted and reported per stage:
+
+- every worker binds its OWN loopback source IP (127.0.1.<wid+1>) before
+  connecting, so each gets the full ephemeral-port range instead of the
+  fleet sharing one (host, port) 4-tuple space (~28k ports);
+- every worker caps its connection share at its RLIMIT_NOFILE soft limit
+  minus headroom, and REPORTS the cap — when a ramp stage falls short of
+  its target, the result names the fd/rlimit ceiling that was actually
+  hit instead of silently shrinking (the bench logs it);
+- verdict counts (ACCEPTED / DUPLICATE / SHEDDING / rejections) come back
+  per worker over the control pipe and aggregate per stage, so an
+  admission-refusing server is visible as such, not as throughput.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import multiprocessing
+import math
+import resource
+import selectors
+import socket
+import sys
+import time
+
+_FD_HEADROOM = 128  # fds a worker keeps free for pipes/stdio/selector
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadGenConfig:
+    """One ramp run against a serving address (see module docstring)."""
+
+    host: str = "127.0.0.1"
+    port: int = 0
+    connections: int = 2048    # ramp TARGET (stages double toward it)
+    processes: int = 4         # client worker processes
+    stage_s: float = 5.0       # measured wall time per ramp stage
+    model: str = "diurnal"     # flat | diurnal | bursty
+    think_s: float = 0.05      # closed-loop base think time per conn
+    period_s: float = 4.0      # diurnal period / burst cycle length
+    burst_duty: float = 0.25   # bursty: fraction of each cycle in-burst
+    round_hint: int = 0        # round number stamped on submissions
+    client_base: int = 1 << 20  # id space floor (clear of real cohorts)
+    ramp_start: int = 2048     # first stage's connection count
+    source_ips: bool = True    # per-worker loopback source IPs
+    connect_timeout_s: float = 10.0
+
+
+def _rate_mult(model: str, t: float, period_s: float,
+               burst_duty: float) -> float:
+    """Offered-rate multiplier at wall time t (think = think_s / mult)."""
+    if model == "diurnal":
+        # the day curve swept across the stage: trough 0.1x, peak 1.0x
+        return 0.55 + 0.45 * math.sin(2.0 * math.pi * t / period_s)
+    if model == "bursty":
+        return 4.0 if (t % period_s) < burst_duty * period_s else 0.4
+    return 1.0
+
+
+class _Conn:
+    __slots__ = ("sock", "out", "buf", "next_t", "cid", "state")
+
+    def __init__(self, sock, cid: int):
+        self.sock = sock
+        self.out = b""
+        self.buf = b""
+        self.next_t = 0.0
+        self.cid = cid
+        self.state = "connecting"
+
+
+def _loadgen_worker(cfg: dict, wid: int, share: int, ctl) -> None:
+    """One client process: `share` closed-loop connections on a selectors
+    reactor for stage_s seconds, results over the control pipe. Spawn
+    target — keep the module chain numpy/stdlib-only (G017)."""
+    soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    cap = max(int(soft) - _FD_HEADROOM, 16)
+    n = min(share, cap)
+    src_ip = f"127.0.1.{(wid % 250) + 1}" if cfg["source_ips"] else None
+    addr = (cfg["host"], cfg["port"])
+    sel = selectors.DefaultSelector()
+    conns: list[_Conn] = []
+    statuses: dict[str, int] = {}
+    errors = 0
+    submissions = 0
+
+    def _line(cid: int) -> bytes:
+        return (json.dumps({"client_id": cid,
+                            "round": int(cfg["round_hint"]),
+                            "latency_s": 0.0}) + "\n").encode()
+
+    t0 = time.monotonic()
+    deadline = t0 + float(cfg["stage_s"])
+    connect_deadline = t0 + float(cfg["connect_timeout_s"])
+    opened = 0
+    for i in range(n):
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setblocking(False)
+            if src_ip is not None:
+                try:
+                    s.bind((src_ip, 0))
+                except OSError:
+                    pass  # exotic loopback config: fall back to default
+            try:
+                s.connect(addr)
+            except BlockingIOError:
+                pass
+            cid = int(cfg["client_base"]) + wid * cap + i
+            c = _Conn(s, cid)
+            sel.register(s, selectors.EVENT_WRITE, c)
+            conns.append(c)
+            opened += 1
+        except OSError:
+            errors += 1
+            break  # fd/port exhaustion: report how far we got
+    while time.monotonic() < deadline:
+        now = time.monotonic()
+        events = sel.select(timeout=0.01)
+        for key, mask in events:
+            c: _Conn = key.data
+            try:
+                if c.state == "connecting" and (mask
+                                                & selectors.EVENT_WRITE):
+                    err = c.sock.getsockopt(socket.SOL_SOCKET,
+                                            socket.SO_ERROR)
+                    if err:
+                        raise OSError(err, "connect failed")
+                    if now > connect_deadline:
+                        raise OSError("connect deadline")
+                    c.state = "sending"
+                    c.out = _line(c.cid)
+                if c.state == "sending" and (mask & selectors.EVENT_WRITE):
+                    sent = c.sock.send(c.out)
+                    c.out = c.out[sent:]
+                    if not c.out:
+                        c.state = "reading"
+                        sel.modify(c.sock, selectors.EVENT_READ, c)
+                elif c.state == "reading" and (mask & selectors.EVENT_READ):
+                    data = c.sock.recv(4096)
+                    if not data:
+                        raise OSError("server closed connection")
+                    c.buf += data
+                    if b"\n" in c.buf:
+                        line, _, c.buf = c.buf.partition(b"\n")
+                        st = json.loads(line).get("status", "?")
+                        statuses[st] = statuses.get(st, 0) + 1
+                        submissions += 1
+                        # closed loop: think (model-modulated), resubmit
+                        mult = _rate_mult(cfg["model"], now - t0,
+                                          cfg["period_s"],
+                                          cfg["burst_duty"])
+                        c.next_t = now + float(cfg["think_s"]) / max(
+                            mult, 1e-3)
+                        c.state = "thinking"
+                        sel.unregister(c.sock)
+            except (OSError, ValueError):
+                errors += 1
+                try:
+                    sel.unregister(c.sock)
+                except (KeyError, ValueError):
+                    pass
+                try:
+                    c.sock.close()
+                except OSError:
+                    pass
+                c.state = "dead"
+        # wake thinkers whose timers expired (scan is O(conns); at 12.5k
+        # conns per worker and 100 wakes/s this is the cheap part next to
+        # the syscalls)
+        for c in conns:
+            if c.state == "thinking" and now >= c.next_t:
+                c.state = "sending"
+                c.out = _line(c.cid)
+                sel.register(c.sock, selectors.EVENT_WRITE, c)
+    for c in conns:
+        try:
+            c.sock.close()
+        except OSError:
+            pass
+    ctl.send({
+        "wid": wid, "share": share, "opened": opened,
+        "fd_cap": cap, "fd_capped": share > cap,
+        "submissions": submissions, "statuses": statuses,
+        "errors": errors,
+    })
+    ctl.close()
+    sys.exit(0)
+
+
+def run_stage(cfg: LoadGenConfig, conns: int) -> dict:
+    """One ramp stage: `conns` connections across cfg.processes worker
+    processes, measured for cfg.stage_s. Returns the aggregated stage
+    record (achieved conns, submissions/s, verdict mix, fd ceiling)."""
+    ctx = multiprocessing.get_context("spawn")
+    per = max(conns // cfg.processes, 1)
+    shares = [per] * cfg.processes
+    shares[-1] += conns - per * cfg.processes
+    workers = []
+    wire = dataclasses.asdict(cfg)
+    for wid, share in enumerate(shares):
+        parent, child = ctx.Pipe()
+        p = ctx.Process(target=_loadgen_worker,
+                        args=(wire, wid, share, child),
+                        name=f"loadgen-{wid}", daemon=True)
+        p.start()
+        child.close()
+        workers.append((p, parent))
+    t0 = time.monotonic()
+    results = []
+    for p, parent in workers:
+        try:
+            if parent.poll(cfg.stage_s + cfg.connect_timeout_s + 30.0):
+                results.append(parent.recv())
+        except (EOFError, OSError):
+            pass
+        p.join(5.0)
+        if p.is_alive():
+            p.kill()
+            p.join(1.0)
+        try:
+            parent.close()
+        except OSError:
+            pass
+    wall = time.monotonic() - t0
+    total_sub = sum(r["submissions"] for r in results)
+    statuses: dict[str, int] = {}
+    for r in results:
+        for k, v in r["statuses"].items():
+            statuses[k] = statuses.get(k, 0) + v
+    return {
+        "target_conns": conns,
+        "opened_conns": sum(r["opened"] for r in results),
+        "processes": len(results),
+        "submissions": total_sub,
+        "submissions_per_s": round(total_sub / max(cfg.stage_s, 1e-9), 1),
+        "wall_s": round(wall, 3),
+        "statuses": statuses,
+        "errors": sum(r["errors"] for r in results),
+        "fd_cap_per_proc": min((r["fd_cap"] for r in results), default=0),
+        "fd_capped": any(r["fd_capped"] for r in results),
+    }
+
+
+def run_ramp(cfg: LoadGenConfig, log=print) -> dict:
+    """The full ramp: doubling stages from cfg.ramp_start toward
+    cfg.connections, stopping early (and saying why) when the fd/rlimit
+    ceiling or socket errors cap the achievable fleet. Returns
+    {"stages": [...], "peak_submissions_per_s": ..., "ceiling": ...}."""
+    stages = []
+    target = max(int(cfg.connections), 1)
+    c = min(max(int(cfg.ramp_start), 1), target)
+    plan = []
+    while True:
+        plan.append(c)
+        if c >= target:
+            break
+        c = min(c * 2, target)
+    ceiling = None
+    for conns in plan:
+        stage = run_stage(cfg, conns)
+        stages.append(stage)
+        log(f"loadgen: stage {conns} conns -> opened "
+            f"{stage['opened_conns']}, {stage['submissions_per_s']}/s, "
+            f"errors {stage['errors']}"
+            + (f", fd-capped at {stage['fd_cap_per_proc']}/proc"
+               if stage["fd_capped"] else ""))
+        if stage["fd_capped"] or stage["opened_conns"] < conns * 0.9:
+            soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+            ceiling = {
+                "at_target_conns": conns,
+                "opened_conns": stage["opened_conns"],
+                "rlimit_nofile": [int(soft), int(hard)],
+                "why": ("per-process RLIMIT_NOFILE"
+                        if stage["fd_capped"] else
+                        "connect failures (port/fd exhaustion or "
+                        "server accept ceiling)"),
+            }
+            log(f"loadgen: ramp CEILING at {conns} target conns — "
+                f"{ceiling['why']} (rlimit_nofile={soft}/{hard})")
+            break
+    return {
+        "stages": stages,
+        "peak_submissions_per_s": max(
+            (s["submissions_per_s"] for s in stages), default=0.0),
+        "max_opened_conns": max(
+            (s["opened_conns"] for s in stages), default=0),
+        "ceiling": ceiling,
+    }

@@ -1,0 +1,612 @@
+"""Process-sharded ingest, root half: SO_REUSEPORT worker processes over
+shared-memory ring handoff (--serve_shards with --serve_shard_mode process).
+
+The thread-sharded ingest (serve/scale/shard.py) spreads CONNECTIONS over
+N reactors but every byte of decode, gauntlet arithmetic, and admission
+bookkeeping still serializes on one GIL — the submissions/s ceiling is one
+core no matter what --serve_shards says. This module is the promotion to
+real worker PROCESSES, shared-nothing end to end:
+
+- the root RESERVES the shared port (a bound, never-listening SO_REUSEPORT
+  socket — it holds the address without joining the kernel's accept
+  group), then spawns N workers (serve/scale/procshard_worker.py, "spawn"
+  start method — the entry chain is numpy-only, graftlint G017); each
+  worker binds+listens SO_REUSEPORT on that port and the kernel spreads
+  connections among them by 4-tuple hash;
+- client OWNERSHIP is still `shard_for` (splitmix64, deployment-stable):
+  each worker owns its slice of admission state outright — dedup set,
+  early-pending buffer, quarantine screen against the round's BROADCAST
+  median snapshot — and kernel-misrouted frames are counted + forwarded
+  to the owner's direct port, verdict relayed back;
+- the shard->root handoff is one `ShmRingBlock` per shard speaking the
+  PR 17 block/slot protocol: a shard's output IS a validated table block,
+  the root's close concatenates ring views and the `_RingUploader`
+  overlap carries over. Process shards move bytes and verdicts, never
+  arithmetic — served==batch stays bitwise, fastpath on or off;
+- worker lifecycle is a first-class robustness surface: SIGTERM = clean
+  drain; `shard_kill` (resilience/faults.py) SIGKILLs a worker mid-run
+  and the dead shard's clients are dropped + re-queued bitwise (they
+  never arrive — exactly a client_drop of the same set); deaths are
+  counted (serve_shard_deaths_total), dead workers respawn at the next
+  round's open, and per-shard counters aggregate across the process
+  boundary into the root's /metrics and /metrics.prom.
+
+`ProcShardedIngest` presents the transport surface the service expects
+(start/stop/address/addr_for/submit/counters); `ProcShardQueue` presents
+the IngestQueue surface the service + assembler drive (open_round /
+close_round / wait_for / depth / counters / boundary bookkeeping), backed
+by control-pipe RPCs. Compositions that assume one in-process queue
+(--serve_pipeline, --serve_async, --serve_edges) are rejected loudly at
+service construction — named follow-ups, not silent misbehavior.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+
+from ...obs import registry as obreg
+from ..ingest import Arrival
+from ..transport import DEFAULT_MAX_FRAME_BYTES, submit_over_socket
+from .eventloop import DEFAULT_MAX_CONNS_EVENTLOOP
+from .procshard_worker import worker_main
+from .shard import shard_for
+from .shmring import ShmRingBlock
+
+
+class WorkerDead(RuntimeError):
+    """A control-pipe RPC hit a dead or unresponsive worker."""
+
+
+class _WorkerHandle:
+    """Root-side view of one worker process: the process handle, its end
+    of the control pipe (requests serialized under `lock` — one
+    send/recv round trip at a time, so replies can never interleave),
+    and the last counter snapshot it shipped (a dead worker keeps
+    contributing its final counts)."""
+
+    def __init__(self, shard_id: int):
+        self.shard_id = shard_id
+        self.proc = None
+        self.ctl = None
+        self.lock = threading.Lock()
+        self.direct_addr: tuple[str, int] | None = None
+        self.alive = False
+        self.last_queue_counters: dict = {}
+        self.last_registry: dict = {}
+        self._pushed: dict[str, float] = {}  # registry deltas already
+        # applied to the root registry for THIS incarnation
+
+
+class ProcShardedIngest:
+    """N SO_REUSEPORT worker processes fronting shared-nothing shard
+    queues (see module docstring)."""
+
+    def __init__(self, n_shards: int, payload_shape=None,
+                 payload_policy=None, host: str = "127.0.0.1",
+                 port: int = 0, fastpath: bool = False,
+                 gauntlet_workers: int = 2,
+                 queue_kwargs: dict | None = None,
+                 read_deadline_s: float = 30.0,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 max_conns: int = DEFAULT_MAX_CONNS_EVENTLOOP):
+        if n_shards < 2:
+            raise ValueError(
+                f"n_shards must be >= 2, got {n_shards} (one shard IS the "
+                "plain event-loop transport — use EventLoopTransport)")
+        self.n_shards = int(n_shards)
+        self.payload_shape = payload_shape
+        self.payload_policy = payload_policy
+        self.fastpath = bool(fastpath) and payload_shape is not None
+        self.gauntlet_workers = int(gauntlet_workers)
+        self.queue_kwargs = dict(queue_kwargs or {})
+        self.read_deadline_s = read_deadline_s
+        self.max_frame_bytes = max_frame_bytes
+        self.max_conns = max_conns
+        self._host, self._port = host, int(port)
+        self._reserve: object | None = None  # the port-holding socket
+        self._ctx = multiprocessing.get_context("spawn")
+        self.workers = [_WorkerHandle(k) for k in range(self.n_shards)]
+        self.queue = ProcShardQueue(self)
+        self._blocks: list[ShmRingBlock] | None = None
+        self._block_cap = 0
+        self._started = False
+        self._stop_lock = threading.Lock()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        import socket as _socket
+
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1)
+        s.bind((self._host, self._port))
+        # no listen(): the root HOLDS the port (stable address, no bind
+        # race) without joining the kernel's accept group — only the
+        # workers' listening sockets receive connections
+        self._reserve = s
+        self._port = s.getsockname()[1]
+        for w in self.workers:
+            self._spawn(w)
+        self._broadcast_peers()
+        self._started = True
+
+    def _worker_cfg(self, shard_id: int) -> dict:
+        rows, cols = (self.payload_shape
+                      if self.payload_shape is not None else (0, 0))
+        clip = (float(self.payload_policy.clip_multiple)
+                if self.payload_policy is not None else 0.0)
+        return {
+            "shard_id": shard_id, "n_shards": self.n_shards,
+            "host": self._host, "port": self._port,
+            "rows": rows, "cols": cols, "clip_multiple": clip,
+            "fastpath": self.fastpath,
+            "gauntlet_workers": self.gauntlet_workers,
+            "read_deadline_s": self.read_deadline_s,
+            "max_frame_bytes": self.max_frame_bytes,
+            "max_conns": self.max_conns,
+            **{k: self.queue_kwargs[k] for k in (
+                "queue_capacity", "pending_capacity", "shed_watermark",
+                "shed_retry_after_s") if k in self.queue_kwargs},
+        }
+
+    def _spawn(self, w: _WorkerHandle, ready_timeout_s: float = 30.0):
+        parent, child = self._ctx.Pipe()
+        proc = self._ctx.Process(
+            target=worker_main, args=(self._worker_cfg(w.shard_id), child),
+            name=f"serve-shard-{w.shard_id}", daemon=True)
+        proc.start()
+        child.close()
+        if not parent.poll(ready_timeout_s):
+            proc.kill()
+            raise RuntimeError(
+                f"shard worker {w.shard_id} never reported ready "
+                f"(pid {proc.pid})")
+        msg = parent.recv()
+        if msg[0] != "ready":
+            proc.kill()
+            raise RuntimeError(
+                f"shard worker {w.shard_id} bad handshake: {msg!r}")
+        w.proc, w.ctl = proc, parent
+        w.direct_addr = tuple(msg[2])
+        w.alive = True
+        w._pushed = {}
+
+    def _broadcast_peers(self) -> None:
+        peers = {w.shard_id: w.direct_addr
+                 for w in self.workers if w.alive}
+        for w in self.workers:
+            if w.alive:
+                try:
+                    self._rpc(w, ("peers", peers))
+                except WorkerDead:
+                    pass
+
+    def respawn_dead(self) -> None:
+        """Bring dead workers back (called at each round open): a fresh
+        process, a fresh shard queue — its admission state starts empty,
+        exactly like a restarted deployment shard — and a peer-table
+        rebroadcast so forwards reach the new direct port."""
+        changed = False
+        for w in self.workers:
+            if not w.alive:
+                try:
+                    self._spawn(w)
+                    changed = True
+                    print(f"serve: shard {w.shard_id} worker respawned "
+                          f"(pid {w.proc.pid})", file=sys.stderr, flush=True)
+                except (OSError, RuntimeError) as e:
+                    print(f"serve: shard {w.shard_id} respawn failed: {e}",
+                          file=sys.stderr, flush=True)
+        if changed:
+            self._broadcast_peers()
+
+    def stop(self, join_deadline_s: float = 5.0) -> None:
+        with self._stop_lock:
+            for w in self.workers:
+                if not w.alive:
+                    continue
+                try:
+                    reply = self._rpc(w, ("stop",), timeout_s=join_deadline_s)
+                    if reply and reply[0] == "stopped":
+                        w.last_queue_counters = reply[1]
+                        self._push_registry(w, reply[2])
+                except WorkerDead:
+                    pass
+            for w in self.workers:
+                if w.proc is not None:
+                    try:
+                        w.proc.terminate()  # SIGTERM: the clean drain path
+                    except (OSError, ValueError):
+                        pass
+            deadline = time.monotonic() + join_deadline_s
+            for w in self.workers:
+                if w.proc is not None:
+                    w.proc.join(max(deadline - time.monotonic(), 0.1))
+                    if w.proc.is_alive():
+                        w.proc.kill()
+                        w.proc.join(1.0)
+                    w.alive = False
+                    if w.ctl is not None:
+                        try:
+                            w.ctl.close()
+                        except OSError:
+                            pass
+                        w.ctl = None
+            self._release_blocks()
+            if self._reserve is not None:
+                try:
+                    self._reserve.close()
+                except OSError:
+                    pass
+                self._reserve = None
+            self._started = False
+
+    def _release_blocks(self) -> None:
+        """Unlink every shm segment — the ONE cleanup path (root-owned;
+        workers only ever close their mappings). Runs on every exit:
+        stop() is reached from service.close(), __exit__, and the CLI's
+        finally blocks; a /dev/shm leak test pins it."""
+        if self._blocks is not None:
+            for b in self._blocks:
+                b.close()
+                b.unlink()
+            self._blocks = None
+            self._block_cap = 0
+
+    # -- control-pipe RPC ------------------------------------------------------
+
+    def _rpc(self, w: _WorkerHandle, msg: tuple, timeout_s: float = 15.0):
+        """One serialized request/reply round trip; a broken or silent
+        pipe marks the worker dead (counted) and raises WorkerDead."""
+        if not w.alive or w.ctl is None:
+            raise WorkerDead(f"shard {w.shard_id} is down")
+        with w.lock:
+            try:
+                w.ctl.send(msg)
+                if not w.ctl.poll(timeout_s):
+                    raise WorkerDead(
+                        f"shard {w.shard_id} RPC timeout on {msg[0]!r}")
+                return w.ctl.recv()
+            except (BrokenPipeError, EOFError, OSError):
+                self._mark_dead(w, why=f"pipe broke on {msg[0]!r}")
+                raise WorkerDead(f"shard {w.shard_id} died") from None
+            except WorkerDead:
+                self._mark_dead(w, why=f"RPC timeout on {msg[0]!r}")
+                raise
+
+    def _mark_dead(self, w: _WorkerHandle, why: str) -> None:
+        if not w.alive:
+            return
+        w.alive = False
+        obreg.default().counter("serve_shard_deaths_total").inc()
+        print(f"serve: shard {w.shard_id} worker DEAD ({why}) — its "
+              "clients are dropped + re-queued this round; respawn at "
+              "next open", file=sys.stderr, flush=True)
+
+    def kill_shard(self, shard_id: int) -> None:
+        """The shard_kill fault: SIGKILL the worker mid-run — no drain, no
+        goodbye, the exact failure mode of an OOM-killed or segfaulted
+        shard. Its clients' submissions fail at the socket and the round
+        closes without them (dropped + re-queued bitwise)."""
+        w = self.workers[int(shard_id)]
+        if not w.alive or w.proc is None:
+            return
+        try:
+            os.kill(w.proc.pid, signal.SIGKILL)
+            w.proc.join(2.0)
+        except (OSError, ValueError):
+            pass
+        self._mark_dead(w, why="shard_kill fault")
+
+    # -- transport surface -----------------------------------------------------
+
+    @property
+    def address(self) -> tuple[str, int] | None:
+        return (self._host, self._port) if self._started else None
+
+    @property
+    def addresses(self) -> list:
+        return [w.direct_addr for w in self.workers]
+
+    def addr_for(self, client_id: int) -> tuple[str, int] | None:
+        return self.workers[shard_for(client_id, self.n_shards)].direct_addr
+
+    # graftlint: drain-point — client-side blocking round-trip on the
+    # caller's thread (traffic generator / tests), hash-routed
+    def submit(self, sub) -> str:
+        addr = self.addr_for(sub.client_id)
+        if addr is None:
+            raise RuntimeError("ProcShardedIngest not started")
+        return submit_over_socket(addr, sub)
+
+    # -- cross-process counters ------------------------------------------------
+
+    def _push_registry(self, w: _WorkerHandle, snap: dict) -> None:
+        """Fold one worker's registry snapshot into the ROOT registry:
+        counters land as deltas against what this incarnation already
+        pushed (monotone across polls), per-shard gauges land as sets.
+        This is what makes /metrics.prom whole again across the process
+        boundary — the renderer reads one registry, same as ever."""
+        reg = obreg.default()
+        w.last_registry = snap
+        for name, val in snap.items():
+            if isinstance(val, (int, float)):  # a Counter
+                delta = float(val) - w._pushed.get(name, 0.0)
+                if delta > 0:
+                    reg.counter(name).inc(delta)
+                    w._pushed[name] = float(val)
+            elif (isinstance(val, dict) and "value" in val
+                    and name.startswith(f"serve_shard{w.shard_id}_")):
+                reg.gauge(name).set(float(val["value"]))
+
+    def poll_counters(self) -> None:
+        """Pull + fold every live worker's counters (queue + registry)."""
+        for w in self.workers:
+            if not w.alive:
+                continue
+            try:
+                qc, snap = self._rpc(w, ("counters",), timeout_s=5.0)
+                w.last_queue_counters = qc
+                self._push_registry(w, snap)
+            except WorkerDead:
+                pass
+
+    def counters(self) -> dict:
+        """Per-shard snapshot for the /metrics JSON `shards` block — same
+        shape as the thread-sharded ingest's, plus liveness."""
+        self.poll_counters()
+        out = {}
+        for w in self.workers:
+            snap = w.last_registry
+            k = w.shard_id
+
+            def _c(name, k=k, snap=snap):
+                v = snap.get(f"serve_shard{k}_{name}_total", 0)
+                return int(v) if isinstance(v, (int, float)) else 0
+
+            def _g(name, k=k, snap=snap):
+                v = snap.get(f"serve_shard{k}_{name}", {})
+                return float(v.get("value", 0.0)) if isinstance(v, dict) \
+                    else 0.0
+
+            out[str(k)] = {
+                "addr": (f"{w.direct_addr[0]}:{w.direct_addr[1]}"
+                         if w.direct_addr else None),
+                "alive": bool(w.alive),
+                "pid": (w.proc.pid if w.proc is not None else None),
+                "conns": int(_g("conns")),
+                "submissions": _c("submissions"),
+                "shed": _c("shed"),
+                "misrouted": _c("misrouted"),
+                "conn_refused": _c("conn_refused"),
+                "retry_after_s": _g("retry_after_s"),
+            }
+        return out
+
+    # -- the shm ring ----------------------------------------------------------
+
+    def prepare_blocks(self, capacity: int) -> list[ShmRingBlock]:
+        """Per-shard root-side shm blocks sized `capacity` (the FULL
+        cohort — one shape for every shard and every round, so the
+        close's scatter compiles once; ownership keeps actual occupancy
+        at ~capacity/n_shards). Recreated only if the cohort size ever
+        changes (a session never does mid-run)."""
+        if self._blocks is not None and self._block_cap != int(capacity):
+            self._release_blocks()
+        if self._blocks is None:
+            rows, cols = self.payload_shape
+            self._blocks = [ShmRingBlock.create(rows, cols, int(capacity))
+                            for _ in range(self.n_shards)]
+            self._block_cap = int(capacity)
+        return self._blocks
+
+    def ring_blocks(self) -> list[ShmRingBlock]:
+        assert self._blocks is not None, "fastpath round not opened"
+        return self._blocks
+
+
+class ProcShardQueue:
+    """The IngestQueue surface the service + assembler drive, proxied over
+    the worker control pipes. Admission state lives IN the workers; this
+    object only routes round lifecycle and aggregates. Early-pending
+    checkpoint persistence and the async stale band are not available in
+    process mode (rejected at service construction / warned on restore) —
+    named follow-ups."""
+
+    def __init__(self, transport: ProcShardedIngest):
+        self.t = transport
+        self.payload_policy = transport.payload_policy
+        self.shed_retry_after_s = float(
+            transport.queue_kwargs.get("shed_retry_after_s", 1.0))
+        self.on_accept = None
+        self._open: dict[int, np.ndarray] = {}
+        self._closed = False
+        self._counters_lock = threading.Lock()
+
+    # -- round lifecycle -------------------------------------------------------
+
+    def open_round(self, rnd: int, invited_ids) -> None:
+        if self._closed:
+            raise RuntimeError("ProcShardQueue is closed")
+        if rnd in self._open:
+            raise RuntimeError(f"round {rnd} is already open")
+        self.t.respawn_dead()
+        ids = np.asarray(invited_ids, np.int64)
+        # the round's quarantine baseline is computed ONCE on the root
+        # (it may read device state) and BROADCAST — every shard screens
+        # against the same median snapshot, same as the one-queue path
+        median = 0.0
+        p = self.payload_policy
+        if (p is not None and p.clip_multiple > 0
+                and p.quarantine_median is not None):
+            median = float(p.quarantine_median())
+        names = [None] * self.t.n_shards
+        cap = 0
+        if self.t.fastpath:
+            blocks = self.t.prepare_blocks(len(ids))
+            cap = len(ids)
+            for b in blocks:
+                # root-side reset guards the DEAD-worker case: a killed
+                # shard never resets its block, and stale positions from
+                # a previous round must not scatter into this one. Live
+                # workers reset again on the open message (idempotent —
+                # no writer exists between close and open).
+                b.reset(rnd)
+            names = [b.name for b in blocks]
+        for w in self.t.workers:
+            if not w.alive:
+                continue
+            try:
+                self.t._rpc(w, ("open", int(rnd), ids, median,
+                                names[w.shard_id], cap))
+            except WorkerDead:
+                pass
+        self._open[rnd] = ids
+
+    def attach_block(self, rnd: int, block) -> None:
+        pass  # worker-side blocks attach via the open broadcast
+
+    def close_round(self, rnd: int | None = None):
+        if rnd is None:
+            if not self._open:
+                return []
+            rnd = min(self._open)
+        if self._open.pop(rnd, None) is None:
+            return []
+        merged: list[Arrival] = []
+        n = self.t.n_shards
+        for w in self.t.workers:
+            if not w.alive:
+                continue
+            try:
+                reply = self.t._rpc(w, ("close", int(rnd)))
+            except WorkerDead:
+                continue  # dead shard == its clients never arrived
+            _, meta, extras = reply
+            for cid, lat, order, wall, table in meta:
+                # globalize recv_order while preserving each shard's
+                # local admission order (disjoint residues per shard)
+                merged.append(Arrival(
+                    client_id=cid, latency_s=lat,
+                    recv_order=order * n + w.shard_id, wall_t=wall,
+                    table=table))
+            if extras and self.t._blocks is not None:
+                self.t._blocks[w.shard_id].adopt_extras(extras)
+        # deterministic merge order: a pure function of the submission
+        # set, never of cross-process scheduling (close_virtual is
+        # order-independent anyway; this pins the wall path's tie-breaks)
+        merged.sort(key=lambda a: (a.latency_s, a.client_id))
+        if self.on_accept is not None:
+            for _ in merged:
+                self.on_accept(1)
+        return merged
+
+    def _gather_meta(self, rnd: int) -> list[Arrival]:
+        out: list[Arrival] = []
+        n = self.t.n_shards
+        for w in self.t.workers:
+            if not w.alive:
+                continue
+            try:
+                meta = self.t._rpc(w, ("arrivals", int(rnd)), timeout_s=5.0)
+            except WorkerDead:
+                continue
+            out.extend(Arrival(client_id=cid, latency_s=lat,
+                               recv_order=order * n + w.shard_id,
+                               wall_t=wall, table=None)
+                       for cid, lat, order, wall, _ in meta)
+        return out
+
+    def arrivals(self, rnd: int | None = None) -> list[Arrival]:
+        if rnd is None:
+            if not self._open:
+                return []
+            rnd = min(self._open)
+        return self._gather_meta(rnd)
+
+    # graftlint: drain-point — the serving queue's sanctioned wait: the
+    # assembler blocks HERE (wall-clock closes), polling worker counts
+    def wait_for(self, count: int, timeout_s: float,
+                 rnd: int | None = None) -> list[Arrival]:
+        deadline = time.monotonic() + timeout_s
+        if rnd is None and self._open:
+            rnd = min(self._open)
+        while True:
+            total = 0
+            for w in self.t.workers:
+                if not w.alive:
+                    continue
+                try:
+                    total += int(self.t._rpc(w, ("count", int(rnd)),
+                                             timeout_s=5.0))
+                except (WorkerDead, TypeError):
+                    pass
+            if self._closed or total >= count \
+                    or time.monotonic() >= deadline:
+                return self._gather_meta(rnd)
+            time.sleep(0.005)
+
+    def shutdown(self) -> None:
+        self._closed = True
+
+    # -- metrics + bookkeeping surfaces ---------------------------------------
+
+    def depth(self) -> int:
+        total = 0
+        for w in self.t.workers:
+            if not w.alive:
+                continue
+            try:
+                total += int(self.t._rpc(w, ("depth",), timeout_s=5.0))
+            except (WorkerDead, TypeError):
+                pass
+        return total
+
+    def counters(self) -> dict[str, int]:
+        """Cross-process admission totals: the sum of every shard's queue
+        counters (dead shards contribute their last-shipped snapshot)."""
+        with self._counters_lock:
+            self.t.poll_counters()
+            out: dict[str, int] = {}
+            for w in self.t.workers:
+                for k, v in w.last_queue_counters.items():
+                    out[k] = out.get(k, 0) + int(v)
+            return out
+
+    def note_wire_malformed(self) -> None:
+        pass  # the root serves no wire in process mode
+
+    def open_rounds(self) -> list[int]:
+        return sorted(self._open)
+
+    def prune_stale(self, rnd: int) -> int:
+        return 0  # no stale band in process mode (async is rejected)
+
+    def drain_stale(self) -> list:
+        return []
+
+    def boundary_snapshot(self):
+        return [], {}
+
+    def restore_pending(self, pending) -> None:
+        if pending:
+            print(f"serve: NOTE — {len(pending)} checkpointed pending "
+                  "early submission(s) NOT restored: the process-sharded "
+                  "ingest's pending buffers live in the workers "
+                  "(checkpoint persistence across shard processes is a "
+                  "follow-up)", file=sys.stderr, flush=True)
+
+    def restore_band(self, band) -> None:
+        raise RuntimeError(
+            "stale-band restore in process-shard mode — async composition "
+            "is rejected at construction, this should be unreachable")

@@ -1,0 +1,267 @@
+"""Shard WORKER process entry: the shared-nothing half of --serve_shards'
+process mode (serve/scale/procshard.py is the root half).
+
+Each worker owns one shard's ingest outright — its own event-loop reactor
+pair, its own batched gauntlet, its own `IngestQueue` slice of admission
+state (dedup set, early-pending buffer, quarantine screen against the
+round's BROADCAST median snapshot) — and talks to the root over exactly
+two channels: the control pipe (round opens/closes, counter snapshots,
+drain) and the shared-memory ring block its validated tables land in
+(serve/scale/shmring.py). Decode, screen arithmetic, and admission
+bookkeeping never touch the root's interpreter: that is the whole point
+of the promotion from reactor threads to processes.
+
+Sockets:
+
+- the MAIN reactor binds SO_REUSEPORT on the service's shared port — the
+  kernel spreads accepted connections across workers by 4-tuple hash,
+  which is arbitrary with respect to client id, so a frame for a client
+  this worker does not own is a MISROUTE: counted per shard, then
+  FORWARDED over loopback to the owner's direct port, with the owner's
+  verdict relayed back on the original connection (the reply is deferred
+  through the reactor's wake pipe; the reactor never blocks on a forward).
+- the DIRECT reactor binds an ephemeral private port, reported to the
+  root at startup and broadcast to peers: deterministic hash-routed
+  traffic (`addr_for`) and peer forwards land here, and it never
+  re-forwards (it IS the owner — no forwarding loops by construction).
+
+Lifecycle: SIGTERM = clean drain (stop accepting, finalize in-flight
+verdicts, detach the shm mapping, exit 0); a SIGKILL mid-round is the
+`shard_kill` fault surface — the root detects the dead pipe, counts the
+death, and the shard's clients are dropped + re-queued bitwise (they
+simply never arrive, exactly like a client_drop of the same set).
+
+IMPORT DISCIPLINE (graftlint G017): multiprocessing "spawn" re-imports
+this module inside every worker. Its transitive module-level import chain
+must stay numpy/stdlib-only — importing jax (or anything that transitively
+initializes a device runtime) from here would fork the accelerator into N
+processes. The serve/sketch package __init__s are lazy (PEP 562) for
+exactly this reason.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+import numpy as np
+
+from ...obs import registry as obreg
+from ..ingest import IngestQueue, PayloadPolicy
+from ..transport import submit_over_socket
+from .eventloop import EventLoopTransport
+from .shard import shard_for
+from .shmring import ShmRingBlock
+
+
+class _Forwarder:
+    """The misroute relay: a tiny thread pool (one thread is plenty —
+    misroutes are the exception, not the traffic) that round-trips a
+    forwarded submission to its owner's direct port and hands the verdict
+    back to the reactor's deferred-reply path. Blocking lives HERE, never
+    on the reactor (G015)."""
+
+    def __init__(self, shard_id: int):
+        self.shard_id = shard_id
+        self.peers: dict[int, tuple[str, int]] = {}
+        self._q: list = []
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"shard{shard_id}-forward", daemon=True)
+        self._thread.start()
+
+    def set_peers(self, peers: dict[int, tuple[str, int]]) -> None:
+        with self._cv:
+            self.peers = dict(peers)
+
+    def enqueue(self, owner: int, sub, deliver) -> None:
+        with self._cv:
+            self._q.append((owner, sub, deliver))
+            self._cv.notify()
+
+    # graftlint: drain-point — the forwarder's own thread blocks on the
+    # peer round trip by design; the reactor defers and keeps serving
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                self._cv.wait_for(lambda: self._q or self._stop)
+                if self._stop and not self._q:
+                    return
+                owner, sub, deliver = self._q.pop(0)
+                addr = self.peers.get(owner)
+            if addr is None:
+                deliver("CONN_FAILED")
+                continue
+            try:
+                status = submit_over_socket(addr, sub)
+            except (OSError, ValueError):
+                # owner unreachable (dead shard, drain race): the client
+                # sees a transport-style failure and its retry discipline
+                # applies — never a silent drop
+                status = "CONN_FAILED"
+            deliver(status)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=2.0)
+
+
+class _WorkerReactor(EventLoopTransport):
+    """The SO_REUSEPORT-facing reactor: decides owned submissions locally,
+    forwards the rest to their owner and relays the owner's verdict (the
+    reply defers through the wake pipe — same mechanism as the batched
+    gauntlet's verdicts)."""
+
+    def __init__(self, queue: IngestQueue, shard_id: int, n_shards: int,
+                 forwarder: _Forwarder, **kw):
+        super().__init__(queue, shard_id=shard_id, reuse_port=True, **kw)
+        self.n_shards = n_shards
+        self.forwarder = forwarder
+
+    def _submit_reply(self, sub):
+        owner = shard_for(sub.client_id, self.n_shards)
+        if owner != self.shard_id:
+            self._shard_counter("misrouted").inc()
+            conn = self._cur_conn
+
+            def deliver(status: str) -> None:
+                with self._deferred_lock:
+                    self._deferred.append((conn, status))
+                self._wake()
+
+            self.forwarder.enqueue(owner, sub, deliver)
+            return None  # reply comes later, via the deferred flush
+        return super()._submit_reply(sub)
+
+
+def _arrival_meta(arrivals, ship_tables: bool):
+    """Pipe-friendly arrival tuples. Ring mode ships NO tables (the bytes
+    are already in the shm block); the non-ring sketch path ships the
+    validated ndarray (pickled over the pipe — the slow-but-correct twin
+    the fastpath pin is checked against)."""
+    return [(int(a.client_id), float(a.latency_s), int(a.recv_order),
+             float(a.wall_t),
+             (np.asarray(a.table, np.float32)
+              if ship_tables and a.table is not None else None))
+            for a in arrivals]
+
+
+def worker_main(cfg: dict, ctl) -> None:
+    """The spawn target. `cfg` is a plain picklable dict (see
+    procshard.py _worker_cfg); `ctl` is this worker's end of the control
+    pipe. Protocol: every request is a tuple, every request gets exactly
+    one reply — the root serializes requests per worker under a lock."""
+    shard_id = int(cfg["shard_id"])
+    n_shards = int(cfg["n_shards"])
+    drain = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: drain.set())
+
+    median_cell = [0.0]
+    policy = None
+    if cfg.get("rows"):
+        policy = PayloadPolicy(
+            rows=int(cfg["rows"]), cols=int(cfg["cols"]),
+            clip_multiple=float(cfg.get("clip_multiple", 0.0)),
+            quarantine_median=lambda: median_cell[0])
+    queue = IngestQueue(
+        capacity=int(cfg.get("queue_capacity", 1024)),
+        pending_capacity=int(cfg.get("pending_capacity", 256)),
+        payload_policy=policy,
+        shed_watermark=float(cfg.get("shed_watermark", 0.0)),
+        shed_retry_after_s=float(cfg.get("shed_retry_after_s", 1.0)))
+    gauntlet = None
+    if cfg.get("fastpath"):
+        from ..gauntlet import GauntletPool
+
+        gauntlet = GauntletPool(
+            queue, workers=int(cfg.get("gauntlet_workers", 2))).start()
+    forwarder = _Forwarder(shard_id)
+    kw = dict(host=cfg["host"], max_conns=int(cfg["max_conns"]),
+              max_frame_bytes=int(cfg["max_frame_bytes"]),
+              read_deadline_s=float(cfg["read_deadline_s"]))
+    main = _WorkerReactor(queue, shard_id=shard_id, n_shards=n_shards,
+                          forwarder=forwarder, port=int(cfg["port"]), **kw)
+    direct = EventLoopTransport(queue, shard_id=shard_id, port=0, **kw)
+    main.gauntlet = direct.gauntlet = gauntlet
+    blocks: dict[str, ShmRingBlock] = {}
+    armed: dict[int, ShmRingBlock] = {}
+    try:
+        main.start()
+        direct.start()
+        ctl.send(("ready", shard_id, direct.address))
+        while not drain.is_set():
+            if not ctl.poll(0.2):
+                continue
+            try:
+                msg = ctl.recv()
+            except (EOFError, OSError):
+                break  # root died: drain
+            op = msg[0]
+            if op == "peers":
+                forwarder.set_peers(msg[1])
+                ctl.send(("ok",))
+            elif op == "open":
+                _, rnd, ids, median, shm_name, cap = msg
+                median_cell[0] = float(median)
+                block = None
+                if shm_name is not None:
+                    block = blocks.get(shm_name)
+                    if block is None:
+                        block = ShmRingBlock.attach(
+                            shm_name, int(cfg["rows"]), int(cfg["cols"]),
+                            int(cap))
+                        blocks[shm_name] = block
+                    block.reset(int(rnd))
+                queue.open_round(int(rnd), np.asarray(ids, np.int64))
+                if block is not None:
+                    queue.attach_block(int(rnd), block)
+                    armed[int(rnd)] = block
+                ctl.send(("ok",))
+            elif op == "close":
+                rnd = int(msg[1])
+                arrivals = queue.close_round(rnd)
+                block = armed.pop(rnd, None)
+                extras = []
+                if block is not None:
+                    # every acquired slot finalizes before the reply:
+                    # the root's shm reads order behind this round trip
+                    block.wait_final(5.0)
+                    extras = [(int(p), t) for p, t in block.extras]
+                ctl.send(("closed", _arrival_meta(
+                    arrivals, ship_tables=block is None), extras))
+            elif op == "count":
+                ctl.send(len(queue.arrivals(int(msg[1]))))
+            elif op == "arrivals":
+                ctl.send(_arrival_meta(queue.arrivals(int(msg[1])),
+                                       ship_tables=False))
+            elif op == "depth":
+                ctl.send(queue.depth())
+            elif op == "counters":
+                ctl.send((queue.counters(), obreg.default().snapshot()))
+            elif op == "stop":
+                ctl.send(("stopped", queue.counters(),
+                          obreg.default().snapshot()))
+                break
+            else:
+                ctl.send(("error", f"unknown op {op!r}"))
+    finally:
+        # the drain path — SIGTERM, "stop", or a dead root pipe all land
+        # here: stop accepting, fail in-flight verdicts out, detach (never
+        # unlink — the segment is the root's to remove)
+        main.stop()
+        direct.stop()
+        if gauntlet is not None:
+            gauntlet.stop()
+        forwarder.stop()
+        queue.shutdown()
+        for b in blocks.values():
+            b.close()
+        try:
+            ctl.close()
+        except OSError:
+            pass
+        sys.exit(0)

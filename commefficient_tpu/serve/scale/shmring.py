@@ -1,0 +1,224 @@
+"""Shared-memory table ring: the shard-process -> root handoff block.
+
+The process-sharded ingest (serve/scale/procshard.py) moves the decode +
+gauntlet + admission work into worker PROCESSES — so the PR 17 ring's
+"write the validated table once" contract has to hold across a process
+boundary. `ShmRingBlock` is the `serve/ring.py` RingBlock speaking the
+exact same block/slot protocol (acquire / commit / reject / add_extra /
+final_prefix / wait_final / snapshot, slots never reused within a round,
+rejected slots zeroed back), but backed by one `multiprocessing.
+shared_memory` segment so the WORKER's gauntlet writes land in memory the
+ROOT's close path (and its mid-window `_RingUploader`) reads directly —
+the shard->root handoff IS the ring, no serialize/copy hop.
+
+Ownership and visibility:
+
+- the ROOT creates the segment (`ShmRingBlock.create`) and is the only
+  unlinker (`unlink`); a worker `attach`es by name and only ever `close`s
+  its mapping — a dead worker can therefore never leak a segment the root
+  still accounts for, and the root's teardown is THE cleanup path (pinned
+  by a /dev/shm leak test).
+- the worker publishes per-slot bytes, then position/valid, then the final
+  flag, then (commit/reject only) bumps nothing further for that slot; the
+  root reads flags before bytes never the reverse. On the platforms this
+  repo serves (x86-64 TSO) a flag observed set implies the slot bytes that
+  preceded it are visible; the authoritative close additionally rides the
+  control-pipe round trip (the worker replies to "close" only after
+  `wait_final`), which is a real happens-before on any platform.
+- `extras` (overflow fallback tables) stay worker-local and cross in the
+  close reply over the control pipe — the root grafts them back with
+  `adopt_extras` so `snapshot()` keeps the RingBlock contract.
+
+Layout (one segment): [count:int64 x 8 header | positions:int32[cap] |
+valid:uint8[cap] | final:uint8[cap] | pad to 64 | tables:f32[cap, r, c]].
+
+This module is on the worker-process import chain and must stay
+numpy/stdlib-only (graftlint G017): no jax, nothing device-touching.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from ...obs import registry as obreg
+from ..ring import RingSlot
+
+_HEADER_BYTES = 64
+
+
+def _layout(rows: int, cols: int, capacity: int):
+    """(positions_off, valid_off, final_off, tables_off, total_bytes) of
+    one segment — a pure function of the block shape, so creator and
+    attacher can never disagree about where a field lives."""
+    pos_off = _HEADER_BYTES
+    valid_off = pos_off + 4 * capacity
+    final_off = valid_off + capacity
+    tables_off = (final_off + capacity + 63) // 64 * 64
+    return pos_off, valid_off, final_off, tables_off, (
+        tables_off + 4 * capacity * rows * cols)
+
+
+class ShmRingBlock:
+    """One round's cross-process landing zone (see module docstring).
+    Speaks the RingBlock protocol; `role` is "root" (creator/unlinker) or
+    "worker" (attacher/writer)."""
+
+    def __init__(self, shm: shared_memory.SharedMemory, rows: int,
+                 cols: int, capacity: int, role: str):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.rows, self.cols = int(rows), int(cols)
+        self.capacity = int(capacity)
+        self.role = role
+        self._shm = shm
+        self.name = shm.name
+        pos_off, valid_off, final_off, tab_off, total = _layout(
+            self.rows, self.cols, self.capacity)
+        buf = shm.buf
+        # typed views over the root-owned shm segment — NOT wire input:
+        # every byte here was already screened by validate_payload in the
+        # worker's gauntlet before it was written (the one G011 boundary);
+        # this is the trusted cross-process handoff of its output
+        self._count = np.frombuffer(buf, np.int64, 1, 0)  # graftlint: disable=G011 — trusted shm view, post-validation
+        self.positions = np.frombuffer(buf, np.int32, capacity, pos_off)  # graftlint: disable=G011 — trusted shm view, post-validation
+        self.valid = np.frombuffer(buf, bool, capacity, valid_off)  # graftlint: disable=G011 — trusted shm view, post-validation
+        self._final = np.frombuffer(buf, bool, capacity, final_off)  # graftlint: disable=G011 — trusted shm view, post-validation
+        self.tables = np.frombuffer(  # graftlint: disable=G011 — trusted shm view, post-validation
+            buf, np.float32, capacity * rows * cols, tab_off).reshape(
+                capacity, rows, cols)
+        self.rnd = -1
+        self.extras: list[tuple[int, np.ndarray]] = []
+        self._watermark = 0
+        self._lock = threading.Lock()
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def create(cls, rows: int, cols: int, capacity: int) -> "ShmRingBlock":
+        """Root side: allocate the segment (zero-filled by the OS)."""
+        total = _layout(rows, cols, capacity)[4]
+        shm = shared_memory.SharedMemory(create=True, size=total)
+        return cls(shm, rows, cols, capacity, role="root")
+
+    @classmethod
+    def attach(cls, name: str, rows: int, cols: int,
+               capacity: int) -> "ShmRingBlock":
+        """Worker side: map the root's segment by name (never unlinks)."""
+        shm = shared_memory.SharedMemory(name=name)
+        return cls(shm, rows, cols, capacity, role="worker")
+
+    # -- the RingBlock protocol ----------------------------------------------
+
+    @property
+    def count(self) -> int:
+        return int(self._count[0])
+
+    def reset(self, rnd: int) -> None:
+        """Re-arm for a new round: zero the buffer (the exact +0.0 every
+        untouched slot must read as) and clear the state. Worker side —
+        the writer owns the bytes between rounds (the root only resets a
+        block it is about to discard)."""
+        with self._lock:
+            self.tables[...] = 0.0
+            self.positions[...] = -1
+            self.valid[...] = False
+            self._final[...] = False
+            self._count[0] = 0
+            self.rnd = int(rnd)
+            self.extras = []
+            self._watermark = 0
+
+    def acquire(self) -> RingSlot | None:
+        """Claim the next free slot (None when full — the decode falls
+        back to a standalone table + `add_extra`, counted)."""
+        with self._lock:
+            i = int(self._count[0])
+            if i >= self.capacity:
+                obreg.default().counter("serve_ring_overflow_total").inc()
+                return None
+            self._count[0] = i + 1
+            return RingSlot(self, i)
+
+    def commit(self, slot: RingSlot, position: int) -> None:
+        with self._lock:
+            self.positions[slot.index] = int(position)
+            self.valid[slot.index] = True
+            self._final[slot.index] = True
+
+    def reject(self, slot: RingSlot) -> None:
+        """Zero a rejected slot back: a rejected payload stays bitwise a
+        client that never submitted."""
+        with self._lock:
+            self.tables[slot.index][...] = 0.0
+            self.valid[slot.index] = False
+            self._final[slot.index] = True
+
+    def add_extra(self, position: int, table: np.ndarray) -> None:
+        with self._lock:
+            self.extras.append((int(position), np.asarray(table,
+                                                          np.float32)))
+
+    def adopt_extras(self, extras) -> None:
+        """Root side: graft the worker's overflow extras (shipped in the
+        close reply) so `snapshot()` keeps the RingBlock contract."""
+        with self._lock:
+            self.extras = [(int(p), np.asarray(t, np.float32))
+                           for p, t in extras]
+
+    def final_prefix(self) -> int:
+        """Contiguous finalized prefix — what the overlap uploader may
+        ship right now. Monotone; safe to poll cross-process (flags are
+        written after slot bytes — see module docstring)."""
+        with self._lock:
+            w = self._watermark
+            n = int(self._count[0])
+            while w < n and self._final[w]:
+                w += 1
+            self._watermark = w
+            return w
+
+    # graftlint: drain-point — cross-process finalization wait (poll; the
+    # authoritative barrier is the control pipe's close round trip)
+    def wait_final(self, timeout_s: float) -> bool:
+        """Poll until every acquired slot is finalized (bounded: acquires
+        stop at the round close). No cross-process condvar — the segment
+        holds only flags — so this is a short-sleep poll; the root's close
+        path additionally orders behind the worker's close reply."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            n = int(self._count[0])
+            if bool(self._final[:n].all()):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.0005)
+
+    def snapshot(self) -> tuple[int, np.ndarray, np.ndarray, list]:
+        with self._lock:
+            return (int(self._count[0]), self.positions.copy(),
+                    self.valid.copy(), list(self.extras))
+
+    # -- teardown -------------------------------------------------------------
+
+    def close(self) -> None:
+        """Drop this process's mapping (both roles; idempotent)."""
+        # the np views alias shm.buf — drop them first or SharedMemory
+        # refuses to close an exported buffer
+        self._count = self.positions = self.valid = None
+        self._final = self.tables = None
+        try:
+            self._shm.close()
+        except (OSError, BufferError):
+            pass
+
+    def unlink(self) -> None:
+        """Remove the segment from /dev/shm — ROOT only, exactly once,
+        on every service exit path (leak-pinned in tests)."""
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass

@@ -183,16 +183,28 @@ class ServeConfig:
     staleness_alpha: float = 0.5
     stale_rounds: int = 1
     # --serve_transport: which SOCKET engine serves connections.
-    # "threaded" (default, the reference): one OS thread per connection —
-    # fine for chaos tests, capped at DEFAULT_MAX_CONNS_THREADED.
-    # "eventloop": the serve/scale selectors reactor — one thread
-    # multiplexing thousands of connections (the C1M path). Identical
-    # admission decisions (shared LineProtocol); inproc ignores it.
-    socket_transport: str = "threaded"
-    # --serve_shards: >= 2 runs that many event-loop reactors over the one
-    # admission queue, clients routed by client-id hash (serve/scale/
-    # shard.py) — per-shard counters + shed hints in /metrics(.prom)
+    # "eventloop" (default since PR 18): the serve/scale selectors
+    # reactor — one thread multiplexing thousands of connections (the C1M
+    # path). "threaded" (the reference, and the default before PR 18):
+    # one OS thread per connection — fine for chaos tests, capped at
+    # DEFAULT_MAX_CONNS_THREADED; pinning it prints a startup NOTE.
+    # Identical admission decisions (shared LineProtocol); inproc
+    # ignores it.
+    socket_transport: str = "eventloop"
+    # --serve_shards: >= 2 shards the socket ingest across that many
+    # reactors, clients routed by client-id hash — per-shard counters +
+    # shed hints in /metrics(.prom)
     shards: int = 0
+    # --serve_shard_mode: what a shard IS. "thread" (default): N reactor
+    # threads over the ONE admission queue (serve/scale/shard.py) —
+    # connection scale-out, but decode + gauntlet + admission still
+    # serialize on this process's GIL. "process": N SO_REUSEPORT worker
+    # PROCESSES, shared-nothing — each owns its clients' admission state
+    # outright and lands validated tables in a shared-memory ring block
+    # the root's close reads directly (serve/scale/procshard.py). Process
+    # shards move bytes and verdicts, never arithmetic: served params
+    # stay bitwise identical to the fused path.
+    shard_mode: str = "thread"
     # --serve_max_conns: concurrent-connection cap of the socket engine
     # (per reactor when sharded). 0 = the engine's default (threaded 128 —
     # every connection is an OS thread; eventloop 8192, fd-bounded).
@@ -231,8 +243,9 @@ class ServeConfig:
             buffer_size=getattr(args, "serve_buffer", 0),
             staleness_alpha=getattr(args, "serve_staleness", 0.5),
             stale_rounds=getattr(args, "serve_stale_rounds", 1),
-            socket_transport=getattr(args, "serve_transport", "threaded"),
+            socket_transport=getattr(args, "serve_transport", "eventloop"),
             shards=getattr(args, "serve_shards", 0),
+            shard_mode=getattr(args, "serve_shard_mode", "thread"),
             edges=getattr(args, "serve_edges", 0),
             max_conns=getattr(args, "serve_max_conns", 0),
             fastpath=bool(getattr(args, "serve_fastpath", False)),
@@ -333,6 +346,10 @@ class AggregationService:
             raise ValueError(
                 f"--serve_transport must be threaded|eventloop, got "
                 f"{cfg.socket_transport!r}")
+        if cfg.shard_mode not in ("thread", "process"):
+            raise ValueError(
+                f"--serve_shard_mode must be thread|process, got "
+                f"{cfg.shard_mode!r}")
         if cfg.shards >= 2:
             if cfg.transport != "socket":
                 raise ValueError(
@@ -344,8 +361,36 @@ class AggregationService:
                     "--serve_shards runs N event-loop reactors; the "
                     "thread-per-connection transport has no reactor to "
                     "shard — arm --serve_transport eventloop")
+            if cfg.shard_mode == "process":
+                # process shards are shared-nothing: admission state lives
+                # IN the workers, so compositions that reach into the one
+                # in-process queue are named follow-ups, not silent
+                # misbehavior
+                if cfg.async_mode:
+                    raise ValueError(
+                        "--serve_shard_mode process does not compose with "
+                        "--serve_async yet (the stale admission band lives "
+                        "in the worker queues; its cross-process "
+                        "checkpoint/rewind discipline is a follow-up) — "
+                        "drop one of the flags")
+                if cfg.pipeline:
+                    raise ValueError(
+                        "--serve_shard_mode process does not compose with "
+                        "--serve_pipeline yet (the pipelined worker's "
+                        "boundary snapshots assume the in-process queue) — "
+                        "drop one of the flags")
+                if cfg.edges >= 2:
+                    raise ValueError(
+                        "--serve_shard_mode process does not compose with "
+                        "--serve_edges yet (the edge tier consumes the "
+                        "host table stack; the process shards hand over "
+                        "shm ring blocks) — drop one of the flags")
         elif cfg.shards < 0:
             raise ValueError(f"--serve_shards must be >= 0, got {cfg.shards}")
+        elif cfg.shard_mode == "process":
+            raise ValueError(
+                "--serve_shard_mode process needs --serve_shards >= 2 "
+                "(one shard IS the plain event-loop transport)")
         if cfg.edges == 1 or cfg.edges < 0:
             raise ValueError(
                 f"--serve_edges must be 0 (off) or >= 2, got {cfg.edges} "
@@ -497,14 +542,37 @@ class AggregationService:
         # the pipelined worker's payload-compute gate (serve/pipeline.py
         # installs it; None = serial source, compute runs inline)
         self._compute_gate = None
+        self._proc = None  # the process-sharded ingest, when armed
         if cfg.transport == "socket":
             # 0 = the engine's own default cap (threaded 128 threads,
             # eventloop 8192 fds) — the knob exists so a deployment that
             # legitimately holds more connections can raise it
             cap = {"max_conns": cfg.max_conns} if cfg.max_conns else {}
-            if cfg.shards >= 2:
-                # sharded scale-out ingest: N event-loop reactors over the
-                # one admission queue, clients hash-routed per shard
+            if cfg.shards >= 2 and cfg.shard_mode == "process":
+                # process-sharded scale-out ingest: N SO_REUSEPORT worker
+                # processes, shared-nothing (serve/scale/procshard.py).
+                # Admission state lives IN the workers — the service's
+                # queue becomes the control-pipe proxy, and the assembler
+                # drives the same surface it always did.
+                from .scale.procshard import ProcShardedIngest
+
+                self.transport = ProcShardedIngest(
+                    n_shards=cfg.shards, payload_shape=payload_shape,
+                    payload_policy=payload_policy, port=cfg.port,
+                    fastpath=cfg.fastpath,
+                    gauntlet_workers=cfg.gauntlet_workers,
+                    queue_kwargs={
+                        "queue_capacity": cfg.queue_capacity,
+                        "pending_capacity": cfg.pending_capacity,
+                        "shed_watermark": cfg.shed_watermark,
+                        "shed_retry_after_s": cfg.shed_retry_after_s,
+                    }, **cap)
+                self._proc = self.transport
+                self.queue = self.transport.queue
+                self.assembler.queue = self.queue
+            elif cfg.shards >= 2:
+                # thread-sharded scale-out ingest: N event-loop reactors
+                # over the one admission queue, clients hash-routed
                 from .scale.shard import ShardedIngest
 
                 self.transport = ShardedIngest(
@@ -527,25 +595,33 @@ class AggregationService:
         self._gauntlet = None
         self._ring_blocks: dict[int, Any] = {}
         if cfg.fastpath:
-            from .gauntlet import GauntletPool
-            from .ring import TableRing
-
-            self._ring = TableRing(payload_shape[0], payload_shape[1])
             # pre-register the fastpath metrics so /metrics(.prom) shows
             # them at zero from the first scrape, not from first incident
             obreg.default().counter("serve_ring_overflow_total")
             obreg.default().counter("serve_table_bytes_copied_total")
             obreg.default().histogram("serve_ring_occupancy")
             obreg.default().histogram("serve_gauntlet_batch_ms")
-            if cfg.transport == "socket":
-                self._gauntlet = GauntletPool(
-                    self.queue, workers=cfg.gauntlet_workers)
-                # one shared pool across every connection engine — the
-                # sharded ingest's reactors all defer to the same gauntlet
-                for tr in (self.transport.shards
-                           if hasattr(self.transport, "shards")
-                           else (self.transport,)):
-                    tr.gauntlet = self._gauntlet
+            if self._proc is not None:
+                # process shards: each WORKER runs its own batched
+                # gauntlet and lands validated tables in its shm ring
+                # block — the root arms no pool and no host ring; the
+                # close reads the blocks directly
+                pass
+            else:
+                from .gauntlet import GauntletPool
+                from .ring import TableRing
+
+                self._ring = TableRing(payload_shape[0], payload_shape[1])
+                if cfg.transport == "socket":
+                    self._gauntlet = GauntletPool(
+                        self.queue, workers=cfg.gauntlet_workers)
+                    # one shared pool across every connection engine — the
+                    # sharded ingest's reactors all defer to the same
+                    # gauntlet
+                    for tr in (self.transport.shards
+                               if hasattr(self.transport, "shards")
+                               else (self.transport,)):
+                        tr.gauntlet = self._gauntlet
         # all rate/latency metrics live in the process-wide obs registry —
         # the same store the runner's phase histograms land in, so the
         # /metrics endpoint reads ONE source of truth
@@ -666,10 +742,13 @@ class AggregationService:
                     ids = self.session.sample_cohort(rnd)
                     self.queue.open_round(rnd, ids)
                 with self._stage("collect", rnd):
+                    self._consume_shard_kills(rnd)
                     if self.traffic is not None:
+                        submit = self.transport.submit
+                        if self._proc is not None:
+                            submit, _ = self._proc_submit_fns()
                         self.traffic.respond_to_invites(
-                            rnd, ids, self.transport.submit,
-                            self.cfg.deadline_s)
+                            rnd, ids, submit, self.cfg.deadline_s)
                         closed = self.assembler.close_virtual(rnd, ids)
                     else:
                         # external clients: wall-clock W-of-N (socket)
@@ -717,7 +796,14 @@ class AggregationService:
                 self.queue.attach_block(rnd, block)
                 self._ring_blocks[rnd] = block
                 uploader = _RingUploader(block).start()
+            elif self._proc is not None and self.cfg.fastpath:
+                # process shards: open_round armed one shm ring block per
+                # shard; one overlap uploader per block ships each shard's
+                # finalized slots mid-window, same as the fused path
+                uploader = [_RingUploader(b).start()
+                            for b in self._proc.ring_blocks()]
         with self._stage("collect", rnd):
+            self._consume_shard_kills(rnd)
             if self.traffic is not None:
                 plan = self.session.fault_plan
                 wire = (plan.wire_plan(rnd, len(ids))
@@ -739,7 +825,13 @@ class AggregationService:
                             (rnd, int(pos), int(ids[pos]),
                              np.asarray(factor * tables[pos],
                                         np.float32)))
-                if self.cfg.transport == "socket":
+                if self._proc is not None:
+                    # process shards: a dead shard's refused connection
+                    # resolves to CONN_FAILED (its clients are no-shows —
+                    # the shard_kill == client_drop bitwise contract),
+                    # never an exception up the collect stage
+                    submit, abort = self._proc_submit_fns()
+                elif self.cfg.transport == "socket":
                     # the REAL wire: every submission round-trips the
                     # loopback socket (frame encode -> recv -> gauntlet
                     # decode), and a conn_drop is an actual mid-send
@@ -776,6 +868,14 @@ class AggregationService:
                 # tier is excluded by construction (__init__ validation).
                 arrived = closed.arrived
                 wire_tables = self._finish_ring_stack(rnd, closed, uploader)
+                edge_block = None
+            elif self._proc is not None and self.cfg.fastpath:
+                # process-shard fast path: one shm block per shard, same
+                # scatter — ownership partitions the cohort positions, so
+                # the per-block scatters write disjoint rows of one stack
+                arrived = closed.arrived
+                wire_tables = self._finish_proc_ring_stack(
+                    rnd, closed, uploader)
                 edge_block = None
             else:
                 arrived, wire_tables, edge_block = self._edge_round(
@@ -828,6 +928,86 @@ class AggregationService:
         # owns its own bytes) — the block goes back to the pool
         self._ring.release(block)
         return stack
+
+    def _finish_proc_ring_stack(self, rnd: int, closed, uploaders):
+        """The process-shard twin of _finish_ring_stack: one shm block per
+        shard worker, each with its own overlap uploader, scattered into
+        ONE [N, r, c] device stack. Ownership partitions the cohort —
+        every worker admits only clients it owns, each client holds one
+        cohort position — so the per-block scatters write DISJOINT rows:
+        their order cannot matter, and the result is bitwise the fused
+        single-ring stack of the same admission set. The worker's "close"
+        reply (already consumed by the assembler's close) ordered behind
+        its wait_final, so every committed slot's bytes are visible here
+        on any platform; the root-side wait_final is a cheap re-check.
+
+        A shard that DIED mid-round left whatever slots it had finalized
+        before the kill; `closed.arrived` masks its clients out of the
+        close (they were dropped + re-queued), and the arrived filter
+        below drops those slots from the scatter — a partially-written
+        dead block contributes exactly nothing, same as client_drop."""
+        n = len(closed.invited)
+        r, c = self.assembler.payload_shape
+        stack = jnp.zeros((n, r, c), jnp.float32)
+        total = 0
+        for block, up in zip(self._proc.ring_blocks(), uploaders):
+            block.wait_final(timeout_s=5.0)
+            count, positions, valid, extras = block.snapshot()
+            allslots = up.finish()
+            total += count
+            cap = allslots.shape[0]
+            pos_full = np.full(cap, n, np.int32)  # n == dropped sentinel
+            if count:
+                pos = positions[:count]
+                sel = np.flatnonzero(valid[:count] & (pos >= 0) & (pos < n))
+                sel = sel[closed.arrived[pos[sel]] == 1.0]
+                pos_full[sel] = pos[sel]
+            stack = stack.at[jnp.asarray(pos_full)].set(
+                allslots, mode="drop")
+            for pos_e, table in extras:
+                if 0 <= pos_e < n and closed.arrived[pos_e] == 1.0:
+                    stack = stack.at[pos_e].set(table)
+        self.registry.histogram("serve_ring_occupancy").observe(
+            float(total))
+        return stack
+
+    def _consume_shard_kills(self, rnd: int) -> None:
+        """Inject this round's shard_kill faults (process mode only):
+        SIGKILL the scheduled workers at the START of the collect window —
+        their clients' submissions fail at the socket, the round closes
+        without them, and the mask + re-queue makes the death bitwise a
+        client_drop of the dead shard's client set."""
+        if self._proc is None:
+            return
+        plan = self.session.fault_plan
+        if plan is None:
+            return
+        for k in plan.shard_kill_plan(rnd):
+            self._proc.kill_shard(int(k))
+
+    def _proc_submit_fns(self):
+        """(submit, abort) over the process shards: hash-routed to the
+        owner's direct port, with a DEAD shard's refused connection
+        resolving to a CONN_FAILED verdict instead of an exception — the
+        client becomes a no-show and the established drop discipline
+        applies."""
+        tr = self._proc
+
+        def submit(sub):
+            try:
+                return submit_over_socket(tr.addr_for(sub.client_id), sub)
+            except (OSError, ValueError):
+                obreg.default().counter(
+                    "serve_shard_submit_failed_total").inc()
+                return "CONN_FAILED"
+
+        def abort(sub):
+            try:
+                return abort_over_socket(tr.addr_for(sub.client_id), sub)
+            except (OSError, ValueError):
+                return "CONN_FAILED"
+
+        return submit, abort
 
     def _edge_round(self, rnd: int, ids, closed, aux):
         """The two-tier edge-aggregation stage of a payload round (None
@@ -1224,6 +1404,11 @@ class AggregationService:
                                  else None),
             "shards": (self.transport.counters()
                        if hasattr(self.transport, "counters") else None),
+            "shard_mode": (self.cfg.shard_mode
+                           if self.cfg.shards >= 2 else None),
+            "shard_deaths": (int(self.registry.counter(
+                "serve_shard_deaths_total").value)
+                if self._proc is not None else None),
             "edge": (self._edge_tree.counters()
                      if self._edge_tree is not None else None),
             "payload": self.cfg.payload,
@@ -1336,9 +1521,18 @@ def service_from_args(args, session) -> AggregationService | None:
         trace = dataclasses.replace(trace, population=args.num_clients)
     if "seed" not in pinned:
         trace = dataclasses.replace(trace, seed=args.seed)
+    scfg = ServeConfig.from_args(args)
+    if scfg.transport == "socket" and scfg.socket_transport == "threaded":
+        # the default flipped threaded -> eventloop (PR 18); a run still
+        # pinning threaded gets the reference engine, loudly
+        print(
+            "serve: NOTE — --serve_transport threaded is PINNED (the "
+            "default is now eventloop): one OS thread per connection, "
+            "capped at the threaded engine's max_conns. Drop the flag to "
+            "get the event-loop reactor (identical admission decisions; "
+            "see MIGRATION.md)", file=sys.stderr, flush=True)
     service = AggregationService(
-        session, ServeConfig.from_args(args),
-        traffic=TrafficGenerator(trace)).start()
+        session, scfg, traffic=TrafficGenerator(trace)).start()
     addr = service.transport.address
     maddr = (service.metrics_server.address
              if service.metrics_server is not None else None)
@@ -1350,7 +1544,10 @@ def service_from_args(args, session) -> AggregationService | None:
         + (f" ({service.cfg.socket_transport})"
            if service.cfg.transport == "socket" else "")
         + (f" on {addr[0]}:{addr[1]}" if addr else "")
-        + (f", {service.cfg.shards} ingest shards"
+        + (f", {service.cfg.shards} ingest shards "
+           f"({service.cfg.shard_mode}"
+           + (" processes, SO_REUSEPORT + shm ring)"
+              if service.cfg.shard_mode == "process" else "s)")
            if service.cfg.shards >= 2 else "")
         + (f", {service.cfg.edges}-edge tree"
            if service.cfg.edges >= 2 else "")

@@ -2,24 +2,41 @@
 CSVec (SURVEY.md L1). Pure-JAX oracle in `csvec`; Pallas TPU kernels (added
 after profiling) must match it bit-for-bit on the property tests."""
 
-from .csvec import (
-    CSVecSpec,
-    query,
-    query_all,
-    sketch_sparse,
-    sketch_vec,
-    to_dense,
-    unsketch_threshold,
-    unsketch_topk,
-    zero_table,
-)
-from .layerwise import (
-    BlockPlan,
-    accumulate_leaf,
-    apply_delta_tree,
-    make_block_plan,
-    sketch_tree,
-)
+# Lazy (PEP 562) re-exports: `sketch.payload` (numpy-only wire codec) is on
+# the shard worker-process import chain (serve/scale/procshard), and an eager
+# `from .csvec import ...` here would execute jax in every spawned worker —
+# the fork/spawn hazard graftlint G017 polices. Names resolve on first
+# attribute access; the public surface is unchanged.
+_EXPORTS = {
+    "CSVecSpec": "csvec",
+    "query": "csvec",
+    "query_all": "csvec",
+    "sketch_sparse": "csvec",
+    "sketch_vec": "csvec",
+    "to_dense": "csvec",
+    "unsketch_threshold": "csvec",
+    "unsketch_topk": "csvec",
+    "zero_table": "csvec",
+    "BlockPlan": "layerwise",
+    "accumulate_leaf": "layerwise",
+    "apply_delta_tree": "layerwise",
+    "make_block_plan": "layerwise",
+    "sketch_tree": "layerwise",
+}
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
 
 __all__ = [
     "BlockPlan",

@@ -266,28 +266,51 @@ def make_parser(task: str = "cv") -> argparse.ArgumentParser:
                         "window a late table is still admitted and folded; "
                         "older submissions bounce OUT_OF_ROUND and the "
                         "parked entry is dropped (counted)")
-    p.add_argument("--serve_transport", default="threaded",
+    p.add_argument("--serve_transport", default="eventloop",
                    choices=["threaded", "eventloop"],
-                   help="--serve socket: the connection engine. threaded "
-                        "(default, the reference): one OS thread per "
-                        "connection, capped — fine for chaos tests, dead "
-                        "at heavy traffic. eventloop: the serve/scale "
-                        "selectors reactor — ONE thread multiplexing "
-                        "thousands of connections (non-blocking accept, "
-                        "incremental frame reassembly, read deadlines), "
-                        "identical admission decisions (shared protocol, "
-                        "same G011 gauntlet). The C1M path.")
+                   help="--serve socket: the connection engine. eventloop "
+                        "(default since PR 18): the serve/scale selectors "
+                        "reactor — ONE thread multiplexing thousands of "
+                        "connections (non-blocking accept, incremental "
+                        "frame reassembly, read deadlines). The C1M path. "
+                        "threaded (the reference, and the default before "
+                        "PR 18): one OS thread per connection, capped — "
+                        "fine for chaos tests, dead at heavy traffic; "
+                        "pinning it prints a startup NOTE. Identical "
+                        "admission decisions either way (shared protocol, "
+                        "same G011 gauntlet).")
     p.add_argument("--serve_shards", type=int, default=0,
-                   help=">= 2 runs that many event-loop ingest reactors "
-                        "(each its own listener + thread) over the ONE "
-                        "admission queue, clients routed by client-id "
-                        "hash — spreads connection handling and payload-"
-                        "gauntlet CPU across workers. Per-shard admission/"
-                        "shed counters and load-scaled retry-after hints "
-                        "land in /metrics and /metrics.prom, so an "
-                        "overloaded shard is distinguishable from an "
-                        "overloaded server. Requires --serve socket "
+                   help=">= 2 shards the socket ingest that many ways, "
+                        "clients routed by client-id hash — spreads "
+                        "connection handling and payload-gauntlet CPU "
+                        "across workers (reactor threads or real worker "
+                        "processes; --serve_shard_mode). Per-shard "
+                        "admission/shed counters and load-scaled retry-"
+                        "after hints land in /metrics and /metrics.prom, "
+                        "so an overloaded shard is distinguishable from "
+                        "an overloaded server. Requires --serve socket "
                         "--serve_transport eventloop. 0 = one listener")
+    p.add_argument("--serve_shard_mode", default="thread",
+                   choices=["thread", "process"],
+                   help="--serve_shards >= 2: what a shard IS. thread "
+                        "(default): N reactor threads over the ONE "
+                        "admission queue — connection scale-out, but "
+                        "decode/gauntlet/admission still serialize on "
+                        "this process's GIL. process: N SO_REUSEPORT "
+                        "worker PROCESSES (serve/scale/procshard.py), "
+                        "shared-nothing — each owns its clients' "
+                        "admission state outright (dedup, pending, "
+                        "quarantine screen against the round's broadcast "
+                        "median) and lands validated tables in a shared-"
+                        "memory ring block the root's close reads "
+                        "directly; misroutes forward to the owner "
+                        "(counted). A killed worker == its clients "
+                        "dropped + re-queued bitwise (shard_kill fault "
+                        "kind); dead workers respawn at the next round. "
+                        "Served params stay BITWISE identical to thread "
+                        "mode and to the unsharded path, fastpath on or "
+                        "off. Does not compose with --serve_pipeline/"
+                        "--serve_async/--serve_edges yet")
     p.add_argument("--serve_edges", type=int, default=0,
                    help=">= 2 arms TWO-TIER edge aggregation "
                         "(serve/scale/edge.py): the cohort partitions "
@@ -661,8 +684,11 @@ def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
         raise SystemExit(
             "--serve_pipeline pipelines the serving rounds; arm --serve "
             "inproc|socket")
-    if (getattr(args, "serve_transport", "threaded") != "threaded"
-            and getattr(args, "serve", "off") != "socket"):
+    # (the eventloop default means an unpinned non-socket run carries
+    # serve_transport="eventloop" harmlessly — only a PINNED threaded
+    # engine off-socket is detectably pointless now)
+    if (getattr(args, "serve_transport", "eventloop") == "threaded"
+            and getattr(args, "serve", "off") not in ("off", "socket")):
         raise SystemExit(
             "--serve_transport picks the SOCKET connection engine; arm "
             "--serve socket (inproc has no connections to multiplex)")
@@ -675,11 +701,25 @@ def resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
             raise SystemExit(
                 "--serve_shards shards the socket ingest; arm --serve "
                 "socket")
-        if getattr(args, "serve_transport", "threaded") != "eventloop":
+        if getattr(args, "serve_transport", "eventloop") != "eventloop":
             raise SystemExit(
                 "--serve_shards runs N event-loop reactors; arm "
                 "--serve_transport eventloop (thread-per-connection has "
                 "no reactor to shard)")
+    elif getattr(args, "serve_shard_mode", "thread") == "process":
+        raise SystemExit(
+            "--serve_shard_mode process needs --serve_shards >= 2 (one "
+            "shard IS the plain event-loop transport)")
+    if getattr(args, "serve_shard_mode", "thread") == "process":
+        if (getattr(args, "serve_pipeline", False)
+                or getattr(args, "serve_async", False)
+                or getattr(args, "serve_edges", 0) >= 2):
+            raise SystemExit(
+                "--serve_shard_mode process does not compose with "
+                "--serve_pipeline/--serve_async/--serve_edges yet "
+                "(admission state lives in the worker processes; the "
+                "cross-process band/boundary/edge disciplines are named "
+                "follow-ups) — drop one of the flags")
     if getattr(args, "serve_max_conns", 0) < 0:
         raise SystemExit(
             f"--serve_max_conns must be >= 0 (0 = engine default), got "

@@ -174,6 +174,11 @@ def main(argv=None):
             args.serve != "off" and args.serve_payload == "sketch"
             and getattr(args, "serve_edges", 0) >= 2,
             getattr(args, "serve_edges", 0))
+        fault_plan.validate_shard_context(
+            args.serve == "socket"
+            and getattr(args, "serve_shards", 0) >= 2
+            and getattr(args, "serve_shard_mode", "thread") == "process",
+            getattr(args, "serve_shards", 0))
     schedule = triangular(args.lr_scale, args.pivot_epoch, args.num_epochs)
     opt = FedOptimizer(schedule, rounds_per_epoch)
     model = FedModel(session)

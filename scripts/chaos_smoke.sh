@@ -22,6 +22,7 @@
 #        scripts/chaos_smoke.sh pipeline
 #        scripts/chaos_smoke.sh async_byzantine
 #        scripts/chaos_smoke.sh edge
+#        scripts/chaos_smoke.sh procshard
 #        scripts/chaos_smoke.sh postmortem
 #
 # `supervisor` mode exercises preempt -> resume end-to-end the way a k8s
@@ -96,6 +97,17 @@
 # and THE pin: edge-death == the dead edge's whole hash-shard dropped,
 # BITWISE (a client_drop twin at the ledger-derived shard positions lands
 # on identical params).
+#
+# `procshard` mode drives the PROCESS-SHARDED ingest (< 3 min CPU): a
+# real cv_train run over --serve socket with 4 SO_REUSEPORT shard WORKER
+# PROCESSES (--serve_shards 4 --serve_shard_mode process, sketch payload
+# over the loopback wire, shm-ring handoff), shard 1 SIGKILLed mid-run by
+# a shard_kill fault — asserting the shard-death and fault counters
+# fired, the dead shard's clients went through the masking/re-queue
+# machinery, the run finished finite/falling — and THE pin: a dead shard
+# process == its whole hash-shard of clients dropped, BITWISE (a
+# client_drop twin at the ledger-derived ownership positions lands on
+# identical params).
 #
 # `postmortem` mode drives the CRASH POSTMORTEM BUNDLE (< 1 min CPU): a
 # real cv_train run with --ledger armed is wedged mid-round by an injected
@@ -1107,6 +1119,162 @@ print(f"edge: PASS (edge {DEAD_EDGE} killed at round {KILL_ROUND}: "
       f"BITWISE; wire_delay straggler; loss {losses[0]:.4f} -> "
       f"{losses[-1]:.4f}, 12 rounds, params finite)")
 EOF
+fi
+
+if [[ "${1:-}" == "procshard" ]]; then
+    shift
+    # the driver must be a REAL FILE: the process-sharded ingest spawns
+    # its workers with the "spawn" start method, which re-imports
+    # __main__ in every child — impossible when the parent ran from a
+    # `python -` stdin heredoc (every other mode's shape)
+    drv="$(mktemp --suffix=_procshard_chaos.py)"
+    trap 'rm -f "$drv"' EXIT
+    cat > "$drv" <<'EOF'
+# procshard chaos child (< 3 min CPU): the real cv_train.main CLI path
+# (tiny-model substitution) over the PROCESS-SHARDED socket ingest —
+# --serve_shards 4 --serve_shard_mode process, sketch payload over the
+# loopback wire, SO_REUSEPORT workers landing validated tables in the
+# per-shard shm ring — with shard 1 SIGKILLed mid-round by a shard_kill
+# fault. Asserts the shard-death and fault counters fired, the dead
+# shard's clients went through the masking/re-queue machinery, the run
+# finished finite/falling — and THE bitwise pin: dead shard process ==
+# its whole hash-shard dropped (a client_drop twin at the ledger-derived
+# ownership positions lands on identical params).
+#
+# Module level stays stdlib-only ON PURPOSE: every spawned shard worker
+# re-imports this file (as __mp_main__) before its numpy-only entry
+# chain takes over — the main guard keeps the run parent-only and the
+# lazy imports keep the per-worker spawn cost near zero.
+import os
+import sys
+
+# the driver file lives in /tmp (mktemp), so python's script-dir sys.path
+# entry misses the repo — the launcher cd'd to the repo root already
+sys.path.insert(0, os.getcwd())
+
+
+def main():
+    import json
+    import tempfile
+
+    import numpy as np
+
+    import flax.linen as nn
+
+    import commefficient_tpu.data.cifar as cifar
+    import cv_train
+    from commefficient_tpu.obs import registry as obreg
+    from commefficient_tpu.runner import loop as rloop
+    from commefficient_tpu.serve.scale.shard import shard_for
+
+    class _TinyNet(nn.Module):
+        num_classes: int = 10
+        dtype: str = "float32"
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(self.num_classes)(x)
+
+    _orig = cifar.load_cifar_fed
+
+    def _tiny(*a, **kw):
+        kw.update(synthetic_train=64, synthetic_test=32)
+        return _orig(*a, **kw)
+
+    cv_train.ResNet9 = _TinyNet
+    cv_train.load_cifar_fed = _tiny
+
+    box = {}
+    _orig_loop = rloop.run_loop
+
+    def _capture_loop(*a, **kw):
+        stats = _orig_loop(*a, **kw)
+        box["stats"] = stats
+        return stats
+
+    cv_train.run_loop = _capture_loop
+
+    KILL_ROUND, DEAD_SHARD, SHARDS = 3, 1, 4
+    BASE = [
+        "--dataset", "cifar10", "--mode", "sketch",
+        "--k", "2048", "--num_rows", "3", "--num_cols", "8192",
+        "--num_clients", "16", "--num_workers", "8",
+        "--local_batch_size", "4", "--lr_scale", "0.02",
+        "--weight_decay", "0", "--data_root", "/nonexistent",
+        "--num_rounds", "12", "--eval_every", "3",
+        "--serve", "socket", "--serve_transport", "eventloop",
+        "--serve_payload", "sketch",
+        "--serve_shards", str(SHARDS), "--serve_shard_mode", "process",
+        "--serve_quorum", "0", "--serve_deadline", "8.0",
+    ]
+
+    reg = obreg.default()
+    before_kill = reg.counter("resilience_fault_shard_kill_total").value
+    before_death = reg.counter("serve_shard_deaths_total").value
+
+    wdir = tempfile.mkdtemp()
+    rows_path = os.path.join(wdir, "rows.jsonl")
+    ledger_path = os.path.join(wdir, "ledger.jsonl")
+    session = cv_train.main(BASE + [
+        "--log_jsonl", rows_path, "--ledger", ledger_path,
+        "--fault_plan", f"shard_kill@{KILL_ROUND}:shards={DEAD_SHARD}",
+    ])
+    assert session.round == 12, session.round
+    assert reg.counter("resilience_fault_shard_kill_total").value \
+        - before_kill >= 1, "shard_kill counter never fired"
+    assert reg.counter("serve_shard_deaths_total").value \
+        - before_death >= 1, "serve_shard_deaths_total never fired"
+    stats = box["stats"]
+    assert stats.clients_dropped >= 1, stats
+    assert stats.requeue_depth_max >= 1, stats
+
+    rows = [json.loads(l) for l in open(rows_path) if l.strip()]
+    losses = [r["train_loss"] for r in rows]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], f"loss did not fall: {losses}"
+
+    # THE bitwise pin: the run's own round LEDGER records the kill
+    # round's cohort — hash it with the ownership function the ingest
+    # itself routes by, and a twin run that client_drops exactly the
+    # dead shard's positions must land on identical params.
+    import jax
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.obs import ledger as L
+
+    ids = None
+    for rec in L.round_records(ledger_path):
+        if rec["round"] == KILL_ROUND:
+            ids = np.asarray(rec["cohort"], np.int64)
+    assert ids is not None, f"ledger has no round {KILL_ROUND}"
+    doomed = np.flatnonzero(shard_for(ids, SHARDS) == DEAD_SHARD)
+    assert len(doomed) > 0, "ownership hash left the dead shard empty"
+    drop = "+".join(str(int(p)) for p in doomed)
+    twin = cv_train.main(BASE + [
+        "--fault_plan", f"client_drop@{KILL_ROUND}:clients={drop}",
+    ])
+    fa = np.asarray(ravel_pytree(jax.device_get(session.state["params"]))[0])
+    fb = np.asarray(ravel_pytree(jax.device_get(twin.state["params"]))[0])
+    assert np.isfinite(fa).all(), "params went non-finite"
+    assert np.array_equal(fa, fb), (
+        "shard-death run != shard-dropped twin (max abs diff "
+        f"{np.abs(fa - fb).max()})")
+    print(f"procshard: PASS (shard {DEAD_SHARD}/{SHARDS} SIGKILLed at "
+          f"round {KILL_ROUND}: {len(doomed)} owned client(s) dropped == "
+          f"client_drop twin BITWISE; clients_dropped="
+          f"{stats.clients_dropped} requeue_depth_max="
+          f"{stats.requeue_depth_max}; loss {losses[0]:.4f} -> "
+          f"{losses[-1]:.4f}, 12 rounds, params finite)")
+
+
+if __name__ == "__main__":
+    main()
+EOF
+    rc=0
+    timeout -k 10 "${CHAOS_TIMEOUT_S:-420}" python "$drv" "$@" || rc=$?
+    exit $rc
 fi
 
 if [[ "${1:-}" == "postmortem" ]]; then

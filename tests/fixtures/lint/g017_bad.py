@@ -1,0 +1,17 @@
+# graftlint: module=commefficient_tpu/serve/scale/procshard_worker.py
+# G017 violating twin: two fork-unsafe imports in a worker-entry module —
+# a direct module-level jax import (the spawned shard worker would
+# initialize the accelerator runtime per shard), and one smuggled behind
+# a same-directory helper import the module-local view cannot see.
+import json
+
+import jax.numpy as jnp  # direct: module-level jax in the worker chain
+import numpy as np
+
+from .g017_helper_bad import device_merge  # transitive: helper imports jax
+
+
+def worker_main(cfg, ctl):
+    table = np.zeros((cfg["rows"], cfg["cols"]), np.float32)
+    ctl.send(("ready", json.dumps({"ok": True})))
+    return device_merge(jnp.asarray(table))

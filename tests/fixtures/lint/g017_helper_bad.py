@@ -1,0 +1,9 @@
+# graftlint: module=commefficient_tpu/serve/scale/fake_helper.py
+# Helper module for the G017 transitive fixture: the jax import a
+# worker-entry module pulls in one hop away.
+import jax
+import jax.numpy as jnp
+
+
+def device_merge(stack):
+    return jax.jit(jnp.sum)(stack)

@@ -491,6 +491,39 @@ def test_g016_scope_is_fastpath_modules_only():
         os.unlink(path)
 
 
+def test_g017_fires_direct_and_through_transitive_chain():
+    """The violating fixture carries BOTH shapes: a direct module-level
+    jax import in the worker-entry module, and one smuggled behind a
+    same-directory helper import (the spawned worker executes both) —
+    each must be its own finding."""
+    found = _codes(os.path.join(FIXTURES, "g017_bad.py"))
+    assert found.count("G017") >= 2, found
+
+
+def test_g017_scope_is_worker_entry_modules_only():
+    """A module-level jax import anywhere ELSE in the package is business
+    as usual — the rule engages only on the declared worker-entry chain
+    (service.py is the ROOT half; it imports jax by design)."""
+    import tempfile
+
+    src = (
+        "# graftlint: module=commefficient_tpu/serve/service.py\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def merge(stack):\n"
+        "    return jnp.sum(stack, axis=0)\n"
+    )
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as tmp:
+        tmp.write(src)
+        path = tmp.name
+    try:
+        assert "G017" not in _codes(path)
+    finally:
+        os.unlink(path)
+
+
 def test_every_rule_has_fixture_pair():
     # adding a rule without fixtures should fail HERE, not in review
     for code in RULE_CODES:

@@ -1,0 +1,385 @@
+"""Process-sharded ingest (serve/scale/procshard*.py): SO_REUSEPORT worker
+processes, shared-memory ring handoff, worker lifecycle.
+
+The acceptance pins live here:
+
+- SERVED == BATCH stays bitwise when the ingest runs as real worker
+  PROCESSES — fused AND client-sharded sessions, --serve_fastpath on AND
+  off (the shards move bytes and verdicts over shm, never arithmetic);
+- admission state is SHARD-OWNED: a retry on the owner is DUPLICATE, a
+  kernel-misrouted frame through the shared SO_REUSEPORT port is counted,
+  forwarded to the owner, and THEN deduplicated there;
+- every exit path unlinks the shm ring segments — normal close, a stop
+  with a round still open, and a stop after a SIGKILLed worker leave
+  /dev/shm exactly as they found it;
+- per-shard counters cross the process boundary into the root's /metrics
+  (JSON `shards` block) and /metrics.prom;
+- the `shard_kill` fault == a client_drop of the dead shard's client set,
+  bitwise, with the casualties re-queued.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from commefficient_tpu.data.fed_dataset import FedDataset, shard_iid
+from commefficient_tpu.federated.api import FederatedSession
+from commefficient_tpu.modes.config import ModeConfig
+from commefficient_tpu.obs import registry as obreg
+from commefficient_tpu.resilience import FaultPlan
+from commefficient_tpu.serve.ingest import ACCEPTED, DUPLICATE, Submission
+from commefficient_tpu.serve.scale.procshard import ProcShardedIngest
+from commefficient_tpu.serve.scale.shard import shard_for
+from commefficient_tpu.serve.service import AggregationService, ServeConfig
+from commefficient_tpu.serve.traffic import TraceConfig, TrafficGenerator
+from commefficient_tpu.serve.transport import submit_over_socket
+
+LR = 0.05
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+def _quad_loss(params, net_state, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    err = pred - jax.nn.one_hot(batch["y"], pred.shape[-1])
+    mask = batch["mask"]
+    count = jnp.maximum(mask.sum(), 1.0)
+    per_ex = (err ** 2).sum(-1)
+    return (per_ex * mask).sum() / count, {
+        "net_state": net_state,
+        "metrics": {"loss_sum": (per_ex * mask).sum(), "count": mask.sum()}}
+
+
+def _tiny_session(clip=0.0, shards=1, seed=0, fault_plan=None):
+    rs = np.random.RandomState(0)
+    x = rs.randn(96, 6).astype(np.float32)
+    w_true = rs.randn(6, 3).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int32)
+    train = FedDataset(x, y, shard_iid(len(x), 12, np.random.RandomState(1)))
+    params = {"w": jnp.asarray(rs.randn(6, 3).astype(np.float32) * 0.1),
+              "b": jnp.zeros(3)}
+    d = ravel_pytree(params)[0].size
+    mc = ModeConfig(mode="sketch", d=d, k=4, num_rows=3, num_cols=16,
+                    momentum_type="virtual", error_type="virtual")
+    return FederatedSession(
+        train_loss_fn=_quad_loss, eval_loss_fn=_quad_loss,
+        params=params, net_state={}, mode_cfg=mc, train_set=train,
+        num_workers=4, local_batch_size=4, seed=seed,
+        wire_payloads=True, client_update_clip=clip, client_shards=shards,
+        fault_plan=fault_plan,
+    )
+
+
+def _serve(session, rounds, shards=0, shard_mode="thread", fastpath=False,
+           quorum=3, trace_seed=5, deadline=4.0, metrics_port=-1,
+           on_service=None):
+    """Drive served rounds over the real socket wire; shards >= 2 with
+    shard_mode="process" runs the SO_REUSEPORT worker-process ingest."""
+    cfg = ServeConfig(quorum=quorum, deadline_s=deadline,
+                      transport="socket", socket_transport="eventloop",
+                      payload="sketch", shards=shards, shard_mode=shard_mode,
+                      fastpath=fastpath, metrics_port=metrics_port)
+    svc = AggregationService(
+        session, cfg,
+        traffic=TrafficGenerator(
+            TraceConfig(population=session.train_set.num_clients,
+                        seed=trace_seed))).start()
+    rows = []
+    try:
+        src = svc.source()
+        for _ in range(rounds):
+            prep = src.next()
+            rows.append(session.commit_round(
+                session.dispatch_round(prep, LR))[0])
+            src.on_dispatched(session.round - 1)
+            src.on_committed(session.round)
+        if on_service is not None:
+            on_service(svc)
+        src.stop()
+        with session.mutate_lock:
+            rng_state, rng_key = session.rng_snapshot
+            session.rng.set_state(rng_state)
+            session._rng_key = rng_key
+            session._requeue = collections.deque(session._requeue_committed)
+            session._requeue_enqueued = dict(
+                session._requeue_ages_committed)
+    finally:
+        svc.close()
+    return rows
+
+
+def _assert_params_equal(sa, sb):
+    for x, y in zip(
+        jax.tree.leaves(jax.device_get(sa.state["params"])),
+        jax.tree.leaves(jax.device_get(sb.state["params"])),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _assert_rows_equal(ra, rb):
+    for a, b in zip(ra, rb):
+        assert set(a) == set(b)
+        for k in a:
+            assert a[k] == b[k], (k, a[k], b[k])
+
+
+def _shm_names():
+    try:
+        return set(os.listdir("/dev/shm"))
+    except FileNotFoundError:  # non-tmpfs platform: nothing to pin
+        return set()
+
+
+# --------------------------- THE pin: process shards == fused, bitwise
+
+
+@pytest.mark.parametrize("fastpath,session_shards", [
+    (False, 1),
+    (True, 1),
+    (True, 2),   # client-sharded session under the process-shard ingest
+])
+def test_proc_sharded_serving_equals_fused_bitwise(fastpath, session_shards):
+    """THE acceptance pin: serving through N SO_REUSEPORT worker
+    PROCESSES (shm ring handoff, fastpath on and off) is bit-identical —
+    params + every logged row — to the fused single-listener socket path
+    of the same session."""
+    sa = _tiny_session(shards=session_shards)
+    ra = _serve(sa, 3, shards=2, shard_mode="process", fastpath=fastpath)
+    sb = _tiny_session(shards=session_shards)
+    rb = _serve(sb, 3)
+    _assert_params_equal(sa, sb)
+    _assert_rows_equal(ra, rb)
+
+
+def test_proc_shards_equal_thread_shards_bitwise():
+    """Process shards and thread shards are the same admission machine:
+    identical params + rows for the same session/trace."""
+    sa = _tiny_session()
+    ra = _serve(sa, 3, shards=2, shard_mode="process")
+    sb = _tiny_session()
+    rb = _serve(sb, 3, shards=2, shard_mode="thread")
+    _assert_params_equal(sa, sb)
+    _assert_rows_equal(ra, rb)
+
+
+# ------------------------------------------- shard-owned admission state
+
+
+def test_shard_owned_dedup_and_misroute_forwarding():
+    """Admission state is shard-OWNED: a retry on the owner's direct port
+    is DUPLICATE; frames through the shared SO_REUSEPORT port get
+    kernel-spread (misroutes counted + forwarded to the owner) and STILL
+    deduplicate, because the verdict comes from the one owner."""
+    t = ProcShardedIngest(n_shards=2)
+    try:
+        t.start()
+        ids = list(range(100, 148))
+        t.queue.open_round(0, ids)
+        # owner-routed: accept once, DUPLICATE on retry
+        assert t.submit(Submission(client_id=100, round=0,
+                                   latency_s=0.1)) == ACCEPTED
+        assert t.submit(Submission(client_id=100, round=0,
+                                   latency_s=0.1)) == DUPLICATE
+        # shared port: the kernel spreads conns by 4-tuple hash, blind to
+        # client ownership — with 32 submissions over 2 shards the odds of
+        # zero misroutes are 2^-32. All must come back ACCEPTED (forwarded
+        # to the owner), retries all DUPLICATE (owner state, not local).
+        shared = t.address
+        for cid in ids[1:33]:
+            assert submit_over_socket(
+                shared, Submission(client_id=cid, round=0,
+                                   latency_s=0.1)) == ACCEPTED
+        for cid in ids[1:33]:
+            assert submit_over_socket(
+                shared, Submission(client_id=cid, round=0,
+                                   latency_s=0.1)) == DUPLICATE
+        shards = t.counters()
+        assert sum(s["misrouted"] for s in shards.values()) > 0
+        merged = t.queue.close_round(0)
+        assert sorted(a.client_id for a in merged) == ids[:33]
+        # recv_order residues are disjoint per shard (globalization)
+        assert len({a.recv_order for a in merged}) == len(merged)
+    finally:
+        t.stop()
+
+
+def test_shard_for_partitions_every_client():
+    ids = np.arange(5000, 5200)
+    owners = {int(cid): shard_for(int(cid), 4) for cid in ids}
+    assert set(owners.values()) <= set(range(4))
+    assert len(set(owners.values())) > 1
+    # stable: the same id always lands on the same shard
+    for cid in ids[:20]:
+        assert shard_for(int(cid), 4) == owners[int(cid)]
+
+
+# ------------------------------------------------ shm ring segment hygiene
+
+
+def test_shm_ring_cleanup_on_every_exit_path():
+    """No leaked /dev/shm segments: normal stop, stop with a round still
+    open (armed blocks), and stop after a SIGKILLed worker all unlink
+    every ring segment the root created."""
+    before = _shm_names()
+
+    # normal open/close/stop
+    t = ProcShardedIngest(n_shards=2, payload_shape=(3, 16), fastpath=True)
+    t.start()
+    t.queue.open_round(0, list(range(12)))
+    t.queue.close_round(0)
+    t.stop()
+    assert _shm_names() <= before
+
+    # stop with the round still open (blocks armed, never closed)
+    t = ProcShardedIngest(n_shards=2, payload_shape=(3, 16), fastpath=True)
+    t.start()
+    t.queue.open_round(0, list(range(12)))
+    t.stop()
+    assert _shm_names() <= before
+
+    # a worker SIGKILLed mid-round (its mapping dies with it; the root
+    # still owns + unlinks the segment)
+    t = ProcShardedIngest(n_shards=2, payload_shape=(3, 16), fastpath=True)
+    t.start()
+    t.queue.open_round(0, list(range(12)))
+    t.kill_shard(1)
+    t.queue.close_round(0)
+    t.stop()
+    assert _shm_names() <= before
+
+
+def test_dead_worker_respawns_at_next_open():
+    t = ProcShardedIngest(n_shards=2)
+    try:
+        t.start()
+        pid0 = t.workers[1].proc.pid
+        t.queue.open_round(0, list(range(8)))
+        t.kill_shard(1)
+        assert not t.workers[1].alive
+        t.queue.close_round(0)
+        # next open respawns: fresh process, fresh (empty) admission state
+        t.queue.open_round(1, list(range(8)))
+        assert t.workers[1].alive
+        assert t.workers[1].proc.pid != pid0
+        assert t.submit(Submission(client_id=1, round=1,
+                                   latency_s=0.1)) == ACCEPTED
+        t.queue.close_round(1)
+    finally:
+        t.stop()
+
+
+# -------------------------------------------- cross-process observability
+
+
+def test_cross_process_counters_aggregate_into_metrics():
+    """Worker-side counters cross the process boundary: the /metrics JSON
+    `shards` block carries per-shard liveness + totals, the queue
+    counters sum across shards, and /metrics.prom renders the per-shard
+    series from the root registry."""
+    captured = {}
+
+    def grab(svc):
+        host, port = svc.metrics_server.address
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=5) as r:
+            captured["json"] = json.loads(r.read())
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics.prom", timeout=5) as r:
+            captured["prom"] = r.read().decode()
+
+    session = _tiny_session()
+    _serve(session, 2, shards=2, shard_mode="process", metrics_port=0,
+           on_service=grab)
+    snap = captured["json"]
+    assert snap["shard_mode"] == "process"
+    shards = snap["shards"]
+    assert set(shards) == {"0", "1"}
+    for s in shards.values():
+        assert s["alive"] and s["pid"]
+    # every admitted submission was counted by exactly one worker
+    assert snap["submissions"]["accepted"] > 0
+    assert sum(s["submissions"] for s in shards.values()) \
+        >= snap["submissions"]["accepted"]
+    assert "serve_shard0_submissions_total" in captured["prom"]
+    assert "serve_shard1_submissions_total" in captured["prom"]
+
+
+# ----------------------------- worker lifecycle: shard_kill == client_drop
+
+
+def test_shard_kill_equals_client_drop_bitwise():
+    """A SIGKILLed shard worker mid-run == a client_drop of its whole
+    hash-shard (same positions, same round), bitwise, and the casualties
+    go through the requeue machinery. Deaths are counted."""
+    N, kill_round, dead = 2, 1, 1
+    plan = FaultPlan.parse(f"shard_kill@{kill_round}:shards={dead}")
+    sa = _tiny_session(fault_plan=plan)
+    # the doomed set the ownership hash will pick: this round's cohort is
+    # a pure function of the session's sampling stream
+    probe = _tiny_session()
+    ids = [probe.sample_cohort(r) for r in range(kill_round + 1)][-1]
+    doomed = [p for p, cid in enumerate(ids)
+              if shard_for(int(cid), N) == dead]
+    assert doomed, "hash assignment left the dead shard empty"
+    plan_b = FaultPlan.parse(
+        f"client_drop@{kill_round}:clients="
+        + "+".join(str(p) for p in doomed))
+    sb = _tiny_session(fault_plan=plan_b)
+    snap0 = obreg.default().snapshot()
+    ra = _serve(sa, 3, shards=N, shard_mode="process", quorum=0)
+    snap1 = obreg.default().snapshot()
+    rb = _serve(sb, 3, shards=N, shard_mode="process", quorum=0)
+    _assert_params_equal(sa, sb)
+    _assert_rows_equal(ra, rb)
+    assert ra[kill_round]["clients_dropped"] >= len(doomed)
+    assert ra[kill_round]["requeue_depth"] >= len(doomed)
+    assert snap1.get("serve_shard_deaths_total", 0) \
+        > snap0.get("serve_shard_deaths_total", 0)
+    assert snap1.get("resilience_fault_shard_kill_total", 0) \
+        > snap0.get("resilience_fault_shard_kill_total", 0)
+
+
+# --------------------------------------------------- config + plan guards
+
+
+def test_process_mode_rejections():
+    base = dict(quorum=3, deadline_s=4.0, transport="socket",
+                socket_transport="eventloop", payload="sketch")
+    session = _tiny_session()
+    for bad in (
+        dict(shards=2, shard_mode="process", async_mode=True),
+        dict(shards=2, shard_mode="process", pipeline=True),
+        dict(shards=2, shard_mode="process", edges=2),
+        dict(shards=0, shard_mode="process"),
+    ):
+        with pytest.raises(ValueError, match="shard_mode|serve_shards"):
+            AggregationService(session, ServeConfig(**base, **bad))
+    with pytest.raises(ValueError, match="n_shards"):
+        ProcShardedIngest(n_shards=1)
+
+
+def test_shard_kill_plan_validation():
+    plan = FaultPlan.parse("shard_kill@1:shards=1+3")
+    assert plan.has_shard_kill()
+    # vacuous: shard_kill without a process-sharded serve
+    with pytest.raises(ValueError, match="never fire"):
+        plan.validate_shard_context(False, 0)
+    # out-of-range shard index
+    with pytest.raises(ValueError, match="never fire"):
+        plan.validate_shard_context(True, 2)
+    plan.validate_shard_context(True, 4)
+    assert plan.shard_kill_plan(1) == (1, 3)
+    assert plan.shard_kill_plan(0) == ()
+    with pytest.raises(ValueError, match="shards="):
+        FaultPlan.parse("shard_kill@1")  # needs shards=

@@ -630,10 +630,12 @@ def test_shard_transport_config_validation():
     with pytest.raises(ValueError, match="n_shards must be >= 2"):
         ShardedIngest(IngestQueue(capacity=4), n_shards=1)
     s = _tiny_session()
+    # socket_transport defaults to eventloop now — pin threaded explicitly
+    # to keep exercising the shards-need-a-reactor rejection.
     with pytest.raises(ValueError, match="eventloop"):
         AggregationService(
             s, ServeConfig(quorum=3, transport="socket", payload="sketch",
-                           shards=2),
+                           socket_transport="threaded", shards=2),
             traffic=TrafficGenerator(TraceConfig(population=12)))
     with pytest.raises(ValueError, match="no connections to shard"):
         AggregationService(
@@ -720,7 +722,8 @@ def test_cli_flag_validation(tiny_cv):
         cv_train.main(base + ["--serve", "inproc", "--serve_transport",
                               "eventloop", "--serve_shards", "2"])
     with pytest.raises(SystemExit, match="eventloop"):
-        cv_train.main(base + ["--serve", "socket", "--serve_shards", "2"])
+        cv_train.main(base + ["--serve", "socket", "--serve_transport",
+                              "threaded", "--serve_shards", "2"])
     with pytest.raises(SystemExit, match="does not compose"):
         cv_train.main(base + [
             "--serve", "inproc", "--serve_payload", "sketch",
