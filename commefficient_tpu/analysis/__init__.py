@@ -95,6 +95,27 @@ G017  fork-unsafe-import-in-shard-worker         the spawned shard-worker /
                                                  or other accelerator-
                                                  runtime packages from the
                                                  worker-entry modules
+G018  lock-order-inversion                       the lock-acquisition graph
+                                                 across serve/runner/obs
+                                                 (B taken while A held,
+                                                 interprocedurally) has no
+                                                 cycles; `# graftlint:
+                                                 lock-order <name>` declares
+                                                 the sanctioned global order
+G019  unlocked-shared-state                      an attribute mutated from
+                                                 two thread roots (derived
+                                                 from Thread(target=...) +
+                                                 public entry points) is
+                                                 mutated only under a common
+                                                 declared lock, or carries
+                                                 `# graftlint: lockfree <why>`
+G020  signal-unsafe-handler                      functions reachable from
+                                                 signal.signal(...) never
+                                                 acquire non-reentrant locks,
+                                                 open files, or call the
+                                                 buffered JSONL sinks (the
+                                                 instant_signal_safe
+                                                 discipline, machine-checked)
 ====  =========================================  ================================
 
 Run it:
@@ -134,8 +155,10 @@ from .rules_procsafe import ForkUnsafeImportInShardWorker
 from .rules_reactor import BlockingCallInEventLoop
 from .rules_robust import (RobustOrderSensitivity,
                            StalenessFoldBoundary)
+from .rules_signal import SignalUnsafeHandler
 from .rules_sketch import FlatRavelInRoundPath
 from .rules_sync import BlockingCallOnDispatchThread, HostSyncInRoundPath
+from .rules_threads import LockOrderInversion, UnlockedSharedState
 from .rules_wire import WireBytesInCompiledScope
 
 ALL_RULES: tuple[type[Rule], ...] = (
@@ -156,6 +179,9 @@ ALL_RULES: tuple[type[Rule], ...] = (
     BlockingCallInEventLoop,
     PerSubmissionCopyInFastpath,
     ForkUnsafeImportInShardWorker,
+    LockOrderInversion,
+    UnlockedSharedState,
+    SignalUnsafeHandler,
 )
 
 RULE_CODES: tuple[str, ...] = tuple(r.code for r in ALL_RULES)

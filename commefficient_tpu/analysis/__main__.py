@@ -9,6 +9,8 @@ none) and exits 0.
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
 
 from . import ALL_RULES, RULE_CODES
@@ -19,6 +21,31 @@ from .report import render_json, render_text
 # contracts that admit NO grandfathering: parity, reserved leaf, raw
 # checkpoint writes — a violation is a bug today, not debt
 NO_BASELINE_CODES = ("G002", "G003", "G004")
+
+
+def _staged_files() -> list[str] | None:
+    """Repo-relative paths staged for commit, or None outside git.
+    ACMR: added/copied/modified/renamed — deletions have nothing to lint."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        out = subprocess.run(
+            ["git", "diff", "--cached", "--name-only", "--diff-filter=ACMR"],
+            capture_output=True, text=True, check=True, cwd=top,
+        ).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    os.chdir(top)
+    return [ln for ln in out.splitlines() if ln]
+
+
+def _lintable(rel: str) -> bool:
+    return rel.endswith(".py") and (
+        rel.startswith("commefficient_tpu/")
+        or rel in ("cv_train.py", "gpt2_train.py", "bench.py")
+    )
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -45,6 +72,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="additionally write the JSON report to PATH (one "
                         "analysis run serves both the human text and the "
                         "archived report)")
+    p.add_argument("--jobs", type=int, default=0, metavar="N",
+                   help="analyze files across N worker processes "
+                        "(default: CPU count; 1 forces serial; the report "
+                        "is byte-identical either way)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="analyze only the staged .py files (git diff "
+                        "--cached); falls back to the whole package when "
+                        "an analysis/ file itself is staged")
     args = p.parse_args(argv)
 
     if args.write_baseline and args.select:
@@ -64,16 +99,42 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         rules = [r for r in rules if r.code in wanted]
 
+    if args.changed_only and args.paths:
+        print("--changed-only derives its file list from the git index; "
+              "explicit paths would be ignored — pass one or the other",
+              file=sys.stderr)
+        return 2
+
     paths = args.paths or None
     if not paths:
-        import os
-
         paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
+    if args.changed_only:
+        staged = _staged_files()
+        if staged is None:
+            print("graftlint: --changed-only requires a git checkout",
+                  file=sys.stderr)
+            return 2
+        if any(s.startswith("commefficient_tpu/analysis/") for s in staged):
+            print("graftlint: an analysis/ file is staged — the rules "
+                  "themselves changed, linting the whole package",
+                  file=sys.stderr)
+        else:
+            lintable = [s for s in staged if _lintable(s)]
+            if not lintable:
+                print("graftlint: nothing staged to lint")
+                return 0
+            paths = [s for s in lintable if os.path.isfile(s)]
+            if not paths:
+                print("graftlint: nothing staged to lint")
+                return 0
+
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
     baseline = (Baseline.empty() if args.no_baseline or args.write_baseline
                 else Baseline.load(args.baseline))
     try:
-        result = Analyzer(rules=rules, baseline=baseline).run(paths)
+        result = Analyzer(rules=rules, baseline=baseline).run(paths,
+                                                              jobs=jobs)
     except (OSError, ValueError) as e:
         print(f"graftlint: error: {e}", file=sys.stderr)
         return 2

@@ -297,7 +297,8 @@ class Analyzer:
             from . import ALL_RULES
 
             rules = ALL_RULES
-        self.rules: list[Rule] = [r() for r in rules]
+        self._rule_classes: list[type[Rule]] = list(rules)
+        self.rules: list[Rule] = [r() for r in self._rule_classes]
         self.valid_codes = frozenset(r.code for r in self.rules)
         self.baseline = baseline if baseline is not None else Baseline.empty()
         self._suppressed = 0
@@ -331,24 +332,34 @@ class Analyzer:
             kept.append(v)
         return kept
 
-    def run(self, paths: Iterable[str]) -> RunResult:
+    def _check_file_counted(self, path: str) -> tuple[list[Violation], int]:
+        """check_file plus the per-file suppression count — the unit of
+        work the parallel fan-out ships between processes. SyntaxError
+        becomes a G000 finding here so workers never raise."""
+        before = self._suppressed
+        try:
+            found = self.check_file(path)
+        except SyntaxError as e:
+            return ([_parse_error_violation(path, e)], 0)
+        return (found, self._suppressed - before)
+
+    def run(self, paths: Iterable[str], jobs: int = 1) -> RunResult:
+        """Analyze `paths`. With jobs > 1, files fan out over a process
+        pool (per-worker Analyzer rebuilt from the rule CLASSES — rule
+        instances hold unpicklable caches); the report is byte-identical
+        either way because baseline matching and the final sort happen
+        here in the parent, over the same per-file findings."""
         self._suppressed = 0
         files = list(iter_py_files(paths))
+        if jobs > 1 and len(files) > 1:
+            per_file = self._map_parallel(files, jobs)
+        else:
+            per_file = [self._check_file_counted(p) for p in files]
         failures: list[Violation] = []
         baselined: list[Violation] = []
-        for path in files:
-            try:
-                found = self.check_file(path)
-            except SyntaxError as e:
-                rel = project_rel(path)
-                failures.append(Violation(
-                    code="G000", name="parse-error", rel=rel,
-                    lineno=e.lineno or 1, col=e.offset or 0,
-                    message=f"could not parse: {e.msg}",
-                    fixit="fix the syntax error", line_text="",
-                    symbol="<module>",
-                ))
-                continue
+        suppressed = 0
+        for found, supp in per_file:
+            suppressed += supp
             for v in found:
                 if self.baseline.matches(v):
                     baselined.append(v)
@@ -357,7 +368,52 @@ class Analyzer:
         failures.sort(key=lambda v: (v.rel, v.lineno, v.col, v.code))
         return RunResult(
             violations=failures, baselined=baselined,
-            suppressed=self._suppressed,
+            suppressed=suppressed,
             stale_baseline=self.baseline.stale(),
             files_checked=len(files),
         )
+
+    def _map_parallel(self, files: list[str],
+                      jobs: int) -> list[tuple[list[Violation], int]]:
+        import concurrent.futures as cf
+
+        workers = max(2, min(jobs, len(files)))
+        # big-ish chunks amortize the per-task IPC AND let the per-worker
+        # rule caches (the G018 scope graph, the shared module loader)
+        # serve several files per round trip
+        chunk = max(1, len(files) // (workers * 4))
+        try:
+            with cf.ProcessPoolExecutor(
+                    max_workers=workers, initializer=_pool_init,
+                    initargs=(tuple(self._rule_classes),)) as ex:
+                return list(ex.map(_pool_check, files, chunksize=chunk))
+        except Exception:
+            # no usable multiprocessing (sandboxed container, unpicklable
+            # test-local rule subclass, broken pool): the serial path is
+            # always correct, and a genuine rule crash reproduces there
+            return [self._check_file_counted(p) for p in files]
+
+
+def _parse_error_violation(path: str, e: SyntaxError) -> Violation:
+    return Violation(
+        code="G000", name="parse-error", rel=project_rel(path),
+        lineno=e.lineno or 1, col=e.offset or 0,
+        message=f"could not parse: {e.msg}",
+        fixit="fix the syntax error", line_text="",
+        symbol="<module>",
+    )
+
+
+# -- process-pool plumbing (module-level: must be picklable by reference) -----
+
+_WORKER_ANALYZER: Analyzer | None = None
+
+
+def _pool_init(rule_classes: tuple[type[Rule], ...]) -> None:
+    global _WORKER_ANALYZER
+    _WORKER_ANALYZER = Analyzer(rules=rule_classes)
+
+
+def _pool_check(path: str) -> tuple[list[Violation], int]:
+    assert _WORKER_ANALYZER is not None
+    return _WORKER_ANALYZER._check_file_counted(path)

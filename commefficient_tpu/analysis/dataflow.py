@@ -1,18 +1,51 @@
-"""Lightweight intra-module dataflow for the G005/G006 rules.
+"""Shared dataflow for the flow-sensitive rules.
 
-Deliberately NOT a real dataflow framework: the two rules that need flow
-information (donation-after-use, RNG-key-reuse) both reduce to "within one
-function, order the events touching a local name and look at what happens
-between two of them". Source order is used as the event order — exact for
-straight-line code, an approximation inside branches (documented per rule;
-the repo's round-path code is straight-line where these rules bite).
+Two layers, grown in two stages:
+
+1. Lightweight intra-module event ordering for G005/G006
+   (donation-after-use, RNG-key-reuse): "within one function, order the
+   events touching a local name and look at what happens between two of
+   them". Source order is used as the event order — exact for
+   straight-line code, an approximation inside branches (documented per
+   rule; the repo's round-path code is straight-line where these rules
+   bite).
+
+2. The interprocedural substrate the concurrency rules (G018 lock-order,
+   G019 unlocked-shared-state, G020 signal-unsafe-handler) and the G001
+   taint pass stand on:
+
+   - `ModuleLoader`: parse-once cache over helper modules (keyed by
+     path+mtime+size so edited files re-parse), shared by every
+     import-following rule in one analyzer run;
+   - `import_bindings` / `package_root`: the G007/G015 import-resolution
+     machine, moved here from rules_sync so every interprocedural rule
+     resolves `from .helper import fn` / `mod.fn()` identically;
+   - `lock_bindings` / `flow_events`: discover `threading.Lock()/RLock()/
+     Condition()` bindings (module-level names and `self._x` instance
+     attributes) and walk a module emitting acquire/call/mutate events
+     annotated with WHICH declared locks are held at that point
+     (`with`-statement tracking; a nested `def` resets the held set —
+     its body runs later, on whatever thread calls it);
+   - `local_call_targets`: the shared same-module call resolver
+     (nested-first Name lookup, self/cls method dispatch, and
+     unique-match `obj.m()` resolution guarded by a generic-name
+     denylist);
+   - `tainted_names` / `expr_tainted`: fixed-point argument-taint
+     propagation that deliberately does NOT flow through `.shape`/
+     `.dtype`/`.ndim`/`.size`/`len()` — static metadata is host-safe
+     even on traced values.
+
+Still pure `ast`: nothing here imports the analyzed code.
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import os
 from typing import Iterator
+
+from .core import PACKAGE, SourceFile, project_rel
 
 Pos = tuple[int, int]  # (lineno, col_offset) — source-order event position
 
@@ -113,3 +146,475 @@ def int_or_tuple_literal(node: ast.expr) -> tuple[int, ...] | None:
             vals.append(elt.value)
         return tuple(vals)
     return None
+
+
+# == interprocedural substrate (G018/G019/G020 + the G001 taint pass) =========
+
+
+class ModuleLoader:
+    """Parse-once cache over helper modules for the import-following rules.
+
+    Keyed by (abspath, mtime_ns, size) so a file edited between runs (the
+    tempfile-rewrite pattern the directive tests use) re-parses, while the
+    forty-odd serve/runner/obs modules the concurrency rules sweep parse
+    exactly once per process. Unreadable/unparsable modules cache as None —
+    out of static reach, never an error."""
+
+    def __init__(self) -> None:
+        self._cache: dict[str, tuple[tuple, SourceFile | None]] = {}
+
+    def load(self, path: str) -> SourceFile | None:
+        apath = os.path.abspath(path)
+        try:
+            st = os.stat(apath)
+        except OSError:
+            return None
+        key = (st.st_mtime_ns, st.st_size)
+        hit = self._cache.get(apath)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        src: SourceFile | None = None
+        try:
+            with open(apath, encoding="utf-8") as f:
+                text = f.read()
+            src = SourceFile(apath, project_rel(apath), text, _valid_codes())
+        except (OSError, SyntaxError, ValueError):
+            src = None
+        self._cache[apath] = (key, src)
+        return src
+
+
+def _valid_codes() -> frozenset[str]:
+    # late import: the package __init__ imports rule modules which import us
+    from . import RULE_CODES
+
+    return frozenset(RULE_CODES)
+
+
+# one shared loader per process: the concurrency rules all sweep the same
+# serve/runner/obs files, and parallel workers each get their own copy
+LOADER = ModuleLoader()
+
+
+def package_root(start: str) -> str | None:
+    """Nearest ancestor directory CONTAINING the package dir — resolves
+    absolute `commefficient_tpu.*` imports from real modules and from
+    fixture files living outside the package tree alike."""
+    cur = os.path.dirname(os.path.abspath(start))
+    for _ in range(12):
+        if os.path.isdir(os.path.join(cur, PACKAGE)):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return None
+        cur = nxt
+    return None
+
+
+def import_bindings(src: SourceFile) -> dict[str, tuple[str, str]]:
+    """name -> (module file path, target) for every import that resolves to
+    a file we can statically follow: target is a function name for
+    `from .mod import fn`, or the sentinel "*module*" for module bindings
+    (`from . import mod`, `import pkg.mod as m`) whose attributes are
+    resolved at the call site. Relative imports resolve against the file's
+    REAL directory (which makes fixture-local helper modules work); absolute
+    imports resolve only within this package."""
+    out: dict[str, tuple[str, str]] = {}
+    here = os.path.dirname(os.path.abspath(src.path))
+
+    def module_base(level: int, module: str | None) -> str | None:
+        if level > 0:
+            base = here
+            for _ in range(level - 1):
+                base = os.path.dirname(base)
+        else:
+            if not module or module.split(".")[0] != PACKAGE:
+                return None
+            root = package_root(src.path)
+            if root is None:
+                return None
+            base = root
+        if module:
+            base = os.path.join(base, *module.split("."))
+        return base
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom):
+            base = module_base(node.level, node.module)
+            if base is None:
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                bound = a.asname or a.name
+                sub = os.path.join(base, a.name + ".py")
+                mod_file = base + ".py"
+                pkg_init = os.path.join(base, "__init__.py")
+                if os.path.isfile(sub):
+                    out[bound] = (sub, "*module*")
+                elif os.path.isfile(mod_file):
+                    out[bound] = (mod_file, a.name)
+                elif os.path.isfile(pkg_init):
+                    out[bound] = (pkg_init, a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                parts = a.name.split(".")
+                if parts[0] != PACKAGE:
+                    continue  # stdlib/third-party: per-rule tables cover it
+                root = package_root(src.path)
+                if root is None:
+                    continue
+                mod_file = os.path.join(root, *parts) + ".py"
+                pkg_init = os.path.join(root, *parts, "__init__.py")
+                bound = a.asname or parts[0]
+                if a.asname is None:
+                    continue  # dotted access via the bare package name is
+                    # not a call-site shape resolve_dotted feeds us
+                if os.path.isfile(mod_file):
+                    out[bound] = (mod_file, "*module*")
+                elif os.path.isfile(pkg_init):
+                    out[bound] = (pkg_init, "*module*")
+    return out
+
+
+# -- lock bindings and held-lock flow -----------------------------------------
+
+# constructors whose result is a held-via-`with` synchronization primitive;
+# the kind decides reentrancy (G020 exempts RLock) and is named in reports
+LOCK_FACTORIES = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+    "multiprocessing.Lock": "Lock",
+    "multiprocessing.RLock": "RLock",
+    "multiprocessing.Condition": "Condition",
+}
+
+REENTRANT_KINDS = ("RLock",)
+
+
+@dataclasses.dataclass(frozen=True)
+class LockBinding:
+    """One discovered lock/condition binding.
+
+    `key` is globally unique across a scope sweep: "{rel}:{Class}.{attr}"
+    for instance attributes (`self._cv = threading.Condition()` in class C
+    -> "serve/ingest.py:C._cv"), "{rel}:{NAME}" for module-level names.
+    `order_name`, when declared via `# graftlint: lock-order <name>` on or
+    above the binding assignment, places the lock in the sanctioned global
+    acquisition order (names compare lexicographically)."""
+
+    key: str
+    kind: str
+    rel: str
+    lineno: int
+    attr: str
+    order_name: str | None
+
+
+def _marker_above(lines: dict[int, str] | set[int], src: SourceFile,
+                  lineno: int):
+    """Directive marker attached to `lineno`: on the line itself or in the
+    contiguous comment block directly above (the def-marker convention)."""
+    cand = [lineno]
+    ln = lineno - 1
+    while ln >= 1 and src.line(ln).lstrip().startswith("#"):
+        cand.append(ln)
+        ln -= 1
+    if isinstance(lines, dict):
+        for c in cand:
+            if c in lines:
+                return lines[c]
+        return None
+    return any(c in lines for c in cand)
+
+
+def lock_bindings(src: SourceFile) -> dict[str, LockBinding]:
+    """Every lock/condition binding assignment in the module, keyed by the
+    lookup key `flow_events` emits (see LockBinding.key)."""
+    out: dict[str, LockBinding] = {}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        kind = LOCK_FACTORIES.get(src.resolve_dotted(value.func) or "")
+        if kind is None:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else \
+            [node.target]
+        order = _marker_above(src.directives.lock_order_names, src,
+                              node.lineno)
+        for t in targets:
+            key = attr = None
+            if isinstance(t, ast.Name):
+                if src.enclosing_symbol(node.lineno) == "<module>":
+                    key, attr = f"{src.rel}:{t.id}", t.id
+            elif (isinstance(t, ast.Attribute)
+                  and isinstance(t.value, ast.Name)
+                  and t.value.id in ("self", "cls")):
+                qual = src.enclosing_symbol(node.lineno)
+                if "." in qual:  # a method: the class is the prefix
+                    cls = qual.rsplit(".", 1)[0]
+                    key, attr = f"{src.rel}:{cls}.{t.attr}", t.attr
+            if key is not None:
+                out[key] = LockBinding(key, kind, src.rel, node.lineno,
+                                       attr, order)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class FlowEvent:
+    """One acquire/call/mutate event with the held-lock context.
+
+    `held` is the tuple of lock-binding keys held (outermost first) when
+    the event fires; `symbol` the enclosing function qualname (matching
+    SourceFile.functions) or '<module>'. For "mutate", `key` is the
+    attribute key "{rel}:{Class}.{attr}"; for "acquire" the lock key; for
+    "call" it is empty — the rule resolves the callee from `node`."""
+
+    kind: str
+    key: str
+    node: ast.AST
+    held: tuple[str, ...]
+    symbol: str
+
+
+def _lock_expr_key(node: ast.expr, cls: str | None, rel: str) -> str | None:
+    if isinstance(node, ast.Name):
+        return f"{rel}:{node.id}"
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls") and cls is not None):
+        return f"{rel}:{cls}.{node.attr}"
+    return None
+
+
+def _mutate_key(target: ast.expr, cls: str | None, rel: str) -> str | None:
+    """Attribute key a store/del target mutates: `self.x = ...`,
+    `self.x += 1`, `self.buf[i] = v` (a store through the subscript still
+    mutates the shared object behind self.buf). Plain-name and non-self
+    targets are out of scope — G019 is about instance state shared across
+    thread roots."""
+    base = target
+    while isinstance(base, ast.Subscript):
+        base = base.value
+    if (isinstance(base, ast.Attribute) and isinstance(base.value, ast.Name)
+            and base.value.id in ("self", "cls") and cls is not None):
+        return f"{rel}:{cls}.{base.attr}"
+    return None
+
+
+def flow_events(src: SourceFile,
+                bindings: dict[str, LockBinding]) -> list[FlowEvent]:
+    """Walk the module emitting acquire/call/mutate events annotated with
+    the locks held at each point. `with lock:` tracking only — the repo
+    idiom; bare .acquire()/.release() pairs are per-rule concerns. A nested
+    def/lambda resets the held set: its body runs later, on whatever thread
+    calls it, not under the locks lexically surrounding the definition."""
+    events: list[FlowEvent] = []
+
+    def walk(node: ast.AST, qual: str, cls: str | None,
+             held: list[str], symbol: str) -> None:
+        if isinstance(node, ast.ClassDef):
+            for c in ast.iter_child_nodes(node):
+                walk(c, f"{qual}{node.name}.", node.name, held, symbol)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fq = f"{qual}{node.name}"
+            for c in ast.iter_child_nodes(node):
+                walk(c, f"{fq}.", cls, [], fq)
+            return
+        if isinstance(node, ast.Lambda):
+            walk(node.body, qual, cls, [], symbol)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                key = _lock_expr_key(item.context_expr, cls, src.rel)
+                if key is not None and key in bindings:
+                    events.append(FlowEvent("acquire", key,
+                                            item.context_expr,
+                                            tuple(inner), symbol))
+                    inner = inner + [key]
+                else:
+                    walk(item.context_expr, qual, cls, inner, symbol)
+            for c in node.body:
+                walk(c, qual, cls, inner, symbol)
+            return
+        if isinstance(node, ast.Call):
+            events.append(FlowEvent("call", "", node, tuple(held), symbol))
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                               ast.Delete)):
+            targets = (node.targets if isinstance(node, (ast.Assign,
+                                                         ast.Delete))
+                       else [node.target])
+            for t in targets:
+                mk = _mutate_key(t, cls, src.rel)
+                if mk is not None:
+                    events.append(FlowEvent("mutate", mk, t, tuple(held),
+                                            symbol))
+        for c in ast.iter_child_nodes(node):
+            walk(c, qual, cls, held, symbol)
+
+    for child in ast.iter_child_nodes(src.tree):
+        walk(child, "", None, [], "<module>")
+    return events
+
+
+# -- shared same-module call resolution ---------------------------------------
+
+# method names too generic to resolve by-name through an arbitrary receiver:
+# `q.put()` matching a local method `put` would wire unrelated code together
+GENERIC_CALL_NAMES = frozenset({
+    "get", "set", "put", "append", "pop", "close", "open", "send", "recv",
+    "read", "write", "start", "stop", "run", "join", "items", "keys",
+    "values", "update", "add", "remove", "clear", "copy", "next", "submit",
+    "wait", "notify", "notify_all", "acquire", "release", "encode",
+    "decode", "split", "strip", "format", "flush", "seek", "tell",
+})
+
+
+def functions_by_last(src: SourceFile) -> dict[str, set[str]]:
+    """last-name-segment -> qualnames, the lookup table local resolution
+    keys on."""
+    out: dict[str, set[str]] = {}
+    for f in src.functions:
+        out.setdefault(f.qualname.rsplit(".", 1)[-1], set()).add(f.qualname)
+    return out
+
+
+def local_call_targets(src: SourceFile, node: ast.Call, caller: str,
+                       by_last: dict[str, set[str]]) -> set[str]:
+    """Same-module qualnames a call may dispatch to. Name calls prefer a
+    nested function of the caller; `self.m()`/`cls.m()` prefers methods of
+    the caller's own class, else any method named m; `obj.m()` through a
+    plain local name resolves only on a UNIQUE match with a non-generic
+    name (the honest limit of by-name dispatch)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        cands = by_last.get(fn.id, set())
+        if not cands:
+            return set()
+        nested = {q for q in cands if q.startswith(f"{caller}.")}
+        # a Name call cannot dispatch to a method that needs a receiver
+        flat = {q for q in cands if "." not in q}
+        return nested or flat or set()
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        cands = by_last.get(fn.attr, set())
+        if not cands:
+            return set()
+        if fn.value.id in ("self", "cls"):
+            if "." in caller:
+                own = caller.rsplit(".", 1)[0]
+                same_cls = {q for q in cands
+                            if q.rsplit(".", 1)[0] == own}
+                if same_cls:
+                    return same_cls
+            return {q for q in cands if "." in q} or cands
+        # plain receiver: only a unique, distinctive name is trustworthy
+        if (fn.attr not in GENERIC_CALL_NAMES and len(cands) == 1
+                and fn.value.id not in src.module_aliases):
+            return cands
+    return set()
+
+
+def import_call_target(src: SourceFile, node: ast.Call,
+                       imports: dict[str, tuple[str, str]],
+                       ) -> tuple[str, str] | None:
+    """(module path, function name) for a call that resolves through the
+    file's import bindings — `fn()` from `from .mod import fn`, `mod.fn()`
+    from `from . import mod` — or None."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        tgt = imports.get(fn.id)
+        if tgt is not None and tgt[1] != "*module*":
+            return tgt
+        return None
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        mod = imports.get(fn.value.id)
+        if mod is not None and mod[1] == "*module*":
+            return (mod[0], fn.attr)
+    return None
+
+
+# -- argument-taint propagation (the G001 interprocedural pass) ---------------
+
+# attribute reads that yield STATIC metadata, host-safe even on a traced
+# array — taint must not flow through them (float(x.shape[0]) is fine)
+METADATA_ATTRS = frozenset({"shape", "dtype", "ndim", "size"})
+
+
+def expr_tainted(node: ast.expr, tainted: set[str]) -> bool:
+    """Is the value of `node` derived from a tainted name? Structural
+    recursion, NOT ast.walk: `.shape`/`.dtype`/`.ndim`/`.size` access and
+    `len()` launder taint (static metadata), which a flat walk over Names
+    could not express."""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in METADATA_ATTRS:
+            return False
+        return expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "len":
+            return False
+        if isinstance(fn, ast.Attribute) and expr_tainted(fn.value, tainted):
+            return True  # method result on a tainted receiver
+        args = list(node.args) + [k.value for k in node.keywords]
+        return any(expr_tainted(a, tainted) for a in args)
+    if isinstance(node, ast.Starred):
+        return expr_tainted(node.value, tainted)
+    return any(expr_tainted(c, tainted)
+               for c in ast.iter_child_nodes(node)
+               if isinstance(c, ast.expr))
+
+
+def tainted_names(func: ast.AST, seeds: set[str]) -> set[str]:
+    """Fixed point of local names derived from `seeds` within `func` (own
+    body only — nested defs are their own scope). Assignments, augmented
+    assignments, for-targets and with-as bindings propagate; metadata
+    reads and len() do not (see expr_tainted)."""
+    tainted = set(seeds)
+    changed = True
+    while changed:
+        changed = False
+        for node in walk_in_function(func):
+            pairs: list[tuple[list[ast.expr], ast.expr]] = []
+            if isinstance(node, ast.Assign):
+                pairs.append((node.targets, node.value))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                pairs.append(([node.target], node.value))
+            elif isinstance(node, ast.AugAssign):
+                pairs.append(([node.target], node.value))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                pairs.append(([node.target], node.iter))
+            elif isinstance(node, ast.withitem):
+                if node.optional_vars is not None:
+                    pairs.append(([node.optional_vars], node.context_expr))
+            for targets, value in pairs:
+                if not expr_tainted(value, tainted):
+                    continue
+                for t in targets:
+                    for n in ast.walk(t):
+                        if (isinstance(n, ast.Name)
+                                and isinstance(n.ctx, ast.Store)
+                                and n.id not in tainted):
+                            tainted.add(n.id)
+                            changed = True
+    return tainted
+
+
+def param_names(func: ast.AST) -> list[str]:
+    """Positional-or-keyword parameter names of a def, self/cls excluded
+    (the taint seeds and the call-site binding order)."""
+    if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    a = func.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names

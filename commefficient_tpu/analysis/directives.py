@@ -30,6 +30,22 @@ Three forms, all line-anchored comments:
                                          fast-path scope that may copy a
                                          per-submission table (into its
                                          pinned ring slot)
+    # graftlint: lock-order <name>       on/above a lock-binding assignment
+                                         (`self._cv = threading.Condition()`):
+                                         gives the lock a name in the declared
+                                         GLOBAL acquisition order — names sort
+                                         lexicographically (the convention is
+                                         an `l0-`/`l1-`/... prefix), and G018
+                                         sanctions an edge A->B exactly when
+                                         both locks are named and
+                                         name(A) < name(B)
+    # graftlint: lockfree <why>          on/above an assignment to an
+                                         attribute: this shared attribute is
+                                         DELIBERATELY mutated without a lock
+                                         (GIL-atomic flag, monotonic counter)
+                                         — G019 exempt; the <why> is required
+                                         prose, reviewed like a disable
+                                         justification
     # graftlint: module=<relpath>        fixture support: analyze this file as
                                          if it lived at <relpath> (scoped rules
                                          fire on test snippets)
@@ -54,6 +70,8 @@ _DIRECTIVE_RE = re.compile(r"#\s*graftlint:\s*(?P<body>[^#]*)")
 _CODE_RE = re.compile(r"^G\d{3}$")
 # separators that end the code list and start a free-form justification
 _JUSTIFICATION_SPLIT = re.compile(r"\s+(?:—|--)\s+")
+# a declared lock-order name: one token, lexicographically comparable
+_ORDER_NAME_RE = re.compile(r"^[A-Za-z0-9_.-]+$")
 
 
 @dataclasses.dataclass
@@ -84,6 +102,12 @@ class Directives:
     # linenos carrying a ring-write marker (G016's sanctioned per-
     # submission copy site — serve.ring.RingSlot.write)
     ring_write_linenos: set[int]
+    # lineno -> declared lock-order name (G018's sanctioned global order;
+    # names compare lexicographically)
+    lock_order_names: dict[int, str]
+    # linenos carrying a lockfree marker (G019's declared deliberately-
+    # unlocked shared attributes)
+    lockfree_linenos: set[int]
     # fixture impersonation path, or None
     module_override: str | None
     # (lineno, message) for malformed directives — surfaced as G000
@@ -140,6 +164,7 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
         sketch_boundary_linenos=set(), payload_boundary_linenos=set(),
         robust_merge_linenos=set(), staleness_fold_linenos=set(),
         ledger_commit_linenos=set(), ring_write_linenos=set(),
+        lock_order_names={}, lockfree_linenos=set(),
         module_override=None, errors=[],
     )
     for lineno, line in _comments(text):
@@ -148,8 +173,9 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
             continue
         body = m.group("body").strip()
         verb, has_eq, arg = body.partition("=")
+        raw_verb = verb.strip()
         # a justification may trail the verb itself ("drain-point — why")
-        verb = _JUSTIFICATION_SPLIT.split(verb.strip(), maxsplit=1)[0].strip()
+        verb = _JUSTIFICATION_SPLIT.split(raw_verb, maxsplit=1)[0].strip()
         if verb == "disable" and has_eq:
             codes = _parse_codes(arg, lineno, valid_codes, d.errors)
             if codes:
@@ -171,6 +197,31 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
             d.ledger_commit_linenos.add(lineno)
         elif verb == "ring-write" and not has_eq:
             d.ring_write_linenos.add(lineno)
+        elif verb.split(None, 1)[0:1] == ["lock-order"] and not has_eq:
+            # "lock-order <name>": the name is one token; what follows is
+            # free-form (same convention as a disable justification)
+            words = verb.split()
+            if len(words) < 2 or not _ORDER_NAME_RE.match(words[1]):
+                d.errors.append((
+                    lineno,
+                    "lock-order directive needs a name token "
+                    "([A-Za-z0-9_.-]+): `# graftlint: lock-order l0-queue`",
+                ))
+            else:
+                d.lock_order_names[lineno] = words[1]
+        elif verb.split(None, 1)[0:1] == ["lockfree"] and not has_eq:
+            # "lockfree <why>": the why is required prose — an undocumented
+            # lockfree claim is exactly the rot this directive exists to
+            # prevent. Checked against raw_verb: a why introduced with the
+            # `—` justification separator still counts.
+            if len(raw_verb.split(None, 1)) < 2:
+                d.errors.append((
+                    lineno,
+                    "lockfree directive needs a justification: "
+                    "`# graftlint: lockfree monotonic counter, GIL-atomic`",
+                ))
+            else:
+                d.lockfree_linenos.add(lineno)
         elif verb == "module" and has_eq:
             d.module_override = arg.strip()
         elif not verb:
@@ -181,6 +232,7 @@ def parse(text: str, valid_codes: frozenset[str]) -> Directives:
                 f"unknown graftlint directive {verb!r} "
                 "(expected disable/disable-file/drain-point/"
                 "sketch-boundary/payload-boundary/robust-merge/"
-                "staleness-fold/ledger-commit/ring-write/module)",
+                "staleness-fold/ledger-commit/ring-write/lock-order/"
+                "lockfree/module)",
             ))
     return d

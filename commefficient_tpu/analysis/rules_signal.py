@@ -1,0 +1,221 @@
+"""G020 signal-unsafe-handler.
+
+A Python signal handler runs BETWEEN bytecodes of whatever the main thread
+was doing. If the interrupted code holds a non-reentrant lock the handler
+then tries to take, the process deadlocks against itself; if it was
+mid-write to a JSONL sink, the handler's own write interleaves into the
+same buffered stream. PR 7 established the discipline by convention —
+SIGTERM handlers set an Event / write to stderr and use the tracer's
+`instant_signal_safe` (best-effort, lock-skipping) emit, never `instant` —
+and this rule machine-enforces it.
+
+Detection: every handler expression registered via `signal.signal(...)`
+(a module function, a bound `self._method`, an imported helper, or an
+inline lambda) is resolved through the shared dataflow call machinery and
+its reachable body (same-module calls + import bindings, depth-bounded)
+may not:
+
+- acquire a NON-REENTRANT lock binding (`with self._lock:` /
+  `lock.acquire()` on a Lock/Condition/Semaphore — RLock is exempt: the
+  tracer serializes its signal-safe path on one reentrantly);
+- perform file IO (`open()`);
+- call the buffered JSONL sinks (`.instant(...)`, `.append_round(...)` —
+  exact attribute match, so `instant_signal_safe` stays sanctioned).
+
+Violations are reported at the registration site: that is the line that
+turned an ordinary function into signal-context code. Handler expressions
+beyond static reach (restoring a saved previous handler, `signal.SIG_DFL`)
+are skipped silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from . import dataflow
+from .core import Rule, SourceFile, Violation
+
+# reachability bound from the registered handler, in call hops
+_MAX_DEPTH = 4
+
+# buffered JSONL sink methods (exact attribute names): TableLogger.append
+# is host-side but takes the table lock; Tracer.instant and
+# RoundLedger.append_round write line-buffered JSONL under a lock
+_SINK_ATTRS = {
+    "instant": "use instant_signal_safe — the lock-skipping tracer emit",
+    "append_round": "the round ledger is a buffered, locked JSONL sink",
+    "append_jsonl": "buffered JSONL writes interleave under a signal",
+}
+
+
+class SignalUnsafeHandler(Rule):
+    code = "G020"
+    name = "signal-unsafe-handler"
+    fixit = ("a handler may set an Event/flag, write to stderr, or call "
+             "instant_signal_safe; move lock-taking and IO to the code "
+             "that OBSERVES the flag")
+
+    def __init__(self) -> None:
+        self._infos: dict[str, tuple | None] = {}
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        out: list[Violation] = []
+        regs = [n for n in ast.walk(src.tree)
+                if isinstance(n, ast.Call)
+                and src.resolve_dotted(n.func) == "signal.signal"
+                and len(n.args) >= 2]
+        if not regs:
+            return out
+        info = self._module_info(src)
+        for reg in regs:
+            handler = reg.args[1]
+            if isinstance(handler, ast.Lambda):
+                hit = self._scan_body(src, info, handler,
+                                      symbol=src.enclosing_symbol(
+                                          handler.lineno),
+                                      depth=0, seen=set())
+                if hit:
+                    out.append(self.violation(
+                        src, reg, f"signal handler (lambda) {hit}"))
+                continue
+            for path, qual in self._handler_targets(src, info, handler):
+                hit = self._unsafe_in(path, qual, 0, set())
+                if hit:
+                    out.append(self.violation(
+                        src, reg, f"signal handler {qual}() {hit}"))
+                    break
+        return out
+
+    # -- handler resolution ----------------------------------------------------
+
+    def _handler_targets(self, src: SourceFile, info,
+                         handler: ast.expr) -> list[tuple[str, str]]:
+        by_last, imports = info[1], info[2]
+        apath = os.path.abspath(src.path)
+        if isinstance(handler, ast.Name):
+            local = {q for q in by_last.get(handler.id, ()) if "." not in q}
+            if local:
+                return [(apath, q) for q in sorted(local)]
+            tgt = imports.get(handler.id)
+            if tgt is not None and tgt[1] != "*module*":
+                return [tgt]
+            return []
+        if (isinstance(handler, ast.Attribute)
+                and isinstance(handler.value, ast.Name)):
+            if handler.value.id in ("self", "cls"):
+                cands = {q for q in by_last.get(handler.attr, ())
+                         if "." in q}
+                qual = src.enclosing_symbol(handler.lineno)
+                if "." in qual:
+                    own = qual.rsplit(".", 1)[0]
+                    same = {q for q in cands if q.rsplit(".", 1)[0] == own}
+                    cands = same or cands
+                return [(apath, q) for q in sorted(cands)]
+            mod = imports.get(handler.value.id)
+            if mod is not None and mod[1] == "*module*":
+                return [(mod[0], handler.attr)]
+        return []  # SIG_DFL, a saved previous handler: out of static reach
+
+    # -- unsafe scan -----------------------------------------------------------
+
+    def _module_info(self, src: SourceFile):
+        """(bindings, by_last, imports, events_by_symbol) for a module."""
+        apath = os.path.abspath(src.path)
+        cached = self._infos.get(apath)
+        if cached is not None:
+            return cached
+        bindings = dataflow.lock_bindings(src)
+        by_last = dataflow.functions_by_last(src)
+        imports = dataflow.import_bindings(src)
+        events: dict[str, list] = {}
+        for e in dataflow.flow_events(src, bindings):
+            events.setdefault(e.symbol, []).append(e)
+        info = (bindings, by_last, imports, events, src)
+        self._infos[apath] = info
+        return info
+
+    def _unsafe_in(self, path: str, qual: str, depth: int,
+                   seen: set) -> str | None:
+        if depth > _MAX_DEPTH or (path, qual) in seen:
+            return None
+        seen.add((path, qual))
+        src = dataflow.LOADER.load(path)
+        if src is None:
+            return None
+        info = self._module_info(src)
+        bindings, by_last, imports, events, _ = info
+        for e in events.get(qual, ()):
+            if src.directives.disabled(self.code, e.node.lineno):
+                continue
+            if e.kind == "acquire":
+                b = bindings[e.key]
+                if b.kind not in dataflow.REENTRANT_KINDS:
+                    return (f"acquires non-reentrant {b.kind} {b.attr} "
+                            f"({src.rel}:{e.node.lineno}) — deadlocks if "
+                            "the interrupted code holds it")
+                continue
+            if e.kind != "call":
+                continue
+            hit = self._unsafe_call(src, bindings, e.node)
+            if hit:
+                return f"{hit} ({src.rel}:{e.node.lineno})"
+            for npath, nqual in self._call_targets(src, info, e):
+                hit = self._unsafe_in(npath, nqual, depth + 1, seen)
+                if hit:
+                    return hit
+        return None
+
+    def _unsafe_call(self, src: SourceFile, bindings: dict,
+                     node: ast.Call) -> str | None:
+        fn = node.func
+        dotted = src.resolve_dotted(fn)
+        if dotted == "open":
+            return "performs file IO via open()"
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _SINK_ATTRS:
+                return (f"calls the JSONL sink .{fn.attr}() — "
+                        f"{_SINK_ATTRS[fn.attr]}")
+            if fn.attr == "acquire":
+                sym = src.enclosing_symbol(node.lineno)
+                cls = sym.rsplit(".", 1)[0] if "." in sym else None
+                key = dataflow._lock_expr_key(fn.value, cls, src.rel)
+                b = bindings.get(key) if key else None
+                if b is not None and b.kind not in dataflow.REENTRANT_KINDS:
+                    return (f"acquires non-reentrant {b.kind} {b.attr} — "
+                            "deadlocks if the interrupted code holds it")
+        return None
+
+    def _call_targets(self, src: SourceFile, info, event):
+        _, by_last, imports, _, _ = info
+        out = [(os.path.abspath(src.path), q)
+               for q in sorted(dataflow.local_call_targets(
+                   src, event.node, event.symbol, by_last))]
+        tgt = dataflow.import_call_target(src, event.node, imports)
+        if tgt is not None:
+            out.append((os.path.abspath(tgt[0]), tgt[1]))
+        return out
+
+    def _scan_body(self, src: SourceFile, info, lam: ast.Lambda,
+                   symbol: str, depth: int, seen: set) -> str | None:
+        """Inline-lambda handler: scan its body the same way, charged to
+        the registration site's module."""
+        bindings = info[0]
+        for node in ast.walk(lam.body):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = self._unsafe_call(src, bindings, node)
+            if hit:
+                return hit
+            for npath, nqual in self._call_targets(
+                    src, info, _FakeEvent(node, symbol)):
+                hit = self._unsafe_in(npath, nqual, depth + 1, seen)
+                if hit:
+                    return hit
+        return None
+
+
+class _FakeEvent:
+    def __init__(self, node: ast.Call, symbol: str):
+        self.node = node
+        self.symbol = symbol

@@ -20,8 +20,8 @@ declares its sanctioned blocking points in code).
 from __future__ import annotations
 
 import ast
-import os
 
+from . import dataflow
 from .core import PACKAGE, Rule, SourceFile, Violation
 
 # modules where ANY value may be a traced array, so float()/bool() on a
@@ -61,6 +61,15 @@ class HostSyncInRoundPath(Rule):
         f"{PACKAGE}/serve/pipeline.py",
     )
 
+    # the interprocedural taint pass (PR 20): `float(x)` smuggled behind a
+    # helper call fires too. Subclassable off so the regression test can
+    # demonstrate exactly what the pre-taint syntactic rule missed.
+    taint_pass = True
+
+    # hops of helper-call indirection the taint pass follows before giving
+    # up (a coercion buried deeper is beyond honest static reach)
+    _MAX_TAINT_DEPTH = 3
+
     def applies(self, rel: str) -> bool:
         return rel.startswith(self.SCOPE) or rel in self.EXACT
 
@@ -75,7 +84,130 @@ class HostSyncInRoundPath(Rule):
             hit = self._classify(src, node, compiled)
             if hit:
                 out.append(self.violation(src, node, hit))
+        if compiled and self.taint_pass:
+            out.extend(self._taint_findings(src))
         return out
+
+    # -- interprocedural taint -------------------------------------------------
+
+    def _taint_findings(self, src: SourceFile) -> list[Violation]:
+        """float()/bool()/int() on a traced value HIDDEN BEHIND a helper
+        call: every parameter of a compiled-scope function is a potential
+        tracer, so an argument derived from one that flows into an
+        out-of-scope helper which coerces it is the same hidden sync as an
+        inline float() — reported at the call site that smuggles it."""
+        imports = dataflow.import_bindings(src)
+        if not imports:
+            return []
+        out: list[Violation] = []
+        for fnode in ast.walk(src.tree):
+            if not isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            seeds = set(dataflow.param_names(fnode))
+            if not seeds:
+                continue
+            tainted = dataflow.tainted_names(fnode, seeds)
+            for call in dataflow.walk_in_function(fnode):
+                if not isinstance(call, ast.Call):
+                    continue
+                if src.in_drain_point(call.lineno):
+                    continue
+                target = dataflow.import_call_target(src, call, imports)
+                if target is None:
+                    continue
+                passed = self._tainted_params(src, call, tainted,
+                                              target[0], target[1])
+                if not passed:
+                    continue
+                hit = self._coerced_in_helper(target[0], target[1],
+                                              passed, depth=0, seen=set())
+                if hit is not None:
+                    coercer, where = hit
+                    out.append(self.violation(
+                        src, call,
+                        f"{coercer} on a value tainted from a traced "
+                        f"parameter, hidden inside helper {target[1]}() "
+                        f"({where}) — a host sync the syntactic scan "
+                        "cannot see"))
+        return out
+
+    def _tainted_params(self, src: SourceFile, call: ast.Call,
+                        tainted: set[str], path: str,
+                        fname: str) -> frozenset[str]:
+        """Callee parameter names that receive a tainted argument."""
+        helper = dataflow.LOADER.load(path)
+        if helper is None:
+            return frozenset()
+        fdef = _find_def(helper, fname)
+        if fdef is None:
+            return frozenset()
+        params = dataflow.param_names(fdef)
+        hit: set[str] = set()
+        for i, arg in enumerate(call.args):
+            if i < len(params) and dataflow.expr_tainted(arg, tainted):
+                hit.add(params[i])
+        for kw in call.keywords:
+            if (kw.arg is not None and kw.arg in params
+                    and dataflow.expr_tainted(kw.value, tainted)):
+                hit.add(kw.arg)
+        return frozenset(hit)
+
+    def _coerced_in_helper(self, path: str, fname: str,
+                           seeds: frozenset[str], depth: int,
+                           seen: set) -> tuple[str, str] | None:
+        """Does `fname` at `path` coerce a value derived from `seeds` with
+        float()/bool()/int()? Returns (coercer, 'rel:lineno') or None.
+        Compiled-scope helpers are skipped (the syntactic rule already
+        patrols them); drain points and explicit G001 disables in the
+        helper stop the traversal, same contract as G007."""
+        key = (path, fname, seeds)
+        if depth > self._MAX_TAINT_DEPTH or key in seen:
+            return None
+        seen.add(key)
+        helper = dataflow.LOADER.load(path)
+        if helper is None or helper.rel.startswith(_COMPILED_SCOPE):
+            return None
+        fdef = _find_def(helper, fname)
+        if fdef is None:
+            return None
+        if any(f.qualname == fname and f.drain_point
+               for f in helper.functions):
+            return None  # a declared sanctioned sync boundary
+        tainted = dataflow.tainted_names(fdef, set(seeds))
+        imports = None
+        for call in dataflow.walk_in_function(fdef):
+            if not isinstance(call, ast.Call):
+                continue
+            if helper.directives.disabled(self.code, call.lineno):
+                continue
+            if helper.in_drain_point(call.lineno):
+                continue
+            if (isinstance(call.func, ast.Name)
+                    and call.func.id in ("float", "bool", "int")
+                    and len(call.args) == 1
+                    and dataflow.expr_tainted(call.args[0], tainted)):
+                return (f"{call.func.id}()",
+                        f"{helper.rel}:{call.lineno}")
+            # taint flowing one helper deeper: same-module Name call or a
+            # further import binding
+            nxt: tuple[str, str] | None = None
+            if isinstance(call.func, ast.Name) and any(
+                    f.qualname == call.func.id for f in helper.functions):
+                nxt = (path, call.func.id)
+            else:
+                if imports is None:
+                    imports = dataflow.import_bindings(helper)
+                nxt = dataflow.import_call_target(helper, call, imports)
+            if nxt is None:
+                continue
+            passed = self._tainted_params(helper, call, tainted,
+                                          nxt[0], nxt[1])
+            if passed:
+                hit = self._coerced_in_helper(nxt[0], nxt[1], passed,
+                                              depth + 1, seen)
+                if hit is not None:
+                    return hit
+        return None
 
     def _classify(self, src: SourceFile, node: ast.Call,
                   compiled: bool) -> str | None:
@@ -139,12 +271,6 @@ class BlockingCallOnDispatchThread(Rule):
     # overridable per subclass: G015 (rules_reactor.py) reuses this whole
     # reachability machine with the event loop's own roots
     ROOTS = _ROOT_NAMES
-
-    def __init__(self) -> None:
-        # per-analyzer-run cache of parsed helper modules (abspath ->
-        # SourceFile | None); reachability is package-level, so one helper
-        # may be consulted from several scoped files
-        self._helpers: dict[str, SourceFile | None] = {}
 
     def applies(self, rel: str) -> bool:
         return rel.startswith(self.SCOPE) and rel not in self.EXEMPT
@@ -265,18 +391,9 @@ class BlockingCallOnDispatchThread(Rule):
         return None
 
     def _load_helper(self, path: str) -> SourceFile | None:
-        if path in self._helpers:
-            return self._helpers[path]
-        src: SourceFile | None = None
-        try:
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            src = SourceFile(path, _helper_rel(path), text,
-                             frozenset({self.code}))
-        except (OSError, SyntaxError, ValueError):
-            src = None  # unreadable helper: out of static reach
-        self._helpers[path] = src
-        return src
+        # the shared parse cache: one SourceFile per helper per process,
+        # whichever interprocedural rule asked first
+        return dataflow.LOADER.load(path)
 
     def _reachable(self, src: SourceFile) -> set[str]:
         """Qualnames reachable from the dispatch-path roots over same-module
@@ -325,96 +442,20 @@ class BlockingCallOnDispatchThread(Rule):
         return seen
 
 
-# -- import resolution (package-level reachability) ---------------------------
-
-
-def _helper_rel(path: str) -> str:
-    """Project-relative name for a helper module (fixture helpers override
-    it with their own `# graftlint: module=`, applied by SourceFile)."""
-    from .core import project_rel
-
-    return project_rel(path)
-
-
-def _package_root(start: str) -> str | None:
-    """Nearest ancestor directory CONTAINING the package dir — resolves
-    absolute `commefficient_tpu.*` imports from real modules and from
-    fixture files living outside the package tree alike."""
-    cur = os.path.dirname(os.path.abspath(start))
-    for _ in range(12):
-        if os.path.isdir(os.path.join(cur, PACKAGE)):
-            return cur
-        nxt = os.path.dirname(cur)
-        if nxt == cur:
-            return None
-        cur = nxt
+def _find_def(helper: SourceFile,
+              fname: str) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+    """First def named `fname` in the helper (module-level functions is
+    the shape import bindings hand us; a shadowing nested def would have
+    the same body anyway for taint purposes)."""
+    for node in ast.walk(helper.tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == fname):
+            return node
     return None
 
 
-def _import_bindings(src: SourceFile) -> dict[str, tuple[str, str]]:
-    """name -> (module file path, target) for every import that resolves to
-    a file we can statically follow: target is a function name for
-    `from .mod import fn`, or the sentinel "*module*" for module bindings
-    (`from . import mod`, `import pkg.mod as m`) whose attributes are
-    resolved at the call site. Relative imports resolve against the file's
-    REAL directory (which makes fixture-local helper modules work); absolute
-    imports resolve only within this package."""
-    out: dict[str, tuple[str, str]] = {}
-    here = os.path.dirname(os.path.abspath(src.path))
-
-    def module_base(level: int, module: str | None) -> str | None:
-        if level > 0:
-            base = here
-            for _ in range(level - 1):
-                base = os.path.dirname(base)
-        else:
-            if not module or module.split(".")[0] != PACKAGE:
-                return None
-            root = _package_root(src.path)
-            if root is None:
-                return None
-            base = root
-        if module:
-            parts = module.split(".")
-            if level == 0:
-                parts = parts  # starts with PACKAGE, anchored at root
-            base = os.path.join(base, *parts)
-        return base
-
-    for node in ast.walk(src.tree):
-        if isinstance(node, ast.ImportFrom):
-            base = module_base(node.level, node.module)
-            if base is None:
-                continue
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                bound = a.asname or a.name
-                sub = os.path.join(base, a.name + ".py")
-                mod_file = base + ".py"
-                pkg_init = os.path.join(base, "__init__.py")
-                if os.path.isfile(sub):
-                    out[bound] = (sub, "*module*")
-                elif os.path.isfile(mod_file):
-                    out[bound] = (mod_file, a.name)
-                elif os.path.isfile(pkg_init):
-                    out[bound] = (pkg_init, a.name)
-        elif isinstance(node, ast.Import):
-            for a in node.names:
-                parts = a.name.split(".")
-                if parts[0] != PACKAGE:
-                    continue  # stdlib/third-party: _BLOCKING_CALLS covers it
-                root = _package_root(src.path)
-                if root is None:
-                    continue
-                mod_file = os.path.join(root, *parts) + ".py"
-                pkg_init = os.path.join(root, *parts, "__init__.py")
-                bound = a.asname or parts[0]
-                if a.asname is None:
-                    continue  # dotted access via the bare package name is
-                    # not a call-site shape resolve_dotted feeds us
-                if os.path.isfile(mod_file):
-                    out[bound] = (mod_file, "*module*")
-                elif os.path.isfile(pkg_init):
-                    out[bound] = (pkg_init, "*module*")
-    return out
+# import resolution lives in dataflow.py since the concurrency rules joined
+# (G018/G019/G020 resolve imports identically); re-exported names keep the
+# G015 subclass and the tests importing from here working
+_package_root = dataflow.package_root
+_import_bindings = dataflow.import_bindings

@@ -1,0 +1,453 @@
+"""G018 lock-order-inversion and G019 unlocked-shared-state.
+
+The host-side concurrency that carries the serving path — ingest condvars,
+the selectors reactor, the RoundPipeline worker, the checkpoint writer
+thread, transport handler threads — was enforced only by convention until
+PR 20. These two rules machine-check the conventions, on top of the
+dataflow.py interprocedural substrate (lock bindings, held-lock flow
+events, the shared call resolver).
+
+G018 builds the global lock-acquisition graph across serve/, runner/ and
+obs/: an edge A -> B is recorded whenever lock B is acquired while A is
+held — lexically (`with a: with b:`) or interprocedurally (`with a:
+helper()` where helper acquires B, followed through same-module calls and
+import bindings, depth-bounded). Two thread roots taking the same pair in
+opposite orders deadlock; statically that is a cycle in this graph, and
+every edge of a cycle is reported in the file that contains it. The
+`# graftlint: lock-order <name>` directive on a binding assignment places
+the lock in the declared global order (names compare lexicographically;
+the convention is an `l0-`/`l1-`/... prefix): an edge where both ends are
+named and name(A) < name(B) is sanctioned, name(A) > name(B) is a direct
+violation even without a completed cycle.
+
+G019 is module-local: an instance attribute mutated from two different
+thread roots must be mutated only while a common declared lock is held.
+Thread roots are derived, not annotated: every `Thread(target=f)` target
+is a root; public entry points run on the caller's thread (the "main"
+root). Lock context is the lexical `with` held-set plus the must-hold
+facts of the enclosing function (a private helper whose EVERY caller
+holds the lock inherits it — the `_locked` suffix idiom, verified instead
+of trusted). `__init__` mutations are pre-publication and exempt; an
+attribute that is DELIBERATELY lock-free (GIL-atomic flag, monotonic
+counter) carries `# graftlint: lockfree <why>` on one of its mutation
+sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from . import dataflow
+from .core import PACKAGE, Rule, SourceFile, Violation
+
+_SCOPE = (f"{PACKAGE}/serve/", f"{PACKAGE}/runner/", f"{PACKAGE}/obs/")
+
+# interprocedural hops followed when attributing lock acquisitions to a
+# call site (G018) — same spirit as G007's import-depth bound
+_MAX_CALL_DEPTH = 3
+
+
+class _ModuleInfo:
+    """Per-module facts the concurrency rules share: lock bindings, flow
+    events bucketed by enclosing function, the call-resolution tables."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.bindings = dataflow.lock_bindings(src)
+        self.events = dataflow.flow_events(src, self.bindings)
+        self.by_last = dataflow.functions_by_last(src)
+        self.imports = dataflow.import_bindings(src)
+        self.acquires: dict[str, set[str]] = {}
+        self.calls: dict[str, list] = {}
+        for e in self.events:
+            if e.kind == "acquire":
+                self.acquires.setdefault(e.symbol, set()).add(e.key)
+            elif e.kind == "call":
+                self.calls.setdefault(e.symbol, []).append(e)
+
+
+def _site_node(lineno: int, col: int) -> ast.AST:
+    node = ast.Pass()
+    node.lineno = lineno  # type: ignore[attr-defined]
+    node.col_offset = col  # type: ignore[attr-defined]
+    return node
+
+
+class LockOrderInversion(Rule):
+    code = "G018"
+    name = "lock-order-inversion"
+    fixit = ("acquire the two locks in one global order everywhere (declare "
+             "it: `# graftlint: lock-order l0-<name>` on each binding), or "
+             "narrow one critical section so the scopes never nest")
+
+    SCOPE = _SCOPE
+
+    def __init__(self) -> None:
+        # package-root -> (edges, bindings); the scope sweep parses ~40
+        # modules once per analyzer run, every checked file reuses it
+        self._graphs: dict[str, tuple[dict, dict]] = {}
+        self._infos: dict[str, _ModuleInfo | None] = {}
+        self._acq_memo: dict[tuple[str, str], frozenset[str]] = {}
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.SCOPE)
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        root = dataflow.package_root(src.path)
+        if root is not None:
+            edges, bindings = self._scope_graph(root)
+        else:
+            edges, bindings = {}, {}
+        apath = os.path.abspath(src.path)
+        if self._info(apath) is None or root is None or \
+                not apath.startswith(os.path.join(root, PACKAGE) + os.sep):
+            # a fixture impersonating a scope module: merge its own edges
+            info = _ModuleInfo(src)
+            self._infos[apath] = info
+            edges = dict(edges)
+            bindings = dict(bindings)
+            self._merge_module(info, edges, bindings)
+        return self._report(src, edges, bindings)
+
+    # -- graph construction ----------------------------------------------------
+
+    def _scope_graph(self, root: str) -> tuple[dict, dict]:
+        if root in self._graphs:
+            return self._graphs[root]
+        edges: dict[tuple[str, str], tuple[str, int, int]] = {}
+        bindings: dict[str, dataflow.LockBinding] = {}
+        for prefix in self.SCOPE:
+            top = os.path.join(root, *prefix.rstrip("/").split("/"))
+            if not os.path.isdir(top):
+                continue
+            for dirpath, dirnames, files in os.walk(top):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for f in sorted(files):
+                    if not f.endswith(".py"):
+                        continue
+                    info = self._info(os.path.join(dirpath, f))
+                    if info is not None:
+                        self._merge_module(info, edges, bindings)
+        self._graphs[root] = (edges, bindings)
+        return edges, bindings
+
+    def _merge_module(self, info: _ModuleInfo, edges: dict,
+                      bindings: dict) -> None:
+        bindings.update(info.bindings)
+        src = info.src
+        for e in info.events:
+            if not e.held:
+                continue
+            acquired: set[str] = set()
+            if e.kind == "acquire":
+                acquired.add(e.key)
+            elif e.kind == "call":
+                for callee in self._callees(info, e):
+                    acquired |= self._acquired_in(callee[0], callee[1], 0)
+            for b in acquired:
+                for a in e.held:
+                    if a == b:
+                        continue  # self-nesting: reentrancy, not ordering
+                    site = (src.rel, e.node.lineno,
+                            getattr(e.node, "col_offset", 0))
+                    prev = edges.get((a, b))
+                    if prev is None or site < prev:
+                        edges[(a, b)] = site
+
+    def _callees(self, info: _ModuleInfo, event) -> list[tuple[str, str]]:
+        """(module abspath, qualname) targets of a call event — same-module
+        resolution plus import bindings."""
+        out = [(os.path.abspath(info.src.path), q)
+               for q in dataflow.local_call_targets(
+                   info.src, event.node, event.symbol, info.by_last)]
+        tgt = dataflow.import_call_target(info.src, event.node, info.imports)
+        if tgt is not None:
+            out.append((os.path.abspath(tgt[0]), tgt[1]))
+        return out
+
+    def _acquired_in(self, path: str, qualname: str,
+                     depth: int) -> frozenset[str]:
+        """Transitive set of lock keys `qualname` acquires (its own `with`
+        blocks plus depth-bounded callees) — what a call under a held lock
+        contributes to the acquisition graph."""
+        memo_key = (path, qualname)
+        if memo_key in self._acq_memo:
+            return self._acq_memo[memo_key]
+        self._acq_memo[memo_key] = frozenset()  # cycle guard
+        info = self._info(path)
+        if info is None:
+            return frozenset()
+        out = set(info.acquires.get(qualname, ()))
+        if depth < _MAX_CALL_DEPTH:
+            for e in info.calls.get(qualname, ()):
+                for callee in self._callees(info, e):
+                    out |= self._acquired_in(callee[0], callee[1], depth + 1)
+        result = frozenset(out)
+        self._acq_memo[memo_key] = result
+        return result
+
+    def _info(self, path: str) -> _ModuleInfo | None:
+        apath = os.path.abspath(path)
+        if apath in self._infos:
+            return self._infos[apath]
+        src = dataflow.LOADER.load(apath)
+        info = _ModuleInfo(src) if src is not None else None
+        self._infos[apath] = info
+        return info
+
+    # -- reporting -------------------------------------------------------------
+
+    def _report(self, src: SourceFile, edges: dict,
+                bindings: dict) -> list[Violation]:
+        out: list[Violation] = []
+        cyclic: dict[tuple[str, str], tuple[str, int, int]] = {}
+        for (a, b), site in sorted(edges.items(), key=lambda kv: kv[1]):
+            na = bindings[a].order_name if a in bindings else None
+            nb = bindings[b].order_name if b in bindings else None
+            if na is not None and nb is not None:
+                if na < nb:
+                    continue  # the declared order — sanctioned
+                if site[0] == src.rel:
+                    out.append(self.violation(
+                        src, _site_node(site[1], site[2]),
+                        f"{_disp(bindings, b)} acquired while "
+                        f"{_disp(bindings, a)} is held — against the "
+                        f"declared lock order ({nb} sorts before {na})"))
+                continue
+            cyclic[(a, b)] = site
+        # an edge participates in a deadlock cycle iff b reaches a back
+        adj: dict[str, set[str]] = {}
+        for (a, b) in cyclic:
+            adj.setdefault(a, set()).add(b)
+        for (a, b), site in sorted(cyclic.items(), key=lambda kv: kv[1]):
+            if site[0] != src.rel:
+                continue
+            path_back = _find_path(adj, b, a)
+            if path_back is None:
+                continue
+            cycle = " -> ".join(_disp(bindings, k)
+                                for k in [a] + path_back)
+            out.append(self.violation(
+                src, _site_node(site[1], site[2]),
+                f"{_disp(bindings, b)} acquired while "
+                f"{_disp(bindings, a)} is held closes an acquisition "
+                f"cycle ({cycle}) — two threads taking these in opposite "
+                "order deadlock"))
+        return out
+
+
+def _disp(bindings: dict, key: str) -> str:
+    b = bindings.get(key)
+    if b is None:
+        return key
+    return f"{b.attr} ({b.rel}:{b.lineno})"
+
+
+def _find_path(adj: dict[str, set[str]], start: str,
+               goal: str) -> list[str] | None:
+    """Shortest node path start..goal (inclusive) over `adj`, or None."""
+    if start == goal:
+        return [start]
+    parent: dict[str, str] = {start: start}
+    frontier = [start]
+    while frontier:
+        nxt: list[str] = []
+        for cur in frontier:
+            for n in sorted(adj.get(cur, ())):
+                if n in parent:
+                    continue
+                parent[n] = cur
+                if n == goal:
+                    path = [n]
+                    while path[-1] != start:
+                        path.append(parent[path[-1]])
+                    return list(reversed(path))
+                nxt.append(n)
+        frontier = nxt
+    return None
+
+
+class UnlockedSharedState(Rule):
+    code = "G019"
+    name = "unlocked-shared-state"
+    fixit = ("mutate the attribute under the lock that every other mutation "
+             "site holds, or declare it `# graftlint: lockfree <why>` on a "
+             "mutation site if it is deliberately GIL-atomic")
+
+    SCOPE = _SCOPE
+
+    # iteration cap for the must-hold fixed point (monotone intersections
+    # over a module-local call graph converge long before this)
+    _MAX_PASSES = 12
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.SCOPE)
+
+    def check(self, src: SourceFile) -> list[Violation]:
+        info = _ModuleInfo(src)
+        targets = self._thread_targets(info)
+        labels = self._thread_labels(info, targets)
+        must_hold = self._must_hold(info, set(targets))
+        lockfree = self._lockfree_attrs(src, info)
+
+        by_attr: dict[str, list] = {}
+        for e in info.events:
+            if e.kind != "mutate" or e.symbol == "<module>":
+                continue
+            if e.symbol.rsplit(".", 1)[-1] == "__init__":
+                continue  # pre-publication: no other thread sees self yet
+            if e.key in info.bindings:
+                continue  # (re)binding the lock itself
+            by_attr.setdefault(e.key, []).append(e)
+
+        out: list[Violation] = []
+        for key in sorted(by_attr):
+            if key in lockfree:
+                continue
+            muts = by_attr[key]
+            roots: set[str] = set()
+            common: set[str] | None = None
+            for e in muts:
+                roots |= labels.get(e.symbol, frozenset({"main"}))
+                held = set(e.held) | must_hold.get(e.symbol, set())
+                common = held if common is None else (common & held)
+            if len(roots) < 2 or common:
+                continue
+            first = min(muts, key=lambda e: (e.node.lineno,
+                                             getattr(e.node, "col_offset",
+                                                     0)))
+            attr = key.rsplit(".", 1)[-1]
+            out.append(self.violation(
+                src, first.node,
+                f"self.{attr} is mutated from {len(roots)} thread roots "
+                f"({', '.join(sorted(roots))}) with no common lock held "
+                "across the mutation sites"))
+        return out
+
+    # -- thread roots ----------------------------------------------------------
+
+    def _thread_targets(self, info: _ModuleInfo) -> dict[str, str]:
+        """`Thread(target=...)` targets: function qualname -> root label.
+        `target=self._run` resolves to same-module methods named _run,
+        `target=fn` to the module-level fn."""
+        src = info.src
+        out: dict[str, str] = {}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if src.resolve_dotted(node.func) != "threading.Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                cands: set[str] = set()
+                if isinstance(kw.value, ast.Name):
+                    cands = {q for q in info.by_last.get(kw.value.id, ())
+                             if "." not in q}
+                elif (isinstance(kw.value, ast.Attribute)
+                      and isinstance(kw.value.value, ast.Name)
+                      and kw.value.value.id in ("self", "cls")):
+                    cands = {q for q in info.by_last.get(kw.value.attr, ())
+                             if "." in q}
+                for q in cands:
+                    out[q] = f"thread({q.rsplit('.', 1)[-1]})"
+        return out
+
+    def _thread_labels(self, info: _ModuleInfo,
+                       targets: dict[str, str]) -> dict[str, frozenset[str]]:
+        """function qualname -> thread-root labels. `Thread(target=f)`
+        targets seed their own label; public entry points (and module-level
+        calls' targets) seed "main" — the caller's thread. Labels propagate
+        along module-local call edges and into nested functions; a function
+        nothing reaches defaults to "main" at lookup time."""
+        src = info.src
+        seeds: dict[str, set[str]] = {q: {label}
+                                      for q, label in targets.items()}
+        thread_targets = set(seeds)
+        for f in src.functions:
+            last = f.qualname.rsplit(".", 1)[-1]
+            if f.qualname in thread_targets:
+                continue
+            if not last.startswith("_") or (last.startswith("__")
+                                            and last.endswith("__")):
+                seeds.setdefault(f.qualname, set()).add("main")
+        # propagate along call edges to fixed point
+        labels = {q: set(s) for q, s in seeds.items()}
+        for _ in range(self._MAX_PASSES):
+            changed = False
+            for caller, events in info.calls.items():
+                got = labels.get(caller)
+                if not got:
+                    continue
+                for e in events:
+                    for callee in dataflow.local_call_targets(
+                            src, e.node, caller, info.by_last):
+                        have = labels.setdefault(callee, set())
+                        if not got <= have:
+                            have |= got
+                            changed = True
+            if not changed:
+                break
+        # a nested def runs in its parent's thread context
+        for f in src.functions:
+            for q, s in list(labels.items()):
+                if f.qualname.startswith(f"{q}."):
+                    labels.setdefault(f.qualname, set()).update(s)
+        return {q: frozenset(s) for q, s in labels.items() if s}
+
+    # -- must-hold facts -------------------------------------------------------
+
+    def _must_hold(self, info: _ModuleInfo,
+                   thread_targets: set[str]) -> dict[str, set[str]]:
+        """Locks PROVABLY held on entry to each function: the intersection
+        over all module-local call sites of (lexically-held at the site ∪
+        must-hold of the caller). Public functions, thread targets and
+        uncalled functions get the empty set — anyone may call them bare
+        (a thread entry point in particular starts with nothing held, even
+        if someone also calls it directly under a lock)."""
+        src = info.src
+        callers: dict[str, list[tuple[str, tuple[str, ...]]]] = {}
+        for caller, events in info.calls.items():
+            for e in events:
+                for callee in dataflow.local_call_targets(
+                        src, e.node, caller, info.by_last):
+                    callers.setdefault(callee, []).append((caller, e.held))
+        hold: dict[str, set[str]] = {}
+        private = {f.qualname for f in src.functions
+                   if f.qualname.rsplit(".", 1)[-1].startswith("_")
+                   and not f.qualname.rsplit(".", 1)[-1].endswith("__")
+                   and f.qualname not in thread_targets}
+        for _ in range(self._MAX_PASSES):
+            changed = False
+            for callee, sites in callers.items():
+                if callee not in private:
+                    continue
+                acc: set[str] | None = None
+                for caller, held in sites:
+                    site_held = set(held) | hold.get(caller, set())
+                    acc = site_held if acc is None else (acc & site_held)
+                acc = acc or set()
+                if hold.get(callee, set()) != acc:
+                    hold[callee] = acc
+                    changed = True
+            if not changed:
+                break
+        return hold
+
+    # -- lockfree declarations -------------------------------------------------
+
+    def _lockfree_attrs(self, src: SourceFile,
+                        info: _ModuleInfo) -> set[str]:
+        """Attribute keys with a `# graftlint: lockfree <why>` marker on
+        (or in the comment block above) ANY of their mutation sites — the
+        declaration covers the attribute, not the one line."""
+        out: set[str] = set()
+        for e in info.events:
+            if e.kind != "mutate":
+                continue
+            if dataflow._marker_above(src.directives.lockfree_linenos, src,
+                                      e.node.lineno):
+                out.add(e.key)
+        return out

@@ -295,7 +295,10 @@ class _RingUploader:
             # not-yet-acquired tail slots are exact zeros)
             self._chunks.append(jax.device_put(
                 self.block.tables[self._uploaded:b]))
+            # graftlint: lockfree — poll-thread exclusive until finish()
+            # joins the poller; the join IS the synchronization handoff
             self._uploaded = b
+            # graftlint: lockfree — same join-sequenced handoff as _uploaded
             self._bi += 1
 
     # graftlint: drain-point — the uploader's own poll thread sleeps by

@@ -359,14 +359,20 @@ class SocketTransport(LineProtocol):
         if self._accept_thread is not None:
             self._accept_thread.join(
                 timeout=max(deadline - time.monotonic(), 0.1))
-        for t in self._conn_threads:
+        # snapshot under the lock: the accept loop may outlive its join
+        # deadline and still be appending/reaping concurrently
+        with self._conns_lock:
+            joinable = list(self._conn_threads)
+        for t in joinable:
             t.join(timeout=max(deadline - time.monotonic(), 0.1))
-        leaked = [t.name for t in self._conn_threads if t.is_alive()]
+        leaked = [t.name for t in joinable if t.is_alive()]
         if leaked:
             print(f"serve: WARNING — {len(leaked)} connection thread(s) "
                   f"still alive past the stop deadline: {leaked}",
                   file=sys.stderr, flush=True)
-        self._conn_threads = [t for t in self._conn_threads if t.is_alive()]
+        with self._conns_lock:
+            self._conn_threads = [t for t in self._conn_threads
+                                  if t.is_alive()]
         self._sock = None
 
     def submit(self, sub: Submission) -> str:
@@ -388,18 +394,22 @@ class SocketTransport(LineProtocol):
             except OSError:  # socket closed by stop()
                 return
             # reap finished handler threads so a long-lived service's list
-            # doesn't grow one entry per historical connection
-            self._conn_threads = [x for x in self._conn_threads
-                                  if x.is_alive()]
-            if len(self._conn_threads) >= self.max_conns:
+            # doesn't grow one entry per historical connection; under
+            # _conns_lock — stop() walks and rebuilds this list from the
+            # caller's thread while the accept loop may still be alive
+            # (its join has a deadline)
+            with self._conns_lock:
+                self._conn_threads = [x for x in self._conn_threads
+                                      if x.is_alive()]
+                live = len(self._conn_threads)
+            if live >= self.max_conns:
                 # thread-per-connection has a hard architectural ceiling:
                 # every live connection is an OS thread. Past the cap the
                 # connection is refused outright (closed, counted) — the
                 # honest overload answer for this transport; the event-loop
                 # reactor (serve/scale/) is the path that holds thousands
                 obreg.default().counter("serve_conn_refused_total").inc()
-                obtrace.instant("serve-ingest", "conn:refused",
-                                live=len(self._conn_threads))
+                obtrace.instant("serve-ingest", "conn:refused", live=live)
                 try:
                     conn.close()
                 except OSError:
@@ -409,7 +419,8 @@ class SocketTransport(LineProtocol):
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  name="serve-conn", daemon=True)
             t.start()
-            self._conn_threads.append(t)
+            with self._conns_lock:
+                self._conn_threads.append(t)
 
     # graftlint: drain-point — per-connection recv loop, dedicated thread
     def _serve_conn(self, conn: socket.socket) -> None:

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Install the graftlint pre-commit hook: every commit is linted, but only
+# the staged files (whole-package fallback when the analyzer itself
+# changed — see --changed-only in commefficient_tpu/analysis/__main__.py).
+#
+# Idempotent; refuses to clobber a foreign pre-commit hook unless FORCE=1.
+set -euo pipefail
+
+top="$(git rev-parse --show-toplevel 2>/dev/null)" || {
+    echo "install_hooks.sh: not inside a git checkout" >&2
+    exit 1
+}
+hooks_dir="$(git -C "$top" rev-parse --git-path hooks)"
+case "$hooks_dir" in
+    /*) : ;;
+    *) hooks_dir="$top/$hooks_dir" ;;
+esac
+hook="$hooks_dir/pre-commit"
+
+marker="graftlint pre-commit hook"
+if [ -e "$hook" ] && ! grep -q "$marker" "$hook" && [ "${FORCE:-0}" != "1" ]; then
+    echo "install_hooks.sh: $hook exists and is not ours; re-run with FORCE=1 to replace it" >&2
+    exit 1
+fi
+
+mkdir -p "$hooks_dir"
+cat > "$hook" <<'HOOK'
+#!/usr/bin/env bash
+# graftlint pre-commit hook — installed by scripts/install_hooks.sh
+set -euo pipefail
+cd "$(git rev-parse --show-toplevel)"
+exec python -m commefficient_tpu.analysis --changed-only
+HOOK
+chmod +x "$hook"
+echo "installed $hook"

@@ -5,11 +5,16 @@
 #   scripts/lint.sh            # full gate (fails on any violation)
 #   LINT_SKIP=1 scripts/lint.sh    # escape hatch: skip everything, exit 0
 #
-# graftlint is stdlib-only and always runs. ruff/mypy are pinned in
-# pyproject's `lint` extra (pip install -e '.[lint]'); when they are not
-# installed (bare containers) they are SKIPPED WITH A NOTICE, not failed —
-# the project-specific contracts (G001–G008) are the part no generic tool
-# covers, so that is the part that must never be skippable by accident.
+# graftlint is stdlib-only and always runs, fanned out across
+# LINT_JOBS worker processes (default: CPU count; the report is
+# byte-identical at any job count — baseline matching and the final sort
+# happen in the parent). ruff/mypy are pinned in pyproject's `lint` extra
+# (pip install -e '.[lint]'); when they are not installed (bare
+# containers, including the TPU-window image — neither tool ships there,
+# so their burn-down happens wherever the extra IS installed) they are
+# SKIPPED WITH A NOTICE, not failed — the project-specific contracts
+# (G001–G020) are the part no generic tool covers, so that is the part
+# that must never be skippable by accident.
 #
 # The machine-readable report is archived next to the bench JSONs
 # (GRAFTLINT.json at the repo root) so CI and the TPU-window driver can
@@ -31,6 +36,7 @@ echo "== graftlint (commefficient_tpu/analysis) =="
 # triaged). The report is deterministic (no timestamps), so a clean tree
 # leaves the checked-in copy byte-identical.
 python -m commefficient_tpu.analysis "${LINT_PATHS[@]}" \
+    --jobs "${LINT_JOBS:-0}" \
     --report-json GRAFTLINT.json || fail=1
 echo "graftlint report archived to GRAFTLINT.json"
 

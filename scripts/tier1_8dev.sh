@@ -17,8 +17,21 @@ cd "$(dirname "$0")/.."
 
 # static-analysis gate first (graftlint + ruff + mypy, < 60 s, jax-free):
 # a contract violation should fail the slice before any test compiles.
-# LINT_SKIP=1 skips it (escape hatch, e.g. mid-bisect).
-scripts/lint.sh
+# LINT_SKIP=1 skips it (escape hatch, e.g. mid-bisect). The checked-in
+# GRAFTLINT.json must be byte-identical to a fresh run — a drifting
+# archive means someone changed rules/code without regenerating it (and
+# the parallel fan-out must be deterministic for this gate to hold).
+if [[ "${LINT_SKIP:-0}" != "1" && -f GRAFTLINT.json ]]; then
+    cp GRAFTLINT.json /tmp/_graftlint_checked_in.json
+    scripts/lint.sh
+    cmp /tmp/_graftlint_checked_in.json GRAFTLINT.json || {
+        echo "tier1_8dev: GRAFTLINT.json drifted from the checked-in copy" \
+             "— rerun scripts/lint.sh and commit the result" >&2
+        exit 1
+    }
+else
+    scripts/lint.sh
+fi
 
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"

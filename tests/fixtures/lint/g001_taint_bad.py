@@ -1,0 +1,13 @@
+"""Violating fixture for the G001 taint pass: float() on a value derived
+from a traced parameter, smuggled one helper call deep. The pre-taint
+syntactic rule provably misses this (see the regression test that runs
+it with taint_pass disabled).
+"""
+# graftlint: module=commefficient_tpu/modes/taint_demo.py
+
+from .g001_taint_helper import coerce_scale
+
+
+def merge_round(table, scale):
+    s = scale * 2
+    return table, coerce_scale(s)

@@ -1,0 +1,7 @@
+"""Helper the taint fixtures import: the float() hides HERE, one call
+deep — outside compiled scope, invisible to the syntactic G001 scan.
+"""
+
+
+def coerce_scale(v):
+    return float(v)

@@ -1,0 +1,16 @@
+"""Conforming twin: the helper coerces a module constant and shape
+metadata — taint does not flow through `.shape` (static metadata is
+host-safe even on a traced array).
+"""
+# graftlint: module=commefficient_tpu/modes/taint_demo_ok.py
+
+from .g001_taint_helper import coerce_scale
+
+_BASE = 3.0
+
+
+def merge_round(table, scale):
+    del scale
+    n = coerce_scale(_BASE)
+    m = coerce_scale(table.shape[0])
+    return table, n + m

@@ -1,0 +1,28 @@
+"""Violating fixture: a two-lock acquisition cycle, half interprocedural.
+
+`fill_slot` nests the ring lock inside the slot lock; `flush_ring` holds
+the ring lock and calls a helper that takes the slot lock — the classic
+inversion, invisible to a purely lexical scan.
+"""
+# graftlint: module=commefficient_tpu/serve/scale/ringlocks_demo.py
+
+import threading
+
+_SLOT_LOCK = threading.Lock()
+_RING_LOCK = threading.Lock()
+
+
+def fill_slot():
+    with _SLOT_LOCK:
+        with _RING_LOCK:
+            return 1
+
+
+def _grab_slot():
+    with _SLOT_LOCK:
+        return 2
+
+
+def flush_ring():
+    with _RING_LOCK:
+        return _grab_slot()

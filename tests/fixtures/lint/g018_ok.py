@@ -1,0 +1,28 @@
+"""Conforming twin: the same two locks, one global order everywhere —
+and the order is DECLARED with lock-order names (l0- sorts before l1-),
+so the nesting edge is sanctioned, not merely cycle-free by luck.
+"""
+# graftlint: module=commefficient_tpu/serve/scale/ringlocks_demo_ok.py
+
+import threading
+
+# graftlint: lock-order l0-slot
+_SLOT_LOCK = threading.Lock()
+# graftlint: lock-order l1-ring
+_RING_LOCK = threading.Lock()
+
+
+def fill_slot():
+    with _SLOT_LOCK:
+        with _RING_LOCK:
+            return 1
+
+
+def _grab_ring():
+    with _RING_LOCK:
+        return 2
+
+
+def flush_ring():
+    with _SLOT_LOCK:
+        return _grab_ring()

@@ -1,0 +1,25 @@
+"""Violating fixture: an attribute mutated from the reactor thread AND
+the caller's thread with no common lock — the race G019 exists to catch.
+"""
+# graftlint: module=commefficient_tpu/serve/scale/reactor_demo.py
+
+import threading
+
+
+class Reactor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, item):
+        self._inflight += 1  # caller thread, unlocked
+        return item
+
+    def _loop(self):
+        while True:
+            self._inflight -= 1  # reactor thread, unlocked

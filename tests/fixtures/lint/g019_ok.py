@@ -1,0 +1,33 @@
+"""Conforming twin: the shared counter is mutated under the one declared
+lock from both thread roots, and the deliberately lock-free tick counter
+carries its `lockfree` declaration.
+"""
+# graftlint: module=commefficient_tpu/serve/scale/reactor_demo_ok.py
+
+import threading
+
+
+class Reactor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._ticks = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, item):
+        with self._lock:
+            self._inflight += 1
+        # graftlint: lockfree — monotonic GIL-atomic tick counter, read
+        # only for coarse progress reporting
+        self._ticks += 1
+        return item
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._inflight -= 1
+            self._ticks += 1

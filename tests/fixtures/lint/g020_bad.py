@@ -1,0 +1,19 @@
+"""Violating fixture: a SIGTERM handler that takes a non-reentrant lock
+and opens a file — both deadlock/corruption hazards in signal context.
+"""
+
+import signal
+import threading
+
+_STATE_LOCK = threading.Lock()
+
+
+def _on_term(signum, frame):
+    del frame
+    with _STATE_LOCK:
+        with open("/tmp/last_signal.txt", "w") as f:
+            f.write(str(signum))
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)

@@ -1,0 +1,28 @@
+"""Conforming twin: the handler sets an Event, emits through the
+signal-safe tracer entry, and writes to stderr — the PR 7 discipline.
+"""
+
+import signal
+import sys
+import threading
+
+_DRAIN = threading.Event()
+
+
+class _Trace:
+    def instant_signal_safe(self, *args, **kwargs):
+        pass
+
+
+_TRACER = _Trace()
+
+
+def _on_term(signum, frame):
+    del frame
+    _DRAIN.set()
+    _TRACER.instant_signal_safe("term", signum=signum)
+    print("terminating", file=sys.stderr)
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)

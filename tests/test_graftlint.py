@@ -738,3 +738,265 @@ def test_json_report_shape(tmp_path):
     assert report["counts"].get("G002") == 1
     (v,) = report["violations"]
     assert {"code", "rel", "lineno", "message", "fixit"} <= set(v)
+
+
+# -- PR 20: concurrency rules (G018/G019/G020) + the G001 taint pass ---------
+
+
+def test_g018_reports_both_directions_of_the_cycle():
+    # fill_slot nests SLOT->RING lexically; flush_ring reaches RING->SLOT
+    # through _grab_slot — BOTH edges of the inversion must be reported,
+    # each at its own acquisition site
+    vs = [v for v in Analyzer().run(
+        [os.path.join(FIXTURES, "g018_bad.py")]).violations
+        if v.code == "G018"]
+    assert len(vs) == 2
+    assert sorted(v.lineno for v in vs) == [17, 28]
+
+
+def test_g018_edge_against_declared_order_fires(tmp_path):
+    # no cycle at all — a SINGLE nesting that contradicts the declared
+    # lock-order names is already a violation (the declaration is the
+    # contract, not merely a cycle-breaking hint)
+    f = tmp_path / "order_bad.py"
+    f.write_text(
+        "# graftlint: module=commefficient_tpu/serve/scale/order_demo.py\n"
+        "import threading\n"
+        "# graftlint: lock-order l1-ring\n"
+        "_RING = threading.Lock()\n"
+        "# graftlint: lock-order l0-slot\n"
+        "_SLOT = threading.Lock()\n"
+        "def go():\n"
+        "    with _RING:\n"
+        "        with _SLOT:\n"
+        "            return 1\n")
+    vs = [v for v in Analyzer().run([str(f)]).violations if v.code == "G018"]
+    assert len(vs) == 1
+    assert "declared lock order" in vs[0].message
+
+
+def test_g018_declared_order_sanctions_the_nesting(tmp_path):
+    f = tmp_path / "order_ok.py"
+    f.write_text(
+        "# graftlint: module=commefficient_tpu/serve/scale/order_demo2.py\n"
+        "import threading\n"
+        "# graftlint: lock-order l0-slot\n"
+        "_SLOT = threading.Lock()\n"
+        "# graftlint: lock-order l1-ring\n"
+        "_RING = threading.Lock()\n"
+        "def go():\n"
+        "    with _SLOT:\n"
+        "        with _RING:\n"
+        "            return 1\n")
+    assert "G018" not in _codes(str(f))
+
+
+def test_g019_lockfree_directive_is_load_bearing(tmp_path):
+    # strip the lockfree declaration from the conforming twin and the
+    # tick counter becomes a finding — the directive is what sanctions it
+    src = open(os.path.join(FIXTURES, "g019_ok.py"),
+               encoding="utf-8").read()
+    stripped = "\n".join(
+        ln for ln in src.splitlines()
+        if "lockfree" not in ln and "coarse progress" not in ln) + "\n"
+    f = tmp_path / "g019_stripped.py"
+    f.write_text(stripped)
+    assert "G019" in _codes(str(f))
+    assert "G019" not in _codes(os.path.join(FIXTURES, "g019_ok.py"))
+
+
+def test_g019_lock_held_through_private_helper_counts(tmp_path):
+    # must-hold: a private helper mutating shared state is safe when EVERY
+    # call site holds the lock...
+    common = (
+        "# graftlint: module=commefficient_tpu/serve/scale/helper_demo{n}.py\n"
+        "import threading\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "        self._t = None\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._loop)\n"
+        "        self._t.start()\n"
+        "    def _bump(self):\n"
+        "        self._n += 1\n"
+        "    def submit(self):\n"
+        "        {caller}\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n")
+    ok = tmp_path / "helper_ok.py"
+    ok.write_text(common.format(
+        n=1, caller="with self._lock:\n            self._bump()"))
+    assert "G019" not in _codes(str(ok))
+    # ...and a finding when even one call site is bare
+    bad = tmp_path / "helper_bad.py"
+    bad.write_text(common.format(n=2, caller="self._bump()"))
+    assert "G019" in _codes(str(bad))
+
+
+def test_g020_jsonl_sink_call_fires(tmp_path):
+    # the tracer's buffered emits take the ring lock internally — calling
+    # them from signal context is the exact deadlock PR 7 carved
+    # instant_signal_safe out to avoid
+    f = tmp_path / "sink_bad.py"
+    f.write_text(
+        "import signal\n"
+        "class _T:\n"
+        "    def instant(self, *a, **k):\n"
+        "        pass\n"
+        "_TR = _T()\n"
+        "def _h(signum, frame):\n"
+        "    _TR.instant('term')\n"
+        "def install():\n"
+        "    signal.signal(signal.SIGTERM, _h)\n")
+    vs = [v for v in Analyzer().run([str(f)]).violations if v.code == "G020"]
+    assert len(vs) == 1
+    assert "instant_signal_safe" in vs[0].message
+
+
+def test_g020_rlock_is_exempt(tmp_path):
+    # RLock is reentrant: re-acquiring from a handler that interrupted the
+    # holder cannot self-deadlock, so it is not flagged
+    f = tmp_path / "rlock_ok.py"
+    f.write_text(
+        "import signal\n"
+        "import threading\n"
+        "_RL = threading.RLock()\n"
+        "def _h(signum, frame):\n"
+        "    with _RL:\n"
+        "        return signum\n"
+        "def install():\n"
+        "    signal.signal(signal.SIGTERM, _h)\n")
+    assert "G020" not in _codes(str(f))
+
+
+def test_g001_taint_catches_what_the_syntactic_scan_misses():
+    # the acceptance regression pair: the PRE-taint rule (taint_pass
+    # disabled) provably misses the helper-hidden float(); the shipped
+    # rule catches it at the compiled-scope call site
+    from commefficient_tpu.analysis.rules_sync import HostSyncInRoundPath
+
+    class SyntacticOnly(HostSyncInRoundPath):
+        taint_pass = False
+
+    bad = os.path.join(FIXTURES, "g001_taint_bad.py")
+    rules_without = [SyntacticOnly if r is HostSyncInRoundPath else r
+                     for r in ALL_RULES]
+    pre = [v.code for v in Analyzer(rules=rules_without).run([bad]).violations]
+    assert "G001" not in pre  # the miss the taint pass exists to close
+    vs = [v for v in Analyzer().run([bad]).violations if v.code == "G001"]
+    assert len(vs) == 1
+    assert vs[0].lineno == 13
+    assert "coerce_scale" in vs[0].message
+
+
+def test_g001_taint_metadata_is_laundered():
+    # .shape and module constants are host-safe even on traced values —
+    # the ok twin routes both through the same helper and stays silent
+    assert "G001" not in _codes(os.path.join(FIXTURES, "g001_taint_ok.py"))
+
+
+def test_lock_order_directive_needs_a_name(tmp_path):
+    f = tmp_path / "noname.py"
+    f.write_text("# graftlint: lock-order\nx = 1\n")
+    assert "G000" in _codes(str(f))
+
+
+def test_lockfree_directive_needs_a_justification(tmp_path):
+    f = tmp_path / "nowhy.py"
+    f.write_text("# graftlint: lockfree\nx = 1\n")
+    assert "G000" in _codes(str(f))
+
+
+def test_parallel_run_is_byte_deterministic():
+    # jobs>1 fans files across processes; baseline matching and the final
+    # sort happen in the parent, so the result must match serial exactly
+    paths = [os.path.join(FIXTURES, n) for n in
+             ("g018_bad.py", "g019_bad.py", "g020_bad.py",
+              "g001_taint_bad.py", "g002_bad.py", "g007_import_bad.py")]
+    serial = Analyzer().run(paths, jobs=1)
+    par = Analyzer().run(paths, jobs=2)
+    assert par.violations == serial.violations
+    assert par.suppressed == serial.suppressed
+    assert par.files_checked == serial.files_checked
+
+
+def _git(repo, *args):
+    subprocess.run(["git", *args], cwd=repo, check=True,
+                   capture_output=True, text=True)
+
+
+def _tmp_git_repo(tmp_path):
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "t@t")
+    _git(tmp_path, "config", "user.name", "t")
+    return tmp_path
+
+
+def test_changed_only_rejects_explicit_paths():
+    out = subprocess.run(
+        [sys.executable, "-m", "commefficient_tpu.analysis",
+         "--changed-only", "commefficient_tpu"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert out.returncode == 2
+    assert "one or the other" in out.stderr
+
+
+def test_changed_only_lints_exactly_the_staged_files(tmp_path):
+    repo = _tmp_git_repo(tmp_path)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    (repo / "commefficient_tpu").mkdir()
+    demo = repo / "commefficient_tpu" / "tmp_demo.py"
+    demo.write_text("x = 1\n")
+    (repo / "unrelated.txt").write_text("hi\n")
+
+    # nothing lintable staged -> clean exit, nothing analyzed
+    _git(repo, "add", "unrelated.txt")
+    out = subprocess.run(
+        [sys.executable, "-m", "commefficient_tpu.analysis",
+         "--changed-only"],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=60,
+    )
+    assert out.returncode == 0
+    assert "nothing staged to lint" in out.stdout
+
+    # a staged package file IS analyzed (and only it)
+    _git(repo, "add", "commefficient_tpu/tmp_demo.py")
+    out = subprocess.run(
+        [sys.executable, "-m", "commefficient_tpu.analysis",
+         "--changed-only"],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=60,
+    )
+    assert out.returncode == 0
+    assert "1 file(s) checked" in out.stdout
+
+
+def test_install_hooks_writes_changed_only_hook(tmp_path):
+    repo = _tmp_git_repo(tmp_path)
+    out = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "install_hooks.sh")],
+        capture_output=True, text=True, cwd=repo, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    hook = repo / ".git" / "hooks" / "pre-commit"
+    assert hook.is_file()
+    assert os.access(hook, os.X_OK)
+    assert "--changed-only" in hook.read_text()
+    # idempotent re-run over our own hook
+    out = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "install_hooks.sh")],
+        capture_output=True, text=True, cwd=repo, timeout=60,
+    )
+    assert out.returncode == 0
+
+    # but a FOREIGN pre-commit hook is refused without FORCE=1
+    hook.write_text("#!/bin/sh\necho custom\n")
+    out = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "install_hooks.sh")],
+        capture_output=True, text=True, cwd=repo, timeout=60,
+    )
+    assert out.returncode != 0
+    assert "FORCE=1" in out.stderr
